@@ -1,0 +1,39 @@
+"""pinot-tpu: a TPU-native realtime distributed OLAP datastore.
+
+A ground-up re-design of the capabilities of Apache Pinot (reference:
+/root/reference, v0.10.0-SNAPSHOT) for TPU hardware: columnar segments staged
+into HBM, the per-segment Filter -> Projection -> Aggregation operator chain
+executed as fused JAX/XLA (and Pallas) kernels, multi-segment combine via
+`psum` over a `jax.sharding.Mesh`, and a host-side control plane (controller /
+broker / server / minion roles) mirroring the reference's Helix-coordinated
+cluster architecture.
+
+Layer map (bottom-up, mirroring SURVEY.md section 1):
+
+- ``pinot_tpu.spi``      -- contracts: schema, table config, configuration,
+                            filesystem, stream + record-reader SPIs
+                            (ref: pinot-spi)
+- ``pinot_tpu.segment``  -- columnar segment storage engine: builders,
+                            immutable + mutable segments, dictionaries,
+                            forward/inverted/range indexes, star-tree
+                            (ref: pinot-segment-spi + pinot-segment-local)
+- ``pinot_tpu.query``    -- SQL parser, query context/request model, optimizer
+                            (ref: pinot-common sql/ + request context)
+- ``pinot_tpu.engine``   -- the TPU execution engine: plan maker, device
+                            staging, filter/transform/aggregation kernels,
+                            combine (ref: pinot-core query engine)
+- ``pinot_tpu.parallel`` -- mesh construction, sharded multi-segment
+                            execution, ICI collectives
+- ``pinot_tpu.server``   -- server role: table data managers, query executor,
+                            scheduler, transport (ref: pinot-server)
+- ``pinot_tpu.broker``   -- broker role: routing, scatter/gather, reduce
+                            (ref: pinot-broker)
+- ``pinot_tpu.controller`` -- controller role: cluster state, table/segment
+                            lifecycle, assignment, rebalance
+                            (ref: pinot-controller)
+- ``pinot_tpu.minion``   -- background task framework (ref: pinot-minion)
+- ``pinot_tpu.ingestion`` -- batch + realtime ingestion: record readers,
+                            transformers, stream consumers
+"""
+
+__version__ = "0.1.0"

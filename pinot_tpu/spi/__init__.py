@@ -1,0 +1,46 @@
+"""SPI layer: the framework-wide contracts every other layer builds on.
+
+Mirrors the reference's ``pinot-spi`` module (SURVEY.md section 2.1):
+table/schema config model, layered configuration, filesystem SPI, stream SPI,
+record-reader SPI, and the plugin registry.
+"""
+
+from pinot_tpu.spi.data import (
+    DataType,
+    FieldType,
+    FieldSpec,
+    Schema,
+    TimeGranularity,
+)
+from pinot_tpu.spi.table import (
+    TableType,
+    TableConfig,
+    IndexingConfig,
+    SegmentsValidationConfig,
+    StarTreeIndexConfig,
+    UpsertConfig,
+    UpsertMode,
+    SegmentPartitionConfig,
+    TenantConfig,
+    StreamIngestionConfig,
+)
+from pinot_tpu.spi.config import PinotConfiguration
+
+__all__ = [
+    "DataType",
+    "FieldType",
+    "FieldSpec",
+    "Schema",
+    "TimeGranularity",
+    "TableType",
+    "TableConfig",
+    "IndexingConfig",
+    "SegmentsValidationConfig",
+    "StarTreeIndexConfig",
+    "UpsertConfig",
+    "UpsertMode",
+    "SegmentPartitionConfig",
+    "TenantConfig",
+    "StreamIngestionConfig",
+    "PinotConfiguration",
+]

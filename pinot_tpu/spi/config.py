@@ -1,0 +1,140 @@
+"""Layered key/value configuration.
+
+Re-design of ``pinot-spi/.../env/PinotConfiguration.java:88``: merges (in
+priority order) explicit overrides > environment variables (``PINOT_``
+prefixed, mapping ``PINOT_SERVER_PORT`` -> ``pinot.server.port``) >
+properties files > defaults, with relaxed key matching (case-insensitive,
+``-``/``_``/``.``/camelCase-insensitive within a segment).
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from typing import Any, Dict, Iterator, List, Mapping, Optional
+
+_SEP = re.compile(r"[-_.]")
+
+# Layer priorities: higher wins, regardless of insertion order.
+PRIORITY_DEFAULT = 0
+PRIORITY_FILE = 1
+PRIORITY_ENV = 2
+PRIORITY_OVERRIDE = 3
+
+
+def _segments(key: str) -> List[str]:
+    return [s for s in _SEP.split(key.lower()) if s]
+
+
+def _relax(key: str) -> str:
+    """Relaxed key normalization: case-insensitive, separator-insensitive.
+
+    ``timeoutMs`` == ``timeout.ms`` == ``TIMEOUT_MS`` == ``timeout-ms``.
+    """
+    return "".join(_segments(key))
+
+
+class PinotConfiguration:
+    def __init__(self, overrides: Optional[Mapping[str, Any]] = None,
+                 use_env: bool = True):
+        self._store: Dict[str, Any] = {}
+        self._priority: Dict[str, int] = {}
+        self._raw_keys: Dict[str, str] = {}
+        if use_env:
+            for k, v in os.environ.items():
+                if k.startswith("PINOT_"):
+                    # PINOT_SERVER_PORT -> pinot.server.port (prefix retained:
+                    # all framework keys are namespaced under pinot.*)
+                    self.set(k.lower().replace("_", "."), v, PRIORITY_ENV)
+        if overrides:
+            for k, v in overrides.items():
+                self.set(k, v, PRIORITY_OVERRIDE)
+
+    # -- mutation ----------------------------------------------------------
+    def set(self, key: str, value: Any, priority: int = PRIORITY_OVERRIDE) -> None:
+        rk = _relax(key)
+        if self._priority.get(rk, -1) > priority:
+            return  # a higher layer already owns this key
+        self._store[rk] = value
+        self._priority[rk] = priority
+        self._raw_keys[rk] = key
+
+    def set_default(self, key: str, value: Any) -> None:
+        self.set(key, value, PRIORITY_DEFAULT)
+
+    def load_properties_file(self, path: str) -> None:
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line or line.startswith(("#", "!")):
+                    continue
+                if "=" in line:
+                    k, _, v = line.partition("=")
+                    self.set(k.strip(), v.strip(), PRIORITY_FILE)
+
+    # -- access ------------------------------------------------------------
+    def get(self, key: str, default: Any = None) -> Any:
+        return self._store.get(_relax(key), default)
+
+    def get_int(self, key: str, default: int = 0) -> int:
+        v = self.get(key)
+        return default if v is None else int(v)
+
+    def get_float(self, key: str, default: float = 0.0) -> float:
+        v = self.get(key)
+        return default if v is None else float(v)
+
+    def get_bool(self, key: str, default: bool = False) -> bool:
+        v = self.get(key)
+        if v is None:
+            return default
+        if isinstance(v, bool):
+            return v
+        return str(v).strip().lower() in ("true", "1", "yes", "on")
+
+    def get_str(self, key: str, default: str = "") -> str:
+        v = self.get(key)
+        return default if v is None else str(v)
+
+    def subset(self, prefix: str) -> "PinotConfiguration":
+        """All keys under ``prefix``, prefix stripped, matched on whole
+        key segments (``subset('server')`` does NOT match ``serverx.port``)."""
+        psegs = _segments(prefix)
+        out = PinotConfiguration(use_env=False)
+        for rk, raw in self._raw_keys.items():
+            ksegs = _segments(raw)
+            if len(ksegs) > len(psegs) and ksegs[: len(psegs)] == psegs:
+                out.set(".".join(ksegs[len(psegs):]), self._store[rk],
+                        self._priority[rk])
+        return out
+
+    def keys(self) -> Iterator[str]:
+        return iter(self._raw_keys.values())
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {raw: self._store[rk] for rk, raw in self._raw_keys.items()}
+
+    def __contains__(self, key: str) -> bool:
+        return _relax(key) in self._store
+
+    def __repr__(self) -> str:
+        return f"PinotConfiguration({self.to_dict()!r})"
+
+
+class CommonConstants:
+    """Centralized config keys + defaults (ref: pinot-spi CommonConstants.java)."""
+
+    DEFAULT_BROKER_QUERY_PORT = 8099
+    DEFAULT_SERVER_QUERY_PORT = 8098
+    DEFAULT_CONTROLLER_PORT = 9000
+    DEFAULT_QUERY_TIMEOUT_MS = 10_000
+    DEFAULT_MAX_ROWS_IN_RESPONSE = 10_000
+    # Engine defaults (ref: InstancePlanMakerImplV2.java:67-84)
+    DEFAULT_NUM_GROUPS_LIMIT = 100_000
+    DEFAULT_GROUPBY_TRIM_THRESHOLD = 1_000_000
+    DEFAULT_MIN_SEGMENT_GROUP_TRIM_SIZE = -1
+    DEFAULT_MIN_SERVER_GROUP_TRIM_SIZE = 5000
+    # Block size: the reference drains filters in 10k-doc blocks
+    # (DocIdSetPlanNode.java:29). On TPU we tile the doc dimension instead;
+    # this is the host-side fallback block size.
+    MAX_DOC_PER_CALL = 10_000

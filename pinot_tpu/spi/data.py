@@ -1,0 +1,349 @@
+"""Schema and field-spec data model.
+
+Re-design of the reference's ``pinot-spi/.../data/Schema.java`` and
+``FieldSpec.java``: a table schema is a named collection of typed fields, each
+either a DIMENSION, METRIC, TIME or DATE_TIME column, single- or multi-valued.
+
+TPU-first notes: every data type carries its *device representation*
+(``numpy``/``jnp`` dtype) so the storage and engine layers can make layout
+decisions (narrowest-int forward indexes, f32 vs f64 accumulation) directly
+from the schema. Strings/bytes/json are always dictionary-encoded on device --
+the device only ever sees int32 dictIds for them.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+from enum import Enum
+from typing import Any, Dict, Iterable, List, Optional
+
+import numpy as np
+
+
+class DataType(Enum):
+    """Column value types (ref: pinot-spi FieldSpec.DataType).
+
+    ``stored_np`` is the dtype used in host/npy storage for raw (no-dictionary)
+    columns; dictionary-encoded columns store int dictIds regardless.
+    """
+
+    INT = ("INT", np.int32, True)
+    LONG = ("LONG", np.int64, True)
+    FLOAT = ("FLOAT", np.float32, True)
+    DOUBLE = ("DOUBLE", np.float64, True)
+    BOOLEAN = ("BOOLEAN", np.int32, True)  # stored as 0/1, like the reference pre-0.8 string, now int
+    TIMESTAMP = ("TIMESTAMP", np.int64, True)  # millis since epoch
+    STRING = ("STRING", np.object_, False)
+    JSON = ("JSON", np.object_, False)
+    BYTES = ("BYTES", np.object_, False)
+
+    def __init__(self, label: str, stored_np: Any, numeric: bool):
+        self.label = label
+        self.stored_np = stored_np
+        self.numeric = numeric
+
+    @property
+    def is_numeric(self) -> bool:
+        return self.numeric
+
+    @property
+    def is_integral(self) -> bool:
+        return self in (DataType.INT, DataType.LONG, DataType.BOOLEAN, DataType.TIMESTAMP)
+
+    @property
+    def is_floating(self) -> bool:
+        return self in (DataType.FLOAT, DataType.DOUBLE)
+
+    def convert(self, value: Any) -> Any:
+        """Coerce a python value to this type (ingestion-time type coercion,
+        ref: pinot-segment-local recordtransformer/DataTypeTransformer)."""
+        if value is None:
+            return None
+        if self is DataType.INT:
+            return int(value)
+        if self in (DataType.LONG, DataType.TIMESTAMP):
+            return int(value)
+        if self in (DataType.FLOAT, DataType.DOUBLE):
+            return float(value)
+        if self is DataType.BOOLEAN:
+            if isinstance(value, str):
+                return 1 if value.lower() in ("true", "1") else 0
+            return 1 if value else 0
+        if self in (DataType.STRING, DataType.JSON):
+            return value if isinstance(value, str) else (
+                json.dumps(value) if self is DataType.JSON else str(value))
+        if self is DataType.BYTES:
+            if isinstance(value, bytes):
+                return value
+            if isinstance(value, str):
+                return bytes.fromhex(value)
+            return bytes(value)
+        raise ValueError(f"unsupported type {self}")
+
+    @classmethod
+    def from_string(cls, s: str) -> "DataType":
+        return cls[s.upper()]
+
+
+class FieldType(Enum):
+    """Role of a column (ref: FieldSpec.FieldType)."""
+
+    DIMENSION = "DIMENSION"
+    METRIC = "METRIC"
+    TIME = "TIME"
+    DATE_TIME = "DATE_TIME"
+
+
+# Default null placeholder values, mirroring the reference's
+# FieldSpec.DEFAULT_* constants (pinot-spi/.../data/FieldSpec.java).
+_DEFAULT_DIMENSION_NULL = {
+    DataType.INT: np.iinfo(np.int32).min,
+    DataType.LONG: np.iinfo(np.int64).min,
+    DataType.FLOAT: float("-inf"),
+    DataType.DOUBLE: float("-inf"),
+    DataType.BOOLEAN: 0,
+    DataType.TIMESTAMP: 0,
+    DataType.STRING: "null",
+    DataType.JSON: "null",
+    DataType.BYTES: b"",
+}
+_DEFAULT_METRIC_NULL = {
+    DataType.INT: 0,
+    DataType.LONG: 0,
+    DataType.FLOAT: 0.0,
+    DataType.DOUBLE: 0.0,
+    DataType.BOOLEAN: 0,
+    DataType.TIMESTAMP: 0,
+    DataType.STRING: "null",
+    DataType.JSON: "null",
+    DataType.BYTES: b"",
+}
+
+
+@dataclass
+class TimeGranularity:
+    """Time unit + size for TIME / DATE_TIME fields."""
+
+    unit: str = "MILLISECONDS"  # MILLISECONDS | SECONDS | MINUTES | HOURS | DAYS
+    size: int = 1
+
+    _MILLIS = {
+        "MILLISECONDS": 1,
+        "SECONDS": 1000,
+        "MINUTES": 60_000,
+        "HOURS": 3_600_000,
+        "DAYS": 86_400_000,
+    }
+
+    def to_millis(self, value: int) -> int:
+        return int(value) * self.size * self._MILLIS[self.unit.upper()]
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"unit": self.unit, "size": self.size}
+
+    @classmethod
+    def from_dict(cls, d: Any) -> "TimeGranularity":
+        # The reference serializes DATE_TIME granularity as "size:UNIT"
+        # (e.g. "1:DAYS", DateTimeGranularitySpec); TIME uses a dict.
+        if isinstance(d, str):
+            parts = d.split(":")
+            return cls(unit=parts[1], size=int(parts[0]))
+        return cls(unit=d.get("unit", "MILLISECONDS"), size=int(d.get("size", 1)))
+
+
+@dataclass
+class FieldSpec:
+    """One column's spec (ref: pinot-spi/.../data/FieldSpec.java)."""
+
+    name: str
+    data_type: DataType
+    field_type: FieldType = FieldType.DIMENSION
+    single_value: bool = True
+    default_null_value: Any = None
+    max_length: int = 512
+    granularity: Optional[TimeGranularity] = None  # TIME / DATE_TIME only
+    # DATE_TIME format string, e.g. "1:MILLISECONDS:EPOCH" (kept for config parity)
+    format: Optional[str] = None
+
+    def __post_init__(self):
+        if isinstance(self.data_type, str):
+            self.data_type = DataType.from_string(self.data_type)
+        if isinstance(self.field_type, str):
+            self.field_type = FieldType[self.field_type.upper()]
+        if self.default_null_value is None:
+            table = (_DEFAULT_METRIC_NULL if self.field_type is FieldType.METRIC
+                     else _DEFAULT_DIMENSION_NULL)
+            self.default_null_value = table[self.data_type]
+        else:
+            self.default_null_value = self.data_type.convert(self.default_null_value)
+
+    @property
+    def is_dimension(self) -> bool:
+        return self.field_type in (FieldType.DIMENSION, FieldType.TIME, FieldType.DATE_TIME)
+
+    @property
+    def is_metric(self) -> bool:
+        return self.field_type is FieldType.METRIC
+
+    def to_dict(self) -> Dict[str, Any]:
+        d: Dict[str, Any] = {
+            "name": self.name,
+            "dataType": self.data_type.label,
+            "fieldType": self.field_type.value,
+        }
+        if not self.single_value:
+            d["singleValueField"] = False
+        if self.default_null_value is not None:
+            v = self.default_null_value
+            d["defaultNullValue"] = v.hex() if isinstance(v, bytes) else v
+        if self.granularity is not None:
+            d["granularity"] = self.granularity.to_dict()
+        if self.format is not None:
+            d["format"] = self.format
+        if self.max_length != 512:
+            d["maxLength"] = self.max_length
+        return d
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any], field_type: Optional[FieldType] = None) -> "FieldSpec":
+        """``field_type`` is the fallback when the dict has no explicit
+        ``fieldType`` (an explicit one always wins, so TIME specs serialized
+        under ``dateTimeFieldSpecs`` round-trip unchanged)."""
+        dt = DataType.from_string(d["dataType"])
+        default = d.get("defaultNullValue")
+        if default is not None and dt is DataType.BYTES and isinstance(default, str):
+            default = bytes.fromhex(default)
+        gran = d.get("granularity")
+        explicit_ft = d.get("fieldType")
+        ft = (FieldType[explicit_ft.upper()] if explicit_ft
+              else (field_type or FieldType.DIMENSION))
+        return cls(
+            name=d["name"],
+            data_type=dt,
+            field_type=ft,
+            single_value=d.get("singleValueField", True),
+            default_null_value=default,
+            max_length=d.get("maxLength", 512),
+            granularity=TimeGranularity.from_dict(gran) if gran else None,
+            format=d.get("format"),
+        )
+
+
+class Schema:
+    """Table schema: ordered column name -> FieldSpec map.
+
+    Serialization follows the reference's JSON schema layout
+    (``dimensionFieldSpecs`` / ``metricFieldSpecs`` / ``dateTimeFieldSpecs`` /
+    ``timeFieldSpec``) so reference schema files can be loaded directly.
+    """
+
+    def __init__(self, schema_name: str, field_specs: Iterable[FieldSpec],
+                 primary_key_columns: Optional[List[str]] = None):
+        self.schema_name = schema_name
+        self._specs: Dict[str, FieldSpec] = {}
+        for fs in field_specs:
+            if fs.name in self._specs:
+                raise ValueError(f"duplicate column {fs.name!r} in schema {schema_name!r}")
+            self._specs[fs.name] = fs
+        self.primary_key_columns = list(primary_key_columns or [])
+        for pk in self.primary_key_columns:
+            if pk not in self._specs:
+                raise ValueError(f"primary key column {pk!r} not in schema")
+
+    # -- accessors ---------------------------------------------------------
+    @property
+    def column_names(self) -> List[str]:
+        return list(self._specs.keys())
+
+    @property
+    def field_specs(self) -> List[FieldSpec]:
+        return list(self._specs.values())
+
+    @property
+    def dimension_names(self) -> List[str]:
+        return [n for n, fs in self._specs.items() if fs.is_dimension]
+
+    @property
+    def metric_names(self) -> List[str]:
+        return [n for n, fs in self._specs.items() if fs.is_metric]
+
+    @property
+    def time_column(self) -> Optional[str]:
+        for n, fs in self._specs.items():
+            if fs.field_type in (FieldType.TIME, FieldType.DATE_TIME):
+                return n
+        return None
+
+    def has_column(self, name: str) -> bool:
+        return name in self._specs
+
+    def field_spec(self, name: str) -> FieldSpec:
+        try:
+            return self._specs[name]
+        except KeyError:
+            raise KeyError(f"column {name!r} not found in schema {self.schema_name!r}") from None
+
+    def __contains__(self, name: str) -> bool:
+        return name in self._specs
+
+    def __eq__(self, other) -> bool:
+        return (isinstance(other, Schema) and other.schema_name == self.schema_name
+                and other.to_dict() == self.to_dict())
+
+    # -- serde -------------------------------------------------------------
+    def to_dict(self) -> Dict[str, Any]:
+        dims, mets, dts = [], [], []
+        for fs in self._specs.values():
+            if fs.field_type is FieldType.METRIC:
+                mets.append(fs.to_dict())
+            elif fs.field_type in (FieldType.DATE_TIME, FieldType.TIME):
+                dts.append(fs.to_dict())
+            else:
+                dims.append(fs.to_dict())
+        d: Dict[str, Any] = {"schemaName": self.schema_name}
+        if dims:
+            d["dimensionFieldSpecs"] = dims
+        if mets:
+            d["metricFieldSpecs"] = mets
+        if dts:
+            d["dateTimeFieldSpecs"] = dts
+        if self.primary_key_columns:
+            d["primaryKeyColumns"] = self.primary_key_columns
+        return d
+
+    def to_json(self) -> str:
+        return json.dumps(self.to_dict(), indent=2)
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "Schema":
+        specs: List[FieldSpec] = []
+        for fd in d.get("dimensionFieldSpecs", []):
+            specs.append(FieldSpec.from_dict(fd, FieldType.DIMENSION))
+        for fd in d.get("metricFieldSpecs", []):
+            specs.append(FieldSpec.from_dict(fd, FieldType.METRIC))
+        # legacy single timeFieldSpec from reference schemas
+        tfs = d.get("timeFieldSpec")
+        if tfs:
+            inner = tfs.get("incomingGranularitySpec", tfs)
+            specs.append(FieldSpec(
+                name=inner["name"],
+                data_type=DataType.from_string(inner["dataType"]),
+                field_type=FieldType.TIME,
+                granularity=TimeGranularity(unit=inner.get("timeType", "MILLISECONDS")),
+            ))
+        for fd in d.get("dateTimeFieldSpecs", []):
+            specs.append(FieldSpec.from_dict(fd, FieldType.DATE_TIME))
+        return cls(d["schemaName"], specs, d.get("primaryKeyColumns"))
+
+    @classmethod
+    def from_json(cls, s: str) -> "Schema":
+        return cls.from_dict(json.loads(s))
+
+    @classmethod
+    def from_file(cls, path: str) -> "Schema":
+        with open(path) as f:
+            return cls.from_dict(json.load(f))
+
+    def __repr__(self) -> str:
+        return f"Schema({self.schema_name!r}, columns={self.column_names})"

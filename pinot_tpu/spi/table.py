@@ -1,0 +1,358 @@
+"""Table configuration model.
+
+Re-design of ``pinot-spi/.../config/table/TableConfig.java`` and friends:
+JSON-serialized table definitions covering indexing, segment validation
+(replication/retention), tenants, stream ingestion, partitioning, star-tree
+and upsert config. Field names follow the reference's JSON layout so
+reference table-config files load directly.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+from enum import Enum
+from typing import Any, Dict, List, Optional
+
+
+class TableType(Enum):
+    OFFLINE = "OFFLINE"
+    REALTIME = "REALTIME"
+
+    @property
+    def suffix(self) -> str:
+        return "_" + self.value
+
+
+def table_name_with_type(raw_name: str, table_type: TableType) -> str:
+    """'myTable' + OFFLINE -> 'myTable_OFFLINE' (ref: TableNameBuilder)."""
+    if raw_name.endswith(table_type.suffix):
+        return raw_name
+    return raw_name + table_type.suffix
+
+
+def raw_table_name(name: str) -> str:
+    for t in TableType:
+        if name.endswith(t.suffix):
+            return name[: -len(t.suffix)]
+    return name
+
+
+def table_type_from_name(name: str) -> Optional[TableType]:
+    for t in TableType:
+        if name.endswith(t.suffix):
+            return t
+    return None
+
+
+@dataclass
+class StarTreeIndexConfig:
+    """Ref: pinot-spi/.../config/table/StarTreeIndexConfig.java."""
+
+    dimensions_split_order: List[str] = field(default_factory=list)
+    skip_star_node_creation_for_dimensions: List[str] = field(default_factory=list)
+    function_column_pairs: List[str] = field(default_factory=list)  # e.g. "SUM__revenue"
+    max_leaf_records: int = 10_000
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "dimensionsSplitOrder": self.dimensions_split_order,
+            "skipStarNodeCreationForDimensions": self.skip_star_node_creation_for_dimensions,
+            "functionColumnPairs": self.function_column_pairs,
+            "maxLeafRecords": self.max_leaf_records,
+        }
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "StarTreeIndexConfig":
+        return cls(
+            dimensions_split_order=d.get("dimensionsSplitOrder", []),
+            skip_star_node_creation_for_dimensions=d.get("skipStarNodeCreationForDimensions", []),
+            function_column_pairs=d.get("functionColumnPairs", []),
+            max_leaf_records=d.get("maxLeafRecords", 10_000),
+        )
+
+
+@dataclass
+class SegmentPartitionConfig:
+    """column -> {functionName, numPartitions} (ref: SegmentPartitionConfig.java)."""
+
+    column_partition_map: Dict[str, Dict[str, Any]] = field(default_factory=dict)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"columnPartitionMap": self.column_partition_map}
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "SegmentPartitionConfig":
+        return cls(column_partition_map=d.get("columnPartitionMap", {}))
+
+
+@dataclass
+class IndexingConfig:
+    """Ref: pinot-spi/.../config/table/IndexingConfig.java (reduced to the
+    knobs the TPU engine honors)."""
+
+    inverted_index_columns: List[str] = field(default_factory=list)
+    range_index_columns: List[str] = field(default_factory=list)
+    sorted_column: List[str] = field(default_factory=list)
+    bloom_filter_columns: List[str] = field(default_factory=list)
+    no_dictionary_columns: List[str] = field(default_factory=list)
+    json_index_columns: List[str] = field(default_factory=list)
+    var_length_dictionary_columns: List[str] = field(default_factory=list)
+    star_tree_index_configs: List[StarTreeIndexConfig] = field(default_factory=list)
+    enable_default_star_tree: bool = False
+    segment_partition_config: Optional[SegmentPartitionConfig] = None
+    aggregate_metrics: bool = False  # realtime metric pre-aggregation
+    null_handling_enabled: bool = False
+
+    def to_dict(self) -> Dict[str, Any]:
+        d: Dict[str, Any] = {
+            "invertedIndexColumns": self.inverted_index_columns,
+            "rangeIndexColumns": self.range_index_columns,
+            "sortedColumn": self.sorted_column,
+            "bloomFilterColumns": self.bloom_filter_columns,
+            "noDictionaryColumns": self.no_dictionary_columns,
+            "jsonIndexColumns": self.json_index_columns,
+            "varLengthDictionaryColumns": self.var_length_dictionary_columns,
+            "enableDefaultStarTree": self.enable_default_star_tree,
+            "aggregateMetrics": self.aggregate_metrics,
+            "nullHandlingEnabled": self.null_handling_enabled,
+        }
+        if self.star_tree_index_configs:
+            d["starTreeIndexConfigs"] = [c.to_dict() for c in self.star_tree_index_configs]
+        if self.segment_partition_config:
+            d["segmentPartitionConfig"] = self.segment_partition_config.to_dict()
+        return d
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "IndexingConfig":
+        spc = d.get("segmentPartitionConfig")
+        return cls(
+            inverted_index_columns=d.get("invertedIndexColumns") or [],
+            range_index_columns=d.get("rangeIndexColumns") or [],
+            sorted_column=d.get("sortedColumn") or [],
+            bloom_filter_columns=d.get("bloomFilterColumns") or [],
+            no_dictionary_columns=d.get("noDictionaryColumns") or [],
+            json_index_columns=d.get("jsonIndexColumns") or [],
+            var_length_dictionary_columns=d.get("varLengthDictionaryColumns") or [],
+            star_tree_index_configs=[StarTreeIndexConfig.from_dict(c)
+                                     for c in d.get("starTreeIndexConfigs") or []],
+            enable_default_star_tree=d.get("enableDefaultStarTree", False),
+            segment_partition_config=SegmentPartitionConfig.from_dict(spc) if spc else None,
+            aggregate_metrics=d.get("aggregateMetrics", False),
+            null_handling_enabled=d.get("nullHandlingEnabled", False),
+        )
+
+
+@dataclass
+class SegmentsValidationConfig:
+    """Ref: SegmentsValidationAndRetentionConfig.java."""
+
+    time_column_name: Optional[str] = None
+    time_type: str = "MILLISECONDS"
+    replication: int = 1
+    retention_time_unit: Optional[str] = None  # e.g. "DAYS"
+    retention_time_value: Optional[int] = None
+    segment_push_type: str = "APPEND"  # APPEND | REFRESH
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "timeColumnName": self.time_column_name,
+            "timeType": self.time_type,
+            "replication": str(self.replication),
+            "retentionTimeUnit": self.retention_time_unit,
+            "retentionTimeValue": (str(self.retention_time_value)
+                                   if self.retention_time_value is not None else None),
+            "segmentPushType": self.segment_push_type,
+        }
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "SegmentsValidationConfig":
+        rtv = d.get("retentionTimeValue")
+        return cls(
+            time_column_name=d.get("timeColumnName"),
+            time_type=d.get("timeType", "MILLISECONDS"),
+            replication=int(d.get("replication", 1)),
+            retention_time_unit=d.get("retentionTimeUnit"),
+            retention_time_value=int(rtv) if rtv not in (None, "") else None,
+            segment_push_type=d.get("segmentPushType", "APPEND"),
+        )
+
+
+@dataclass
+class TenantConfig:
+    broker: str = "DefaultTenant"
+    server: str = "DefaultTenant"
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"broker": self.broker, "server": self.server}
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "TenantConfig":
+        return cls(broker=d.get("broker", "DefaultTenant"),
+                   server=d.get("server", "DefaultTenant"))
+
+
+class UpsertMode(Enum):
+    NONE = "NONE"
+    FULL = "FULL"
+    PARTIAL = "PARTIAL"
+
+
+@dataclass
+class UpsertConfig:
+    """Ref: pinot-spi/.../config/table/UpsertConfig.java."""
+
+    mode: UpsertMode = UpsertMode.NONE
+    comparison_column: Optional[str] = None  # defaults to the time column
+    partial_upsert_strategies: Dict[str, str] = field(default_factory=dict)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "mode": self.mode.value,
+            "comparisonColumn": self.comparison_column,
+            "partialUpsertStrategies": self.partial_upsert_strategies,
+        }
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "UpsertConfig":
+        return cls(
+            mode=UpsertMode[d.get("mode", "NONE").upper()],
+            comparison_column=d.get("comparisonColumn"),
+            partial_upsert_strategies=d.get("partialUpsertStrategies", {}),
+        )
+
+
+@dataclass
+class StreamIngestionConfig:
+    """Realtime stream config (ref: stream configs in IndexingConfig.streamConfigs).
+
+    ``stream_type`` selects a registered StreamConsumerFactory; the free-form
+    ``properties`` map is passed through to the factory.
+    """
+
+    stream_type: str = "fake"
+    topic: str = ""
+    decoder: str = "json"
+    segment_flush_threshold_rows: int = 100_000
+    segment_flush_threshold_millis: int = 6 * 3600 * 1000
+    properties: Dict[str, Any] = field(default_factory=dict)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "streamType": self.stream_type,
+            "topic": self.topic,
+            "decoder": self.decoder,
+            "segmentFlushThresholdRows": self.segment_flush_threshold_rows,
+            "segmentFlushThresholdMillis": self.segment_flush_threshold_millis,
+            "properties": self.properties,
+        }
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "StreamIngestionConfig":
+        return cls(
+            stream_type=d.get("streamType", "fake"),
+            topic=d.get("topic", ""),
+            decoder=d.get("decoder", "json"),
+            segment_flush_threshold_rows=int(d.get("segmentFlushThresholdRows", 100_000)),
+            segment_flush_threshold_millis=int(d.get("segmentFlushThresholdMillis", 6 * 3600 * 1000)),
+            properties=d.get("properties", {}),
+        )
+
+    @classmethod
+    def from_stream_configs_map(cls, m: Dict[str, Any]) -> "StreamIngestionConfig":
+        """Parse the reference's flat ``tableIndexConfig.streamConfigs`` map
+        (ref: pinot-spi stream/StreamConfig.java key layout, e.g.
+        ``stream.kafka.topic.name``, ``realtime.segment.flush.threshold.size``)."""
+        stream_type = m.get("streamType", "fake")
+        prefix = f"stream.{stream_type}."
+        topic = m.get(prefix + "topic.name", m.get("topic", ""))
+        decoder = m.get(prefix + "decoder.class.name", m.get("decoder", "json"))
+        rows = int(m.get("realtime.segment.flush.threshold.rows",
+                         m.get("realtime.segment.flush.threshold.size", 100_000)))
+        millis = int(m.get("realtime.segment.flush.threshold.time", 6 * 3600 * 1000))
+        props = {k: v for k, v in m.items()
+                 if k not in ("streamType",)}
+        return cls(stream_type=stream_type, topic=topic, decoder=decoder,
+                   segment_flush_threshold_rows=rows,
+                   segment_flush_threshold_millis=millis, properties=props)
+
+
+@dataclass
+class TableConfig:
+    """Ref: pinot-spi/.../config/table/TableConfig.java."""
+
+    table_name: str  # raw name, without type suffix
+    table_type: TableType = TableType.OFFLINE
+    validation_config: SegmentsValidationConfig = field(default_factory=SegmentsValidationConfig)
+    indexing_config: IndexingConfig = field(default_factory=IndexingConfig)
+    tenant_config: TenantConfig = field(default_factory=TenantConfig)
+    upsert_config: Optional[UpsertConfig] = None
+    stream_config: Optional[StreamIngestionConfig] = None
+    query_config: Dict[str, Any] = field(default_factory=dict)  # e.g. timeoutMs
+    custom_config: Dict[str, Any] = field(default_factory=dict)
+
+    def __post_init__(self):
+        if isinstance(self.table_type, str):
+            self.table_type = TableType[self.table_type.upper()]
+        self.table_name = raw_table_name(self.table_name)
+
+    @property
+    def table_name_with_type(self) -> str:
+        return table_name_with_type(self.table_name, self.table_type)
+
+    @property
+    def replication(self) -> int:
+        return self.validation_config.replication
+
+    def to_dict(self) -> Dict[str, Any]:
+        d: Dict[str, Any] = {
+            "tableName": self.table_name_with_type,
+            "tableType": self.table_type.value,
+            "segmentsConfig": self.validation_config.to_dict(),
+            "tableIndexConfig": self.indexing_config.to_dict(),
+            "tenants": self.tenant_config.to_dict(),
+            "metadata": {"customConfigs": self.custom_config},
+        }
+        if self.upsert_config:
+            d["upsertConfig"] = self.upsert_config.to_dict()
+        if self.stream_config:
+            d["streamConfig"] = self.stream_config.to_dict()
+        if self.query_config:
+            d["query"] = self.query_config
+        return d
+
+    def to_json(self) -> str:
+        return json.dumps(self.to_dict(), indent=2)
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "TableConfig":
+        uc = d.get("upsertConfig")
+        sc = d.get("streamConfig")
+        if sc is not None:
+            stream_config = StreamIngestionConfig.from_dict(sc)
+        else:
+            # reference layout: streamConfigs nested inside tableIndexConfig
+            # (ref: pinot-spi/.../config/table/IndexingConfig.java:42)
+            nested = (d.get("tableIndexConfig") or {}).get("streamConfigs")
+            stream_config = (StreamIngestionConfig.from_stream_configs_map(nested)
+                             if nested else None)
+        return cls(
+            table_name=d["tableName"],
+            table_type=TableType[d.get("tableType", "OFFLINE").upper()],
+            validation_config=SegmentsValidationConfig.from_dict(d.get("segmentsConfig", {})),
+            indexing_config=IndexingConfig.from_dict(d.get("tableIndexConfig", {})),
+            tenant_config=TenantConfig.from_dict(d.get("tenants", {})),
+            upsert_config=UpsertConfig.from_dict(uc) if uc else None,
+            stream_config=stream_config,
+            query_config=d.get("query", {}),
+            custom_config=(d.get("metadata") or {}).get("customConfigs", {}),
+        )
+
+    @classmethod
+    def from_json(cls, s: str) -> "TableConfig":
+        return cls.from_dict(json.loads(s))
+
+    @classmethod
+    def from_file(cls, path: str) -> "TableConfig":
+        with open(path) as f:
+            return cls.from_dict(json.load(f))

@@ -1,0 +1,210 @@
+"""SPI layer tests: schema/table-config serde, layered config, partitioning."""
+
+import json
+
+import numpy as np
+import pytest
+
+from pinot_tpu.spi import (
+    DataType,
+    FieldSpec,
+    FieldType,
+    IndexingConfig,
+    PinotConfiguration,
+    Schema,
+    StarTreeIndexConfig,
+    TableConfig,
+    TableType,
+    UpsertConfig,
+    UpsertMode,
+)
+from pinot_tpu.spi.table import raw_table_name, table_name_with_type
+from pinot_tpu.utils.partition import get_partition_function
+
+
+def make_schema():
+    return Schema("baseballStats", [
+        FieldSpec("playerID", DataType.STRING),
+        FieldSpec("teamID", DataType.STRING),
+        FieldSpec("yearID", DataType.INT),
+        FieldSpec("league", DataType.STRING),
+        FieldSpec("homeRuns", DataType.INT, FieldType.METRIC),
+        FieldSpec("runs", DataType.LONG, FieldType.METRIC),
+        FieldSpec("avgScore", DataType.DOUBLE, FieldType.METRIC),
+    ])
+
+
+class TestSchema:
+    def test_roundtrip(self):
+        s = make_schema()
+        s2 = Schema.from_json(s.to_json())
+        assert s2 == s
+        assert s2.column_names == s.column_names
+        assert s2.field_spec("homeRuns").field_type is FieldType.METRIC
+
+    def test_dimension_metric_split(self):
+        s = make_schema()
+        assert "playerID" in s.dimension_names
+        assert "homeRuns" in s.metric_names
+        assert s.time_column is None
+
+    def test_default_null_values(self):
+        s = make_schema()
+        assert s.field_spec("homeRuns").default_null_value == 0
+        assert s.field_spec("yearID").default_null_value == np.iinfo(np.int32).min
+        assert s.field_spec("playerID").default_null_value == "null"
+
+    def test_duplicate_column_rejected(self):
+        with pytest.raises(ValueError):
+            Schema("x", [FieldSpec("a", DataType.INT), FieldSpec("a", DataType.INT)])
+
+    def test_primary_keys(self):
+        s = Schema("t", [FieldSpec("k", DataType.STRING),
+                         FieldSpec("v", DataType.INT, FieldType.METRIC)],
+                   primary_key_columns=["k"])
+        assert Schema.from_json(s.to_json()).primary_key_columns == ["k"]
+        with pytest.raises(ValueError):
+            Schema("t", [FieldSpec("k", DataType.STRING)], primary_key_columns=["nope"])
+
+    def test_reference_style_time_field_spec(self):
+        # The reference's legacy timeFieldSpec JSON shape loads as TIME
+        d = {
+            "schemaName": "airlineStats",
+            "dimensionFieldSpecs": [{"name": "Carrier", "dataType": "STRING"}],
+            "timeFieldSpec": {
+                "incomingGranularitySpec": {
+                    "name": "DaysSinceEpoch", "dataType": "INT", "timeType": "DAYS"}
+            },
+        }
+        s = Schema.from_dict(d)
+        assert s.time_column == "DaysSinceEpoch"
+        assert s.field_spec("DaysSinceEpoch").field_type is FieldType.TIME
+        # round-trip must preserve TIME (not silently become DATE_TIME)
+        s2 = Schema.from_json(s.to_json())
+        assert s2.field_spec("DaysSinceEpoch").field_type is FieldType.TIME
+        assert s2 == s
+
+    def test_max_length_roundtrip(self):
+        fs = FieldSpec("x", DataType.STRING, max_length=64)
+        assert FieldSpec.from_dict(fs.to_dict()).max_length == 64
+
+    def test_float_dimension_null_is_negative_infinity(self):
+        # ref: FieldSpec.java DEFAULT_DIMENSION_NULL_VALUE_OF_FLOAT/DOUBLE
+        assert FieldSpec("f", DataType.FLOAT).default_null_value == float("-inf")
+        assert FieldSpec("d", DataType.DOUBLE).default_null_value == float("-inf")
+
+    def test_data_type_coercion(self):
+        assert DataType.INT.convert("42") == 42
+        assert DataType.DOUBLE.convert("1.5") == 1.5
+        assert DataType.BOOLEAN.convert("true") == 1
+        assert DataType.STRING.convert(7) == "7"
+        assert DataType.BYTES.convert("deadbeef") == b"\xde\xad\xbe\xef"
+
+
+class TestTableConfig:
+    def test_roundtrip(self):
+        tc = TableConfig(
+            table_name="baseballStats",
+            table_type=TableType.OFFLINE,
+            indexing_config=IndexingConfig(
+                inverted_index_columns=["teamID"],
+                star_tree_index_configs=[StarTreeIndexConfig(
+                    dimensions_split_order=["league", "teamID"],
+                    function_column_pairs=["SUM__homeRuns"])],
+            ),
+            upsert_config=UpsertConfig(mode=UpsertMode.FULL),
+        )
+        tc2 = TableConfig.from_json(tc.to_json())
+        assert tc2.table_name == "baseballStats"
+        assert tc2.table_name_with_type == "baseballStats_OFFLINE"
+        assert tc2.indexing_config.inverted_index_columns == ["teamID"]
+        st = tc2.indexing_config.star_tree_index_configs[0]
+        assert st.function_column_pairs == ["SUM__homeRuns"]
+        assert tc2.upsert_config.mode is UpsertMode.FULL
+
+    def test_reference_realtime_stream_configs(self):
+        # reference layout: flat streamConfigs map nested in tableIndexConfig
+        d = {
+            "tableName": "airlineStats",
+            "tableType": "REALTIME",
+            "tableIndexConfig": {
+                "streamConfigs": {
+                    "streamType": "kafka",
+                    "stream.kafka.topic.name": "flights-realtime",
+                    "realtime.segment.flush.threshold.size": "50000",
+                    "realtime.segment.flush.threshold.time": "3600000",
+                },
+            },
+        }
+        tc = TableConfig.from_dict(d)
+        assert tc.stream_config is not None
+        assert tc.stream_config.stream_type == "kafka"
+        assert tc.stream_config.topic == "flights-realtime"
+        assert tc.stream_config.segment_flush_threshold_rows == 50000
+        assert tc.stream_config.segment_flush_threshold_millis == 3600000
+
+    def test_table_name_helpers(self):
+        assert table_name_with_type("t", TableType.REALTIME) == "t_REALTIME"
+        assert raw_table_name("t_OFFLINE") == "t"
+        assert raw_table_name("plain") == "plain"
+
+
+class TestPinotConfiguration:
+    def test_layering_and_relaxed_keys(self, monkeypatch):
+        monkeypatch.setenv("PINOT_SERVER_QUERY_PORT", "9999")
+        cfg = PinotConfiguration({"pinot.broker.timeoutMs": 5000})
+        assert cfg.get_int("pinot.server.query.port") == 9999
+        assert cfg.get_int("PINOT.BROKER.TIMEOUTMS") == 5000
+        cfg.set("pinot.broker.timeoutMs", 1)  # explicit override wins
+        assert cfg.get_int("pinot.broker.timeout-ms") == 1
+
+    def test_env_beats_properties_file(self, monkeypatch, tmp_path):
+        monkeypatch.setenv("PINOT_SERVER_PORT", "9")
+        p = tmp_path / "conf.properties"
+        p.write_text("pinot.server.port=1\n")
+        cfg = PinotConfiguration()
+        cfg.load_properties_file(str(p))  # loaded after env, but env wins
+        assert cfg.get_int("pinot.server.port") == 9
+
+    def test_typed_getters_and_subset(self):
+        cfg = PinotConfiguration({"a.b.flag": "true", "a.b.n": "7", "c": "x"},
+                                 use_env=False)
+        assert cfg.get_bool("a.b.flag") is True
+        sub = cfg.subset("a.b")
+        assert sub.get_int("n") == 7
+        assert "c" not in sub
+
+    def test_subset_respects_segment_boundary(self):
+        cfg = PinotConfiguration({"server.port": 1, "serverx.port": 2}, use_env=False)
+        sub = cfg.subset("server")
+        assert sub.get_int("port") == 1
+        assert "xport" not in sub and "x.port" not in sub
+
+    def test_properties_file(self, tmp_path):
+        p = tmp_path / "server.properties"
+        p.write_text("# comment\npinot.server.port=1234\n")
+        cfg = PinotConfiguration(use_env=False)
+        cfg.load_properties_file(str(p))
+        assert cfg.get_int("pinot.server.port") == 1234
+
+
+class TestPartitionFunctions:
+    def test_modulo(self):
+        f = get_partition_function("Modulo", 4)
+        assert f.partition(10) == 2
+
+    def test_murmur_stability(self):
+        # Kafka murmur2 known values: partition must be stable across runs
+        f = get_partition_function("Murmur", 8)
+        vals = [f.partition(x) for x in ["a", "b", "hello", "12345"]]
+        assert vals == [f.partition(x) for x in ["a", "b", "hello", "12345"]]
+        assert all(0 <= v < 8 for v in vals)
+
+    def test_hashcode_matches_java(self):
+        # "abc".hashCode() == 96354 in Java
+        f = get_partition_function("HashCode", 100000)
+        assert f.partition("abc") == 96354
+
+    def test_unknown_function(self):
+        with pytest.raises(ValueError):
+            get_partition_function("nope", 2)

@@ -1,0 +1,36 @@
+"""Columnar segment storage engine (ref: pinot-segment-spi + pinot-segment-local).
+
+- ``metadata``   segment/column metadata model (metadata.json)
+- ``dictionary`` sorted per-column dictionaries
+- ``creator``    two-pass segment builder
+- ``immutable``  mmap loader + DataSource access
+- ``mutable``    realtime consuming segment (host-resident, append-only)
+"""
+
+from pinot_tpu.segment.metadata import (
+    ColumnMetadata,
+    Encoding,
+    SegmentMetadata,
+    DOC_TILE,
+    pad_capacity,
+)
+from pinot_tpu.segment.creator import SegmentBuilder
+from pinot_tpu.segment.immutable import (
+    DataSource,
+    ImmutableSegment,
+    load_segment,
+    verify_crc,
+)
+
+__all__ = [
+    "ColumnMetadata",
+    "Encoding",
+    "SegmentMetadata",
+    "DOC_TILE",
+    "pad_capacity",
+    "SegmentBuilder",
+    "DataSource",
+    "ImmutableSegment",
+    "load_segment",
+    "verify_crc",
+]

@@ -1,0 +1,195 @@
+"""Per-column sorted dictionaries.
+
+Re-design of ``pinot-segment-local/.../readers/BaseImmutableDictionary.java``
+and ``SegmentDictionaryCreator.java:45``: values are sorted ascending so
+dictId order == value order, which makes range predicates on dictionary
+columns a *dictId interval* — the property the TPU filter kernels exploit
+(a RANGE filter compiles to ``lo <= dictId <= hi``, pure vector compares).
+
+Numeric dictionaries are plain sorted numpy arrays (device-stageable
+directly). String/bytes dictionaries use an offsets+blob layout (mmap
+friendly); the device only ever sees their dictIds.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from pinot_tpu.spi.data import DataType
+
+
+class Dictionary:
+    """Read interface (ref: pinot-segment-spi index/reader/Dictionary.java:33)."""
+
+    data_type: DataType
+
+    def __len__(self) -> int:
+        raise NotImplementedError
+
+    @property
+    def cardinality(self) -> int:
+        return len(self)
+
+    def index_of(self, value: Any) -> int:
+        """value -> dictId, or -1 if absent (ref: Dictionary.NULL_VALUE_INDEX)."""
+        raise NotImplementedError
+
+    def insertion_index_of(self, value: Any) -> int:
+        """Like index_of, but returns -(insertion_point+1) when absent
+        (binary-search contract used by range predicate evaluation)."""
+        raise NotImplementedError
+
+    def get_value(self, dict_id: int) -> Any:
+        raise NotImplementedError
+
+    def get_values(self, dict_ids: Sequence[int]) -> List[Any]:
+        return [self.get_value(i) for i in dict_ids]
+
+    @property
+    def min_value(self) -> Any:
+        return self.get_value(0)
+
+    @property
+    def max_value(self) -> Any:
+        return self.get_value(len(self) - 1)
+
+    def device_values(self) -> Optional[np.ndarray]:
+        """Numeric dictionaries expose their sorted value array for HBM
+        staging (dictId -> value gather on device); None for var-width."""
+        return None
+
+    def range_to_dict_id_interval(self, lo: Any, hi: Any,
+                                  lo_inclusive: bool, hi_inclusive: bool) -> Tuple[int, int]:
+        """Map a value range to the matching closed dictId interval [a, b]
+        (empty iff a > b). Core of dictionary-based range predicate eval
+        (ref: RangePredicateEvaluatorFactory dictionary-based path)."""
+        n = len(self)
+        if lo is None:
+            a = 0
+        else:
+            idx = self.insertion_index_of(lo)
+            if idx >= 0:
+                a = idx if lo_inclusive else idx + 1
+            else:
+                a = -idx - 1
+        if hi is None:
+            b = n - 1
+        else:
+            idx = self.insertion_index_of(hi)
+            if idx >= 0:
+                b = idx if hi_inclusive else idx - 1
+            else:
+                b = -idx - 2
+        return a, b
+
+
+class NumericDictionary(Dictionary):
+    def __init__(self, values: np.ndarray, data_type: DataType):
+        # values must be sorted ascending and unique
+        self._values = values
+        self.data_type = data_type
+
+    def __len__(self) -> int:
+        return int(self._values.shape[0])
+
+    def index_of(self, value: Any) -> int:
+        i = int(np.searchsorted(self._values, value))
+        if i < len(self._values) and self._values[i] == value:
+            return i
+        return -1
+
+    def insertion_index_of(self, value: Any) -> int:
+        i = int(np.searchsorted(self._values, value))
+        if i < len(self._values) and self._values[i] == value:
+            return i
+        return -(i + 1)
+
+    def get_value(self, dict_id: int) -> Any:
+        v = self._values[dict_id]
+        if self.data_type in (DataType.FLOAT, DataType.DOUBLE):
+            return float(v)
+        return int(v)
+
+    def get_values(self, dict_ids: Sequence[int]) -> List[Any]:
+        arr = self._values[np.asarray(dict_ids)]
+        return arr.tolist()
+
+    def device_values(self) -> Optional[np.ndarray]:
+        return self._values
+
+    @property
+    def raw_array(self) -> np.ndarray:
+        return self._values
+
+
+class StringDictionary(Dictionary):
+    """Sorted UTF-8 strings as offsets[card+1] + byte blob.
+
+    Bytes dictionaries reuse this with raw bytes (sorted bytewise, which
+    matches the reference's ByteArray comparison order).
+    """
+
+    def __init__(self, offsets: np.ndarray, blob: np.ndarray, data_type: DataType):
+        self._offsets = offsets
+        self._blob = blob
+        self.data_type = data_type
+        self._is_bytes = data_type is DataType.BYTES
+
+    @classmethod
+    def from_values(cls, sorted_values: List[Any], data_type: DataType) -> "StringDictionary":
+        encoded = [v if isinstance(v, bytes) else str(v).encode("utf-8")
+                   for v in sorted_values]
+        offsets = np.zeros(len(encoded) + 1, dtype=np.int64)
+        for i, e in enumerate(encoded):
+            offsets[i + 1] = offsets[i] + len(e)
+        blob = np.frombuffer(b"".join(encoded), dtype=np.uint8).copy()
+        return cls(offsets, blob, data_type)
+
+    def __len__(self) -> int:
+        return int(self._offsets.shape[0]) - 1
+
+    def _raw(self, dict_id: int) -> bytes:
+        lo, hi = int(self._offsets[dict_id]), int(self._offsets[dict_id + 1])
+        return self._blob[lo:hi].tobytes()
+
+    def get_value(self, dict_id: int) -> Any:
+        raw = self._raw(dict_id)
+        return raw if self._is_bytes else raw.decode("utf-8")
+
+    def _encode(self, value: Any) -> bytes:
+        return value if isinstance(value, bytes) else str(value).encode("utf-8")
+
+    def insertion_index_of(self, value: Any) -> int:
+        target = self._encode(value)
+        lo, hi = 0, len(self)
+        while lo < hi:
+            mid = (lo + hi) // 2
+            if self._raw(mid) < target:
+                lo = mid + 1
+            else:
+                hi = mid
+        if lo < len(self) and self._raw(lo) == target:
+            return lo
+        return -(lo + 1)
+
+    def index_of(self, value: Any) -> int:
+        i = self.insertion_index_of(value)
+        return i if i >= 0 else -1
+
+    @property
+    def offsets(self) -> np.ndarray:
+        return self._offsets
+
+    @property
+    def blob(self) -> np.ndarray:
+        return self._blob
+
+
+def build_dictionary(sorted_unique_values: List[Any], data_type: DataType) -> Dictionary:
+    """Creator-side entry (ref: SegmentDictionaryCreator.java:45)."""
+    if data_type.is_numeric:
+        arr = np.asarray(sorted_unique_values, dtype=data_type.stored_np)
+        return NumericDictionary(arr, data_type)
+    return StringDictionary.from_values(sorted_unique_values, data_type)

@@ -1,0 +1,236 @@
+"""Segment + column metadata.
+
+Re-design of the reference's ``SegmentMetadataImpl`` /
+``metadata.properties`` + ``ColumnMetadata`` (pinot-segment-spi): JSON
+metadata carrying everything the planner and pruners need without touching
+column data — doc counts, per-column cardinality/min/max/sortedness,
+encoding, partition info, time range, CRC.
+
+TPU-first: ``padded_capacity`` records the doc-dimension padding (multiple of
+the TPU lane*sublane tile, 1024 docs) applied to every forward index so
+staged arrays are tile-aligned; kernels mask ``doc_id >= num_docs``.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+from dataclasses import dataclass, field
+from enum import Enum
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from pinot_tpu.spi.data import DataType, FieldType, Schema
+
+# Doc-dimension padding: 8 sublanes x 128 lanes (f32/i32 tile).
+DOC_TILE = 1024
+
+SEGMENT_FORMAT_VERSION = "tpu-v1"
+METADATA_FILE = "metadata.json"
+
+
+def pad_capacity(num_docs: int) -> int:
+    return max(DOC_TILE, ((num_docs + DOC_TILE - 1) // DOC_TILE) * DOC_TILE)
+
+
+class Encoding(Enum):
+    DICT = "DICT"  # forward index holds dictIds into a sorted dictionary
+    RAW = "RAW"    # forward index holds raw values (numeric only on device)
+
+
+def narrowest_int_dtype(cardinality: int) -> str:
+    """Smallest signed int dtype that holds dictIds [0, cardinality).
+
+    The storage analogue of the reference's fixed-bit packing
+    (``io/util/PinotDataBitSet.java:25``): we trade exact bit-packing for
+    byte-aligned narrow ints, which DMA cleanly and upcast to int32 on device.
+    """
+    if cardinality <= (1 << 7):
+        return "int8"
+    if cardinality <= (1 << 15):
+        return "int16"
+    return "int32"
+
+
+@dataclass
+class ColumnMetadata:
+    """Ref: pinot-segment-spi ColumnMetadata."""
+
+    name: str
+    data_type: DataType
+    field_type: FieldType
+    single_value: bool
+    encoding: Encoding
+    cardinality: int
+    stored_dtype: str           # numpy dtype name of the fwd index on disk
+    min_value: Any = None
+    max_value: Any = None
+    is_sorted: bool = False
+    has_dictionary: bool = True
+    has_inverted_index: bool = False
+    has_nulls: bool = False
+    max_num_multi_values: int = 0   # MV only: max values per row
+    total_number_of_entries: int = 0  # MV only: total flattened values
+    partition_function: Optional[str] = None
+    num_partitions: int = 0
+    partitions: List[int] = field(default_factory=list)  # partitions present
+
+    def to_dict(self) -> Dict[str, Any]:
+        d = {
+            "name": self.name,
+            "dataType": self.data_type.label,
+            "fieldType": self.field_type.value,
+            "singleValue": self.single_value,
+            "encoding": self.encoding.value,
+            "cardinality": self.cardinality,
+            "storedDtype": self.stored_dtype,
+            "minValue": _json_value(self.min_value),
+            "maxValue": _json_value(self.max_value),
+            "isSorted": self.is_sorted,
+            "hasDictionary": self.has_dictionary,
+            "hasInvertedIndex": self.has_inverted_index,
+            "hasNulls": self.has_nulls,
+            "maxNumMultiValues": self.max_num_multi_values,
+            "totalNumberOfEntries": self.total_number_of_entries,
+        }
+        if self.partition_function:
+            d["partitionFunction"] = self.partition_function
+            d["numPartitions"] = self.num_partitions
+            d["partitions"] = self.partitions
+        return d
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "ColumnMetadata":
+        dt = DataType.from_string(d["dataType"])
+        return cls(
+            name=d["name"],
+            data_type=dt,
+            field_type=FieldType[d["fieldType"]],
+            single_value=d["singleValue"],
+            encoding=Encoding[d["encoding"]],
+            cardinality=d["cardinality"],
+            stored_dtype=d["storedDtype"],
+            min_value=_unjson_value(d.get("minValue"), dt),
+            max_value=_unjson_value(d.get("maxValue"), dt),
+            is_sorted=d.get("isSorted", False),
+            has_dictionary=d.get("hasDictionary", True),
+            has_inverted_index=d.get("hasInvertedIndex", False),
+            has_nulls=d.get("hasNulls", False),
+            max_num_multi_values=d.get("maxNumMultiValues", 0),
+            total_number_of_entries=d.get("totalNumberOfEntries", 0),
+            partition_function=d.get("partitionFunction"),
+            num_partitions=d.get("numPartitions", 0),
+            partitions=d.get("partitions", []),
+        )
+
+
+def _json_value(v: Any) -> Any:
+    if v is None:
+        return None
+    if isinstance(v, bytes):
+        return {"__bytes__": v.hex()}
+    if isinstance(v, (np.integer,)):
+        return int(v)
+    if isinstance(v, (np.floating,)):
+        f = float(v)
+        if f in (float("inf"), float("-inf")) or f != f:
+            return {"__float__": repr(f)}
+        return f
+    if isinstance(v, float) and (v in (float("inf"), float("-inf")) or v != v):
+        return {"__float__": repr(v)}
+    return v
+
+
+def _unjson_value(v: Any, dt: DataType) -> Any:
+    if v is None:
+        return None
+    if isinstance(v, dict):
+        if "__bytes__" in v:
+            return bytes.fromhex(v["__bytes__"])
+        if "__float__" in v:
+            return float(v["__float__"])
+    return v
+
+
+@dataclass
+class SegmentMetadata:
+    """Ref: metadata.properties + creation.meta (V1Constants.java:25,56)."""
+
+    segment_name: str
+    table_name: str
+    schema: Schema
+    num_docs: int
+    padded_capacity: int
+    format_version: str = SEGMENT_FORMAT_VERSION
+    creation_time_ms: int = 0
+    time_column: Optional[str] = None
+    min_time: Optional[int] = None   # in time-column units
+    max_time: Optional[int] = None
+    crc: int = 0
+    columns: Dict[str, ColumnMetadata] = field(default_factory=dict)
+    star_tree_count: int = 0
+    custom: Dict[str, Any] = field(default_factory=dict)
+
+    @property
+    def total_docs(self) -> int:
+        return self.num_docs
+
+    def column(self, name: str) -> ColumnMetadata:
+        try:
+            return self.columns[name]
+        except KeyError:
+            raise KeyError(
+                f"column {name!r} not in segment {self.segment_name!r}") from None
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "segmentName": self.segment_name,
+            "tableName": self.table_name,
+            "schema": self.schema.to_dict(),
+            "numDocs": self.num_docs,
+            "paddedCapacity": self.padded_capacity,
+            "formatVersion": self.format_version,
+            "creationTimeMs": self.creation_time_ms,
+            "timeColumn": self.time_column,
+            "minTime": self.min_time,
+            "maxTime": self.max_time,
+            "crc": self.crc,
+            "starTreeCount": self.star_tree_count,
+            "columns": {n: c.to_dict() for n, c in self.columns.items()},
+            "custom": self.custom,
+        }
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "SegmentMetadata":
+        return cls(
+            segment_name=d["segmentName"],
+            table_name=d["tableName"],
+            schema=Schema.from_dict(d["schema"]),
+            num_docs=d["numDocs"],
+            padded_capacity=d["paddedCapacity"],
+            format_version=d.get("formatVersion", SEGMENT_FORMAT_VERSION),
+            creation_time_ms=d.get("creationTimeMs", 0),
+            time_column=d.get("timeColumn"),
+            min_time=d.get("minTime"),
+            max_time=d.get("maxTime"),
+            crc=d.get("crc", 0),
+            star_tree_count=d.get("starTreeCount", 0),
+            columns={n: ColumnMetadata.from_dict(c)
+                     for n, c in d.get("columns", {}).items()},
+            custom=d.get("custom", {}),
+        )
+
+    def save(self, path: str) -> None:
+        with open(path, "w") as f:
+            json.dump(self.to_dict(), f, indent=1, sort_keys=True)
+
+    @classmethod
+    def load(cls, path: str) -> "SegmentMetadata":
+        with open(path) as f:
+            return cls.from_dict(json.load(f))
+
+
+def now_ms() -> int:
+    return int(time.time() * 1000)

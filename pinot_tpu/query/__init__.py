@@ -1,0 +1,30 @@
+"""Query layer: SQL parser, expression model, query context, optimizer
+(ref: pinot-common sql/ + request context, pinot-core query/optimizer)."""
+
+from pinot_tpu.query.expressions import (
+    Expr,
+    FilterNode,
+    FilterOp,
+    Function,
+    Identifier,
+    Literal,
+    OrderByExpr,
+    Predicate,
+    PredicateType,
+    STAR,
+)
+from pinot_tpu.query.parser import ParsedQuery, SqlParseError, parse_sql
+from pinot_tpu.query.context import (
+    AggregationFunctionType,
+    QueryContext,
+    build_query_context,
+    compile_query,
+)
+
+__all__ = [
+    "Expr", "FilterNode", "FilterOp", "Function", "Identifier", "Literal",
+    "OrderByExpr", "Predicate", "PredicateType", "STAR",
+    "ParsedQuery", "SqlParseError", "parse_sql",
+    "AggregationFunctionType", "QueryContext", "build_query_context",
+    "compile_query",
+]

@@ -1,0 +1,245 @@
+"""QueryContext: the compiled server-side query representation.
+
+Re-design of ``pinot-core/.../query/request/context/QueryContext.java:72`` +
+``QueryContextConverterUtils``: built from a parsed query, it resolves
+aliases/ordinals, extracts the aggregation functions (including inside
+post-aggregation arithmetic), and exposes everything the plan maker needs.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from enum import Enum
+from typing import Any, Dict, List, Optional, Tuple
+
+from pinot_tpu.query.expressions import (
+    Expr,
+    FilterNode,
+    Function,
+    Identifier,
+    Literal,
+    OrderByExpr,
+    STAR,
+)
+from pinot_tpu.query.parser import ParsedQuery, SqlParseError, parse_sql
+
+
+class AggregationFunctionType(Enum):
+    """Canonical aggregation function list
+    (ref: pinot-segment-spi AggregationFunctionType.java)."""
+
+    COUNT = "count"
+    SUM = "sum"
+    MIN = "min"
+    MAX = "max"
+    AVG = "avg"
+    MINMAXRANGE = "minmaxrange"
+    SUMPRECISION = "sumprecision"
+    MODE = "mode"
+    DISTINCTCOUNT = "distinctcount"
+    DISTINCTCOUNTBITMAP = "distinctcountbitmap"
+    DISTINCTCOUNTHLL = "distinctcounthll"
+    DISTINCTCOUNTRAWHLL = "distinctcountrawhll"
+    SEGMENTPARTITIONEDDISTINCTCOUNT = "segmentpartitioneddistinctcount"
+    PERCENTILE = "percentile"
+    PERCENTILEEST = "percentileest"
+    PERCENTILETDIGEST = "percentiletdigest"
+    # MV variants
+    COUNTMV = "countmv"
+    SUMMV = "summv"
+    MINMV = "minmv"
+    MAXMV = "maxmv"
+    AVGMV = "avgmv"
+    MINMAXRANGEMV = "minmaxrangemv"
+    DISTINCTCOUNTMV = "distinctcountmv"
+    DISTINCTCOUNTHLLMV = "distinctcounthllmv"
+    PERCENTILEMV = "percentilemv"
+    PERCENTILEESTMV = "percentileestmv"
+    PERCENTILETDIGESTMV = "percentiletdigestmv"
+
+    @classmethod
+    def names(cls) -> set:
+        return {m.value for m in cls}
+
+    @classmethod
+    def from_name(cls, name: str) -> "AggregationFunctionType":
+        n = name.lower()
+        # percentile variants carry the percentile in the name: percentile95
+        for prefix in ("percentiletdigest", "percentileest", "percentile"):
+            if n.startswith(prefix) and n[len(prefix):].isdigit():
+                return cls(prefix)
+        return cls(n)
+
+
+def _is_agg_name(name: str) -> bool:
+    n = name.lower()
+    if n in AggregationFunctionType.names():
+        return True
+    for prefix in ("percentiletdigest", "percentileest", "percentile"):
+        if n.startswith(prefix) and n[len(prefix):].isdigit():
+            return True
+    return False
+
+
+@dataclass
+class QueryContext:
+    """Ref: QueryContext.java:72."""
+
+    table_name: str
+    select_expressions: List[Expr]
+    aliases: List[Optional[str]]
+    distinct: bool
+    filter: Optional[FilterNode]
+    group_by: List[Expr]
+    having: Optional[FilterNode]
+    order_by: List[OrderByExpr]
+    limit: int
+    offset: int
+    options: Dict[str, str] = field(default_factory=dict)
+
+    # derived (filled by build):
+    aggregations: List[Function] = field(default_factory=list)
+
+    @property
+    def is_aggregation(self) -> bool:
+        return bool(self.aggregations)
+
+    @property
+    def is_group_by(self) -> bool:
+        return bool(self.group_by)
+
+    @property
+    def is_selection(self) -> bool:
+        return not self.aggregations and not self.distinct
+
+    def referenced_columns(self) -> List[str]:
+        """All physical columns the query touches (staging set)."""
+        cols: List[str] = []
+        for e in self.select_expressions:
+            cols.extend(e.columns())
+        if self.filter is not None:
+            cols.extend(self.filter.columns())
+        for e in self.group_by:
+            cols.extend(e.columns())
+        if self.having is not None:
+            cols.extend(self.having.columns())
+        for ob in self.order_by:
+            cols.extend(ob.expr.columns())
+        seen, out = set(), []
+        for c in cols:
+            if c != "*" and c not in seen:
+                seen.add(c)
+                out.append(c)
+        return out
+
+    def timeout_ms(self, default: int) -> int:
+        return int(self.options.get("timeoutMs", default))
+
+    def __str__(self) -> str:
+        return (f"QueryContext(table={self.table_name}, "
+                f"select={[str(e) for e in self.select_expressions]}, "
+                f"filter={self.filter}, groupBy={[str(e) for e in self.group_by]}, "
+                f"limit={self.limit})")
+
+
+def _collect_aggregations(expr: Expr, out: List[Function]) -> None:
+    """Find aggregation sub-expressions (depth-first, dedup by equality)."""
+    if isinstance(expr, Function):
+        if _is_agg_name(expr.name):
+            if expr not in out:
+                out.append(expr)
+            return  # no nested aggs inside an agg
+        for a in expr.args:
+            _collect_aggregations(a, out)
+
+
+def _resolve_alias(expr: Expr, alias_map: Dict[str, Expr],
+                   select_exprs: List[Expr], top_level: bool = True) -> Expr:
+    """Aliases anywhere; 1-based ordinals ONLY as a whole top-level GROUP BY /
+    ORDER BY item (``ORDER BY a + 1`` is arithmetic, not an ordinal)
+    (ref: rewriters AliasApplier / OrdinalsUpdater)."""
+    if isinstance(expr, Identifier) and expr.name in alias_map:
+        return alias_map[expr.name]
+    if (top_level and isinstance(expr, Literal)
+            and type(expr.value) is int):  # bool is not an ordinal
+        ordinal = expr.value
+        if 1 <= ordinal <= len(select_exprs):
+            return select_exprs[ordinal - 1]
+        raise SqlParseError(f"ordinal {ordinal} out of range")
+    if isinstance(expr, Function):
+        return Function(expr.name,
+                        tuple(_resolve_alias(a, alias_map, select_exprs, False)
+                              for a in expr.args))
+    return expr
+
+
+def _resolve_filter_aliases(node: FilterNode, alias_map: Dict[str, Expr],
+                            select_exprs: List[Expr]) -> FilterNode:
+    if node.predicate is not None:
+        p = node.predicate
+        # aliases only — ordinals are not meaningful in HAVING
+        new_lhs = _resolve_alias(p.lhs, alias_map, select_exprs, top_level=False)
+        if new_lhs is not p.lhs:
+            from dataclasses import replace
+            return FilterNode.pred(replace(p, lhs=new_lhs))
+        return node
+    return FilterNode(node.op,
+                      children=tuple(_resolve_filter_aliases(c, alias_map, select_exprs)
+                                     for c in node.children),
+                      predicate=None)
+
+
+def build_query_context(parsed: ParsedQuery) -> QueryContext:
+    """Ref: QueryContextConverterUtils.getQueryContext."""
+    select_exprs = [e for e, _ in parsed.select]
+    aliases = [a for _, a in parsed.select]
+    alias_map: Dict[str, Expr] = {
+        a: e for e, a in parsed.select if a is not None}
+
+    group_by = [_resolve_alias(e, alias_map, select_exprs) for e in parsed.group_by]
+    order_by = [OrderByExpr(_resolve_alias(ob.expr, alias_map, select_exprs),
+                            ob.ascending)
+                for ob in parsed.order_by]
+    having = (_resolve_filter_aliases(parsed.having, alias_map, select_exprs)
+              if parsed.having is not None else None)
+
+    ctx = QueryContext(
+        table_name=parsed.table,
+        select_expressions=select_exprs,
+        aliases=aliases,
+        distinct=parsed.distinct,
+        filter=parsed.where,
+        group_by=group_by,
+        having=having,
+        order_by=order_by,
+        limit=parsed.limit,
+        offset=parsed.offset,
+        options=dict(parsed.options),
+    )
+
+    aggs: List[Function] = []
+    for e in select_exprs:
+        _collect_aggregations(e, aggs)
+    if having is not None:
+        for p in having.predicates():
+            _collect_aggregations(p.lhs, aggs)
+    for ob in order_by:
+        _collect_aggregations(ob.expr, aggs)
+    ctx.aggregations = aggs
+
+    if ctx.distinct and aggs:
+        raise SqlParseError("DISTINCT with aggregations is not supported")
+    if aggs and not group_by:
+        # pure aggregation: every select expr must be an aggregation or
+        # post-aggregation over them (checked at reduce time)
+        pass
+    return ctx
+
+
+def compile_query(sql: str) -> QueryContext:
+    """SQL -> optimized QueryContext (parse + optimize + context build)."""
+    from pinot_tpu.query.optimizer import optimize
+
+    parsed = parse_sql(sql)
+    parsed = optimize(parsed)
+    return build_query_context(parsed)

@@ -1,0 +1,243 @@
+"""Expression + filter AST.
+
+Re-design of the reference's request-context model
+(``pinot-common/.../common/request/context/ExpressionContext.java``,
+``FilterContext.java``, the ``Predicate`` hierarchy): a small, hashable AST
+the planner compiles into device kernels. Hashability matters: the engine's
+jit cache is keyed on (filter structure, agg structure), so expressions must
+be stable dict keys.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from enum import Enum
+from typing import Any, List, Optional, Tuple
+
+
+# --------------------------------------------------------------------------
+# Expressions
+# --------------------------------------------------------------------------
+
+class Expr:
+    """Base expression node."""
+
+    def columns(self) -> List[str]:
+        """All identifier names referenced (planner uses this for staging)."""
+        out: List[str] = []
+        self._collect_columns(out)
+        return out
+
+    def _collect_columns(self, out: List[str]) -> None:
+        pass
+
+
+@dataclass(frozen=True)
+class Identifier(Expr):
+    name: str
+
+    def _collect_columns(self, out: List[str]) -> None:
+        out.append(self.name)
+
+    def __str__(self) -> str:
+        return self.name
+
+
+@dataclass(frozen=True)
+class Literal(Expr):
+    value: Any  # int | float | str | bool | None (NULL)
+
+    @property
+    def is_null(self) -> bool:
+        return self.value is None
+
+    def __str__(self) -> str:
+        if isinstance(self.value, str):
+            escaped = self.value.replace("'", "''")
+            return f"'{escaped}'"
+        return str(self.value)
+
+
+@dataclass(frozen=True)
+class Function(Expr):
+    """Function call; also represents operators (plus/minus/times/divide...),
+    matching the reference's canonical function-call form
+    (CalciteSqlParser compiles ``a + b`` to ``plus(a, b)``)."""
+
+    name: str  # canonical lower-case name
+    args: Tuple[Expr, ...]
+
+    def __init__(self, name: str, args):
+        object.__setattr__(self, "name", name.lower())
+        object.__setattr__(self, "args", tuple(args))
+
+    def _collect_columns(self, out: List[str]) -> None:
+        for a in self.args:
+            a._collect_columns(out)
+
+    def __str__(self) -> str:
+        return f"{self.name}({','.join(str(a) for a in self.args)})"
+
+
+STAR = Identifier("*")
+
+
+_FOLDABLE = {
+    "plus": lambda a, b: a + b,
+    "minus": lambda a, b: a - b,
+    "times": lambda a, b: a * b,
+    "divide": lambda a, b: a / b,
+    "mod": lambda a, b: a % b,
+}
+
+
+def fold_constants(expr: Expr) -> Expr:
+    """Evaluate literal-only arithmetic sub-trees
+    (ref: CompileTimeFunctionsInvoker)."""
+    if not isinstance(expr, Function):
+        return expr
+    args = tuple(fold_constants(a) for a in expr.args)
+    expr = Function(expr.name, args)
+    fn = _FOLDABLE.get(expr.name)
+    if fn is not None and all(isinstance(a, Literal) and not a.is_null
+                              and isinstance(a.value, (int, float, bool))
+                              for a in args):
+        try:
+            return Literal(fn(args[0].value, args[1].value))
+        except ZeroDivisionError:
+            return expr
+    return expr
+
+
+# --------------------------------------------------------------------------
+# Filter tree
+# --------------------------------------------------------------------------
+
+class PredicateType(Enum):
+    EQ = "EQ"
+    NOT_EQ = "NOT_EQ"
+    IN = "IN"
+    NOT_IN = "NOT_IN"
+    RANGE = "RANGE"
+    REGEXP_LIKE = "REGEXP_LIKE"
+    LIKE = "LIKE"            # rewritten to REGEXP_LIKE by the optimizer
+    TEXT_MATCH = "TEXT_MATCH"
+    JSON_MATCH = "JSON_MATCH"
+    IS_NULL = "IS_NULL"
+    IS_NOT_NULL = "IS_NOT_NULL"
+
+
+@dataclass(frozen=True)
+class Predicate:
+    """Leaf predicate over one expression (ref: request/context/predicate/*).
+
+    RANGE uses (lower, upper, lower_inclusive, upper_inclusive) with None for
+    unbounded — the single representation for >, >=, <, <=, BETWEEN (the
+    reference encodes the same as a range string ``(lo,hi]``).
+    """
+
+    type: PredicateType
+    lhs: Expr
+    values: Tuple[Any, ...] = ()
+    lower: Any = None
+    upper: Any = None
+    lower_inclusive: bool = False
+    upper_inclusive: bool = False
+
+    @property
+    def value(self) -> Any:
+        return self.values[0] if self.values else None
+
+    def __str__(self) -> str:
+        t = self.type
+        if t in (PredicateType.EQ, PredicateType.NOT_EQ):
+            op = "=" if t is PredicateType.EQ else "!="
+            return f"{self.lhs} {op} {self.value!r}"
+        if t in (PredicateType.IN, PredicateType.NOT_IN):
+            return f"{self.lhs} {t.value} {self.values!r}"
+        if t is PredicateType.RANGE:
+            lb = "[" if self.lower_inclusive else "("
+            ub = "]" if self.upper_inclusive else ")"
+            lo = "*" if self.lower is None else repr(self.lower)
+            hi = "*" if self.upper is None else repr(self.upper)
+            return f"{self.lhs} IN {lb}{lo},{hi}{ub}"
+        if t in (PredicateType.IS_NULL, PredicateType.IS_NOT_NULL):
+            return f"{self.lhs} {t.value}"
+        return f"{t.value}({self.lhs}, {self.values!r})"
+
+
+class FilterOp(Enum):
+    AND = "AND"
+    OR = "OR"
+    NOT = "NOT"
+    PREDICATE = "PREDICATE"
+
+
+@dataclass(frozen=True)
+class FilterNode:
+    """Ref: FilterContext.java — AND/OR/NOT tree with Predicate leaves."""
+
+    op: FilterOp
+    children: Tuple["FilterNode", ...] = ()
+    predicate: Optional[Predicate] = None
+
+    def __init__(self, op: FilterOp, children=(), predicate: Optional[Predicate] = None):
+        object.__setattr__(self, "op", op)
+        object.__setattr__(self, "children", tuple(children))
+        object.__setattr__(self, "predicate", predicate)
+
+    @classmethod
+    def pred(cls, predicate: Predicate) -> "FilterNode":
+        return cls(FilterOp.PREDICATE, predicate=predicate)
+
+    @classmethod
+    def and_(cls, children) -> "FilterNode":
+        return cls(FilterOp.AND, children=children)
+
+    @classmethod
+    def or_(cls, children) -> "FilterNode":
+        return cls(FilterOp.OR, children=children)
+
+    @classmethod
+    def not_(cls, child: "FilterNode") -> "FilterNode":
+        return cls(FilterOp.NOT, children=(child,))
+
+    def columns(self) -> List[str]:
+        out: List[str] = []
+        self._collect(out)
+        return out
+
+    def _collect(self, out: List[str]) -> None:
+        if self.predicate is not None:
+            out.extend(self.predicate.lhs.columns())
+        for c in self.children:
+            c._collect(out)
+
+    def predicates(self) -> List[Predicate]:
+        out: List[Predicate] = []
+        if self.predicate is not None:
+            out.append(self.predicate)
+        for c in self.children:
+            out.extend(c.predicates())
+        return out
+
+    def __str__(self) -> str:
+        if self.op is FilterOp.PREDICATE:
+            return str(self.predicate)
+        if self.op is FilterOp.NOT:
+            return f"NOT ({self.children[0]})"
+        sep = f" {self.op.value} "
+        return "(" + sep.join(str(c) for c in self.children) + ")"
+
+
+# --------------------------------------------------------------------------
+# Order-by
+# --------------------------------------------------------------------------
+
+@dataclass(frozen=True)
+class OrderByExpr:
+    expr: Expr
+    ascending: bool = True
+
+    def __str__(self) -> str:
+        return f"{self.expr} {'ASC' if self.ascending else 'DESC'}"

@@ -1,0 +1,518 @@
+"""SQL parser: SQL text -> parsed query AST.
+
+Re-design of the reference's Calcite-based parser
+(``pinot-common/.../sql/parsers/CalciteSqlParser.java:67``) as a hand-written
+lexer + recursive-descent parser for the Pinot SQL dialect:
+
+    SELECT [DISTINCT] select_list FROM table
+    [WHERE bool_expr] [GROUP BY expr_list] [HAVING bool_expr]
+    [ORDER BY expr [ASC|DESC], ...] [LIMIT n [OFFSET m] | LIMIT m, n]
+    [OPTION(k=v, ...)]
+
+Operators compile to canonical function calls (``a + b`` -> ``plus(a,b)``)
+and comparisons compile to the Predicate model, exactly as the reference
+normalizes through its thrift ``PinotQuery`` AST.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+from pinot_tpu.query.expressions import (
+    Expr,
+    FilterNode,
+    Function,
+    Identifier,
+    Literal,
+    OrderByExpr,
+    Predicate,
+    PredicateType,
+    STAR,
+    fold_constants,
+)
+
+
+class SqlParseError(Exception):
+    pass
+
+
+# --------------------------------------------------------------------------
+# Lexer
+# --------------------------------------------------------------------------
+
+_TOKEN_RE = re.compile(r"""
+    (?P<ws>\s+)
+  | (?P<number>\d+\.\d*([eE][+-]?\d+)?|\.\d+([eE][+-]?\d+)?|\d+([eE][+-]?\d+)?)
+  | (?P<string>'(?:[^']|'')*')
+  | (?P<qident>"(?:[^"]|"")*")
+  | (?P<ident>[A-Za-z_$][A-Za-z0-9_$]*)
+  | (?P<op><>|!=|<=|>=|=|<|>|\(|\)|,|\*|\+|-|/|%|\.)
+""", re.VERBOSE)
+
+
+@dataclass
+class Token:
+    kind: str   # number | string | ident | qident | op | eof
+    text: str
+    pos: int
+
+    @property
+    def upper(self) -> str:
+        return self.text.upper()
+
+
+def tokenize(sql: str) -> List[Token]:
+    tokens: List[Token] = []
+    pos = 0
+    n = len(sql)
+    while pos < n:
+        m = _TOKEN_RE.match(sql, pos)
+        if m is None:
+            raise SqlParseError(f"unexpected character {sql[pos]!r} at position {pos}")
+        kind = m.lastgroup
+        # number group has inner groups; find the outer kind
+        for k in ("ws", "number", "string", "qident", "ident", "op"):
+            if m.group(k) is not None:
+                kind = k
+                break
+        if kind != "ws":
+            tokens.append(Token(kind, m.group(kind), pos))
+        pos = m.end()
+    tokens.append(Token("eof", "", n))
+    return tokens
+
+
+_KEYWORDS = {
+    "SELECT", "DISTINCT", "FROM", "WHERE", "GROUP", "BY", "HAVING", "ORDER",
+    "LIMIT", "OFFSET", "OPTION", "AND", "OR", "NOT", "IN", "BETWEEN", "LIKE",
+    "IS", "NULL", "TRUE", "FALSE", "AS", "ASC", "DESC", "CASE", "WHEN",
+    "THEN", "ELSE", "END",
+}
+
+# function-call predicates: f(col, literal) used in WHERE position
+_PREDICATE_FUNCTIONS = {
+    "regexp_like": PredicateType.REGEXP_LIKE,
+    "text_match": PredicateType.TEXT_MATCH,
+    "json_match": PredicateType.JSON_MATCH,
+}
+
+
+@dataclass
+class ParsedQuery:
+    """Raw parse result (the analogue of the thrift PinotQuery,
+    ref: pinot-common/src/thrift/query.thrift:25)."""
+
+    table: str
+    select: List[Tuple[Expr, Optional[str]]]  # (expr, alias)
+    distinct: bool = False
+    where: Optional[FilterNode] = None
+    group_by: List[Expr] = field(default_factory=list)
+    having: Optional[FilterNode] = None
+    order_by: List[OrderByExpr] = field(default_factory=list)
+    limit: int = 10
+    offset: int = 0
+    options: Dict[str, str] = field(default_factory=dict)
+
+
+class _Parser:
+    def __init__(self, sql: str):
+        self.tokens = tokenize(sql)
+        self.i = 0
+
+    # -- token helpers -----------------------------------------------------
+    def peek(self, ahead: int = 0) -> Token:
+        return self.tokens[min(self.i + ahead, len(self.tokens) - 1)]
+
+    def next(self) -> Token:
+        t = self.tokens[self.i]
+        if t.kind != "eof":
+            self.i += 1
+        return t
+
+    def at_keyword(self, *words: str) -> bool:
+        t = self.peek()
+        return t.kind == "ident" and t.upper in words
+
+    def accept_keyword(self, *words: str) -> bool:
+        if self.at_keyword(*words):
+            self.next()
+            return True
+        return False
+
+    def expect_keyword(self, word: str) -> None:
+        if not self.accept_keyword(word):
+            t = self.peek()
+            raise SqlParseError(f"expected {word} at position {t.pos}, got {t.text!r}")
+
+    def at_op(self, *ops: str) -> bool:
+        t = self.peek()
+        return t.kind == "op" and t.text in ops
+
+    def accept_op(self, *ops: str) -> bool:
+        if self.at_op(*ops):
+            self.next()
+            return True
+        return False
+
+    def expect_op(self, op: str) -> None:
+        if not self.accept_op(op):
+            t = self.peek()
+            raise SqlParseError(f"expected {op!r} at position {t.pos}, got {t.text!r}")
+
+    # -- entry -------------------------------------------------------------
+    def parse(self) -> ParsedQuery:
+        self.expect_keyword("SELECT")
+        distinct = self.accept_keyword("DISTINCT")
+        select = self.parse_select_list()
+        self.expect_keyword("FROM")
+        table = self.parse_table_name()
+        where = group_by = having = None
+        order_by: List[OrderByExpr] = []
+        limit, offset = 10, 0
+        options: Dict[str, str] = {}
+        if self.accept_keyword("WHERE"):
+            where = self.parse_bool_expr()
+        if self.accept_keyword("GROUP"):
+            self.expect_keyword("BY")
+            group_by = self.parse_expr_list()
+        if self.accept_keyword("HAVING"):
+            having = self.parse_bool_expr()
+        if self.accept_keyword("ORDER"):
+            self.expect_keyword("BY")
+            order_by = self.parse_order_list()
+        if self.accept_keyword("LIMIT"):
+            a = self.parse_int()
+            if self.accept_op(","):
+                offset, limit = a, self.parse_int()  # MySQL style LIMIT off, n
+            elif self.accept_keyword("OFFSET"):
+                limit, offset = a, self.parse_int()
+            else:
+                limit = a
+        if self.accept_keyword("OPTION"):
+            self.expect_op("(")
+            while not self.accept_op(")"):
+                k = self.next().text
+                self.expect_op("=")
+                v = self.next().text
+                if v.startswith("'"):
+                    v = v[1:-1].replace("''", "'")
+                options[k] = v
+                self.accept_op(",")
+        t = self.peek()
+        if t.kind != "eof":
+            raise SqlParseError(f"unexpected trailing input at position {t.pos}: {t.text!r}")
+        return ParsedQuery(table=table, select=select, distinct=distinct,
+                           where=where, group_by=group_by or [], having=having,
+                           order_by=order_by, limit=limit, offset=offset,
+                           options=options)
+
+    def parse_table_name(self) -> str:
+        parts = [self.parse_identifier_token()]
+        while self.accept_op("."):
+            parts.append(self.parse_identifier_token())
+        return ".".join(parts)
+
+    def parse_identifier_token(self) -> str:
+        t = self.next()
+        if t.kind == "qident":
+            return t.text[1:-1].replace('""', '"')
+        if t.kind == "ident":
+            return t.text
+        raise SqlParseError(f"expected identifier at position {t.pos}, got {t.text!r}")
+
+    def parse_int(self) -> int:
+        t = self.next()
+        if t.kind != "number" or not t.text.isdigit():
+            raise SqlParseError(f"expected integer at position {t.pos}, "
+                                f"got {t.text!r}")
+        return int(t.text)
+
+    # -- select list ---------------------------------------------------------
+    def parse_select_list(self) -> List[Tuple[Expr, Optional[str]]]:
+        items: List[Tuple[Expr, Optional[str]]] = []
+        while True:
+            expr = self.parse_expr()
+            alias = None
+            if self.accept_keyword("AS"):
+                alias = self.parse_identifier_token()
+            elif (self.peek().kind in ("ident", "qident")
+                  and self.peek().upper not in _KEYWORDS):
+                alias = self.parse_identifier_token()
+            items.append((expr, alias))
+            if not self.accept_op(","):
+                break
+        return items
+
+    def parse_expr_list(self) -> List[Expr]:
+        out = [self.parse_expr()]
+        while self.accept_op(","):
+            out.append(self.parse_expr())
+        return out
+
+    def parse_order_list(self) -> List[OrderByExpr]:
+        out = []
+        while True:
+            e = self.parse_expr()
+            asc = True
+            if self.accept_keyword("DESC"):
+                asc = False
+            else:
+                self.accept_keyword("ASC")
+            out.append(OrderByExpr(e, asc))
+            if not self.accept_op(","):
+                break
+        return out
+
+    # -- boolean expressions -------------------------------------------------
+    def parse_bool_expr(self) -> FilterNode:
+        return self.parse_or()
+
+    def parse_or(self) -> FilterNode:
+        left = self.parse_and()
+        children = [left]
+        while self.accept_keyword("OR"):
+            children.append(self.parse_and())
+        return children[0] if len(children) == 1 else FilterNode.or_(children)
+
+    def parse_and(self) -> FilterNode:
+        children = [self.parse_not()]
+        while self.accept_keyword("AND"):
+            children.append(self.parse_not())
+        return children[0] if len(children) == 1 else FilterNode.and_(children)
+
+    def parse_not(self) -> FilterNode:
+        if self.accept_keyword("NOT"):
+            return FilterNode.not_(self.parse_not())
+        return self.parse_bool_primary()
+
+    def parse_bool_primary(self) -> FilterNode:
+        if self.at_op("("):
+            # ambiguous: grouped boolean vs parenthesized arithmetic.
+            # Try boolean group; backtrack if it turns out to be arithmetic.
+            save = self.i
+            try:
+                self.expect_op("(")
+                node = self.parse_bool_expr()
+                self.expect_op(")")
+                # if a comparison/arith operator follows, it was arithmetic
+                if not (self.at_op("=", "!=", "<>", "<", "<=", ">", ">=", "+",
+                                   "-", "*", "/", "%")
+                        or self.at_keyword("BETWEEN", "IN", "LIKE", "IS", "NOT")):
+                    return node
+            except SqlParseError:
+                pass
+            self.i = save
+        return self.parse_predicate()
+
+    def parse_predicate(self) -> FilterNode:
+        lhs = self.parse_expr()
+
+        # function-call predicates: regexp_like(col, 're'), text_match(...)
+        if isinstance(lhs, Function) and lhs.name in _PREDICATE_FUNCTIONS:
+            ptype = _PREDICATE_FUNCTIONS[lhs.name]
+            if len(lhs.args) != 2 or not isinstance(lhs.args[1], Literal):
+                raise SqlParseError(f"{lhs.name} expects (expr, literal)")
+            return FilterNode.pred(Predicate(
+                ptype, lhs.args[0], values=(lhs.args[1].value,)))
+
+        negate = False
+        if self.accept_keyword("NOT"):
+            negate = True
+
+        if self.accept_keyword("IN"):
+            self.expect_op("(")
+            values = [self.parse_literal_value()]
+            while self.accept_op(","):
+                values.append(self.parse_literal_value())
+            self.expect_op(")")
+            ptype = PredicateType.NOT_IN if negate else PredicateType.IN
+            return FilterNode.pred(Predicate(ptype, lhs, values=tuple(values)))
+
+        if self.accept_keyword("BETWEEN"):
+            lo = self.parse_literal_value()
+            self.expect_keyword("AND")
+            hi = self.parse_literal_value()
+            node = FilterNode.pred(Predicate(
+                PredicateType.RANGE, lhs, lower=lo, upper=hi,
+                lower_inclusive=True, upper_inclusive=True))
+            return FilterNode.not_(node) if negate else node
+
+        if self.accept_keyword("LIKE"):
+            pattern = self.parse_literal_value()
+            node = FilterNode.pred(Predicate(
+                PredicateType.LIKE, lhs, values=(pattern,)))
+            return FilterNode.not_(node) if negate else node
+
+        if negate:
+            raise SqlParseError("expected IN/BETWEEN/LIKE after NOT")
+
+        if self.accept_keyword("IS"):
+            is_not = self.accept_keyword("NOT")
+            self.expect_keyword("NULL")
+            ptype = PredicateType.IS_NOT_NULL if is_not else PredicateType.IS_NULL
+            return FilterNode.pred(Predicate(ptype, lhs))
+
+        for op in ("=", "!=", "<>", "<=", ">=", "<", ">"):
+            if self.accept_op(op):
+                rhs = self.parse_expr()
+                return self._comparison(op, lhs, rhs)
+
+        raise SqlParseError(
+            f"expected predicate operator at position {self.peek().pos}, "
+            f"got {self.peek().text!r}")
+
+    _SWAP = {"<": ">", "<=": ">=", ">": "<", ">=": "<="}
+
+    def _comparison(self, op: str, lhs: Expr, rhs: Expr) -> FilterNode:
+        # fold constant arithmetic so 'b > 2 + 3' has a literal rhs
+        lhs, rhs = fold_constants(lhs), fold_constants(rhs)
+        # normalize to expr-vs-literal (swap '5 < col' -> 'col > 5')
+        if isinstance(lhs, Literal) and not isinstance(rhs, Literal):
+            lhs, rhs = rhs, lhs
+            op = self._SWAP.get(op, op)
+        if not isinstance(rhs, Literal):
+            raise SqlParseError(
+                f"comparison right-hand side must be a literal, got {rhs}")
+        v = rhs.value
+        if op == "=":
+            return FilterNode.pred(Predicate(PredicateType.EQ, lhs, values=(v,)))
+        if op in ("!=", "<>"):
+            return FilterNode.pred(Predicate(PredicateType.NOT_EQ, lhs, values=(v,)))
+        if op == ">":
+            return FilterNode.pred(Predicate(PredicateType.RANGE, lhs, lower=v))
+        if op == ">=":
+            return FilterNode.pred(Predicate(PredicateType.RANGE, lhs, lower=v,
+                                             lower_inclusive=True))
+        if op == "<":
+            return FilterNode.pred(Predicate(PredicateType.RANGE, lhs, upper=v))
+        return FilterNode.pred(Predicate(PredicateType.RANGE, lhs, upper=v,
+                                         upper_inclusive=True))
+
+    def parse_literal_value(self) -> Any:
+        e = self.parse_expr()
+        if not isinstance(e, Literal):
+            raise SqlParseError(f"expected literal, got {e}")
+        return e.value
+
+    # -- value expressions ---------------------------------------------------
+    def parse_expr(self) -> Expr:
+        return self.parse_add()
+
+    def parse_add(self) -> Expr:
+        left = self.parse_mul()
+        while self.at_op("+", "-"):
+            op = self.next().text
+            right = self.parse_mul()
+            left = Function("plus" if op == "+" else "minus", (left, right))
+        return left
+
+    def parse_mul(self) -> Expr:
+        left = self.parse_unary()
+        while self.at_op("*", "/", "%"):
+            op = self.next().text
+            right = self.parse_unary()
+            name = {"*": "times", "/": "divide", "%": "mod"}[op]
+            left = Function(name, (left, right))
+        return left
+
+    def parse_unary(self) -> Expr:
+        if self.accept_op("-"):
+            inner = self.parse_unary()
+            if isinstance(inner, Literal) and isinstance(inner.value, (int, float)):
+                return Literal(-inner.value)
+            return Function("minus", (Literal(0), inner))
+        return self.parse_primary()
+
+    def parse_primary(self) -> Expr:
+        t = self.peek()
+        if t.kind == "number":
+            self.next()
+            text = t.text
+            if "." in text or "e" in text.lower():
+                return Literal(float(text))
+            return Literal(int(text))
+        if t.kind == "string":
+            self.next()
+            return Literal(t.text[1:-1].replace("''", "'"))
+        if t.kind == "op" and t.text == "(":
+            self.next()
+            e = self.parse_expr()
+            self.expect_op(")")
+            return e
+        if t.kind == "op" and t.text == "*":
+            self.next()
+            return STAR
+        if t.kind == "qident":
+            self.next()
+            return Identifier(t.text[1:-1].replace('""', '"'))
+        if t.kind == "ident":
+            up = t.upper
+            if up == "NULL":
+                self.next()
+                return Literal(None)
+            if up == "TRUE":
+                self.next()
+                return Literal(True)
+            if up == "FALSE":
+                self.next()
+                return Literal(False)
+            if up == "CASE":
+                return self.parse_case()
+            self.next()
+            if self.at_op("("):
+                return self.parse_function_call(t.text)
+            return Identifier(t.text)
+        raise SqlParseError(f"unexpected token {t.text!r} at position {t.pos}")
+
+    def parse_function_call(self, name: str) -> Expr:
+        self.expect_op("(")
+        if self.accept_op(")"):
+            return Function(name, ())
+        if self.accept_keyword("DISTINCT"):
+            # COUNT(DISTINCT x) -> distinctcount(x), like the reference rewrite
+            args = self.parse_expr_list()
+            self.expect_op(")")
+            if name.lower() == "count":
+                return Function("distinctcount", args)
+            raise SqlParseError(f"DISTINCT not supported inside {name}")
+        args = self.parse_expr_list()
+        self.expect_op(")")
+        return Function(name, args)
+
+    def parse_case(self) -> Expr:
+        """CASE WHEN cond THEN v [...] [ELSE v] END ->
+        case(cond1, v1, cond2, v2, ..., else)."""
+        self.expect_keyword("CASE")
+        args: List[Expr] = []
+        while self.accept_keyword("WHEN"):
+            cond = self.parse_bool_expr()
+            self.expect_keyword("THEN")
+            val = self.parse_expr()
+            args.append(_FilterExpr(cond))
+            args.append(val)
+        if self.accept_keyword("ELSE"):
+            args.append(self.parse_expr())
+        else:
+            args.append(Literal(None))
+        self.expect_keyword("END")
+        return Function("case", args)
+
+
+@dataclass(frozen=True)
+class _FilterExpr(Expr):
+    """A boolean filter used in expression position (CASE WHEN)."""
+
+    filter: FilterNode
+
+    def _collect_columns(self, out) -> None:
+        out.extend(self.filter.columns())
+
+    def __str__(self) -> str:
+        return str(self.filter)
+
+
+def parse_sql(sql: str) -> ParsedQuery:
+    """Public entry (ref: CalciteSqlParser.compileToPinotQuery)."""
+    return _Parser(sql.strip().rstrip(";")).parse()

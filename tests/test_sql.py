@@ -1,0 +1,226 @@
+"""SQL parser + query context + optimizer tests
+(mirrors pinot-common CalciteSqlCompilerTest coverage areas)."""
+
+import pytest
+
+from pinot_tpu.query import (
+    FilterOp,
+    Function,
+    Identifier,
+    Literal,
+    PredicateType,
+    SqlParseError,
+    compile_query,
+    parse_sql,
+)
+from pinot_tpu.query.optimizer import like_to_regex
+
+
+class TestParser:
+    def test_basic_selection(self):
+        q = parse_sql("SELECT a, b FROM tbl LIMIT 5")
+        assert q.table == "tbl"
+        assert [str(e) for e, _ in q.select] == ["a", "b"]
+        assert q.limit == 5 and q.offset == 0
+
+    def test_star(self):
+        q = parse_sql("select * from tbl")
+        assert q.select[0][0] == Identifier("*")
+
+    def test_default_limit_is_10(self):
+        assert parse_sql("SELECT a FROM t").limit == 10
+
+    def test_aliases(self):
+        q = parse_sql("SELECT a AS x, sum(b) total FROM t GROUP BY x")
+        assert q.select[0][1] == "x"
+        assert q.select[1][1] == "total"
+
+    def test_where_comparisons(self):
+        q = parse_sql("SELECT a FROM t WHERE b = 3 AND c > 1.5 AND d <= 'x'")
+        node = q.where
+        assert node.op is FilterOp.AND
+        types = [c.predicate.type for c in node.children]
+        assert types == [PredicateType.EQ, PredicateType.RANGE, PredicateType.RANGE]
+        rng = node.children[1].predicate
+        assert rng.lower == 1.5 and not rng.lower_inclusive and rng.upper is None
+
+    def test_swapped_comparison(self):
+        q = parse_sql("SELECT a FROM t WHERE 5 < b")
+        p = q.where.predicate
+        assert p.type is PredicateType.RANGE and p.lower == 5
+
+    def test_between_in_like(self):
+        q = parse_sql("SELECT a FROM t WHERE a BETWEEN 1 AND 10 "
+                      "AND b IN ('x','y') AND c NOT IN (1) AND d LIKE 'ab%'")
+        ps = [c.predicate for c in q.where.children]
+        assert ps[0].type is PredicateType.RANGE and ps[0].lower_inclusive and ps[0].upper_inclusive
+        assert ps[1].type is PredicateType.IN and ps[1].values == ("x", "y")
+        assert ps[2].type is PredicateType.NOT_IN
+        assert ps[3].type is PredicateType.LIKE
+
+    def test_is_null(self):
+        q = parse_sql("SELECT a FROM t WHERE b IS NULL OR c IS NOT NULL")
+        ps = [c.predicate for c in q.where.children]
+        assert ps[0].type is PredicateType.IS_NULL
+        assert ps[1].type is PredicateType.IS_NOT_NULL
+
+    def test_not_and_grouping(self):
+        q = parse_sql("SELECT a FROM t WHERE NOT (a = 1 OR b = 2) AND c = 3")
+        assert q.where.op is FilterOp.AND
+        assert q.where.children[0].op is FilterOp.NOT
+        assert q.where.children[0].children[0].op is FilterOp.OR
+
+    def test_parenthesized_arithmetic_in_predicate(self):
+        q = parse_sql("SELECT a FROM t WHERE (a + 1) * 2 > 6")
+        p = q.where.predicate
+        assert p.type is PredicateType.RANGE
+        assert str(p.lhs) == "times(plus(a,1),2)"
+
+    def test_function_predicates(self):
+        q = parse_sql("SELECT a FROM t WHERE regexp_like(b, '^x.*') AND text_match(c, 'foo')")
+        ps = [c.predicate for c in q.where.children]
+        assert ps[0].type is PredicateType.REGEXP_LIKE
+        assert ps[1].type is PredicateType.TEXT_MATCH
+
+    def test_arithmetic_canonical_functions(self):
+        q = parse_sql("SELECT a + b * 2 - c FROM t")
+        assert str(q.select[0][0]) == "minus(plus(a,times(b,2)),c)"
+
+    def test_unary_minus(self):
+        q = parse_sql("SELECT a FROM t WHERE b > -5")
+        assert q.where.predicate.lower == -5
+
+    def test_string_escapes(self):
+        q = parse_sql("SELECT a FROM t WHERE b = 'it''s'")
+        assert q.where.predicate.value == "it's"
+
+    def test_quoted_identifiers(self):
+        q = parse_sql('SELECT "select" FROM t WHERE "group" = 1')
+        assert str(q.select[0][0]) == "select"
+
+    def test_order_limit_offset(self):
+        q = parse_sql("SELECT a FROM t ORDER BY a DESC, b LIMIT 7 OFFSET 3")
+        assert not q.order_by[0].ascending and q.order_by[1].ascending
+        assert q.limit == 7 and q.offset == 3
+        q2 = parse_sql("SELECT a FROM t LIMIT 3, 7")  # MySQL style
+        assert q2.limit == 7 and q2.offset == 3
+
+    def test_options(self):
+        q = parse_sql("SELECT a FROM t OPTION(timeoutMs=100, useStarTree=false)")
+        assert q.options == {"timeoutMs": "100", "useStarTree": "false"}
+
+    def test_count_distinct_rewrite(self):
+        q = parse_sql("SELECT COUNT(DISTINCT a) FROM t")
+        assert str(q.select[0][0]) == "distinctcount(a)"
+
+    def test_case_when(self):
+        q = parse_sql("SELECT CASE WHEN a > 1 THEN 'hi' ELSE 'lo' END FROM t")
+        f = q.select[0][0]
+        assert isinstance(f, Function) and f.name == "case"
+
+    def test_distinct(self):
+        assert parse_sql("SELECT DISTINCT a, b FROM t").distinct
+
+    def test_trailing_semicolon(self):
+        assert parse_sql("SELECT a FROM t;").table == "t"
+
+    def test_errors(self):
+        for bad in ["SELECT", "SELECT a", "SELECT a FROM", "SELECT a FROM t WHERE",
+                    "SELECT a FROM t WHERE b ==", "SELECT a FROM t garbage here",
+                    "SELECT a FROM t WHERE b = c"]:
+            with pytest.raises(SqlParseError):
+                parse_sql(bad)
+
+
+class TestQueryContext:
+    def test_aggregation_extraction(self):
+        ctx = compile_query("SELECT sum(a), max(b), count(*) FROM t")
+        assert [f.name for f in ctx.aggregations] == ["sum", "max", "count"]
+        assert ctx.is_aggregation and not ctx.is_group_by
+
+    def test_post_aggregation(self):
+        ctx = compile_query("SELECT sum(a) / count(a) FROM t")
+        assert [f.name for f in ctx.aggregations] == ["sum", "count"]
+
+    def test_group_by_alias_and_ordinal(self):
+        ctx = compile_query("SELECT team t, sum(runs) FROM x GROUP BY 1 ORDER BY 2 DESC")
+        assert str(ctx.group_by[0]) == "team"
+        assert str(ctx.order_by[0].expr) == "sum(runs)"
+
+    def test_having_aggregations_collected(self):
+        ctx = compile_query("SELECT team, sum(r) FROM x GROUP BY team HAVING min(r) > 2")
+        assert {f.name for f in ctx.aggregations} == {"sum", "min"}
+
+    def test_referenced_columns(self):
+        ctx = compile_query(
+            "SELECT sum(a) FROM t WHERE b = 1 GROUP BY c ORDER BY sum(a)")
+        assert ctx.referenced_columns() == ["a", "b", "c"]
+
+    def test_count_star_columns(self):
+        ctx = compile_query("SELECT count(*) FROM t")
+        assert ctx.referenced_columns() == []
+
+    def test_selection_query(self):
+        ctx = compile_query("SELECT a, b FROM t WHERE c > 1 ORDER BY a LIMIT 5")
+        assert ctx.is_selection
+
+    def test_percentile_variants(self):
+        ctx = compile_query("SELECT percentile95(lat), percentiletdigest90(lat) FROM t")
+        assert [f.name for f in ctx.aggregations] == ["percentile95", "percentiletdigest90"]
+
+
+class TestOptimizer:
+    def test_flatten_and(self):
+        ctx = compile_query("SELECT a FROM t WHERE (a=1 AND b=2) AND c=3")
+        assert ctx.filter.op is FilterOp.AND
+        assert len(ctx.filter.children) == 3
+
+    def test_merge_eq_to_in(self):
+        ctx = compile_query("SELECT a FROM t WHERE b='x' OR b='y' OR b='z'")
+        p = ctx.filter.predicate
+        assert p.type is PredicateType.IN
+        assert set(p.values) == {"x", "y", "z"}
+
+    def test_merge_ranges(self):
+        ctx = compile_query("SELECT a FROM t WHERE b > 1 AND b <= 10 AND b >= 2")
+        p = ctx.filter.predicate
+        assert p.type is PredicateType.RANGE
+        assert p.lower == 2 and p.lower_inclusive
+        assert p.upper == 10 and p.upper_inclusive
+
+    def test_like_rewrite(self):
+        ctx = compile_query("SELECT a FROM t WHERE b LIKE 'ab%c_'")
+        p = ctx.filter.predicate
+        assert p.type is PredicateType.REGEXP_LIKE
+        assert p.value == "^ab.*c.$"
+
+    def test_like_to_regex_escaping(self):
+        assert like_to_regex("a.b%") == r"^a\.b.*$"
+
+    def test_constant_folding(self):
+        ctx = compile_query("SELECT a + 2 * 3 FROM t")
+        assert str(ctx.select_expressions[0]) == "plus(a,6)"
+
+    def test_folding_consistent_across_clauses(self):
+        # select/order_by/having/where must fold identically (expression
+        # identity keys the jit cache and result-column matching)
+        ctx = compile_query("SELECT sum(a) * (1 + 1) FROM t "
+                            "WHERE b > 2 + 3 ORDER BY sum(a) * (1 + 1)")
+        assert ctx.select_expressions[0] == ctx.order_by[0].expr
+        assert ctx.filter.predicate.lower == 5
+
+    def test_ordinal_only_at_top_level(self):
+        # ORDER BY a + 1 is arithmetic, not ordinal 1 (regression)
+        ctx = compile_query("SELECT a, b FROM t ORDER BY a + 1")
+        assert str(ctx.order_by[0].expr) == "plus(a,1)"
+        ctx2 = compile_query("SELECT a, b FROM t GROUP BY mod(a, 2)")
+        assert str(ctx2.group_by[0]) == "mod(a,2)"
+
+    def test_mixed_type_range_merge_survives(self):
+        # must not crash with TypeError (regression)
+        ctx = compile_query("SELECT a FROM t WHERE b > 1 AND b > 'x'")
+        assert len(ctx.filter.children) == 2
+
+    def test_fractional_limit_rejected(self):
+        with pytest.raises(SqlParseError):
+            parse_sql("SELECT a FROM t LIMIT 1.5")

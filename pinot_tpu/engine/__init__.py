@@ -1,0 +1,31 @@
+"""TPU query execution engine (ref: pinot-core query engine, SURVEY.md 2.4).
+
+The per-segment Filter -> Projection -> Transform -> Aggregation chain runs
+as fused masked vector ops under jax.jit (kernels.py), planned per query
+structure (plan.py), with host paths for selection/distinct/fallback
+(host_engine.py) and reduce-side merging (results.py).
+"""
+
+def ensure_x64() -> None:
+    """Enable 64-bit jax types for exact OLAP semantics (reference aggregates
+    in double/long). Called at executor/session setup — not at import — so
+    importing this package does not flip process-global jax config. On TPU
+    f64/i64 are emulated (f32-pairs); metadata-driven narrowing to f32/i32 is
+    the planned optimization for the hot kernels."""
+    import jax
+
+    jax.config.update("jax_enable_x64", True)
+
+
+from pinot_tpu.engine.errors import QueryError, UnsupportedQueryError
+from pinot_tpu.engine.executor import ServerQueryExecutor
+from pinot_tpu.engine.results import DataSchema, QueryStats, ResultTable
+
+__all__ = [
+    "QueryError",
+    "UnsupportedQueryError",
+    "ServerQueryExecutor",
+    "DataSchema",
+    "QueryStats",
+    "ResultTable",
+]

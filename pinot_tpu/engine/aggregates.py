@@ -1,0 +1,278 @@
+"""Aggregation function library.
+
+Re-design of ``pinot-core/.../query/aggregation/function/*`` (50 files): each
+function defines (a) an intermediate *state* that partials from different
+segments/servers merge into (the analogue of the reference's intermediate
+result + ``merge()``), (b) host (numpy) computation, and (c) whether the
+per-segment partial can be computed by the device kernels (kernels.py emits
+the jax ops by function name).
+
+States are plain python values/tuples so they serialize over the wire
+(ref: ObjectSerDeUtils custom serde).
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from pinot_tpu.engine.errors import QueryError, UnsupportedQueryError
+from pinot_tpu.query.expressions import Expr, Function, Identifier, Literal
+
+POS_INF = float("inf")
+NEG_INF = float("-inf")
+
+
+@dataclass
+class AggDef:
+    """One aggregation function's behavior."""
+
+    name: str               # canonical lower-case (incl. percentile suffix)
+    base: str               # family: count/sum/min/.../percentile
+    mv: bool                # MV variant (arg is a multi-value column)
+    percentile: Optional[float] = None  # percentile family only
+    device_scalar: bool = True    # device kernel for filtered scalar agg
+    device_grouped: bool = True   # device kernel for group-by agg
+    result_type: str = "DOUBLE"   # DataSchema column type of the final value
+
+    # ---- state algebra ---------------------------------------------------
+    def empty_state(self) -> Any:
+        return _EMPTY[self.base]() if callable(_EMPTY[self.base]) else _EMPTY[self.base]
+
+    def merge(self, a: Any, b: Any) -> Any:
+        return _MERGE[self.base](a, b)
+
+    def finalize(self, state: Any) -> Any:
+        return _FINAL[self.base](self, state)
+
+    # ---- host computation ------------------------------------------------
+    def compute_host(self, values: Optional[np.ndarray],
+                     mask: np.ndarray) -> Any:
+        """Scalar aggregation over filtered docs. ``values`` is per-doc for SV
+        functions; for MV functions it is a list-of-arrays per doc."""
+        return _HOST[self.base](self, values, mask)
+
+
+# --------------------------------------------------------------------------
+# state algebra per family
+# --------------------------------------------------------------------------
+
+_EMPTY: Dict[str, Any] = {
+    "count": 0,
+    "sum": 0.0,
+    "min": POS_INF,
+    "max": NEG_INF,
+    "avg": (0.0, 0),
+    "minmaxrange": (POS_INF, NEG_INF),
+    "distinctcount": frozenset(),
+    "mode": dict,
+    "percentile": tuple,
+}
+
+_MERGE: Dict[str, Callable[[Any, Any], Any]] = {
+    "count": lambda a, b: a + b,
+    "sum": lambda a, b: a + b,
+    "min": lambda a, b: min(a, b),
+    "max": lambda a, b: max(a, b),
+    "avg": lambda a, b: (a[0] + b[0], a[1] + b[1]),
+    "minmaxrange": lambda a, b: (min(a[0], b[0]), max(a[1], b[1])),
+    "distinctcount": lambda a, b: frozenset(a) | frozenset(b),
+    "mode": lambda a, b: _merge_counts(a, b),
+    "percentile": lambda a, b: tuple(a) + tuple(b),
+}
+
+
+def _merge_counts(a: Dict, b: Dict) -> Dict:
+    out = dict(a)
+    for k, v in b.items():
+        out[k] = out.get(k, 0) + v
+    return out
+
+
+def _final_avg(d: AggDef, s) -> float:
+    # ref: AvgAggregationFunction — sum/count, NEGATIVE_INFINITY for empty
+    return s[0] / s[1] if s[1] else NEG_INF
+
+
+def _final_percentile(d: AggDef, s) -> float:
+    vals = np.sort(np.asarray(s, dtype=np.float64))
+    if vals.size == 0:
+        return NEG_INF
+    # ref: PercentileAggregationFunction.extractFinalResult
+    idx = int(vals.size * d.percentile / 100.0)
+    return float(vals[min(idx, vals.size - 1)])
+
+
+_FINAL: Dict[str, Callable[[AggDef, Any], Any]] = {
+    "count": lambda d, s: int(s),
+    "sum": lambda d, s: float(s),
+    "min": lambda d, s: float(s),
+    "max": lambda d, s: float(s),
+    "avg": _final_avg,
+    "minmaxrange": lambda d, s: float(s[1] - s[0]),
+    "distinctcount": lambda d, s: len(s),
+    "mode": lambda d, s: (float(max(s, key=lambda k: (s[k], k))) if s else NEG_INF),
+    "percentile": _final_percentile,
+}
+
+
+# --------------------------------------------------------------------------
+# host computation per family
+# --------------------------------------------------------------------------
+
+def _host_count(d: AggDef, values, mask) -> int:
+    if d.mv:
+        return int(sum(len(v) for v, m in zip(values, mask) if m))
+    return int(np.count_nonzero(mask))
+
+
+def _flat_filtered(d: AggDef, values, mask) -> np.ndarray:
+    """Filtered values flattened (MV: all values of matching docs)."""
+    if d.mv:
+        parts = [np.asarray(v, dtype=np.float64)
+                 for v, m in zip(values, mask) if m and len(v)]
+        return (np.concatenate(parts) if parts
+                else np.empty(0, dtype=np.float64))
+    return np.asarray(values, dtype=np.float64)[mask]
+
+
+def _host_sum(d: AggDef, values, mask) -> float:
+    return float(_flat_filtered(d, values, mask).sum())
+
+
+def _host_min(d: AggDef, values, mask) -> float:
+    v = _flat_filtered(d, values, mask)
+    return float(v.min()) if v.size else POS_INF
+
+
+def _host_max(d: AggDef, values, mask) -> float:
+    v = _flat_filtered(d, values, mask)
+    return float(v.max()) if v.size else NEG_INF
+
+
+def _host_avg(d: AggDef, values, mask):
+    v = _flat_filtered(d, values, mask)
+    return (float(v.sum()), int(v.size))
+
+
+def _host_minmaxrange(d: AggDef, values, mask):
+    v = _flat_filtered(d, values, mask)
+    if not v.size:
+        return (POS_INF, NEG_INF)
+    return (float(v.min()), float(v.max()))
+
+
+def _host_distinctcount(d: AggDef, values, mask):
+    if d.mv:
+        out = set()
+        for v, m in zip(values, mask):
+            if m:
+                out.update(v)
+        return frozenset(out)
+    vals = np.asarray(values, dtype=object)[mask] if getattr(values, "dtype", None) == object \
+        else np.asarray(values)[mask]
+    return frozenset(np.unique(vals).tolist())
+
+
+def _host_mode(d: AggDef, values, mask):
+    v = _flat_filtered(d, values, mask)
+    uniq, counts = np.unique(v, return_counts=True)
+    return {float(u): int(c) for u, c in zip(uniq, counts)}
+
+
+def _host_percentile(d: AggDef, values, mask):
+    return tuple(_flat_filtered(d, values, mask).tolist())
+
+
+_HOST: Dict[str, Callable] = {
+    "count": _host_count,
+    "sum": _host_sum,
+    "min": _host_min,
+    "max": _host_max,
+    "avg": _host_avg,
+    "minmaxrange": _host_minmaxrange,
+    "distinctcount": _host_distinctcount,
+    "mode": _host_mode,
+    "percentile": _host_percentile,
+}
+
+
+# --------------------------------------------------------------------------
+# registry / resolution
+# --------------------------------------------------------------------------
+
+_RESULT_TYPE = {
+    "count": "LONG",
+    "sum": "DOUBLE",
+    "min": "DOUBLE",
+    "max": "DOUBLE",
+    "avg": "DOUBLE",
+    "minmaxrange": "DOUBLE",
+    "distinctcount": "INT",
+    "mode": "DOUBLE",
+    "percentile": "DOUBLE",
+}
+
+# families with device kernels (kernels.py); others run on the host path
+_DEVICE_SCALAR = {"count", "sum", "min", "max", "avg", "minmaxrange",
+                  "distinctcount"}
+_DEVICE_GROUPED = {"count", "sum", "min", "max", "avg", "minmaxrange"}
+
+
+def resolve_agg(fn: Function) -> AggDef:
+    """Canonical Function -> AggDef (ref: AggregationFunctionFactory)."""
+    name = fn.name
+    mv = name.endswith("mv")
+    base_name = name[:-2] if mv else name
+
+    percentile = None
+    for prefix in ("percentiletdigest", "percentileest", "percentile"):
+        if base_name.startswith(prefix):
+            digits = base_name[len(prefix):]
+            if digits.isdigit():
+                percentile = float(digits)
+                base_name = prefix
+                break
+            if digits == "":
+                # percentile(col, N) 2-arg form
+                if len(fn.args) >= 2 and isinstance(fn.args[1], Literal):
+                    percentile = float(fn.args[1].value)
+                    base_name = prefix
+                    break
+                raise QueryError(f"{name} requires a percentile argument")
+
+    family = {
+        "count": "count", "sum": "sum", "min": "min", "max": "max",
+        "avg": "avg", "minmaxrange": "minmaxrange",
+        "distinctcount": "distinctcount", "distinctcountbitmap": "distinctcount",
+        "segmentpartitioneddistinctcount": "distinctcount",
+        "mode": "mode",
+        "percentile": "percentile", "percentileest": "percentile",
+        "percentiletdigest": "percentile",
+    }.get(base_name)
+    if family is None:
+        raise UnsupportedQueryError(f"aggregation function {name!r} not supported")
+
+    return AggDef(
+        name=name,
+        base=family,
+        mv=mv,
+        percentile=percentile,
+        device_scalar=(family in _DEVICE_SCALAR) and not mv or (mv and family in
+                      {"count", "sum", "min", "max", "avg"}),
+        device_grouped=(family in _DEVICE_GROUPED) and not mv,
+        result_type=_RESULT_TYPE[family],
+    )
+
+
+def agg_value_expr(fn: Function) -> Optional[Expr]:
+    """The expression aggregated over, or None for COUNT(*)."""
+    if not fn.args:
+        return None
+    a0 = fn.args[0]
+    if isinstance(a0, Identifier) and a0.name == "*":
+        return None
+    return a0

@@ -1,0 +1,14 @@
+"""Engine exceptions (ref: BadQueryRequestException / QueryException codes)."""
+
+
+class QueryError(Exception):
+    """User-facing query error (bad request, type mismatch, unsupported)."""
+
+    def __init__(self, message: str, code: int = 700):
+        super().__init__(message)
+        self.code = code
+
+
+class UnsupportedQueryError(QueryError):
+    def __init__(self, message: str):
+        super().__init__(message, code=150)

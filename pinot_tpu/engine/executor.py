@@ -1,0 +1,254 @@
+"""Segment query executor: per-segment execution + instance-level combine.
+
+Re-design of ``ServerQueryExecutorV1Impl.java:75`` +
+``BaseCombineOperator.java:55``: dispatches each query to the device kernels
+(aggregation/group-by), the host paths (selection/distinct/fallback), or the
+metadata fast paths (ref: MetadataBasedAggregationOperator /
+DictionaryBasedAggregationOperator, AggregationPlanNode.java:172-181), then
+merges per-segment partials and reduces to a ResultTable.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from pinot_tpu.engine import host_engine
+from pinot_tpu.engine.aggregates import AggDef, agg_value_expr, resolve_agg
+from pinot_tpu.engine.errors import QueryError
+from pinot_tpu.engine.kernels import KernelCache
+from pinot_tpu.engine.plan import PlanError, SegmentPlan, plan_segment
+from pinot_tpu.engine.results import (
+    AggResult,
+    GroupByResult,
+    QueryStats,
+    ResultTable,
+    reduce_aggregation,
+    reduce_group_by,
+)
+from pinot_tpu.engine.staging import StagingCache
+from pinot_tpu.query.context import QueryContext
+from pinot_tpu.query.expressions import Identifier
+from pinot_tpu.segment.immutable import ImmutableSegment
+from pinot_tpu.spi.config import CommonConstants
+
+
+class ServerQueryExecutor:
+    """One per server instance; owns the staging + kernel caches."""
+
+    def __init__(self, use_device: bool = True,
+                 num_groups_limit: int = CommonConstants.DEFAULT_NUM_GROUPS_LIMIT):
+        from pinot_tpu.engine import ensure_x64
+
+        ensure_x64()
+        self.staging = StagingCache()
+        self.kernels = KernelCache()
+        self.use_device = use_device
+        self.num_groups_limit = num_groups_limit
+
+    # -- public ------------------------------------------------------------
+    def execute(self, ctx: QueryContext,
+                segments: List[ImmutableSegment]) -> Tuple[ResultTable, QueryStats]:
+        stats = QueryStats(num_segments_queried=len(segments))
+        if not segments:
+            raise QueryError(f"no segments for table {ctx.table_name!r}")
+        self._validate_columns(ctx, segments[0])
+
+        if ctx.distinct:
+            return host_engine.execute_distinct(ctx, segments, stats), stats
+        if ctx.is_selection:
+            return host_engine.execute_selection(ctx, segments, stats), stats
+
+        aggs = [resolve_agg(f) for f in ctx.aggregations]
+        if ctx.is_group_by:
+            merged = self._execute_group_by(ctx, aggs, segments, stats)
+            if merged.trim(self.num_groups_limit):
+                stats.num_groups_limit_reached = True
+            schema_types = self._schema_types(segments[0])
+            return reduce_group_by(ctx, aggs, merged, schema_types), stats
+
+        merged_agg = self._execute_aggregation(ctx, aggs, segments, stats)
+        return reduce_aggregation(ctx, aggs, merged_agg), stats
+
+    # -- aggregation (no group-by) ----------------------------------------
+    def _execute_aggregation(self, ctx: QueryContext, aggs: List[AggDef],
+                             segments: List[ImmutableSegment],
+                             stats: QueryStats) -> AggResult:
+        merged: Optional[AggResult] = None
+        for seg in segments:
+            part = self._segment_aggregation(ctx, aggs, seg, stats)
+            if merged is None:
+                merged = part
+            else:
+                merged.merge(part, aggs)
+        return merged
+
+    def _segment_aggregation(self, ctx: QueryContext, aggs: List[AggDef],
+                             seg: ImmutableSegment,
+                             stats: QueryStats) -> AggResult:
+        fast = self._metadata_fast_path(ctx, aggs, seg, stats)
+        if fast is not None:
+            return fast
+        if self.use_device:
+            try:
+                plan = plan_segment(ctx, seg)
+                return self._run_device_scalar(plan, seg, stats)
+            except PlanError:
+                pass
+        return host_engine.host_aggregate_segment(ctx, aggs, seg, stats)
+
+    def _metadata_fast_path(self, ctx: QueryContext, aggs: List[AggDef],
+                            seg: ImmutableSegment,
+                            stats: QueryStats) -> Optional[AggResult]:
+        """Filter-less COUNT(*)/MIN/MAX answered from metadata
+        (ref: MetadataBasedAggregationOperator, DictionaryBasedAggregationOperator)."""
+        if ctx.filter is not None or ctx.is_group_by:
+            return None
+        states: List[Any] = []
+        for agg, fn in zip(aggs, ctx.aggregations):
+            vexpr = agg_value_expr(fn)
+            if agg.base == "count" and not agg.mv and vexpr is None:
+                states.append(seg.num_docs)
+                continue
+            if (agg.base in ("min", "max", "minmaxrange") and not agg.mv
+                    and isinstance(vexpr, Identifier)):
+                cm = seg.metadata.columns.get(vexpr.name)
+                if (cm is not None and cm.data_type.is_numeric
+                        and not cm.has_nulls and cm.min_value is not None):
+                    lo, hi = float(cm.min_value), float(cm.max_value)
+                    states.append(lo if agg.base == "min" else
+                                  hi if agg.base == "max" else (lo, hi))
+                    continue
+            return None
+        stats.num_segments_processed += 1
+        stats.num_segments_matched += 1
+        stats.total_docs += seg.num_docs
+        return AggResult(states)
+
+    def _run_device_scalar(self, plan: SegmentPlan, seg: ImmutableSegment,
+                           stats: QueryStats) -> AggResult:
+        out = self._run_kernel(plan, seg, stats)
+        agg_specs = plan.spec[1]
+        states: List[Any] = []
+        for i, (agg, aspec) in enumerate(zip(plan.agg_defs, agg_specs)):
+            raw = out[f"agg{i}"]
+            states.append(self._decode_scalar_state(agg, aspec, raw, seg))
+        return AggResult(states)
+
+    def _decode_scalar_state(self, agg: AggDef, aspec: Tuple, raw: Any,
+                             seg: ImmutableSegment) -> Any:
+        if aspec[0] == "distinctcount":
+            presence = np.asarray(raw)
+            ids = np.nonzero(presence)[0]
+            d = seg.data_source(aspec[1]).dictionary
+            return frozenset(d.get_values(ids))
+        base = aspec[0]
+        if base == "count":
+            return int(raw)
+        if base in ("sum", "min", "max"):
+            return float(raw)
+        if base == "avg":
+            return (float(raw[0]), int(raw[1]))
+        if base == "minmaxrange":
+            return (float(raw[0]), float(raw[1]))
+        raise AssertionError(base)
+
+    # -- group-by ----------------------------------------------------------
+    def _execute_group_by(self, ctx: QueryContext, aggs: List[AggDef],
+                          segments: List[ImmutableSegment],
+                          stats: QueryStats) -> GroupByResult:
+        merged = GroupByResult()
+        for seg in segments:
+            part = self._segment_group_by(ctx, aggs, seg, stats)
+            merged.merge(part, aggs)
+        return merged
+
+    def _segment_group_by(self, ctx: QueryContext, aggs: List[AggDef],
+                          seg: ImmutableSegment,
+                          stats: QueryStats) -> GroupByResult:
+        if self.use_device:
+            try:
+                plan = plan_segment(ctx, seg)
+                return self._run_device_grouped(plan, seg, stats)
+            except PlanError:
+                pass
+        return host_engine.host_group_by_segment(ctx, aggs, seg, stats)
+
+    def _run_device_grouped(self, plan: SegmentPlan, seg: ImmutableSegment,
+                            stats: QueryStats) -> GroupByResult:
+        out = self._run_kernel(plan, seg, stats)
+        presence = np.asarray(out["presence"])
+        gidx = np.nonzero(presence)[0]
+        result = GroupByResult()
+        if gidx.size == 0:
+            return result
+
+        # decode composed keys -> per-column dictIds -> values, using the
+        # planner's own strides (single source of truth for key layout)
+        cards = plan.group_cards
+        strides = plan.group_strides.astype(np.int64)
+        key_cols: List[List[Any]] = []
+        for i, ((strat, col), card) in enumerate(zip(plan.group_defs, cards)):
+            dids = (gidx // strides[i]) % card
+            if strat == "gdict":
+                d = seg.data_source(col).dictionary
+                key_cols.append(d.get_values(dids))
+            else:  # graw value-space
+                base = int(seg.metadata.column(col).min_value)
+                key_cols.append([int(x) + base for x in dids])
+        keys = list(zip(*key_cols))
+
+        agg_specs = plan.spec[1]
+        states_per_agg: List[List[Any]] = []
+        for i, (agg, aspec) in enumerate(zip(plan.agg_defs, agg_specs)):
+            raw = out[f"agg{i}"]
+            base = aspec[0]
+            if base == "count":
+                arr = np.asarray(raw)[gidx]
+                states_per_agg.append([int(v) for v in arr])
+            elif base in ("sum", "min", "max"):
+                arr = np.asarray(raw)[gidx]
+                states_per_agg.append([float(v) for v in arr])
+            elif base == "avg":
+                s = np.asarray(raw[0])[gidx]
+                c = np.asarray(raw[1])[gidx]
+                states_per_agg.append([(float(a), int(b)) for a, b in zip(s, c)])
+            elif base == "minmaxrange":
+                lo = np.asarray(raw[0])[gidx]
+                hi = np.asarray(raw[1])[gidx]
+                states_per_agg.append([(float(a), float(b)) for a, b in zip(lo, hi)])
+            else:
+                raise AssertionError(base)
+
+        for gi, key in enumerate(keys):
+            result.groups[key] = [states_per_agg[ai][gi]
+                                  for ai in range(len(plan.agg_defs))]
+        return result
+
+    # -- shared ------------------------------------------------------------
+    def _run_kernel(self, plan: SegmentPlan, seg: ImmutableSegment,
+                    stats: QueryStats) -> Dict[str, Any]:
+        staged = self.staging.stage(seg)
+        cols = {name: staged.column(name).tree() for name in plan.columns}
+        kernel = self.kernels.get(plan.spec)
+        out = kernel(cols, tuple(plan.params), np.int32(seg.num_docs))
+        stats.num_segments_processed += 1
+        stats.total_docs += seg.num_docs
+        matched = int(out.get("num_matched",
+                              np.asarray(out.get("presence", [0])).sum()))
+        stats.num_docs_scanned += matched
+        stats.num_segments_matched += 1 if matched else 0
+        return out
+
+    def _validate_columns(self, ctx: QueryContext,
+                          seg: ImmutableSegment) -> None:
+        known = set(seg.metadata.columns.keys())
+        for c in ctx.referenced_columns():
+            if c not in known:
+                raise QueryError(f"unknown column {c!r} in table "
+                                 f"{ctx.table_name!r}")
+
+    def _schema_types(self, seg: ImmutableSegment) -> Dict[str, str]:
+        return {name: cm.data_type.label
+                for name, cm in seg.metadata.columns.items()}

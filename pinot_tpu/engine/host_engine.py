@@ -1,0 +1,314 @@
+"""Host (numpy) execution paths.
+
+Covers what the device kernels don't: selection queries (pure data movement),
+DISTINCT, and aggregation shapes outside the device planner's coverage
+(exotic aggregations, expression group-bys, MV group-bys). Also the execution
+path for host-resident (consuming) segments. Doubles as the oracle the device
+kernels are tested against.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from pinot_tpu.engine.aggregates import AggDef, agg_value_expr, resolve_agg
+from pinot_tpu.engine.errors import QueryError, UnsupportedQueryError
+from pinot_tpu.engine.host_eval import eval_expr_values, eval_filter, read_values
+from pinot_tpu.engine.results import (
+    AggResult,
+    DataSchema,
+    GroupByResult,
+    QueryStats,
+    ResultTable,
+)
+from pinot_tpu.query.context import QueryContext
+from pinot_tpu.query.expressions import Expr, Function, Identifier, Literal
+from pinot_tpu.segment.immutable import ImmutableSegment
+from pinot_tpu.spi.data import Schema
+
+
+# --------------------------------------------------------------------------
+# column helpers
+# --------------------------------------------------------------------------
+
+def _expand_select(ctx: QueryContext, schema: Schema) -> List[Expr]:
+    out: List[Expr] = []
+    for e in ctx.select_expressions:
+        if isinstance(e, Identifier) and e.name == "*":
+            out.extend(Identifier(c) for c in schema.column_names)
+        else:
+            out.append(e)
+    return out
+
+
+def _column_type(segment: ImmutableSegment, e: Expr) -> str:
+    if isinstance(e, Identifier) and e.name in segment.metadata.columns:
+        cm = segment.metadata.column(e.name)
+        label = cm.data_type.label
+        return label if cm.single_value else label + "_ARRAY"
+    if isinstance(e, Literal):
+        return "STRING" if isinstance(e.value, str) else "DOUBLE"
+    return "DOUBLE"
+
+
+def _select_values(segment: ImmutableSegment, e: Expr,
+                   doc_ids: np.ndarray) -> List[Any]:
+    if isinstance(e, Identifier):
+        return read_values(segment, e.name, doc_ids)
+    vals = eval_expr_values(segment, e, doc_ids)
+    return [v.item() if hasattr(v, "item") else v for v in vals]
+
+
+# --------------------------------------------------------------------------
+# selection
+# --------------------------------------------------------------------------
+
+def execute_selection(ctx: QueryContext, segments: List[ImmutableSegment],
+                      stats: Optional[QueryStats] = None) -> ResultTable:
+    """Ref: SelectionOnlyOperator / SelectionOrderByOperator + reducer."""
+    if not segments:
+        raise QueryError("no segments to query")
+    schema = segments[0].metadata.schema
+    select = _expand_select(ctx, schema)
+    names = _select_names(ctx, select)
+    types = [_column_type(segments[0], e) for e in select]
+    need = ctx.offset + ctx.limit
+
+    if not ctx.order_by:
+        rows: List[List[Any]] = []
+        for seg in segments:
+            if len(rows) >= need:
+                break
+            mask = eval_filter(seg, ctx.filter)
+            _track(stats, seg, mask)
+            doc_ids = np.nonzero(mask)[0][: need - len(rows)]
+            if doc_ids.size == 0:
+                continue
+            cols = [_select_values(seg, e, doc_ids) for e in select]
+            rows.extend([list(r) for r in zip(*cols)])
+        return ResultTable(DataSchema(names, types),
+                           rows[ctx.offset: ctx.offset + ctx.limit])
+
+    # ordered selection: collect order keys from all segments, sort, gather
+    candidates: List[Tuple[int, np.ndarray, List[np.ndarray]]] = []
+    for si, seg in enumerate(segments):
+        mask = eval_filter(seg, ctx.filter)
+        _track(stats, seg, mask)
+        doc_ids = np.nonzero(mask)[0]
+        if doc_ids.size == 0:
+            continue
+        keys = [_order_key_array(seg, ob.expr, doc_ids) for ob in ctx.order_by]
+        candidates.append((si, doc_ids, keys))
+    if not candidates:
+        return ResultTable(DataSchema(names, types), [])
+
+    seg_idx = np.concatenate([np.full(len(d), si) for si, d, _ in candidates])
+    docs = np.concatenate([d for _, d, _ in candidates])
+    key_cols = []
+    for ki in range(len(ctx.order_by)):
+        key_cols.append(np.concatenate([k[ki] for _, _, k in candidates]))
+    order = _lexsort(key_cols, [ob.ascending for ob in ctx.order_by])
+    order = order[ctx.offset: ctx.offset + ctx.limit]
+
+    rows = [None] * len(order)
+    pos_of = {int(o): i for i, o in enumerate(order)}
+    for si, seg in enumerate(segments):
+        sel = [int(o) for o in order if seg_idx[o] == si]
+        if not sel:
+            continue
+        doc_ids = docs[sel]
+        cols = [_select_values(seg, e, doc_ids) for e in select]
+        for j, o in enumerate(sel):
+            rows[pos_of[o]] = [c[j] for c in cols]
+    return ResultTable(DataSchema(names, types), rows)
+
+
+def _select_names(ctx: QueryContext, select: List[Expr]) -> List[str]:
+    # when '*' was expanded the aliases list no longer lines up; rebuild
+    if len(select) == len(ctx.select_expressions):
+        return [a if a else str(e) for e, a in zip(select, ctx.aliases)]
+    return [str(e) for e in select]
+
+
+def _order_key_array(segment: ImmutableSegment, e: Expr,
+                     doc_ids: np.ndarray) -> np.ndarray:
+    vals = eval_expr_values(segment, e, doc_ids)
+    return np.asarray(vals)
+
+
+def _lexsort(key_cols: List[np.ndarray], ascending: List[bool]) -> np.ndarray:
+    """Stable multi-key sort with per-key direction (strings included)."""
+    processed = []
+    for arr, asc in zip(key_cols, ascending):
+        if arr.dtype == object:
+            _, codes = np.unique(arr, return_inverse=True)
+            arr = codes
+        processed.append(arr if asc else _negate(arr))
+    # np.lexsort sorts by last key first
+    return np.lexsort(list(reversed(processed)))
+
+
+def _negate(arr: np.ndarray) -> np.ndarray:
+    if np.issubdtype(arr.dtype, np.integer):
+        return -arr.astype(np.int64)
+    return -arr.astype(np.float64)
+
+
+def _track(stats: Optional[QueryStats], seg: ImmutableSegment,
+           mask: np.ndarray) -> None:
+    if stats is None:
+        return
+    matched = int(np.count_nonzero(mask))
+    stats.num_segments_processed += 1
+    stats.num_segments_matched += 1 if matched else 0
+    stats.num_docs_scanned += matched
+    stats.total_docs += seg.num_docs
+
+
+# --------------------------------------------------------------------------
+# distinct
+# --------------------------------------------------------------------------
+
+def execute_distinct(ctx: QueryContext, segments: List[ImmutableSegment],
+                     stats: Optional[QueryStats] = None) -> ResultTable:
+    """Ref: DistinctOperator + DistinctDataTableReducer."""
+    schema = segments[0].metadata.schema
+    select = _expand_select(ctx, schema)
+    names = _select_names(ctx, select)
+    types = [_column_type(segments[0], e) for e in select]
+    seen: Dict[Tuple, List[Any]] = {}
+    for seg in segments:
+        mask = eval_filter(seg, ctx.filter)
+        _track(stats, seg, mask)
+        doc_ids = np.nonzero(mask)[0]
+        if doc_ids.size == 0:
+            continue
+        cols = [_select_values(seg, e, doc_ids) for e in select]
+        for r in zip(*cols):
+            key = tuple(tuple(v) if isinstance(v, list) else v for v in r)
+            if key not in seen:
+                seen[key] = list(r)
+    rows = list(seen.values())
+    if ctx.order_by:
+        idx_of = {str(e): i for i, e in enumerate(select)}
+        def sort_key(row):
+            parts = []
+            for ob in ctx.order_by:
+                i = idx_of.get(str(ob.expr))
+                if i is None:
+                    raise QueryError(f"ORDER BY {ob.expr} not in DISTINCT list")
+                from pinot_tpu.engine.results import _Reversible
+                parts.append(_Reversible(row[i], ob.ascending))
+            return tuple(parts)
+        rows.sort(key=sort_key)
+    return ResultTable(DataSchema(names, types),
+                       rows[ctx.offset: ctx.offset + ctx.limit])
+
+
+# --------------------------------------------------------------------------
+# aggregation fallback (host)
+# --------------------------------------------------------------------------
+
+def _agg_input_values(segment: ImmutableSegment, agg: AggDef, fn: Function,
+                      mask: np.ndarray):
+    vexpr = agg_value_expr(fn)
+    if vexpr is None:
+        return np.zeros(segment.num_docs)  # COUNT(*): values unused
+    if agg.mv:
+        if not isinstance(vexpr, Identifier):
+            raise UnsupportedQueryError("MV aggregation argument must be a column")
+        ds = segment.data_source(vexpr.name)
+        offsets = np.asarray(ds.mv_offsets)
+        d = ds.dictionary
+        dv = np.asarray(d.device_values()) if d and d.device_values() is not None else None
+        flat = np.asarray(ds.forward_index)
+        out = []
+        for i in range(segment.num_docs):
+            ids = flat[offsets[i]:offsets[i + 1]]
+            if dv is not None:
+                out.append(dv[ids])
+            else:
+                out.append(np.array(d.get_values(ids), dtype=object))
+        return out
+    vals = eval_expr_values(segment, vexpr)
+    return vals
+
+
+def host_aggregate_segment(ctx: QueryContext, aggs: List[AggDef],
+                           segment: ImmutableSegment,
+                           stats: Optional[QueryStats] = None) -> AggResult:
+    mask = eval_filter(segment, ctx.filter)
+    _track(stats, segment, mask)
+    states = []
+    for agg, fn in zip(aggs, ctx.aggregations):
+        vals = _agg_input_values(segment, agg, fn, mask)
+        states.append(agg.compute_host(vals, mask))
+    return AggResult(states)
+
+
+def _group_value_array(segment: ImmutableSegment, e: Expr) -> np.ndarray:
+    vals = eval_expr_values(segment, e)
+    return np.asarray(vals)
+
+
+def host_group_by_segment(ctx: QueryContext, aggs: List[AggDef],
+                          segment: ImmutableSegment,
+                          stats: Optional[QueryStats] = None) -> GroupByResult:
+    mask = eval_filter(segment, ctx.filter)
+    _track(stats, segment, mask)
+    filtered = np.nonzero(mask)[0]
+    result = GroupByResult()
+    if filtered.size == 0:
+        return result
+
+    # composed group codes over filtered docs
+    key_values: List[np.ndarray] = []
+    codes_list: List[np.ndarray] = []
+    for e in ctx.group_by:
+        arr = _group_value_array(segment, e)[filtered]
+        uniq, codes = np.unique(arr, return_inverse=True)
+        key_values.append(uniq)
+        codes_list.append(codes)
+    combined = codes_list[0].astype(np.int64)
+    for c, u in zip(codes_list[1:], key_values[1:]):
+        combined = combined * len(u) + c
+    uniq_keys, gid = np.unique(combined, return_inverse=True)
+
+    # decode group key tuples
+    def decode(k: int) -> Tuple:
+        parts = []
+        for u in reversed(key_values[1:]):
+            parts.append(u[k % len(u)])
+            k //= len(u)
+        parts.append(key_values[0][k])
+        return tuple(_py(v) for v in reversed(parts))
+
+    keys = [decode(int(k)) for k in uniq_keys]
+
+    order = np.argsort(gid, kind="stable")
+    boundaries = np.searchsorted(gid[order], np.arange(len(uniq_keys) + 1))
+
+    for agg, fn in zip(aggs, ctx.aggregations):
+        vals = _agg_input_values(segment, agg, fn, mask)
+        for g in range(len(uniq_keys)):
+            idx = filtered[order[boundaries[g]:boundaries[g + 1]]]
+            sub_mask = np.ones(len(idx), dtype=bool)
+            if agg.mv:
+                sub_vals = [vals[i] for i in idx]
+            else:
+                sub_vals = np.asarray(vals)[idx]
+            st = agg.compute_host(sub_vals, sub_mask)
+            result.groups.setdefault(keys[g], []).append(st)
+    return result
+
+
+def _py(v: Any) -> Any:
+    if isinstance(v, np.integer):
+        return int(v)
+    if isinstance(v, np.floating):
+        return float(v)
+    if isinstance(v, np.str_):
+        return str(v)
+    return v

@@ -1,0 +1,304 @@
+"""Host (numpy) evaluation of filters and expressions over a segment.
+
+This is the CPU execution path, used for (a) selection queries (data movement,
+not compute — the device adds nothing), (b) consuming/mutable segments that
+are not yet device-staged (mirroring the reference, where the realtime tail
+is served from the mutable segment), and (c) as the oracle the device kernels
+are tested against.
+
+Predicate semantics follow the reference's filter operators
+(``operator/filter/*``): on multi-value columns a predicate matches a doc if
+ANY value matches (ref: MV doc-id iterators).
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from pinot_tpu.engine.errors import QueryError, UnsupportedQueryError
+from pinot_tpu.query.expressions import (
+    Expr,
+    FilterNode,
+    FilterOp,
+    Function,
+    Identifier,
+    Literal,
+    Predicate,
+    PredicateType,
+)
+from pinot_tpu.segment.immutable import DataSource, ImmutableSegment
+from pinot_tpu.spi.data import DataType
+
+
+# --------------------------------------------------------------------------
+# Filter evaluation -> boolean doc mask
+# --------------------------------------------------------------------------
+
+def eval_filter(segment: ImmutableSegment, node: Optional[FilterNode]) -> np.ndarray:
+    n = segment.num_docs
+    if node is None:
+        return np.ones(n, dtype=bool)
+    return _eval_node(segment, node)
+
+
+def _eval_node(segment: ImmutableSegment, node: FilterNode) -> np.ndarray:
+    if node.op is FilterOp.AND:
+        out = _eval_node(segment, node.children[0])
+        for c in node.children[1:]:
+            out = out & _eval_node(segment, c)
+        return out
+    if node.op is FilterOp.OR:
+        out = _eval_node(segment, node.children[0])
+        for c in node.children[1:]:
+            out = out | _eval_node(segment, c)
+        return out
+    if node.op is FilterOp.NOT:
+        return ~_eval_node(segment, node.children[0])
+    return eval_predicate(segment, node.predicate)
+
+
+def _matching_dict_ids(ds: DataSource, pred: Predicate) -> np.ndarray:
+    """Predicate -> sorted array of matching dictIds (the host analogue of
+    the reference's dictionary-based predicate evaluators,
+    ``operator/filter/predicate/*``)."""
+    d = ds.dictionary
+    card = d.cardinality
+    t = pred.type
+    dt = ds.metadata.data_type
+
+    def conv(v):
+        try:
+            return dt.convert(v)
+        except (ValueError, TypeError) as e:
+            raise QueryError(f"cannot convert {v!r} for column "
+                             f"{ds.name!r} ({dt.label}): {e}")
+
+    if t is PredicateType.EQ:
+        i = d.index_of(conv(pred.value))
+        return np.array([i] if i >= 0 else [], dtype=np.int64)
+    if t is PredicateType.NOT_EQ:
+        i = d.index_of(conv(pred.value))
+        ids = np.arange(card, dtype=np.int64)
+        return ids[ids != i] if i >= 0 else ids
+    if t is PredicateType.IN:
+        ids = sorted({d.index_of(conv(v)) for v in pred.values} - {-1})
+        return np.array(ids, dtype=np.int64)
+    if t is PredicateType.NOT_IN:
+        hit = {d.index_of(conv(v)) for v in pred.values} - {-1}
+        return np.array([i for i in range(card) if i not in hit], dtype=np.int64)
+    if t is PredicateType.RANGE:
+        lo = conv(pred.lower) if pred.lower is not None else None
+        hi = conv(pred.upper) if pred.upper is not None else None
+        a, b = d.range_to_dict_id_interval(lo, hi, pred.lower_inclusive,
+                                           pred.upper_inclusive)
+        return np.arange(max(a, 0), min(b, card - 1) + 1, dtype=np.int64)
+    if t is PredicateType.REGEXP_LIKE:
+        try:
+            rx = re.compile(str(pred.value))
+        except re.error as e:
+            raise QueryError(f"bad regex {pred.value!r}: {e}")
+        return np.array([i for i in range(card)
+                         if rx.search(str(d.get_value(i)))], dtype=np.int64)
+    if t is PredicateType.TEXT_MATCH:
+        # without a Lucene-style text index, TEXT_MATCH falls back to a
+        # term-containment check over the dictionary
+        term = str(pred.value).lower()
+        return np.array([i for i in range(card)
+                         if term in str(d.get_value(i)).lower()], dtype=np.int64)
+    raise UnsupportedQueryError(f"predicate {t} not supported on "
+                                f"dictionary column {ds.name!r}")
+
+
+def eval_predicate(segment: ImmutableSegment, pred: Predicate) -> np.ndarray:
+    n = segment.num_docs
+    # IS_NULL / IS_NOT_NULL read the null bitmap regardless of encoding
+    if pred.type in (PredicateType.IS_NULL, PredicateType.IS_NOT_NULL):
+        col = _predicate_column(pred)
+        ds = segment.data_source(col)
+        nb = ds.null_bitmap
+        isnull = (np.asarray(nb[:n]) if nb is not None
+                  else np.zeros(n, dtype=bool))
+        return isnull if pred.type is PredicateType.IS_NULL else ~isnull
+
+    if not isinstance(pred.lhs, Identifier):
+        # expression predicate: evaluate values then compare
+        return _eval_expr_predicate(segment, pred)
+
+    ds = segment.data_source(pred.lhs.name)
+    cm = ds.metadata
+
+    # Exclusive predicates on MV columns: ALL values must satisfy
+    # (ref: BaseDictionaryBasedPredicateEvaluator.applyMV isExclusive) —
+    # evaluate the inclusive form and negate.
+    if not cm.single_value and pred.type in (PredicateType.NOT_EQ,
+                                             PredicateType.NOT_IN):
+        from dataclasses import replace
+        inner_t = (PredicateType.EQ if pred.type is PredicateType.NOT_EQ
+                   else PredicateType.IN)
+        return ~eval_predicate(segment, replace(pred, type=inner_t))
+
+    if cm.has_dictionary:
+        ids = _matching_dict_ids(ds, pred)
+        if cm.single_value:
+            fwd = np.asarray(ds.forward_index[:n])
+            if len(ids) == 0:
+                return np.zeros(n, dtype=bool)
+            if len(ids) == int(ids[-1] - ids[0]) + 1:  # contiguous interval
+                return (fwd >= ids[0]) & (fwd <= ids[-1])
+            return np.isin(fwd, ids)
+        offsets = np.asarray(ds.mv_offsets)
+        flat = np.asarray(ds.forward_index)
+        if len(ids) == 0:
+            return np.zeros(n, dtype=bool)
+        hit = np.isin(flat, ids)
+        # any per row: reduceat over CSR offsets (empty rows -> False)
+        return _any_per_row(hit, offsets, n)
+
+    # RAW column: compare values directly
+    vals = np.asarray(ds.forward_index[:n])
+    return _compare_values(vals, pred, cm.data_type)
+
+
+def _any_per_row(flat_hits: np.ndarray, offsets: np.ndarray, n: int) -> np.ndarray:
+    counts = np.diff(offsets)
+    rows = np.repeat(np.arange(n), counts)  # row index of each flat entry
+    out = np.zeros(n, dtype=bool)
+    out[rows[flat_hits]] = True
+    return out
+
+
+def _compare_values(vals: np.ndarray, pred: Predicate, dt: DataType) -> np.ndarray:
+    t = pred.type
+
+    def conv(v):
+        try:
+            return dt.convert(v)
+        except (ValueError, TypeError) as e:
+            raise QueryError(f"cannot convert {v!r} to {dt.label}: {e}")
+
+    if t is PredicateType.EQ:
+        return vals == conv(pred.value)
+    if t is PredicateType.NOT_EQ:
+        return vals != conv(pred.value)
+    if t is PredicateType.IN:
+        return np.isin(vals, [conv(v) for v in pred.values])
+    if t is PredicateType.NOT_IN:
+        return ~np.isin(vals, [conv(v) for v in pred.values])
+    if t is PredicateType.RANGE:
+        mask = np.ones(vals.shape, dtype=bool)
+        if pred.lower is not None:
+            lo = conv(pred.lower)
+            mask &= (vals >= lo) if pred.lower_inclusive else (vals > lo)
+        if pred.upper is not None:
+            hi = conv(pred.upper)
+            mask &= (vals <= hi) if pred.upper_inclusive else (vals < hi)
+        return mask
+    raise UnsupportedQueryError(f"predicate {t} not supported on raw column")
+
+
+def _eval_expr_predicate(segment: ImmutableSegment, pred: Predicate) -> np.ndarray:
+    vals = eval_expr_values(segment, pred.lhs)
+    dt = (DataType.DOUBLE if np.issubdtype(np.asarray(vals).dtype, np.floating)
+          else DataType.LONG)
+    if np.asarray(vals).dtype == object:
+        dt = DataType.STRING
+    return _compare_values(np.asarray(vals), pred, dt)
+
+
+def _predicate_column(pred: Predicate) -> str:
+    cols = pred.lhs.columns()
+    if not cols:
+        raise QueryError(f"predicate references no column: {pred}")
+    return cols[0]
+
+
+# --------------------------------------------------------------------------
+# Expression evaluation -> value arrays
+# --------------------------------------------------------------------------
+
+_ARITH = {
+    "plus": np.add,
+    "minus": np.subtract,
+    "times": np.multiply,
+    "divide": np.true_divide,
+    "mod": np.mod,
+}
+
+# scalar transform functions usable host-side (subset of the reference's 42
+# transform functions, operator/transform/function/*)
+_UNARY = {
+    "abs": np.abs,
+    "ceil": np.ceil,
+    "floor": np.floor,
+    "exp": np.exp,
+    "ln": np.log,
+    "sqrt": np.sqrt,
+}
+
+
+def eval_expr_values(segment: ImmutableSegment, expr: Expr,
+                     doc_ids: Optional[np.ndarray] = None) -> np.ndarray:
+    """Evaluate an expression to per-doc values (numeric -> float/int arrays,
+    strings -> object arrays). SV only; MV columns are handled by the MV
+    aggregation functions."""
+    n = segment.num_docs
+
+    if isinstance(expr, Literal):
+        return np.full(n if doc_ids is None else len(doc_ids), expr.value)
+
+    if isinstance(expr, Identifier):
+        ds = segment.data_source(expr.name)
+        cm = ds.metadata
+        if not cm.single_value:
+            raise UnsupportedQueryError(
+                f"multi-value column {expr.name!r} in expression position")
+        fwd = np.asarray(ds.forward_index[:n])
+        if doc_ids is not None:
+            fwd = fwd[doc_ids]
+        if not cm.has_dictionary:
+            return fwd
+        if cm.data_type.is_numeric:
+            return np.asarray(ds.dictionary.device_values())[fwd]
+        return np.array(ds.dictionary.get_values(fwd), dtype=object)
+
+    if isinstance(expr, Function):
+        name = expr.name
+        if name in _ARITH:
+            a = _to_float(eval_expr_values(segment, expr.args[0], doc_ids))
+            b = _to_float(eval_expr_values(segment, expr.args[1], doc_ids))
+            return _ARITH[name](a, b)
+        if name in _UNARY:
+            a = _to_float(eval_expr_values(segment, expr.args[0], doc_ids))
+            return _UNARY[name](a)
+        raise UnsupportedQueryError(f"transform function {name!r} not supported")
+
+    raise UnsupportedQueryError(f"cannot evaluate expression {expr}")
+
+
+def _to_float(a: np.ndarray) -> np.ndarray:
+    if a.dtype == object:
+        raise QueryError("arithmetic on non-numeric column")
+    return a.astype(np.float64) if not np.issubdtype(a.dtype, np.floating) else a
+
+
+def read_values(segment: ImmutableSegment, column: str,
+                doc_ids: np.ndarray) -> List[Any]:
+    """Gather output values for selection results (host path)."""
+    ds = segment.data_source(column)
+    cm = ds.metadata
+    if cm.single_value:
+        fwd = np.asarray(ds.forward_index)[doc_ids]
+        if not cm.has_dictionary:
+            return [cm.data_type.convert(v) for v in fwd]
+        return ds.dictionary.get_values(fwd)
+    offsets = np.asarray(ds.mv_offsets)
+    flat = np.asarray(ds.forward_index)
+    d = ds.dictionary
+    out = []
+    for i in doc_ids:
+        ids = flat[offsets[i]:offsets[i + 1]]
+        out.append(d.get_values(ids))
+    return out

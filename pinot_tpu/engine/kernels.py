@@ -1,0 +1,304 @@
+"""Device kernels: spec -> jitted query function.
+
+The TPU execution of the reference's per-segment operator chain
+(``Filter -> DocIdSet -> Projection -> Transform -> Aggregate``, SURVEY.md
+section 3.1 hot loop): instead of streaming 10k-doc blocks through iterators,
+the whole segment is evaluated as fixed-shape masked vector ops that XLA
+fuses into a few HBM passes:
+
+- filter tree  -> boolean doc mask (vector compares / LUT gathers)
+- projection   -> dictId gathers (``dictvals[fwd]``)
+- aggregation  -> masked reductions; group-by via composed keys +
+                  ``jax.ops.segment_sum`` scatter-adds (the fixed-shape
+                  analogue of DictionaryBasedGroupKeyGenerator + GroupByResultHolder)
+
+One kernel is built per *spec* (query structure + static sizes) and cached;
+literal values arrive as device arrays so repeated query shapes skip
+retracing entirely.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Dict, List, Tuple
+
+import jax
+import jax.numpy as jnp
+
+POS_INF = float("inf")
+NEG_INF = float("-inf")
+
+
+class _ParamCursor:
+    """Walks the flat params tuple in the same order the planner wrote it."""
+
+    def __init__(self, params):
+        self.params = params
+        self.i = 0
+
+    def take(self):
+        p = self.params[self.i]
+        self.i += 1
+        return p
+
+
+# --------------------------------------------------------------------------
+# filter mask emission
+# --------------------------------------------------------------------------
+
+def _emit_filter(spec: Tuple, cols: Dict[str, Dict[str, jnp.ndarray]],
+                 pc: _ParamCursor, capacity: int) -> jnp.ndarray:
+    op = spec[0]
+    if op == "true":
+        return jnp.ones(capacity, dtype=bool)
+    if op == "false":
+        return jnp.zeros(capacity, dtype=bool)
+    if op == "and":
+        m = _emit_filter(spec[1][0], cols, pc, capacity)
+        for s in spec[1][1:]:
+            m = m & _emit_filter(s, cols, pc, capacity)
+        return m
+    if op == "or":
+        m = _emit_filter(spec[1][0], cols, pc, capacity)
+        for s in spec[1][1:]:
+            m = m | _emit_filter(s, cols, pc, capacity)
+        return m
+    if op == "not":
+        return ~_emit_filter(spec[1][0], cols, pc, capacity)
+
+    col = spec[1]
+    c = cols[col]
+
+    # ---- dictionary SV strategies ----
+    if op == "eq":
+        return c["fwd"] == pc.take()
+    if op == "neq":
+        return c["fwd"] != pc.take()
+    if op == "range":
+        iv = pc.take()
+        return (c["fwd"] >= iv[0]) & (c["fwd"] <= iv[1])
+    if op == "lut":
+        return pc.take()[c["fwd"]]
+
+    # ---- dictionary MV strategies (ANY-value-matches semantics) ----
+    if op.startswith("mv_"):
+        mv, cnt = c["mv"], c["mvcount"]
+        entry_valid = (jnp.arange(mv.shape[1], dtype=jnp.int32)[None, :]
+                       < cnt[:, None])
+        sub = op[3:]
+        if sub == "eq":
+            hit = mv == pc.take()
+        elif sub == "neq":
+            hit = mv != pc.take()
+        elif sub == "range":
+            iv = pc.take()
+            hit = (mv >= iv[0]) & (mv <= iv[1])
+        else:  # lut
+            hit = pc.take()[mv]
+        return (hit & entry_valid).any(axis=-1)
+
+    # ---- raw-value strategies ----
+    if op == "veq":
+        return c["fwd"] == pc.take()
+    if op == "vneq":
+        return c["fwd"] != pc.take()
+    if op == "vrange":
+        lo, hi = pc.take(), pc.take()
+        lo_inc, hi_inc = spec[2], spec[3]
+        m = (c["fwd"] >= lo) if lo_inc else (c["fwd"] > lo)
+        m &= (c["fwd"] <= hi) if hi_inc else (c["fwd"] < hi)
+        return m
+    if op in ("vin", "vnotin"):
+        vals = pc.take()
+        m = (c["fwd"][:, None] == vals[None, :]).any(axis=-1)
+        return ~m if op == "vnotin" else m
+
+    # ---- null strategies ----
+    if op == "isnull":
+        return c["null"]
+    if op == "isnotnull":
+        return ~c["null"]
+
+    raise AssertionError(f"unknown filter op {op!r}")
+
+
+# --------------------------------------------------------------------------
+# value expression emission
+# --------------------------------------------------------------------------
+
+def _emit_value(vspec: Tuple, cols, pc: _ParamCursor) -> jnp.ndarray:
+    op = vspec[0]
+    if op == "lit":
+        return pc.take()
+    if op == "col":
+        _, name, has_dict = vspec
+        c = cols[name]
+        if has_dict:
+            return c["dictvals"][c["fwd"]]
+        return c["fwd"]
+    if op == "fn":
+        _, name, args = vspec
+        vals = [_emit_value(a, cols, pc) for a in args]
+        a = vals[0].astype(jnp.float64) if hasattr(vals[0], "astype") else vals[0]
+        b = vals[1].astype(jnp.float64) if hasattr(vals[1], "astype") else vals[1]
+        if name == "plus":
+            return a + b
+        if name == "minus":
+            return a - b
+        if name == "times":
+            return a * b
+        if name == "divide":
+            return a / b
+        if name == "mod":
+            return a % b
+    raise AssertionError(f"unknown value op {vspec!r}")
+
+
+# --------------------------------------------------------------------------
+# kernel factory
+# --------------------------------------------------------------------------
+
+def build_kernel(spec: Tuple):
+    """spec = (filter_spec, agg_specs, group_specs, num_groups, capacity)
+    -> jitted fn(cols, params, num_docs) -> dict of partial arrays."""
+    filter_spec, agg_specs, group_specs, num_groups, capacity = spec
+
+    def kernel(cols, params, num_docs):
+        pc = _ParamCursor(params)
+        mask = _emit_filter(filter_spec, cols, pc, capacity)
+        valid = jnp.arange(capacity, dtype=jnp.int32) < num_docs
+        mask = mask & valid
+
+        if not group_specs:
+            out: Dict[str, Any] = {"num_matched": mask.sum(dtype=jnp.int64)}
+            for i, aspec in enumerate(agg_specs):
+                out[f"agg{i}"] = _emit_scalar_agg(aspec, cols, pc, mask)
+            return out
+
+        # ---- group-by path ----
+        strides = pc.take()           # [g] int32
+        _bases = pc.take()            # [g] int64 (host uses for decode; raw
+        #                               group keys subtract base on device)
+        keys = jnp.zeros(capacity, dtype=jnp.int32)
+        for gi, (strat, colname) in enumerate(group_specs):
+            c = cols[colname]
+            if strat == "gdict":
+                k = c["fwd"]
+            else:  # graw: value-space key
+                k = (c["fwd"] - _bases[gi]).astype(jnp.int32)
+            keys = keys + k * strides[gi]
+        seg_ids = jnp.where(mask, keys, num_groups)  # overflow bucket
+        out = {
+            "presence": jax.ops.segment_sum(
+                mask.astype(jnp.int64), seg_ids,
+                num_segments=num_groups + 1)[:num_groups]
+        }
+        for i, aspec in enumerate(agg_specs):
+            out[f"agg{i}"] = _emit_grouped_agg(aspec, cols, pc, mask, seg_ids,
+                                               num_groups)
+        return out
+
+    return jax.jit(kernel)
+
+
+def _masked_values(aspec, cols, pc, mask):
+    base, mv, vspec = aspec[0], aspec[1], aspec[2]
+    # MV values are read inside the MV branch (dense mv + counts), not here
+    vals = (_emit_value(vspec, cols, pc)
+            if (vspec is not None and not mv) else None)
+    return base, mv, vals
+
+
+def _emit_scalar_agg(aspec, cols, pc, mask):
+    if aspec[0] == "distinctcount":
+        _, colname, card = aspec
+        fwd = cols[colname]["fwd"]
+        presence = jnp.zeros(card, dtype=jnp.int32).at[fwd].max(
+            mask.astype(jnp.int32), mode="drop")
+        return presence  # [card] 0/1; host maps present dictIds -> values
+    base, mv, vals = _masked_values(aspec, cols, pc, mask)
+
+    if mv:
+        c = cols[aspec[2][1]]
+        mvv, cnt = c["dictvals"][c["mv"]], c["mvcount"]
+        entry = (jnp.arange(c["mv"].shape[1], dtype=jnp.int32)[None, :]
+                 < cnt[:, None]) & mask[:, None]
+        fv = mvv.astype(jnp.float64)
+        if base == "count":
+            return jnp.where(mask, cnt.astype(jnp.int64), 0).sum()
+        if base == "sum":
+            return jnp.where(entry, fv, 0.0).sum()
+        if base == "min":
+            return jnp.where(entry, fv, POS_INF).min()
+        if base == "max":
+            return jnp.where(entry, fv, NEG_INF).max()
+        if base == "avg":
+            return (jnp.where(entry, fv, 0.0).sum(),
+                    entry.sum(dtype=jnp.int64))
+        raise AssertionError(f"MV agg {base} has no device kernel")
+
+    if base == "count":
+        return mask.sum(dtype=jnp.int64)
+    fv = vals.astype(jnp.float64) if vals.ndim else jnp.full(mask.shape[0],
+                                                             vals,
+                                                             dtype=jnp.float64)
+    if base == "sum":
+        return jnp.where(mask, fv, 0.0).sum()
+    if base == "min":
+        return jnp.where(mask, fv, POS_INF).min()
+    if base == "max":
+        return jnp.where(mask, fv, NEG_INF).max()
+    if base == "avg":
+        return (jnp.where(mask, fv, 0.0).sum(), mask.sum(dtype=jnp.int64))
+    if base == "minmaxrange":
+        return (jnp.where(mask, fv, POS_INF).min(),
+                jnp.where(mask, fv, NEG_INF).max())
+    raise AssertionError(f"agg {base} has no device scalar kernel")
+
+
+def _emit_grouped_agg(aspec, cols, pc, mask, seg_ids, num_groups):
+    base, mv, vals = _masked_values(aspec, cols, pc, mask)
+    n = num_groups + 1
+    if base == "count":
+        return jax.ops.segment_sum(mask.astype(jnp.int64), seg_ids,
+                                   num_segments=n)[:num_groups]
+    fv = vals.astype(jnp.float64) if vals.ndim else jnp.full(mask.shape[0],
+                                                             vals,
+                                                             dtype=jnp.float64)
+    if base == "sum":
+        return jax.ops.segment_sum(jnp.where(mask, fv, 0.0), seg_ids,
+                                   num_segments=n)[:num_groups]
+    if base == "min":
+        return jax.ops.segment_min(jnp.where(mask, fv, POS_INF), seg_ids,
+                                   num_segments=n)[:num_groups]
+    if base == "max":
+        return jax.ops.segment_max(jnp.where(mask, fv, NEG_INF), seg_ids,
+                                   num_segments=n)[:num_groups]
+    if base == "avg":
+        return (jax.ops.segment_sum(jnp.where(mask, fv, 0.0), seg_ids,
+                                    num_segments=n)[:num_groups],
+                jax.ops.segment_sum(mask.astype(jnp.int64), seg_ids,
+                                    num_segments=n)[:num_groups])
+    if base == "minmaxrange":
+        return (jax.ops.segment_min(jnp.where(mask, fv, POS_INF), seg_ids,
+                                    num_segments=n)[:num_groups],
+                jax.ops.segment_max(jnp.where(mask, fv, NEG_INF), seg_ids,
+                                    num_segments=n)[:num_groups])
+    raise AssertionError(f"agg {base} has no device grouped kernel")
+
+
+class KernelCache:
+    """spec -> jitted kernel (the engine's plan cache)."""
+
+    def __init__(self):
+        self._cache: Dict[Tuple, Any] = {}
+
+    def get(self, spec: Tuple):
+        k = self._cache.get(spec)
+        if k is None:
+            k = build_kernel(spec)
+            self._cache[spec] = k
+        return k
+
+    def __len__(self) -> int:
+        return len(self._cache)

@@ -1,0 +1,287 @@
+"""Result model + reduce-side finalization.
+
+Re-design of the reference's ``DataSchema`` / ``ResultTable`` response model
+(``pinot-common/.../utils/DataSchema.java:46``,
+``response/broker/ResultTable.java``) and the reduce machinery
+(``IndexedTable.java:38``, ``HavingFilterHandler``,
+``PostAggregationHandler``): merged group states -> HAVING -> order-by ->
+offset/limit -> select-row materialization.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from pinot_tpu.engine.aggregates import AggDef
+from pinot_tpu.engine.errors import QueryError, UnsupportedQueryError
+from pinot_tpu.query.context import QueryContext
+from pinot_tpu.query.expressions import (
+    Expr,
+    FilterNode,
+    FilterOp,
+    Function,
+    Identifier,
+    Literal,
+    Predicate,
+    PredicateType,
+)
+
+_ARITH = {
+    "plus": lambda a, b: a + b,
+    "minus": lambda a, b: a - b,
+    "times": lambda a, b: a * b,
+    "divide": lambda a, b: (a / b) if b else float("nan"),
+    "mod": lambda a, b: a % b,
+}
+
+
+@dataclass
+class DataSchema:
+    column_names: List[str]
+    column_types: List[str]
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"columnNames": self.column_names,
+                "columnDataTypes": self.column_types}
+
+
+@dataclass
+class ResultTable:
+    schema: DataSchema
+    rows: List[List[Any]]
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"dataSchema": self.schema.to_dict(), "rows": self.rows}
+
+
+@dataclass
+class QueryStats:
+    """Per-query execution stats surfaced in the response metadata
+    (ref: MetadataKey numDocsScanned etc., ServerQueryExecutorV1Impl:232-256)."""
+
+    num_segments_queried: int = 0
+    num_segments_processed: int = 0
+    num_segments_matched: int = 0
+    num_docs_scanned: int = 0
+    total_docs: int = 0
+    num_groups_limit_reached: bool = False
+
+    def merge(self, other: "QueryStats") -> None:
+        self.num_segments_queried += other.num_segments_queried
+        self.num_segments_processed += other.num_segments_processed
+        self.num_segments_matched += other.num_segments_matched
+        self.num_docs_scanned += other.num_docs_scanned
+        self.total_docs += other.total_docs
+        self.num_groups_limit_reached |= other.num_groups_limit_reached
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "numSegmentsQueried": self.num_segments_queried,
+            "numSegmentsProcessed": self.num_segments_processed,
+            "numSegmentsMatched": self.num_segments_matched,
+            "numDocsScanned": self.num_docs_scanned,
+            "totalDocs": self.total_docs,
+            "numGroupsLimitReached": self.num_groups_limit_reached,
+        }
+
+
+# --------------------------------------------------------------------------
+# intermediate (mergeable) results — the DataTable payload equivalent
+# --------------------------------------------------------------------------
+
+@dataclass
+class AggResult:
+    """Aggregation without group-by: one state per aggregation."""
+
+    states: List[Any]
+
+    def merge(self, other: "AggResult", aggs: List[AggDef]) -> None:
+        self.states = [a.merge(s, o) for a, s, o in
+                       zip(aggs, self.states, other.states)]
+
+
+@dataclass
+class GroupByResult:
+    """group key (tuple of python values) -> [state per agg]
+    (ref: IndexedTable)."""
+
+    groups: Dict[Tuple, List[Any]] = field(default_factory=dict)
+
+    def merge(self, other: "GroupByResult", aggs: List[AggDef]) -> None:
+        for key, states in other.groups.items():
+            mine = self.groups.get(key)
+            if mine is None:
+                self.groups[key] = list(states)
+            else:
+                self.groups[key] = [a.merge(m, s) for a, m, s in
+                                    zip(aggs, mine, states)]
+
+    def trim(self, max_size: int) -> bool:
+        """Cap group count (ref: numGroupsLimit). Returns True if trimmed."""
+        if len(self.groups) <= max_size:
+            return False
+        self.groups = dict(list(self.groups.items())[:max_size])
+        return True
+
+
+@dataclass
+class SelectionResult:
+    """Selection rows (+ order-by keys when ordered, for streaming merge)."""
+
+    rows: List[List[Any]]
+    order_keys: Optional[List[Tuple]] = None
+
+
+# --------------------------------------------------------------------------
+# reduce: merged results -> final ResultTable
+# --------------------------------------------------------------------------
+
+def _env_lookup(env: Dict[str, Any], expr: Expr) -> Any:
+    key = str(expr)
+    if key in env:
+        return env[key]
+    if isinstance(expr, Literal):
+        return expr.value
+    if isinstance(expr, Function) and expr.name in _ARITH:
+        a = _env_lookup(env, expr.args[0])
+        b = _env_lookup(env, expr.args[1])
+        try:
+            return _ARITH[expr.name](float(a), float(b))
+        except (TypeError, ValueError) as e:
+            raise QueryError(f"post-aggregation arithmetic failed: {e}")
+    raise QueryError(f"expression {expr} is not in GROUP BY or an aggregation")
+
+
+def _eval_scalar_filter(node: FilterNode, env: Dict[str, Any]) -> bool:
+    """HAVING evaluation over a single group's env
+    (ref: HavingFilterHandler)."""
+    if node.op is FilterOp.AND:
+        return all(_eval_scalar_filter(c, env) for c in node.children)
+    if node.op is FilterOp.OR:
+        return any(_eval_scalar_filter(c, env) for c in node.children)
+    if node.op is FilterOp.NOT:
+        return not _eval_scalar_filter(node.children[0], env)
+    p = node.predicate
+    v = _env_lookup(env, p.lhs)
+    t = p.type
+    if t is PredicateType.EQ:
+        return v == p.value
+    if t is PredicateType.NOT_EQ:
+        return v != p.value
+    if t is PredicateType.IN:
+        return v in p.values
+    if t is PredicateType.NOT_IN:
+        return v not in p.values
+    if t is PredicateType.RANGE:
+        if p.lower is not None:
+            if p.lower_inclusive:
+                if v < p.lower:
+                    return False
+            elif v <= p.lower:
+                return False
+        if p.upper is not None:
+            if p.upper_inclusive:
+                if v > p.upper:
+                    return False
+            elif v >= p.upper:
+                return False
+        return True
+    raise UnsupportedQueryError(f"HAVING predicate {t} not supported")
+
+
+def _group_env(ctx: QueryContext, aggs: List[AggDef], key: Tuple,
+               states: List[Any]) -> Dict[str, Any]:
+    env: Dict[str, Any] = {}
+    for e, v in zip(ctx.group_by, key):
+        env[str(e)] = v
+    for fn, agg, st in zip(ctx.aggregations, aggs, states):
+        env[str(fn)] = agg.finalize(st)
+    return env
+
+
+def reduce_group_by(ctx: QueryContext, aggs: List[AggDef],
+                    merged: GroupByResult,
+                    schema_types: Dict[str, str]) -> ResultTable:
+    """Ref: GroupByDataTableReducer.java:66."""
+    envs = [ _group_env(ctx, aggs, key, states)
+             for key, states in merged.groups.items() ]
+    if ctx.having is not None:
+        envs = [e for e in envs if _eval_scalar_filter(ctx.having, e)]
+
+    if ctx.order_by:
+        def sort_key(env):
+            parts = []
+            for ob in ctx.order_by:
+                v = _env_lookup(env, ob.expr)
+                parts.append(_Reversible(v, ob.ascending))
+            return tuple(parts)
+        envs.sort(key=sort_key)
+    rows_env = envs[ctx.offset: ctx.offset + ctx.limit]
+
+    names, types = _result_schema(ctx, aggs, schema_types)
+    rows = [[_finalize_cell(_env_lookup(env, e)) for e in ctx.select_expressions]
+            for env in rows_env]
+    return ResultTable(DataSchema(names, types), rows)
+
+
+def reduce_aggregation(ctx: QueryContext, aggs: List[AggDef],
+                       merged: AggResult) -> ResultTable:
+    """Ref: AggregationDataTableReducer."""
+    env: Dict[str, Any] = {}
+    for fn, agg, st in zip(ctx.aggregations, aggs, merged.states):
+        env[str(fn)] = agg.finalize(st)
+    names, types = _result_schema(ctx, aggs, {})
+    row = [_finalize_cell(_env_lookup(env, e)) for e in ctx.select_expressions]
+    return ResultTable(DataSchema(names, types), [row])
+
+
+def _finalize_cell(v: Any) -> Any:
+    if isinstance(v, (np.integer,)):
+        return int(v)
+    if isinstance(v, (np.floating,)):
+        return float(v)
+    if isinstance(v, np.bool_):
+        return bool(v)
+    return v
+
+
+def _result_schema(ctx: QueryContext, aggs: List[AggDef],
+                   schema_types: Dict[str, str]) -> Tuple[List[str], List[str]]:
+    agg_types = {str(fn): a.result_type
+                 for fn, a in zip(ctx.aggregations, aggs)}
+    names: List[str] = []
+    types: List[str] = []
+    for e, alias in zip(ctx.select_expressions, ctx.aliases):
+        names.append(alias if alias else str(e))
+        k = str(e)
+        if k in agg_types:
+            types.append(agg_types[k])
+        elif k in schema_types:
+            types.append(schema_types[k])
+        elif isinstance(e, Literal):
+            types.append("STRING" if isinstance(e.value, str) else "DOUBLE")
+        else:
+            types.append("DOUBLE")  # post-aggregation arithmetic
+    return names, types
+
+
+class _Reversible:
+    """Sort-key wrapper supporting DESC for arbitrary comparable values."""
+
+    __slots__ = ("v", "asc")
+
+    def __init__(self, v, asc: bool):
+        self.v = v
+        self.asc = asc
+
+    def __lt__(self, other: "_Reversible") -> bool:
+        if self.v == other.v:
+            return False
+        lt = self.v < other.v
+        return lt if self.asc else not lt
+
+    def __eq__(self, other) -> bool:
+        return self.v == other.v

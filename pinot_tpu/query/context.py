@@ -196,8 +196,15 @@ def build_query_context(parsed: ParsedQuery) -> QueryContext:
     alias_map: Dict[str, Expr] = {
         a: e for e, a in parsed.select if a is not None}
 
-    group_by = [_resolve_alias(e, alias_map, select_exprs) for e in parsed.group_by]
-    order_by = [OrderByExpr(_resolve_alias(ob.expr, alias_map, select_exprs),
+    # ordinals must be resolved BEFORE constant folding ('ORDER BY 1 + 1' is
+    # a constant sort key, not ordinal 2), so folding happens here, after
+    # _resolve_alias, not in the optimizer
+    from pinot_tpu.query.expressions import fold_constants
+
+    group_by = [fold_constants(_resolve_alias(e, alias_map, select_exprs))
+                for e in parsed.group_by]
+    order_by = [OrderByExpr(fold_constants(
+                    _resolve_alias(ob.expr, alias_map, select_exprs)),
                             ob.ascending)
                 for ob in parsed.order_by]
     having = (_resolve_filter_aliases(parsed.having, alias_map, select_exprs)
@@ -229,10 +236,17 @@ def build_query_context(parsed: ParsedQuery) -> QueryContext:
 
     if ctx.distinct and aggs:
         raise SqlParseError("DISTINCT with aggregations is not supported")
-    if aggs and not group_by:
-        # pure aggregation: every select expr must be an aggregation or
-        # post-aggregation over them (checked at reduce time)
-        pass
+    if group_by and not aggs:
+        # GROUP BY without aggregations == SELECT DISTINCT over the group
+        # expressions (the reference's PQL->SQL group-by semantics)
+        group_keys = {str(e) for e in group_by}
+        for e in select_exprs:
+            if str(e) not in group_keys:
+                raise SqlParseError(
+                    f"non-aggregate select expression {e} must appear in "
+                    f"GROUP BY")
+        ctx.distinct = True
+        ctx.group_by = []
     return ctx
 
 

@@ -172,10 +172,9 @@ def optimize_filter(node: Optional[FilterNode]) -> Optional[FilterNode]:
 
 
 def optimize(parsed: ParsedQuery) -> ParsedQuery:
+    # group_by/order_by are folded in build_query_context AFTER ordinal
+    # resolution ('ORDER BY 1 + 1' must not collapse into ordinal 2)
     parsed.where = optimize_filter(parsed.where)
     parsed.having = optimize_filter(parsed.having)
     parsed.select = [(fold_constants(e), a) for e, a in parsed.select]
-    parsed.group_by = [fold_constants(e) for e in parsed.group_by]
-    parsed.order_by = [OrderByExpr(fold_constants(ob.expr), ob.ascending)
-                       for ob in parsed.order_by]
     return parsed

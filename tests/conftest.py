@@ -7,12 +7,18 @@ the driver's ``dryrun_multichip`` uses).
 
 import os
 
-# Must be set before jax is imported anywhere.
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Must run before jax initializes a backend. Note: the environment presets
+# JAX_PLATFORMS=axon (the real-TPU tunnel) and the axon plugin overrides the
+# env var, so jax.config.update is the only reliable way to force CPU here.
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 

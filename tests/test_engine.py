@@ -1,0 +1,372 @@
+"""Execution engine parity tests: device kernels vs pandas oracle, and
+device path vs host path (mirrors the reference's *QueriesTest strategy,
+pinot-core/src/test/java/org/apache/pinot/queries/)."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from pinot_tpu.engine import QueryError, ServerQueryExecutor
+from pinot_tpu.query import compile_query
+from pinot_tpu.segment import SegmentBuilder, load_segment
+from pinot_tpu.spi import (
+    DataType,
+    FieldSpec,
+    FieldType,
+    IndexingConfig,
+    Schema,
+)
+
+RNG = np.random.default_rng(7)
+N = 3000
+
+
+def make_data():
+    teams = ["ATL", "BOS", "CHC", "NYA", "SFO", "LAD", "HOU"]
+    leagues = ["AL", "NL"]
+    df = pd.DataFrame({
+        "team": [teams[i] for i in RNG.integers(0, len(teams), N)],
+        "league": [leagues[i] for i in RNG.integers(0, 2, N)],
+        "year": RNG.integers(1990, 2021, N).astype(np.int64),
+        "runs": RNG.integers(0, 150, N).astype(np.int64),
+        "score": np.round(RNG.normal(50, 12, N), 3),
+        "salary": RNG.integers(10_000, 5_000_000, N).astype(np.int64),  # raw
+    })
+    tags = [[f"t{j}" for j in RNG.choice(5, size=RNG.integers(0, 4), replace=False)]
+            for _ in range(N)]
+    mvnums = [RNG.integers(0, 30, RNG.integers(1, 5)).astype(np.int64).tolist()
+              for _ in range(N)]
+    return df, tags, mvnums
+
+
+def make_schema():
+    return Schema("stats", [
+        FieldSpec("team", DataType.STRING),
+        FieldSpec("league", DataType.STRING),
+        FieldSpec("year", DataType.INT),
+        FieldSpec("tags", DataType.STRING, single_value=False),
+        FieldSpec("nums", DataType.INT, single_value=False),
+        FieldSpec("runs", DataType.LONG, FieldType.METRIC),
+        FieldSpec("score", DataType.DOUBLE, FieldType.METRIC),
+        FieldSpec("salary", DataType.LONG, FieldType.METRIC),
+    ])
+
+
+@pytest.fixture(scope="module")
+def setup(tmp_path_factory):
+    out = tmp_path_factory.mktemp("engine_segs")
+    df, tags, mvnums = make_data()
+    cols = {c: df[c].tolist() for c in df.columns}
+    cols["tags"] = [t or None for t in tags]
+    cols["nums"] = mvnums
+    # two segments over row halves (exercises the combine/merge path)
+    half = N // 2
+    segs = []
+    for i, sl in enumerate([slice(0, half), slice(half, N)]):
+        b = SegmentBuilder(
+            make_schema(), f"stats_{i}",
+            indexing_config=IndexingConfig(no_dictionary_columns=["salary"]))
+        b.build({k: v[sl] for k, v in cols.items()}, str(out))
+        segs.append(load_segment(str(out / f"stats_{i}")))
+    df["tags"] = tags
+    df["nums"] = mvnums
+    return df, segs
+
+
+@pytest.fixture(scope="module")
+def device_exec():
+    return ServerQueryExecutor(use_device=True)
+
+
+@pytest.fixture(scope="module")
+def host_exec():
+    return ServerQueryExecutor(use_device=False)
+
+
+def run(executor, segments, sql):
+    ctx = compile_query(sql)
+    rt, stats = executor.execute(ctx, segments)
+    return rt
+
+
+def rows(executor, segments, sql):
+    return run(executor, segments, sql).rows
+
+
+class TestAggregationParity:
+    SQL = "SELECT count(*), sum(runs), min(score), max(score), avg(runs), minmaxrange(year) FROM stats WHERE team = 'BOS'"
+
+    def _expected(self, df):
+        d = df[df.team == "BOS"]
+        return [len(d), float(d.runs.sum()), float(d.score.min()),
+                float(d.score.max()), float(d.runs.mean()),
+                float(d.year.max() - d.year.min())]
+
+    def test_device_matches_pandas(self, setup, device_exec):
+        df, segs = setup
+        got = rows(device_exec, segs, self.SQL)[0]
+        exp = self._expected(df)
+        assert got[0] == exp[0]
+        for g, e in zip(got[1:], exp[1:]):
+            assert g == pytest.approx(e, rel=1e-12)
+
+    def test_host_matches_device(self, setup, device_exec, host_exec):
+        df, segs = setup
+        assert rows(host_exec, segs, self.SQL) == rows(device_exec, segs, self.SQL)
+
+
+class TestFilters:
+    CASES = [
+        ("year BETWEEN 2000 AND 2010", lambda d: (d.year >= 2000) & (d.year <= 2010)),
+        ("team IN ('ATL','BOS','LAD')", lambda d: d.team.isin(["ATL", "BOS", "LAD"])),
+        ("team NOT IN ('ATL')", lambda d: ~d.team.isin(["ATL"])),
+        ("team != 'SFO'", lambda d: d.team != "SFO"),
+        ("score > 60.5", lambda d: d.score > 60.5),
+        ("score <= 40", lambda d: d.score <= 40),
+        ("team LIKE 'B%'", lambda d: d.team.str.startswith("B")),
+        ("regexp_like(team, '^[AB]')", lambda d: d.team.str.match("[AB]")),
+        ("salary > 2500000", lambda d: d.salary > 2500000),
+        ("salary BETWEEN 100000 AND 200000",
+         lambda d: (d.salary >= 100000) & (d.salary <= 200000)),
+        ("team = 'BOS' AND year > 2005 OR league = 'NL' AND runs < 10",
+         lambda d: (d.team == "BOS") & (d.year > 2005) | (d.league == "NL") & (d.runs < 10)),
+        ("NOT (team = 'BOS' OR team = 'ATL')",
+         lambda d: ~((d.team == "BOS") | (d.team == "ATL"))),
+        ("year = 2015", lambda d: d.year == 2015),
+        ("team = 'NOPE'", lambda d: d.team == "NOPE"),
+    ]
+
+    @pytest.mark.parametrize("where,fn", CASES, ids=[c[0][:40] for c in CASES])
+    def test_count_parity(self, setup, device_exec, where, fn):
+        df, segs = setup
+        got = rows(device_exec, segs, f"SELECT count(*) FROM stats WHERE {where}")
+        assert got[0][0] == int(fn(df).sum())
+
+    def test_mv_predicate(self, setup, device_exec):
+        df, segs = setup
+        got = rows(device_exec, segs,
+                   "SELECT count(*) FROM stats WHERE tags = 't1'")
+        exp = sum(1 for t in df.tags if "t1" in t)
+        assert got[0][0] == exp
+
+    def test_mv_in_predicate(self, setup, device_exec):
+        df, segs = setup
+        got = rows(device_exec, segs,
+                   "SELECT count(*) FROM stats WHERE tags IN ('t1','t3')")
+        exp = sum(1 for t in df.tags if set(t) & {"t1", "t3"})
+        assert got[0][0] == exp
+
+    @pytest.mark.parametrize("exec_name", ["device", "host"])
+    def test_mv_exclusive_predicates_all_semantics(self, setup, device_exec,
+                                                   host_exec, exec_name):
+        # NOT_EQ / NOT_IN on MV: ALL values must satisfy (regression,
+        # ref: BaseDictionaryBasedPredicateEvaluator.applyMV isExclusive)
+        df, segs = setup
+        ex = device_exec if exec_name == "device" else host_exec
+        got = rows(ex, segs, "SELECT count(*) FROM stats WHERE tags != 't1'")
+        exp = sum(1 for t in df.tags if "t1" not in t)  # doc must NOT contain t1
+        assert got[0][0] == exp
+        got2 = rows(ex, segs,
+                    "SELECT count(*) FROM stats WHERE tags NOT IN ('t1','t3')")
+        exp2 = sum(1 for t in df.tags if not (set(t) & {"t1", "t3"}))
+        assert got2[0][0] == exp2
+
+
+class TestGroupBy:
+    SQL = ("SELECT team, sum(runs), count(*) FROM stats WHERE year >= 2000 "
+           "GROUP BY team ORDER BY sum(runs) DESC LIMIT 5")
+
+    def _expected(self, df):
+        d = df[df.year >= 2000]
+        g = d.groupby("team").agg(s=("runs", "sum"), c=("runs", "size"))
+        g = g.sort_values("s", ascending=False).head(5)
+        return [[t, float(r.s), int(r.c)] for t, r in g.iterrows()]
+
+    def test_device_matches_pandas(self, setup, device_exec):
+        df, segs = setup
+        assert rows(device_exec, segs, self.SQL) == self._expected(df)
+
+    def test_host_matches_device(self, setup, device_exec, host_exec):
+        df, segs = setup
+        assert rows(host_exec, segs, self.SQL) == rows(device_exec, segs, self.SQL)
+
+    def test_multi_column_group(self, setup, device_exec):
+        df, segs = setup
+        got = rows(device_exec, segs,
+                   "SELECT league, team, avg(score) FROM stats "
+                   "GROUP BY league, team ORDER BY league, team LIMIT 100")
+        g = df.groupby(["league", "team"]).score.mean().reset_index()
+        g = g.sort_values(["league", "team"])
+        exp = [[r.league, r.team, pytest.approx(r.score, rel=1e-12)]
+               for r in g.itertuples()]
+        assert got == exp
+
+    def test_group_by_int_column(self, setup, device_exec):
+        df, segs = setup
+        got = rows(device_exec, segs,
+                   "SELECT year, max(runs) FROM stats GROUP BY year "
+                   "ORDER BY year LIMIT 50")
+        g = df.groupby("year").runs.max().reset_index().sort_values("year")
+        assert got == [[int(r.year), float(r.runs)] for r in g.itertuples()]
+
+    def test_having(self, setup, device_exec):
+        df, segs = setup
+        got = rows(device_exec, segs,
+                   "SELECT team, count(*) FROM stats GROUP BY team "
+                   "HAVING count(*) > 400 ORDER BY count(*) DESC LIMIT 10")
+        g = df.groupby("team").size()
+        g = g[g > 400].sort_values(ascending=False)
+        assert got == [[t, int(c)] for t, c in g.items()]
+
+    def test_group_by_raw_int(self, setup, device_exec, host_exec):
+        # salary is raw (no dictionary): host and device must agree
+        sql = ("SELECT year, sum(salary) FROM stats GROUP BY year "
+               "ORDER BY year LIMIT 40")
+        assert rows(device_exec, setup[1], sql) == rows(host_exec, setup[1], sql)
+
+    def test_post_aggregation(self, setup, device_exec):
+        df, segs = setup
+        got = rows(device_exec, segs,
+                   "SELECT team, sum(runs) / count(*) FROM stats GROUP BY team "
+                   "ORDER BY team LIMIT 10")
+        g = df.groupby("team").agg(s=("runs", "sum"), c=("runs", "size"))
+        exp = [[t, pytest.approx(r.s / r.c, rel=1e-12)] for t, r in
+               g.sort_index().iterrows()]
+        assert got == exp
+
+
+class TestMVAggregations:
+    def test_summv_countmv(self, setup, device_exec):
+        df, segs = setup
+        got = rows(device_exec, segs,
+                   "SELECT summv(nums), countmv(nums), minmv(nums), maxmv(nums) "
+                   "FROM stats WHERE team = 'ATL'")
+        sel = df[df.team == "ATL"].nums
+        flat = [x for row in sel for x in row]
+        assert got[0][0] == pytest.approx(sum(flat))
+        assert got[0][1] == len(flat)
+        assert got[0][2] == min(flat)
+        assert got[0][3] == max(flat)
+
+    def test_host_matches_device(self, setup, device_exec, host_exec):
+        sql = "SELECT summv(nums), avgmv(nums) FROM stats WHERE year < 2000"
+        assert (rows(device_exec, setup[1], sql)
+                == rows(host_exec, setup[1], sql))
+
+
+class TestDistinctCount:
+    def test_distinctcount(self, setup, device_exec):
+        df, segs = setup
+        got = rows(device_exec, segs,
+                   "SELECT distinctcount(team), distinctcount(year) FROM stats "
+                   "WHERE league = 'AL'")
+        d = df[df.league == "AL"]
+        assert got[0] == [d.team.nunique(), d.year.nunique()]
+
+    def test_count_distinct_sql(self, setup, device_exec):
+        df, segs = setup
+        got = rows(device_exec, segs, "SELECT COUNT(DISTINCT team) FROM stats")
+        assert got[0][0] == df.team.nunique()
+
+
+class TestPercentile:
+    def test_percentile_host_path(self, setup, device_exec):
+        df, segs = setup
+        got = rows(device_exec, segs,
+                   "SELECT percentile95(score) FROM stats WHERE team='CHC'")
+        vals = np.sort(df[df.team == "CHC"].score.values)
+        exp = vals[min(int(len(vals) * 0.95), len(vals) - 1)]
+        assert got[0][0] == pytest.approx(exp)
+
+
+class TestSelection:
+    def test_selection_limit(self, setup, device_exec):
+        df, segs = setup
+        got = rows(device_exec, segs,
+                   "SELECT team, year, runs FROM stats WHERE team='HOU' LIMIT 7")
+        d = df[df.team == "HOU"].head(7)
+        assert got == [[r.team, int(r.year), int(r.runs)] for r in d.itertuples()]
+
+    def test_selection_order_by(self, setup, device_exec):
+        df, segs = setup
+        got = rows(device_exec, segs,
+                   "SELECT year, score FROM stats WHERE team='BOS' "
+                   "ORDER BY score DESC LIMIT 5")
+        d = df[df.team == "BOS"].sort_values("score", ascending=False).head(5)
+        assert got == [[int(r.year), pytest.approx(r.score)] for r in d.itertuples()]
+
+    def test_selection_offset(self, setup, device_exec):
+        df, segs = setup
+        got = rows(device_exec, segs,
+                   "SELECT year FROM stats WHERE team='BOS' "
+                   "ORDER BY year LIMIT 5 OFFSET 3")
+        d = df[df.team == "BOS"].sort_values("year").year.iloc[3:8]
+        assert [r[0] for r in got] == [int(y) for y in d]
+
+    def test_select_star(self, setup, device_exec):
+        df, segs = setup
+        rt = run(device_exec, segs, "SELECT * FROM stats LIMIT 2")
+        assert rt.schema.column_names == list(make_schema().column_names)
+        assert len(rt.rows) == 2
+        assert rt.rows[0][0] == df.team.iloc[0]
+
+
+class TestDistinct:
+    def test_distinct(self, setup, device_exec):
+        df, segs = setup
+        got = rows(device_exec, segs,
+                   "SELECT DISTINCT league FROM stats ORDER BY league")
+        assert got == [["AL"], ["NL"]]
+
+    def test_group_by_without_aggregation_is_distinct(self, setup, device_exec):
+        # regression: must not run as plain selection with duplicates
+        df, segs = setup
+        got = rows(device_exec, segs,
+                   "SELECT league FROM stats GROUP BY league ORDER BY league")
+        assert got == [["AL"], ["NL"]]
+
+    def test_group_by_select_mismatch_rejected(self, setup, device_exec):
+        from pinot_tpu.query import SqlParseError
+        with pytest.raises(SqlParseError, match="must appear in"):
+            compile_query("SELECT team FROM stats GROUP BY league")
+
+
+class TestFastPaths:
+    def test_metadata_count_star(self, setup, device_exec):
+        df, segs = setup
+        rt, stats = device_exec.execute(
+            compile_query("SELECT count(*) FROM stats"), segs)
+        assert rt.rows[0][0] == len(df)
+        assert stats.num_docs_scanned == 0  # metadata path: no scan
+
+    def test_metadata_min_max(self, setup, device_exec):
+        df, segs = setup
+        got = rows(device_exec, segs, "SELECT min(year), max(year) FROM stats")
+        assert got[0] == [float(df.year.min()), float(df.year.max())]
+
+
+class TestErrors:
+    def test_unknown_column(self, setup, device_exec):
+        with pytest.raises(QueryError, match="unknown column"):
+            run(device_exec, setup[1], "SELECT nope FROM stats")
+
+    def test_empty_result_aggregation(self, setup, device_exec):
+        got = rows(device_exec, setup[1],
+                   "SELECT count(*), sum(runs) FROM stats WHERE team='ZZZ'")
+        assert got[0][0] == 0
+        assert got[0][1] == 0.0
+
+    def test_empty_group_by(self, setup, device_exec):
+        got = rows(device_exec, setup[1],
+                   "SELECT team, count(*) FROM stats WHERE team='ZZZ' GROUP BY team")
+        assert got == []
+
+
+class TestJitCaching:
+    def test_literal_change_reuses_kernel(self, setup, device_exec):
+        segs = setup[1]
+        run(device_exec, segs, "SELECT sum(runs) FROM stats WHERE year > 2000")
+        n = len(device_exec.kernels)
+        run(device_exec, segs, "SELECT sum(runs) FROM stats WHERE year > 2010")
+        run(device_exec, segs, "SELECT sum(runs) FROM stats WHERE year > 1995")
+        assert len(device_exec.kernels) == n  # same structure -> same kernel

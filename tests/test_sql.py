@@ -209,11 +209,16 @@ class TestOptimizer:
         assert ctx.select_expressions[0] == ctx.order_by[0].expr
         assert ctx.filter.predicate.lower == 5
 
+    def test_constant_order_by_is_not_ordinal(self):
+        # ORDER BY 1 + 1 is a constant sort key, not ordinal 2 (regression)
+        ctx = compile_query("SELECT a, b FROM t ORDER BY 1 + 1")
+        assert str(ctx.order_by[0].expr) == "2"
+
     def test_ordinal_only_at_top_level(self):
         # ORDER BY a + 1 is arithmetic, not ordinal 1 (regression)
         ctx = compile_query("SELECT a, b FROM t ORDER BY a + 1")
         assert str(ctx.order_by[0].expr) == "plus(a,1)"
-        ctx2 = compile_query("SELECT a, b FROM t GROUP BY mod(a, 2)")
+        ctx2 = compile_query("SELECT count(*) FROM t GROUP BY mod(a, 2)")
         assert str(ctx2.group_by[0]) == "mod(a,2)"
 
     def test_mixed_type_range_merge_survives(self):

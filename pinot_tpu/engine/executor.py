@@ -129,30 +129,7 @@ class ServerQueryExecutor:
     def _run_device_scalar(self, plan: SegmentPlan, seg: ImmutableSegment,
                            stats: QueryStats) -> AggResult:
         out = self._run_kernel(plan, seg, stats)
-        agg_specs = plan.spec[1]
-        states: List[Any] = []
-        for i, (agg, aspec) in enumerate(zip(plan.agg_defs, agg_specs)):
-            raw = out[f"agg{i}"]
-            states.append(self._decode_scalar_state(agg, aspec, raw, seg))
-        return AggResult(states)
-
-    def _decode_scalar_state(self, agg: AggDef, aspec: Tuple, raw: Any,
-                             seg: ImmutableSegment) -> Any:
-        if aspec[0] == "distinctcount":
-            presence = np.asarray(raw)
-            ids = np.nonzero(presence)[0]
-            d = seg.data_source(aspec[1]).dictionary
-            return frozenset(d.get_values(ids))
-        base = aspec[0]
-        if base == "count":
-            return int(raw)
-        if base in ("sum", "min", "max"):
-            return float(raw)
-        if base == "avg":
-            return (float(raw[0]), int(raw[1]))
-        if base == "minmaxrange":
-            return (float(raw[0]), float(raw[1]))
-        raise AssertionError(base)
+        return decode_scalar_result(plan, seg, out)
 
     # -- group-by ----------------------------------------------------------
     def _execute_group_by(self, ctx: QueryContext, aggs: List[AggDef],
@@ -178,53 +155,7 @@ class ServerQueryExecutor:
     def _run_device_grouped(self, plan: SegmentPlan, seg: ImmutableSegment,
                             stats: QueryStats) -> GroupByResult:
         out = self._run_kernel(plan, seg, stats)
-        presence = np.asarray(out["presence"])
-        gidx = np.nonzero(presence)[0]
-        result = GroupByResult()
-        if gidx.size == 0:
-            return result
-
-        # decode composed keys -> per-column dictIds -> values, using the
-        # planner's own strides (single source of truth for key layout)
-        cards = plan.group_cards
-        strides = plan.group_strides.astype(np.int64)
-        key_cols: List[List[Any]] = []
-        for i, ((strat, col), card) in enumerate(zip(plan.group_defs, cards)):
-            dids = (gidx // strides[i]) % card
-            if strat == "gdict":
-                d = seg.data_source(col).dictionary
-                key_cols.append(d.get_values(dids))
-            else:  # graw value-space
-                base = int(seg.metadata.column(col).min_value)
-                key_cols.append([int(x) + base for x in dids])
-        keys = list(zip(*key_cols))
-
-        agg_specs = plan.spec[1]
-        states_per_agg: List[List[Any]] = []
-        for i, (agg, aspec) in enumerate(zip(plan.agg_defs, agg_specs)):
-            raw = out[f"agg{i}"]
-            base = aspec[0]
-            if base == "count":
-                arr = np.asarray(raw)[gidx]
-                states_per_agg.append([int(v) for v in arr])
-            elif base in ("sum", "min", "max"):
-                arr = np.asarray(raw)[gidx]
-                states_per_agg.append([float(v) for v in arr])
-            elif base == "avg":
-                s = np.asarray(raw[0])[gidx]
-                c = np.asarray(raw[1])[gidx]
-                states_per_agg.append([(float(a), int(b)) for a, b in zip(s, c)])
-            elif base == "minmaxrange":
-                lo = np.asarray(raw[0])[gidx]
-                hi = np.asarray(raw[1])[gidx]
-                states_per_agg.append([(float(a), float(b)) for a, b in zip(lo, hi)])
-            else:
-                raise AssertionError(base)
-
-        for gi, key in enumerate(keys):
-            result.groups[key] = [states_per_agg[ai][gi]
-                                  for ai in range(len(plan.agg_defs))]
-        return result
+        return decode_grouped_result(plan, seg, out)
 
     # -- shared ------------------------------------------------------------
     def _run_kernel(self, plan: SegmentPlan, seg: ImmutableSegment,
@@ -252,3 +183,88 @@ class ServerQueryExecutor:
     def _schema_types(self, seg: ImmutableSegment) -> Dict[str, str]:
         return {name: cm.data_type.label
                 for name, cm in seg.metadata.columns.items()}
+
+
+# --------------------------------------------------------------------------
+# kernel-output decode (shared with the sharded combine path, which merges
+# partials on device and decodes against the batch's unified dictionaries)
+# --------------------------------------------------------------------------
+
+def decode_scalar_result(plan: SegmentPlan, provider: Any,
+                         out: Dict[str, Any]) -> AggResult:
+    """``provider`` is anything with ``data_source(col).dictionary`` —
+    an ImmutableSegment or a SegmentBatch."""
+    states: List[Any] = []
+    for i, aspec in enumerate(plan.spec[1]):
+        raw = out[f"agg{i}"]
+        states.append(_decode_scalar_state(aspec, raw, provider))
+    return AggResult(states)
+
+
+def _decode_scalar_state(aspec: Tuple, raw: Any, provider: Any) -> Any:
+    base = aspec[0]
+    if base == "distinctcount":
+        presence = np.asarray(raw)
+        ids = np.nonzero(presence)[0]
+        d = provider.data_source(aspec[1]).dictionary
+        return frozenset(d.get_values(ids))
+    if base == "count":
+        return int(raw)
+    if base in ("sum", "min", "max"):
+        return float(raw)
+    if base == "avg":
+        return (float(raw[0]), int(raw[1]))
+    if base == "minmaxrange":
+        return (float(raw[0]), float(raw[1]))
+    raise AssertionError(base)
+
+
+def decode_grouped_result(plan: SegmentPlan, provider: Any,
+                          out: Dict[str, Any]) -> GroupByResult:
+    presence = np.asarray(out["presence"])
+    gidx = np.nonzero(presence)[0]
+    result = GroupByResult()
+    if gidx.size == 0:
+        return result
+
+    # decode composed keys -> per-column dictIds -> values, using the
+    # planner's own strides (single source of truth for key layout)
+    cards = plan.group_cards
+    strides = plan.group_strides.astype(np.int64)
+    key_cols: List[List[Any]] = []
+    for i, ((strat, col), card) in enumerate(zip(plan.group_defs, cards)):
+        dids = (gidx // strides[i]) % card
+        if strat == "gdict":
+            d = provider.data_source(col).dictionary
+            key_cols.append(d.get_values(dids))
+        else:  # graw value-space
+            base = int(provider.metadata.column(col).min_value)
+            key_cols.append([int(x) + base for x in dids])
+    keys = list(zip(*key_cols))
+
+    agg_specs = plan.spec[1]
+    states_per_agg: List[List[Any]] = []
+    for i, aspec in enumerate(agg_specs):
+        raw = out[f"agg{i}"]
+        base = aspec[0]
+        if base == "count":
+            arr = np.asarray(raw)[gidx]
+            states_per_agg.append([int(v) for v in arr])
+        elif base in ("sum", "min", "max"):
+            arr = np.asarray(raw)[gidx]
+            states_per_agg.append([float(v) for v in arr])
+        elif base == "avg":
+            s = np.asarray(raw[0])[gidx]
+            c = np.asarray(raw[1])[gidx]
+            states_per_agg.append([(float(a), int(b)) for a, b in zip(s, c)])
+        elif base == "minmaxrange":
+            lo = np.asarray(raw[0])[gidx]
+            hi = np.asarray(raw[1])[gidx]
+            states_per_agg.append([(float(a), float(b)) for a, b in zip(lo, hi)])
+        else:
+            raise AssertionError(base)
+
+    for gi, key in enumerate(keys):
+        result.groups[key] = [states_per_agg[ai][gi]
+                              for ai in range(len(plan.agg_defs))]
+    return result

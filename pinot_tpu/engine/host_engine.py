@@ -191,6 +191,13 @@ def execute_distinct(ctx: QueryContext, segments: List[ImmutableSegment],
             if key not in seen:
                 seen[key] = list(r)
     rows = list(seen.values())
+    if ctx.having is not None:
+        # GROUP BY without aggregations converts to DISTINCT (context.py);
+        # its HAVING filters on the group expressions, evaluated per row
+        from pinot_tpu.engine.results import _eval_scalar_filter
+        keys = [str(e) for e in select]
+        rows = [r for r in rows
+                if _eval_scalar_filter(ctx.having, dict(zip(keys, r)))]
     if ctx.order_by:
         idx_of = {str(e): i for i, e in enumerate(select)}
         def sort_key(row):

@@ -158,15 +158,24 @@ def _emit_value(vspec: Tuple, cols, pc: _ParamCursor) -> jnp.ndarray:
 # kernel factory
 # --------------------------------------------------------------------------
 
-def build_kernel(spec: Tuple):
+def build_kernel_body(spec: Tuple, capacity_override: int = 0):
     """spec = (filter_spec, agg_specs, group_specs, num_groups, capacity)
-    -> jitted fn(cols, params, num_docs) -> dict of partial arrays."""
-    filter_spec, agg_specs, group_specs, num_groups, capacity = spec
+    -> unjitted fn(cols, params, num_docs, doc_offset) -> dict of partials.
 
-    def kernel(cols, params, num_docs):
+    ``doc_offset`` is the global doc index of local row 0 — nonzero when the
+    doc dimension is sharded over a mesh axis (the sharded combine path
+    evaluates each device's sub-range of the scan; ref: the doc-dimension
+    "context parallelism" mapping, SURVEY.md §5). ``capacity_override``
+    replaces the spec's capacity with the per-shard local capacity.
+    """
+    filter_spec, agg_specs, group_specs, num_groups, capacity = spec
+    if capacity_override:
+        capacity = capacity_override
+
+    def kernel(cols, params, num_docs, doc_offset):
         pc = _ParamCursor(params)
         mask = _emit_filter(filter_spec, cols, pc, capacity)
-        valid = jnp.arange(capacity, dtype=jnp.int32) < num_docs
+        valid = (jnp.arange(capacity, dtype=jnp.int32) + doc_offset) < num_docs
         mask = mask & valid
 
         if not group_specs:
@@ -198,7 +207,41 @@ def build_kernel(spec: Tuple):
                                                num_groups)
         return out
 
+    return kernel
+
+
+def build_kernel(spec: Tuple):
+    """Single-segment entry: jitted fn(cols, params, num_docs)."""
+    body = build_kernel_body(spec)
+
+    def kernel(cols, params, num_docs):
+        return body(cols, params, num_docs, jnp.int32(0))
+
     return jax.jit(kernel)
+
+
+def partial_reduce_ops(spec: Tuple) -> Dict[str, Tuple[str, ...]]:
+    """Per-output-leaf merge op ('sum'|'min'|'max') for combining partials
+    across segments/devices — the state algebra of the combine phase
+    (ref: BaseCombineOperator merge + AggregationFunction.merge)."""
+    _, agg_specs, group_specs, _, _ = spec
+    ops: Dict[str, Tuple[str, ...]] = {}
+    if group_specs:
+        ops["presence"] = ("sum",)
+    else:
+        ops["num_matched"] = ("sum",)
+    for i, aspec in enumerate(agg_specs):
+        base = aspec[0]
+        ops[f"agg{i}"] = {
+            "count": ("sum",),
+            "sum": ("sum",),
+            "min": ("min",),
+            "max": ("max",),
+            "avg": ("sum", "sum"),
+            "minmaxrange": ("min", "max"),
+            "distinctcount": ("max",),
+        }[base]
+    return ops
 
 
 def _masked_values(aspec, cols, pc, mask):

@@ -1,0 +1,26 @@
+"""Distributed execution: segment batches sharded over TPU meshes.
+
+The TPU-native combine layer (ref: SURVEY.md §2.12 parallelism inventory):
+segments stack into unified-dictionary batches (batch.py), shard over a
+``jax.sharding.Mesh`` with ``shard_map``, and merge partial aggregates via
+ICI collectives (combine.py). ``ShardedQueryExecutor`` (executor.py) is the
+drop-in server executor over that path.
+"""
+
+from pinot_tpu.parallel.batch import SegmentBatch
+from pinot_tpu.parallel.combine import (
+    DOC_AXIS,
+    SEG_AXIS,
+    build_sharded_kernel,
+    make_combine_mesh,
+)
+from pinot_tpu.parallel.executor import ShardedQueryExecutor
+
+__all__ = [
+    "SegmentBatch",
+    "ShardedQueryExecutor",
+    "make_combine_mesh",
+    "build_sharded_kernel",
+    "SEG_AXIS",
+    "DOC_AXIS",
+]

@@ -1,0 +1,249 @@
+"""Segment batches: N segments unified into one device-executable block.
+
+The device-side combine (ref: ``BaseCombineOperator.java:55`` merging
+per-segment partials thread-by-thread) needs per-segment partial states that
+are *directly addable* on device. Per-segment dictionaries make dictIds
+incomparable across segments, so a batch re-keys every dictionary column into
+a **unified table-level dictionary** (host-side merge of the per-segment
+sorted dictionaries) and stacks the remapped forward indexes into
+``[num_segments, capacity]`` arrays. Group-by keys and DISTINCTCOUNT
+presence bitmaps composed from unified dictIds then merge across
+segments/devices with plain ``sum``/``max`` — i.e. ``psum``/``pmax`` over
+ICI (SURVEY.md §2.12 "Intra-server segment parallelism").
+
+A batch duck-types the segment interfaces the planner reads
+(``metadata.column()``, ``data_source().dictionary``, ``padded_capacity``)
+so ``plan_segment`` plans once against the unified key space.
+"""
+
+from __future__ import annotations
+
+from collections.abc import Mapping
+from dataclasses import replace
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from pinot_tpu.segment.dictionary import (
+    Dictionary,
+    NumericDictionary,
+    StringDictionary,
+)
+from pinot_tpu.segment.immutable import ImmutableSegment
+from pinot_tpu.segment.metadata import ColumnMetadata, SegmentMetadata
+from pinot_tpu.spi.data import DataType
+
+
+class _LazyColumnMap(Mapping):
+    """Column name -> merged ColumnMetadata, merged on first access (wide
+    tables don't pay dictionary unification for columns a query never
+    touches — mirrors the lazy per-column staging in engine/staging.py)."""
+
+    def __init__(self, batch: "SegmentBatch"):
+        self._batch = batch
+
+    def __getitem__(self, name: str) -> "ColumnMetadata":
+        return self._batch._merged_column(name)
+
+    def __iter__(self):
+        return iter(self._batch.segments[0].metadata.columns)
+
+    def __len__(self) -> int:
+        return len(self._batch.segments[0].metadata.columns)
+
+
+class BatchDataSource:
+    """Column access over the whole batch (planner-facing)."""
+
+    def __init__(self, batch: "SegmentBatch", name: str):
+        self.name = name
+        self.metadata = batch.metadata.column(name)
+        self.dictionary: Optional[Dictionary] = batch.unified_dictionary(name)
+
+
+class SegmentBatch:
+    """N same-table segments, re-keyed to unified dictionaries and stacked
+    into fixed-shape arrays ready for sharded device execution."""
+
+    def __init__(self, segments: List[ImmutableSegment]):
+        if not segments:
+            raise ValueError("empty segment batch")
+        self.segments = segments
+        first = segments[0].metadata
+        cols = set(first.columns.keys())
+        for s in segments[1:]:
+            if set(s.metadata.columns.keys()) != cols:
+                raise ValueError("segments in a batch must share a schema")
+
+        self.capacity = max(s.padded_capacity for s in segments)
+        self._dicts: Dict[str, Optional[Dictionary]] = {}
+        # per column: list of per-segment remap arrays (old dictId -> unified)
+        self._remaps: Dict[str, List[np.ndarray]] = {}
+        self._merged: Dict[str, ColumnMetadata] = {}
+        self._stacked: Dict[str, Dict[str, np.ndarray]] = {}
+        self._data_sources: Dict[str, BatchDataSource] = {}
+
+        self.metadata = SegmentMetadata(
+            segment_name="batch(" + ",".join(s.segment_name for s in segments) + ")",
+            table_name=first.table_name,
+            schema=first.schema,
+            num_docs=sum(s.num_docs for s in segments),
+            padded_capacity=self.capacity,
+            time_column=first.time_column,
+            columns=_LazyColumnMap(self),
+        )
+
+    # -- segment duck-type (planner interface) -----------------------------
+    @property
+    def segment_name(self) -> str:
+        return self.metadata.segment_name
+
+    @property
+    def num_docs(self) -> int:
+        return self.metadata.num_docs
+
+    @property
+    def padded_capacity(self) -> int:
+        return self.capacity
+
+    @property
+    def num_segments(self) -> int:
+        return len(self.segments)
+
+    def data_source(self, column: str) -> BatchDataSource:
+        ds = self._data_sources.get(column)
+        if ds is None:
+            self.metadata.column(column)
+            ds = BatchDataSource(self, column)
+            self._data_sources[column] = ds
+        return ds
+
+    def unified_dictionary(self, column: str) -> Optional[Dictionary]:
+        self._merged_column(column)
+        return self._dicts.get(column)
+
+    def num_docs_array(self, pad_to: int = 0) -> np.ndarray:
+        """[S] per-segment doc counts (0 for pad segments)."""
+        n = max(pad_to, self.num_segments)
+        out = np.zeros(n, dtype=np.int32)
+        for i, s in enumerate(self.segments):
+            out[i] = s.num_docs
+        return out
+
+    # -- unified dictionary construction -----------------------------------
+    def _merged_column(self, name: str) -> ColumnMetadata:
+        cm = self._merged.get(name)
+        if cm is None:
+            cm = self._merge_column(name)
+            self._merged[name] = cm
+        return cm
+
+    def _merge_column(self, name: str) -> ColumnMetadata:
+        cms = [s.metadata.column(name) for s in self.segments]
+        base = cms[0]
+        for cm in cms[1:]:
+            if (cm.data_type is not base.data_type
+                    or cm.single_value != base.single_value
+                    or cm.has_dictionary != base.has_dictionary):
+                raise ValueError(f"column {name!r} layout differs across batch")
+        has_nulls = any(cm.has_nulls for cm in cms)
+        max_mv = max(cm.max_num_multi_values for cm in cms)
+        total_entries = sum(cm.total_number_of_entries for cm in cms)
+
+        if base.has_dictionary:
+            dicts = [s.data_source(name).dictionary for s in self.segments]
+            unified, remaps = _merge_dictionaries(dicts, base.data_type)
+            self._dicts[name] = unified
+            self._remaps[name] = remaps
+            card = unified.cardinality
+            min_v, max_v = unified.min_value, unified.max_value
+        else:
+            card = sum(cm.cardinality for cm in cms)
+            vals = [cm.min_value for cm in cms if cm.min_value is not None]
+            min_v = min(vals) if vals else None
+            vals = [cm.max_value for cm in cms if cm.max_value is not None]
+            max_v = max(vals) if vals else None
+
+        return replace(
+            base, cardinality=card, min_value=min_v, max_value=max_v,
+            is_sorted=False, has_nulls=has_nulls,
+            has_inverted_index=False,
+            max_num_multi_values=max_mv,
+            total_number_of_entries=total_entries)
+
+    # -- stacked device-ready arrays ---------------------------------------
+    def stacked_column(self, name: str, pad_segments: int = 0) -> Dict[str, np.ndarray]:
+        """The batch analogue of ``StagedColumn.tree()``: per-segment arrays
+        get a leading ``[S]`` axis; shared arrays (``dictvals``) do not.
+        ``pad_segments`` extends S with empty segments (num_docs=0)."""
+        cached = self._stacked.get(name)
+        if cached is not None and cached["__S"] >= max(pad_segments, self.num_segments):
+            out = dict(cached)
+            out.pop("__S")
+            return out
+
+        cm = self.metadata.column(name)
+        S = max(pad_segments, self.num_segments)
+        cap = self.capacity
+        out: Dict[str, np.ndarray] = {}
+
+        if cm.single_value:
+            if cm.has_dictionary:
+                fwd = np.zeros((S, cap), dtype=np.int32)
+                for i, seg in enumerate(self.segments):
+                    raw = np.asarray(seg.data_source(name).forward_index)
+                    fwd[i, :raw.shape[0]] = self._remaps[name][i][raw]
+            else:
+                dt = np.int64 if cm.data_type.is_integral else np.float64
+                fwd = np.zeros((S, cap), dtype=dt)
+                for i, seg in enumerate(self.segments):
+                    raw = np.asarray(seg.data_source(name).forward_index)
+                    fwd[i, :raw.shape[0]] = raw.astype(dt)
+            out["fwd"] = fwd
+        else:
+            max_mv = max(cm.max_num_multi_values, 1)
+            mv = np.zeros((S, cap, max_mv), dtype=np.int32)
+            cnt = np.zeros((S, cap), dtype=np.int32)
+            for i, seg in enumerate(self.segments):
+                dense, counts = seg.data_source(name).dense_mv()
+                remapped = self._remaps[name][i][dense]
+                mv[i, :dense.shape[0], :dense.shape[1]] = remapped
+                cnt[i, :counts.shape[0]] = counts
+            out["mv"] = mv
+            out["mvcount"] = cnt
+
+        if cm.has_dictionary and cm.data_type.is_numeric:
+            vals = np.asarray(self._dicts[name].device_values())
+            out["dictvals"] = vals.astype(
+                np.int64 if cm.data_type.is_integral else np.float64)
+
+        if cm.has_nulls:
+            nb = np.zeros((S, cap), dtype=bool)
+            for i, seg in enumerate(self.segments):
+                b = seg.data_source(name).null_bitmap
+                if b is not None:
+                    nb[i, :np.asarray(b).shape[0]] = np.asarray(b)
+            out["null"] = nb
+
+        self._stacked[name] = dict(out, __S=S)
+        return out
+
+
+def _merge_dictionaries(dicts: List[Dictionary], data_type: DataType):
+    """Merge per-segment sorted dictionaries into one table-level dictionary;
+    returns (unified, [per-segment oldId->newId remap arrays])."""
+    if data_type.is_numeric:
+        arrays = [np.asarray(d.device_values()) for d in dicts]
+        unified_vals = np.unique(np.concatenate(arrays))
+        unified: Dictionary = NumericDictionary(unified_vals, data_type)
+        remaps = [np.searchsorted(unified_vals, a).astype(np.int32)
+                  for a in arrays]
+        return unified, remaps
+
+    value_lists = [d.get_values(range(d.cardinality)) for d in dicts]
+    all_vals = sorted(set().union(*[set(v) for v in value_lists]))
+    unified = StringDictionary.from_values(all_vals, data_type)
+    index = {v: i for i, v in enumerate(all_vals)}
+    remaps = [np.asarray([index[v] for v in vals], dtype=np.int32)
+              for vals in value_lists]
+    return unified, remaps

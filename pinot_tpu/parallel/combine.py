@@ -1,0 +1,184 @@
+"""Sharded multi-segment combine: shard_map over a device mesh + ICI collectives.
+
+TPU-native re-design of the instance-level combine
+(ref: ``BaseCombineOperator.java:55-140`` — N executor tasks over the segment
+list, partials merged through a BlockingQueue). Here the segment list is a
+:class:`SegmentBatch` stacked into ``[S, capacity]`` arrays and sharded over
+a 2-D ``jax.sharding.Mesh``:
+
+- ``seg`` axis: segments data-parallel across devices (the reference's
+  task-per-segment-group parallelism),
+- ``doc`` axis: the doc dimension of every segment split across devices
+  (the "context parallelism" of the scan, SURVEY.md §5).
+
+Each device runs the single-segment kernel body (vmapped over its local
+segments) and partials merge with ``psum``/``pmin``/``pmax`` over **both**
+mesh axes — XLA lowers these to ICI all-reduces. The merged result is
+replicated, so the host decode is identical to the single-segment path.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from pinot_tpu.engine.kernels import build_kernel_body, partial_reduce_ops
+from pinot_tpu.engine.plan import PlanError
+
+SEG_AXIS = "seg"
+DOC_AXIS = "doc"
+
+# shard spec per staged-column array kind. dictvals is the unified
+# dictionary: replicated (every device gathers from the full dictionary).
+KIND_SPEC = {
+    "fwd": P(SEG_AXIS, DOC_AXIS),
+    "mv": P(SEG_AXIS, DOC_AXIS, None),
+    "mvcount": P(SEG_AXIS, DOC_AXIS),
+    "null": P(SEG_AXIS, DOC_AXIS),
+    "dictvals": P(),
+}
+
+
+def device_stage_column(mesh: Mesh, tree: Dict[str, np.ndarray]):
+    """Host column arrays -> committed device arrays with the combine
+    shardings (the sharded analogue of StagedSegment: pay H2D once, reuse
+    across queries)."""
+    return {k: jax.device_put(v, NamedSharding(mesh, KIND_SPEC[k]))
+            for k, v in tree.items()}
+
+
+def make_combine_mesh(devices: Optional[List] = None,
+                      doc_shards: int = 1) -> Mesh:
+    """Mesh over all (or given) devices: segments over ``seg``, the doc
+    dimension over ``doc``. ``doc_shards`` must divide the device count."""
+    if devices is None:
+        devices = jax.devices()
+    n = len(devices)
+    if n % doc_shards:
+        raise ValueError(f"doc_shards {doc_shards} !| {n} devices")
+    arr = np.asarray(devices).reshape(n // doc_shards, doc_shards)
+    return Mesh(arr, (SEG_AXIS, DOC_AXIS))
+
+
+def _local_reduce(v: jnp.ndarray, op: str) -> jnp.ndarray:
+    if op == "sum":
+        return v.sum(axis=0)
+    if op == "min":
+        return v.min(axis=0)
+    if op == "max":
+        return v.max(axis=0)
+    raise AssertionError(op)
+
+
+def _cross_reduce(v: jnp.ndarray, op: str, axes) -> jnp.ndarray:
+    if op == "sum":
+        return jax.lax.psum(v, axes)
+    if op == "min":
+        return jax.lax.pmin(v, axes)
+    if op == "max":
+        return jax.lax.pmax(v, axes)
+    raise AssertionError(op)
+
+
+class ShardedKernelCache:
+    """(spec, mesh-shape) -> compiled sharded combine kernel."""
+
+    def __init__(self, mesh: Mesh):
+        self.mesh = mesh
+        self._cache: Dict[Tuple, object] = {}
+
+    def get(self, spec: Tuple, col_layouts: Tuple[Tuple[str, Tuple[str, ...]], ...]):
+        key = (spec, col_layouts)
+        fn = self._cache.get(key)
+        if fn is None:
+            fn = build_sharded_kernel(spec, self.mesh, col_layouts)
+            self._cache[key] = fn
+        return fn
+
+    def __len__(self) -> int:
+        return len(self._cache)
+
+
+def build_sharded_kernel(spec: Tuple, mesh: Mesh,
+                         col_layouts: Tuple[Tuple[str, Tuple[str, ...]], ...]):
+    """Compile the sharded combine for one kernel spec.
+
+    ``col_layouts``: per staged column, its array keys (('fwd',),
+    ('mv','mvcount'), +'dictvals'/'null') — static so the shard specs and
+    vmap axes are built once per (spec, layout).
+    """
+    n_seg = mesh.shape[SEG_AXIS]
+    n_doc = mesh.shape[DOC_AXIS]
+    capacity = spec[-1]
+    if capacity % n_doc:
+        # PlanError so the executor falls back to the per-segment path
+        raise PlanError(f"capacity {capacity} !| doc axis {n_doc}")
+    local_cap = capacity // n_doc
+    body = build_kernel_body(spec, capacity_override=local_cap)
+    reducers = partial_reduce_ops(spec)
+
+    kind_axis = {"fwd": 0, "mv": 0, "mvcount": 0, "null": 0, "dictvals": None}
+
+    cols_spec = {name: {k: KIND_SPEC[k] for k in keys}
+                 for name, keys in col_layouts}
+    cols_axes = {name: {k: kind_axis[k] for k in keys}
+                 for name, keys in col_layouts}
+
+    def per_device(cols, params, num_docs):
+        doc_off = (jax.lax.axis_index(DOC_AXIS) * local_cap).astype(jnp.int32)
+
+        def one_segment(seg_cols, nd):
+            return body(seg_cols, params, nd, doc_off)
+
+        partials = jax.vmap(one_segment, in_axes=(cols_axes, 0))(cols, num_docs)
+        out = {}
+        axes = (SEG_AXIS, DOC_AXIS)
+        for key, val in partials.items():
+            ops = reducers[key]
+            if isinstance(val, tuple):
+                out[key] = tuple(
+                    _cross_reduce(_local_reduce(v, op), op, axes)
+                    for v, op in zip(val, ops))
+            else:
+                out[key] = _cross_reduce(_local_reduce(val, ops[0]),
+                                         ops[0], axes)
+        # per-segment matched doc counts [S] (stats parity with the
+        # per-segment executor: numSegmentsMatched / numDocsScanned)
+        if "num_matched" in partials:
+            local = partials["num_matched"]            # [S_local]
+        else:
+            local = partials["presence"].sum(axis=1)   # [S_local]
+        local = jax.lax.psum(local, DOC_AXIS)
+        out["seg_matched"] = jax.lax.all_gather(local, SEG_AXIS, tiled=True)
+        return out
+
+    sharded = jax.shard_map(
+        per_device, mesh=mesh,
+        in_specs=(cols_spec, P(), P(SEG_AXIS)),
+        out_specs=_out_specs(spec, reducers),
+        check_vma=False)
+    return jax.jit(sharded)
+
+
+def _out_specs(spec: Tuple, reducers: Dict[str, Tuple[str, ...]]):
+    """Replicated out specs mirroring the kernel output tree."""
+    _, agg_specs, group_specs, _, _ = spec
+    out = {}
+    if group_specs:
+        out["presence"] = P()
+    else:
+        out["num_matched"] = P()
+    for i, ops in ((i, reducers[f"agg{i}"]) for i in range(len(agg_specs))):
+        out[f"agg{i}"] = tuple(P() for _ in ops) if len(ops) > 1 else P()
+    out["seg_matched"] = P()
+    return out
+
+
+def pad_segments(n: int, n_seg: int) -> int:
+    """Segments padded up to a multiple of the seg-axis size."""
+    return ((n + n_seg - 1) // n_seg) * n_seg

@@ -151,7 +151,9 @@ class SegmentBuilder:
         default = fs.default_null_value
         if fs.single_value:
             for i, v in enumerate(values):
-                if v is None:
+                if v is None or (isinstance(v, float) and v != v):
+                    # None and float NaN are both nulls (real-world readers
+                    # surface missing numeric cells as NaN)
                     null_mask[i] = True
                     out.append(default)
                 else:

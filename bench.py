@@ -1,0 +1,125 @@
+"""Benchmark: fused TPU query kernels vs the host (CPU/numpy) execution path.
+
+Workload: BASELINE.json configs #1/#2/#5 reduced to the current feature set —
+filtered aggregations + dictionary group-bys over a multi-segment table, run
+through the sharded device combine (parallel/executor.py) and through the
+pure-host engine (engine/host_engine.py), same result tables asserted equal.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"} where value
+is the device p50 latency over the query suite and vs_baseline is the
+host-path / device-path speedup (>1 means the TPU path is faster).
+"""
+
+from __future__ import annotations
+
+import json
+import tempfile
+import time
+
+import numpy as np
+
+NUM_SEGMENTS = 8
+DOCS_PER_SEGMENT = 131_072
+WARMUP = 2
+ITERS = 7
+
+QUERIES = [
+    # config #1: filtered SUM/COUNT aggregation
+    "SELECT count(*), sum(qty) FROM sales WHERE region = 'east'",
+    "SELECT sum(price) FROM sales WHERE year BETWEEN 2017 AND 2021 AND kind != 'c'",
+    # config #2: GROUP BY SUM/MIN/MAX/AVG on dictionary columns
+    "SELECT region, sum(qty), count(*) FROM sales GROUP BY region ORDER BY region",
+    "SELECT region, kind, sum(price), avg(price), min(qty), max(qty) FROM sales "
+    "GROUP BY region, kind ORDER BY region, kind",
+    "SELECT year, min(price), max(price) FROM sales WHERE kind = 'a' "
+    "GROUP BY year ORDER BY year",
+    # distinct-count + expression aggregation
+    "SELECT distinctcount(region) FROM sales WHERE qty > 25",
+    "SELECT sum(qty * price) FROM sales WHERE region IN ('west', 'south')",
+]
+
+
+def _frame(n: int, seed: int):
+    rng = np.random.default_rng(seed)
+    regions = ["east", "west", "north", "south"]
+    kinds = ["a", "b", "c"]
+    return {
+        "region": [regions[i] for i in rng.integers(0, 4, n)],
+        "kind": [kinds[i] for i in rng.integers(0, 3, n)],
+        "year": [int(v) for v in rng.integers(2015, 2024, n)],
+        "qty": [int(v) for v in rng.integers(1, 50, n)],
+        "price": [float(v) for v in np.round(rng.normal(100.0, 25.0, n), 2)],
+    }
+
+
+def _build_segments(tmpdir: str):
+    from pinot_tpu.segment import SegmentBuilder, load_segment
+    from pinot_tpu.spi import DataType, FieldSpec, FieldType, Schema
+
+    schema = Schema("sales", [
+        FieldSpec("region", DataType.STRING),
+        FieldSpec("kind", DataType.STRING),
+        FieldSpec("year", DataType.INT),
+        FieldSpec("qty", DataType.LONG, FieldType.METRIC),
+        FieldSpec("price", DataType.DOUBLE, FieldType.METRIC),
+    ])
+    segs = []
+    for i in range(NUM_SEGMENTS):
+        b = SegmentBuilder(schema, f"sales_{i}")
+        b.build(_frame(DOCS_PER_SEGMENT, seed=100 + i), tmpdir)
+        segs.append(load_segment(f"{tmpdir}/sales_{i}"))
+    return segs
+
+
+def _time_suite(run, ctxs) -> float:
+    """p50 over ITERS full-suite passes, seconds."""
+    for _ in range(WARMUP):
+        for ctx in ctxs:
+            run(ctx)
+    samples = []
+    for _ in range(ITERS):
+        t0 = time.perf_counter()
+        for ctx in ctxs:
+            run(ctx)
+        samples.append(time.perf_counter() - t0)
+    return float(np.percentile(samples, 50))
+
+
+def main() -> None:
+    from pinot_tpu.engine import ServerQueryExecutor
+    from pinot_tpu.parallel import ShardedQueryExecutor
+    from pinot_tpu.query import compile_query
+
+    tmpdir = tempfile.mkdtemp(prefix="bench_segs_")
+    segs = _build_segments(tmpdir)
+    ctxs = [compile_query(q) for q in QUERIES]
+
+    device_ex = ShardedQueryExecutor()
+    host_ex = ServerQueryExecutor(use_device=False)
+
+    # parity gate: device suite must match host suite before timing
+    for ctx in ctxs:
+        dev, _ = device_ex.execute(ctx, segs)
+        host, _ = host_ex.execute(ctx, segs)
+        assert len(dev.rows) == len(host.rows), ctx.sql
+        for dr, hr in zip(dev.rows, host.rows):
+            for d, h in zip(dr, hr):
+                if isinstance(h, float):
+                    assert abs(d - h) <= 1e-6 * max(1.0, abs(h)), (ctx.sql, d, h)
+                else:
+                    assert d == h, (ctx.sql, d, h)
+
+    dev_s = _time_suite(lambda c: device_ex.execute(c, segs), ctxs)
+    host_s = _time_suite(lambda c: host_ex.execute(c, segs), ctxs)
+
+    per_query_ms = dev_s / len(QUERIES) * 1e3
+    print(json.dumps({
+        "metric": "multi_segment_query_suite_p50_latency",
+        "value": round(per_query_ms, 3),
+        "unit": "ms/query",
+        "vs_baseline": round(host_s / dev_s, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

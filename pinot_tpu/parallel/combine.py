@@ -75,7 +75,12 @@ def _local_reduce(v: jnp.ndarray, op: str) -> jnp.ndarray:
     raise AssertionError(op)
 
 
-def _cross_reduce(v: jnp.ndarray, op: str, axes) -> jnp.ndarray:
+def _cross_reduce(v: jnp.ndarray, op: str, axes, mesh: Mesh) -> jnp.ndarray:
+    # collectives only over axes with >1 device: a size-1 axis is a no-op,
+    # and single-chip AOT backends may lower only Sum all-reduces
+    axes = tuple(a for a in axes if mesh.shape[a] > 1)
+    if not axes:
+        return v
     if op == "sum":
         return jax.lax.psum(v, axes)
     if op == "min":
@@ -142,19 +147,22 @@ def build_sharded_kernel(spec: Tuple, mesh: Mesh,
             ops = reducers[key]
             if isinstance(val, tuple):
                 out[key] = tuple(
-                    _cross_reduce(_local_reduce(v, op), op, axes)
+                    _cross_reduce(_local_reduce(v, op), op, axes, mesh)
                     for v, op in zip(val, ops))
             else:
                 out[key] = _cross_reduce(_local_reduce(val, ops[0]),
-                                         ops[0], axes)
+                                         ops[0], axes, mesh)
         # per-segment matched doc counts [S] (stats parity with the
         # per-segment executor: numSegmentsMatched / numDocsScanned)
         if "num_matched" in partials:
             local = partials["num_matched"]            # [S_local]
         else:
             local = partials["presence"].sum(axis=1)   # [S_local]
-        local = jax.lax.psum(local, DOC_AXIS)
-        out["seg_matched"] = jax.lax.all_gather(local, SEG_AXIS, tiled=True)
+        if mesh.shape[DOC_AXIS] > 1:
+            local = jax.lax.psum(local, DOC_AXIS)
+        if mesh.shape[SEG_AXIS] > 1:
+            local = jax.lax.all_gather(local, SEG_AXIS, tiled=True)
+        out["seg_matched"] = local
         return out
 
     sharded = jax.shard_map(

@@ -26,6 +26,7 @@ from pinot_tpu.engine.plan import PlanError, SegmentPlan, plan_segment
 from pinot_tpu.engine.results import AggResult, GroupByResult, QueryStats
 from pinot_tpu.parallel.batch import SegmentBatch
 from pinot_tpu.parallel.combine import (
+    DOC_AXIS,
     SEG_AXIS,
     ShardedKernelCache,
     device_stage_column,
@@ -56,7 +57,9 @@ class ShardedQueryExecutor(ServerQueryExecutor):
             try:
                 batch, out, plan = self._run_sharded(ctx, segments, stats)
                 return decode_scalar_result(plan, batch, out)
-            except PlanError:
+            except (PlanError, ValueError):
+                # ValueError: segments not batchable (mixed layouts/schemas,
+                # batch.py) — the per-segment path still serves them
                 pass
         return super()._execute_aggregation(ctx, aggs, segments, stats)
 
@@ -65,7 +68,7 @@ class ShardedQueryExecutor(ServerQueryExecutor):
             try:
                 batch, out, plan = self._run_sharded(ctx, segments, stats)
                 return decode_grouped_result(plan, batch, out)
-            except PlanError:
+            except (PlanError, ValueError):
                 pass
         return super()._execute_group_by(ctx, aggs, segments, stats)
 
@@ -73,16 +76,32 @@ class ShardedQueryExecutor(ServerQueryExecutor):
     def batch_for(self, segments: List[ImmutableSegment]) -> SegmentBatch:
         key = tuple(s.segment_name for s in segments)
         b = self._batches.get(key)
-        if b is None:
+        if b is None or any(cached is not seg for cached, seg
+                            in zip(b.segments, segments)):
+            # identity check: a reloaded segment keeps its name but must not
+            # serve stale device arrays (same guard as StagingCache)
+            if b is not None:
+                self._evict_batch(b)
             b = SegmentBatch(segments)
             self._batches[key] = b
         return b
+
+    def _evict_batch(self, batch: SegmentBatch) -> None:
+        name = batch.metadata.segment_name
+        for k in [k for k in self._device_cols if k[0] == name]:
+            del self._device_cols[k]
 
     def _run_sharded(self, ctx: QueryContext,
                      segments: List[ImmutableSegment],
                      stats: QueryStats):
         batch = self.batch_for(segments)
         plan = plan_segment(ctx, batch)
+
+        # reject before paying dictionary unification + H2D staging
+        if plan.spec[-1] % self.mesh.shape[DOC_AXIS]:
+            raise PlanError(
+                f"capacity {plan.spec[-1]} !| doc axis "
+                f"{self.mesh.shape[DOC_AXIS]}")
 
         S = pad_segments(batch.num_segments, self.mesh.shape[SEG_AXIS])
         cols = {name: self._staged_column(batch, name, S)

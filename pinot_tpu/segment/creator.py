@@ -159,12 +159,22 @@ class SegmentBuilder:
                 else:
                     out.append(fs.data_type.convert(v))
         else:
+            def is_nan(x):
+                return isinstance(x, float) and x != x
+
             for i, v in enumerate(values):
-                if v is None or (isinstance(v, (list, tuple, np.ndarray)) and len(v) == 0):
+                if v is None or is_nan(v) or (
+                        isinstance(v, (list, tuple, np.ndarray)) and len(v) == 0):
                     null_mask[i] = True
                     out.append([default])
                 elif isinstance(v, (list, tuple, np.ndarray)):
-                    out.append([fs.data_type.convert(x) for x in v])
+                    vals = [fs.data_type.convert(x) for x in v
+                            if not (x is None or is_nan(x))]
+                    if vals:
+                        out.append(vals)
+                    else:
+                        null_mask[i] = True
+                        out.append([default])
                 else:
                     out.append([fs.data_type.convert(v)])
         return out, null_mask

@@ -92,6 +92,10 @@ def _matching_dict_ids(ds: DataSource, pred: Predicate) -> np.ndarray:
     if t is PredicateType.RANGE:
         lo = conv(pred.lower) if pred.lower is not None else None
         hi = conv(pred.upper) if pred.upper is not None else None
+        if hasattr(d, "matching_range_ids"):
+            # unsorted (mutable) dictionary: value scan, not dictId interval
+            return d.matching_range_ids(lo, hi, pred.lower_inclusive,
+                                        pred.upper_inclusive)
         a, b = d.range_to_dict_id_interval(lo, hi, pred.lower_inclusive,
                                            pred.upper_inclusive)
         return np.arange(max(a, 0), min(b, card - 1) + 1, dtype=np.int64)

@@ -69,6 +69,10 @@ class PlanError(UnsupportedQueryError):
 
 
 def plan_segment(ctx: QueryContext, segment: ImmutableSegment) -> SegmentPlan:
+    if getattr(segment, "is_mutable", False):
+        # consuming segments are host-resident (unsorted dictionaries, live
+        # append) — served by the host engine until sealed (SURVEY.md §7)
+        raise PlanError("mutable segment -> host path")
     params: List[np.ndarray] = []
     columns: List[str] = []
 

@@ -1,0 +1,52 @@
+"""Ingestion: record transformers, stream SPI, realtime consumption
+(ref: pinot-spi stream/, pinot-segment-local recordtransformer/,
+pinot-core data/manager/realtime/)."""
+
+from pinot_tpu.ingestion.stream import (
+    JsonMessageDecoder,
+    MemoryStream,
+    MessageBatch,
+    PartitionLevelConsumer,
+    StreamConsumerFactory,
+    StreamMessage,
+    StreamMessageDecoder,
+    StreamMetadataProvider,
+    StreamOffset,
+    create_consumer_factory,
+    create_decoder,
+    register_decoder,
+    register_stream_type,
+)
+from pinot_tpu.ingestion.transformers import (
+    CompositeTransformer,
+    ComplexTypeTransformer,
+    DataTypeTransformer,
+    ExpressionTransformer,
+    FilterTransformer,
+    NullValueTransformer,
+    RecordTransformer,
+    SanitizationTransformer,
+    transform_rows,
+)
+from pinot_tpu.ingestion.realtime import (
+    CompletionReply,
+    CompletionResponse,
+    ConsumerState,
+    LocalCompletionProtocol,
+    RealtimeSegmentDataManager,
+    SegmentCompletionProtocol,
+)
+
+__all__ = [
+    "JsonMessageDecoder", "MemoryStream", "MessageBatch",
+    "PartitionLevelConsumer", "StreamConsumerFactory", "StreamMessage",
+    "StreamMessageDecoder", "StreamMetadataProvider", "StreamOffset",
+    "create_consumer_factory", "create_decoder", "register_decoder",
+    "register_stream_type",
+    "CompositeTransformer", "ComplexTypeTransformer", "DataTypeTransformer",
+    "ExpressionTransformer", "FilterTransformer", "NullValueTransformer",
+    "RecordTransformer", "SanitizationTransformer", "transform_rows",
+    "CompletionReply", "CompletionResponse", "ConsumerState",
+    "LocalCompletionProtocol", "RealtimeSegmentDataManager",
+    "SegmentCompletionProtocol",
+]

@@ -1,0 +1,323 @@
+"""Realtime segment consumption: the consume loop + commit state machine.
+
+Re-design of ``pinot-core/.../data/manager/realtime/LLRealtimeSegmentDataManager.java:100``:
+a per-partition consumer drains ``MessageBatch``es from the stream into a
+host-resident :class:`MutableSegment` (decode -> transform -> index), tracks
+offsets, and on reaching the flush threshold negotiates the commit with the
+controller through the segment-completion protocol
+(``SegmentCompletionProtocol.java:54``): segmentConsumed -> HOLD / CATCHUP /
+COMMIT -> build immutable segment -> split commit (upload file, then commit
+metadata). The committed stream offset range recorded in segment metadata is
+the checkpoint (SURVEY.md §5 checkpoint/resume).
+"""
+
+from __future__ import annotations
+
+import enum
+import logging
+import os
+import threading
+import time
+
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, Optional
+
+from pinot_tpu.ingestion.stream import (
+    StreamConsumerFactory,
+    StreamMessageDecoder,
+    StreamOffset,
+    create_consumer_factory,
+    create_decoder,
+)
+from pinot_tpu.ingestion.transformers import CompositeTransformer
+from pinot_tpu.segment.metadata import SegmentMetadata
+from pinot_tpu.segment.mutable import MutableSegment
+from pinot_tpu.spi.data import Schema
+from pinot_tpu.spi.table import TableConfig
+
+log = logging.getLogger(__name__)
+
+
+class ConsumerState(enum.Enum):
+    """Ref: LLRealtimeSegmentDataManager.State:101."""
+
+    INITIAL_CONSUMING = "INITIAL_CONSUMING"
+    CATCHING_UP = "CATCHING_UP"
+    HOLDING = "HOLDING"
+    COMMITTING = "COMMITTING"
+    COMMITTED = "COMMITTED"
+    RETAINING = "RETAINING"
+    DISCARDED = "DISCARDED"
+    ERROR = "ERROR"
+
+
+class CompletionResponse(enum.Enum):
+    """Controller replies (ref: SegmentCompletionProtocol responses)."""
+
+    HOLD = "HOLD"
+    CATCHUP = "CATCHUP"
+    COMMIT = "COMMIT"
+    KEEP = "KEEP"
+    DISCARD = "DISCARD"
+    NOT_LEADER = "NOT_LEADER"
+
+
+@dataclass
+class CompletionReply:
+    response: CompletionResponse
+    # for CATCHUP: the offset to catch up to
+    target_offset: Optional[StreamOffset] = None
+
+
+class SegmentCompletionProtocol:
+    """Client side of the controller commit FSM (ref:
+    protocols/SegmentCompletionProtocol.java:54 message types)."""
+
+    def segment_consumed(self, segment_name: str, instance: str,
+                         offset: StreamOffset) -> CompletionReply:
+        raise NotImplementedError
+
+    def segment_commit_start(self, segment_name: str, instance: str,
+                             offset: StreamOffset) -> CompletionReply:
+        raise NotImplementedError
+
+    def segment_commit_upload(self, segment_name: str, instance: str,
+                              segment_dir: str) -> str:
+        """Upload the built segment; returns the deep-store location."""
+        raise NotImplementedError
+
+    def segment_commit_end(self, segment_name: str, instance: str,
+                           offset: StreamOffset, location: str,
+                           metadata: SegmentMetadata) -> CompletionReply:
+        raise NotImplementedError
+
+    def segment_stopped_consuming(self, segment_name: str, instance: str,
+                                  reason: str) -> None:
+        pass
+
+
+class LocalCompletionProtocol(SegmentCompletionProtocol):
+    """Single-replica protocol: the caller always commits (standalone /
+    quickstart mode — no controller FSM in the loop)."""
+
+    def segment_consumed(self, segment_name, instance, offset):
+        return CompletionReply(CompletionResponse.COMMIT)
+
+    def segment_commit_start(self, segment_name, instance, offset):
+        return CompletionReply(CompletionResponse.COMMIT)
+
+    def segment_commit_upload(self, segment_name, instance, segment_dir):
+        return segment_dir
+
+    def segment_commit_end(self, segment_name, instance, offset, location,
+                           metadata):
+        return CompletionReply(CompletionResponse.COMMIT)
+
+
+@dataclass
+class ConsumptionResult:
+    state: ConsumerState
+    rows_indexed: int
+    rows_dropped: int
+    final_offset: StreamOffset
+    segment_dir: Optional[str] = None
+    metadata: Optional[SegmentMetadata] = None
+
+
+class RealtimeSegmentDataManager:
+    """One consuming segment of one stream partition.
+
+    Synchronous core (``consume_until``/``run_once``) + an optional
+    background thread (``start``/``stop``) mirroring the reference's
+    PartitionConsumer thread (run():590).
+    """
+
+    def __init__(self, segment_name: str, table_config: TableConfig,
+                 schema: Schema, partition: int,
+                 start_offset: StreamOffset,
+                 protocol: Optional[SegmentCompletionProtocol] = None,
+                 instance_id: str = "server_0",
+                 output_dir: str = "/tmp/pinot_tpu_segments",
+                 consumer_factory: Optional[StreamConsumerFactory] = None,
+                 on_committed: Optional[Callable[["RealtimeSegmentDataManager",
+                                                  SegmentMetadata, str], None]] = None):
+        sc = table_config.stream_config
+        if sc is None:
+            raise ValueError("table has no stream config")
+        self.segment_name = segment_name
+        self.table_config = table_config
+        self.schema = schema
+        self.partition = partition
+        self.instance_id = instance_id
+        self.output_dir = output_dir
+        self.protocol = protocol or LocalCompletionProtocol()
+        self.on_committed = on_committed
+
+        factory = consumer_factory or create_consumer_factory(sc)
+        self._consumer = factory.create_partition_consumer(partition)
+        self._decoder: StreamMessageDecoder = create_decoder(sc.decoder)
+        self._transformer = CompositeTransformer.for_table(table_config, schema)
+
+        self.segment = MutableSegment(
+            schema, segment_name,
+            capacity=max(sc.segment_flush_threshold_rows, 1),
+            indexing_config=table_config.indexing_config)
+        self.start_offset = start_offset
+        self.current_offset = start_offset
+        self.flush_threshold_rows = sc.segment_flush_threshold_rows
+        self.flush_threshold_ms = sc.segment_flush_threshold_millis
+        self._start_time_ms = int(time.time() * 1000)
+
+        self.state = ConsumerState.INITIAL_CONSUMING
+        self.rows_indexed = 0
+        self.rows_dropped = 0
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- consume core -------------------------------------------------------
+    def _index_batch(self, limit_offset: Optional[StreamOffset] = None) -> int:
+        batch = self._consumer.fetch_messages(self.current_offset)
+        n = 0
+        for msg in batch.messages:
+            if limit_offset is not None and msg.offset >= limit_offset:
+                break
+            row = self._decoder.decode(msg)
+            if row is not None:
+                row = self._transformer.transform(row)
+            if row is None:
+                self.rows_dropped += 1
+            else:
+                if not self.segment.index(row):
+                    break
+                self.rows_indexed += 1
+            n += 1
+            self.current_offset = StreamOffset(msg.offset.value + 1)
+        return n
+
+    def _threshold_reached(self) -> bool:
+        if self.rows_indexed >= self.flush_threshold_rows:
+            return True
+        age = int(time.time() * 1000) - self._start_time_ms
+        return age >= self.flush_threshold_ms and self.rows_indexed > 0
+
+    def run_once(self) -> ConsumerState:
+        """One iteration of the consume/commit state machine
+        (ref: PartitionConsumer.run():590-705)."""
+        if self.state in (ConsumerState.INITIAL_CONSUMING,
+                          ConsumerState.CATCHING_UP):
+            limit = (self._catchup_target
+                     if self.state is ConsumerState.CATCHING_UP else None)
+            self._index_batch(limit)
+            if self.state is ConsumerState.CATCHING_UP:
+                if (self._catchup_target is not None
+                        and self.current_offset >= self._catchup_target):
+                    self.state = ConsumerState.HOLDING
+            elif self._threshold_reached():
+                self.state = ConsumerState.HOLDING
+
+        if self.state is ConsumerState.HOLDING:
+            reply = self.protocol.segment_consumed(
+                self.segment_name, self.instance_id, self.current_offset)
+            if reply.response is CompletionResponse.COMMIT:
+                self.state = ConsumerState.COMMITTING
+            elif reply.response is CompletionResponse.CATCHUP:
+                self._catchup_target = reply.target_offset
+                self.state = ConsumerState.CATCHING_UP
+            elif reply.response is CompletionResponse.KEEP:
+                self.state = ConsumerState.RETAINING
+            elif reply.response is CompletionResponse.DISCARD:
+                self.state = ConsumerState.DISCARDED
+            # HOLD: stay HOLDING, retry next tick
+
+        if self.state is ConsumerState.COMMITTING:
+            self._commit()
+        return self.state
+
+    _catchup_target: Optional[StreamOffset] = None
+
+    def _commit(self) -> None:
+        """Split commit (ref: commitSegment:939 + SplitSegmentCommitter):
+        build -> upload -> metadata flip."""
+        try:
+            reply = self.protocol.segment_commit_start(
+                self.segment_name, self.instance_id, self.current_offset)
+            if reply.response is not CompletionResponse.COMMIT:
+                self.state = ConsumerState.HOLDING
+                return
+            md, seg_dir = self.build_segment()
+            location = self.protocol.segment_commit_upload(
+                self.segment_name, self.instance_id, seg_dir)
+            end = self.protocol.segment_commit_end(
+                self.segment_name, self.instance_id, self.current_offset,
+                location, md)
+            if end.response is CompletionResponse.COMMIT:
+                self.state = ConsumerState.COMMITTED
+                self._committed_metadata = md
+                self._committed_dir = seg_dir
+                if self.on_committed is not None:
+                    self.on_committed(self, md, seg_dir)
+            else:
+                self.state = ConsumerState.HOLDING
+        except Exception:
+            log.exception("commit failed for %s", self.segment_name)
+            self.state = ConsumerState.ERROR
+
+    _committed_metadata: Optional[SegmentMetadata] = None
+    _committed_dir: Optional[str] = None
+
+    def build_segment(self):
+        """Ref: buildSegmentForCommit:754 — mutable -> immutable conversion.
+        Stream offsets land in segment custom metadata (the checkpoint)."""
+        os.makedirs(self.output_dir, exist_ok=True)
+        md = self.segment.build_immutable(self.output_dir)
+        md.custom.update({
+            "segment.realtime.startOffset": str(self.start_offset),
+            "segment.realtime.endOffset": str(self.current_offset),
+            "segment.realtime.partition": self.partition,
+        })
+        seg_dir = os.path.join(self.output_dir, self.segment_name)
+        md.save(os.path.join(seg_dir, "metadata.json"))
+        return md, seg_dir
+
+    # -- synchronous drive (tests, quickstart) ------------------------------
+    def consume_until_committed(self, max_iters: int = 10_000) -> ConsumptionResult:
+        for _ in range(max_iters):
+            st = self.run_once()
+            if st in (ConsumerState.COMMITTED, ConsumerState.RETAINING,
+                      ConsumerState.DISCARDED, ConsumerState.ERROR):
+                break
+        return ConsumptionResult(
+            self.state, self.rows_indexed, self.rows_dropped,
+            self.current_offset, self._committed_dir, self._committed_metadata)
+
+    # -- background thread (server runtime) ---------------------------------
+    def start(self, tick_seconds: float = 0.05) -> None:
+        def loop():
+            while not self._stop.is_set():
+                st = self.run_once()
+                if st in (ConsumerState.COMMITTED, ConsumerState.RETAINING,
+                          ConsumerState.DISCARDED, ConsumerState.ERROR):
+                    break
+                if st is ConsumerState.HOLDING:
+                    self._stop.wait(tick_seconds)
+                elif not self._has_new_data():
+                    self._stop.wait(tick_seconds)
+            self._consumer.close()
+
+        self._thread = threading.Thread(target=loop, daemon=True,
+                                        name=f"consumer-{self.segment_name}")
+        self._thread.start()
+
+    def _has_new_data(self) -> bool:
+        batch = self._consumer.fetch_messages(self.current_offset,
+                                              max_messages=1)
+        return batch.message_count > 0
+
+    def stop(self, reason: str = "shutdown") -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+        if self.state not in (ConsumerState.COMMITTED,
+                              ConsumerState.DISCARDED):
+            self.protocol.segment_stopped_consuming(
+                self.segment_name, self.instance_id, reason)

@@ -1,0 +1,267 @@
+"""Stream SPI: pluggable realtime stream consumption.
+
+Re-design of ``pinot-spi/.../stream/*`` (27 files):
+``StreamConsumerFactory`` -> ``PartitionLevelConsumer`` fetching
+``MessageBatch``es by offset, ``StreamMetadataProvider`` for partition
+counts/offsets, ``StreamMessageDecoder`` for payload decode. Includes an
+in-process ``MemoryStream`` (the test/quickstart analogue of the reference's
+embedded Kafka, ``KafkaStarterUtils`` / ``StreamDataServerStartable``).
+
+Offsets are plain int64s (the reference's ``LongMsgOffset``); a factory
+registry keyed by ``stream.type`` mirrors ``StreamConsumerFactoryProvider``.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, List, Optional
+
+from pinot_tpu.spi.table import StreamIngestionConfig
+
+
+# --------------------------------------------------------------------------
+# offsets + message batch
+# --------------------------------------------------------------------------
+
+@dataclass(frozen=True, order=True)
+class StreamOffset:
+    """Ref: StreamPartitionMsgOffset / LongMsgOffset."""
+
+    value: int
+
+    def __str__(self) -> str:
+        return str(self.value)
+
+    @classmethod
+    def parse(cls, s: str) -> "StreamOffset":
+        return cls(int(s))
+
+
+@dataclass
+class StreamMessage:
+    payload: Any
+    offset: StreamOffset
+    key: Optional[Any] = None
+    timestamp_ms: int = 0
+
+
+@dataclass
+class MessageBatch:
+    """Ref: MessageBatch.java — messages + the offset to resume from."""
+
+    messages: List[StreamMessage]
+    next_offset: StreamOffset
+
+    @property
+    def message_count(self) -> int:
+        return len(self.messages)
+
+
+# --------------------------------------------------------------------------
+# SPI interfaces
+# --------------------------------------------------------------------------
+
+class PartitionLevelConsumer:
+    """Ref: PartitionLevelConsumer.java — fetch [start, end) by offset."""
+
+    def fetch_messages(self, start: StreamOffset,
+                       max_messages: int = 5000,
+                       timeout_ms: int = 5000) -> MessageBatch:
+        raise NotImplementedError
+
+    def close(self) -> None:
+        pass
+
+
+class StreamMetadataProvider:
+    """Ref: StreamMetadataProvider.java."""
+
+    def partition_count(self) -> int:
+        raise NotImplementedError
+
+    def earliest_offset(self, partition: int) -> StreamOffset:
+        raise NotImplementedError
+
+    def latest_offset(self, partition: int) -> StreamOffset:
+        raise NotImplementedError
+
+    def close(self) -> None:
+        pass
+
+
+class StreamConsumerFactory:
+    """Ref: StreamConsumerFactory.java."""
+
+    def __init__(self, config: StreamIngestionConfig):
+        self.config = config
+
+    def create_partition_consumer(self, partition: int) -> PartitionLevelConsumer:
+        raise NotImplementedError
+
+    def create_metadata_provider(self) -> StreamMetadataProvider:
+        raise NotImplementedError
+
+
+class StreamMessageDecoder:
+    """Ref: StreamMessageDecoder.java — payload -> row dict or None."""
+
+    def decode(self, message: StreamMessage) -> Optional[Dict[str, Any]]:
+        raise NotImplementedError
+
+
+class JsonMessageDecoder(StreamMessageDecoder):
+    """Ref: org.apache.pinot.plugin.inputformat.json JSONMessageDecoder."""
+
+    def decode(self, message: StreamMessage) -> Optional[Dict[str, Any]]:
+        p = message.payload
+        if isinstance(p, dict):
+            return dict(p)
+        if isinstance(p, bytes):
+            p = p.decode("utf-8")
+        try:
+            v = json.loads(p)
+        except (json.JSONDecodeError, TypeError):
+            return None
+        return v if isinstance(v, dict) else None
+
+
+# --------------------------------------------------------------------------
+# factory registry (ref: StreamConsumerFactoryProvider)
+# --------------------------------------------------------------------------
+
+_FACTORIES: Dict[str, Callable[[StreamIngestionConfig], StreamConsumerFactory]] = {}
+_DECODERS: Dict[str, Callable[[], StreamMessageDecoder]] = {}
+
+
+def register_stream_type(name: str,
+                         factory: Callable[[StreamIngestionConfig], StreamConsumerFactory]) -> None:
+    _FACTORIES[name.lower()] = factory
+
+
+def register_decoder(name: str, ctor: Callable[[], StreamMessageDecoder]) -> None:
+    _DECODERS[name.lower()] = ctor
+
+
+def create_consumer_factory(config: StreamIngestionConfig) -> StreamConsumerFactory:
+    f = _FACTORIES.get((config.stream_type or "").lower())
+    if f is None:
+        raise ValueError(f"unknown stream type {config.stream_type!r}; "
+                         f"registered: {sorted(_FACTORIES)}")
+    return f(config)
+
+
+def create_decoder(name: Optional[str]) -> StreamMessageDecoder:
+    if not name:
+        return JsonMessageDecoder()
+    d = _DECODERS.get(name.lower())
+    if d is None:
+        # accept reference class names, e.g. '...JSONMessageDecoder'
+        if "json" in name.lower():
+            return JsonMessageDecoder()
+        raise ValueError(f"unknown decoder {name!r}")
+    return d()
+
+
+# --------------------------------------------------------------------------
+# in-memory stream (embedded-Kafka analogue for tests/quickstarts)
+# --------------------------------------------------------------------------
+
+class MemoryStream:
+    """In-process partitioned log. Producers append; consumers fetch by
+    offset. One global registry by topic name so table configs can reference
+    topics the way Kafka configs do."""
+
+    _topics: Dict[str, "MemoryStream"] = {}
+    _lock = threading.Lock()
+
+    def __init__(self, topic: str, num_partitions: int = 1):
+        self.topic = topic
+        self.num_partitions = num_partitions
+        self._partitions: List[List[StreamMessage]] = [
+            [] for _ in range(num_partitions)]
+        self._plock = threading.Lock()
+
+    @classmethod
+    def create(cls, topic: str, num_partitions: int = 1) -> "MemoryStream":
+        with cls._lock:
+            s = cls(topic, num_partitions)
+            cls._topics[topic] = s
+            return s
+
+    @classmethod
+    def get(cls, topic: str) -> "MemoryStream":
+        with cls._lock:
+            s = cls._topics.get(topic)
+            if s is None:
+                raise KeyError(f"no such topic {topic!r}")
+            return s
+
+    @classmethod
+    def delete(cls, topic: str) -> None:
+        with cls._lock:
+            cls._topics.pop(topic, None)
+
+    def produce(self, payload: Any, partition: Optional[int] = None,
+                key: Optional[Any] = None, timestamp_ms: int = 0) -> StreamOffset:
+        with self._plock:
+            if partition is None:
+                partition = (hash(key) if key is not None else 0) % self.num_partitions
+            log = self._partitions[partition]
+            off = StreamOffset(len(log))
+            log.append(StreamMessage(payload, off, key, timestamp_ms))
+            return off
+
+    def fetch(self, partition: int, start: StreamOffset,
+              max_messages: int) -> MessageBatch:
+        with self._plock:
+            log = self._partitions[partition]
+            msgs = log[start.value: start.value + max_messages]
+            next_off = StreamOffset(start.value + len(msgs))
+            return MessageBatch(list(msgs), next_off)
+
+    def latest_offset(self, partition: int) -> StreamOffset:
+        with self._plock:
+            return StreamOffset(len(self._partitions[partition]))
+
+
+class MemoryStreamConsumer(PartitionLevelConsumer):
+    def __init__(self, stream: MemoryStream, partition: int):
+        self._stream = stream
+        self._partition = partition
+
+    def fetch_messages(self, start: StreamOffset, max_messages: int = 5000,
+                       timeout_ms: int = 5000) -> MessageBatch:
+        return self._stream.fetch(self._partition, start, max_messages)
+
+
+class MemoryStreamMetadataProvider(StreamMetadataProvider):
+    def __init__(self, stream: MemoryStream):
+        self._stream = stream
+
+    def partition_count(self) -> int:
+        return self._stream.num_partitions
+
+    def earliest_offset(self, partition: int) -> StreamOffset:
+        return StreamOffset(0)
+
+    def latest_offset(self, partition: int) -> StreamOffset:
+        return self._stream.latest_offset(partition)
+
+
+class MemoryStreamConsumerFactory(StreamConsumerFactory):
+    """stream.type = 'memory'; topic from stream config."""
+
+    def _stream(self) -> MemoryStream:
+        return MemoryStream.get(self.config.topic)
+
+    def create_partition_consumer(self, partition: int) -> MemoryStreamConsumer:
+        return MemoryStreamConsumer(self._stream(), partition)
+
+    def create_metadata_provider(self) -> MemoryStreamMetadataProvider:
+        return MemoryStreamMetadataProvider(self._stream())
+
+
+register_stream_type("memory", MemoryStreamConsumerFactory)

@@ -1,0 +1,216 @@
+"""Record transformer pipeline: ingestion-time row transforms.
+
+Re-design of ``pinot-segment-local/.../recordtransformer/*`` —
+``CompositeTransformer.java`` chains (in the reference's order):
+ExpressionTransformer (derived columns), FilterTransformer (row drops),
+DataTypeTransformer (schema coercion), NullValueTransformer (defaults +
+null tracking), SanitizationTransformer (string cleanup), and
+ComplexTypeTransformer (nested-object flattening/unnesting) — over
+``GenericRow``-style dicts before they reach the segment writer.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Iterable, List, Optional
+
+from pinot_tpu.query.functions import EvalError, eval_row_filter, eval_scalar
+from pinot_tpu.query.parser import parse_expression, parse_filter_expression
+from pinot_tpu.spi.data import FieldSpec, Schema
+from pinot_tpu.spi.table import TableConfig
+
+Row = Dict[str, Any]
+
+# sentinel: transformer dropped the row (ref: GenericRow skip-record flag)
+SKIP = None
+
+
+class RecordTransformer:
+    """transform(row) -> row | None (None = drop; ref: RecordTransformer.java)."""
+
+    def transform(self, row: Row) -> Optional[Row]:
+        raise NotImplementedError
+
+
+class ExpressionTransformer(RecordTransformer):
+    """Derived columns from SQL expressions over source fields
+    (ref: ExpressionTransformer.java; expressions come from
+    ingestionConfig.transformConfigs and schema transformFunction)."""
+
+    def __init__(self, expressions: Dict[str, str]):
+        self._exprs = {col: parse_expression(e) for col, e in expressions.items()}
+
+    def transform(self, row: Row) -> Optional[Row]:
+        for col, expr in self._exprs.items():
+            # reference semantics: don't overwrite an existing non-null value
+            if row.get(col) is None:
+                try:
+                    row[col] = eval_scalar(expr, row)
+                except EvalError:
+                    row[col] = None
+        return row
+
+
+class FilterTransformer(RecordTransformer):
+    """Drops rows matching filterConfig.filterFunction
+    (ref: FilterTransformer.java)."""
+
+    def __init__(self, filter_function: str):
+        self._filter = parse_filter_expression(filter_function)
+
+    def transform(self, row: Row) -> Optional[Row]:
+        try:
+            if eval_row_filter(self._filter, row):
+                return SKIP
+        except EvalError:
+            pass
+        return row
+
+
+class DataTypeTransformer(RecordTransformer):
+    """Coerces values to the schema's declared types; drops columns not in
+    the schema (ref: DataTypeTransformer.java)."""
+
+    def __init__(self, schema: Schema):
+        self._specs: Dict[str, FieldSpec] = {fs.name: fs
+                                             for fs in schema.field_specs}
+
+    def transform(self, row: Row) -> Optional[Row]:
+        out: Row = {}
+        for name, fs in self._specs.items():
+            v = row.get(name)
+            if v is None:
+                out[name] = None
+                continue
+            try:
+                if fs.single_value:
+                    if isinstance(v, (list, tuple)):
+                        v = v[0] if v else None
+                    out[name] = None if v is None else fs.data_type.convert(v)
+                else:
+                    vals = v if isinstance(v, (list, tuple)) else [v]
+                    out[name] = [fs.data_type.convert(x) for x in vals
+                                 if x is not None]
+            except (ValueError, TypeError):
+                out[name] = None
+        return out
+
+
+class NullValueTransformer(RecordTransformer):
+    """Replaces nulls with the field's default null value and records which
+    fields were null (ref: NullValueTransformer.java; the segment writer
+    uses ``__nulls__`` for the null vector when nullHandlingEnabled)."""
+
+    NULL_FIELDS_KEY = "__nulls__"
+
+    def __init__(self, schema: Schema):
+        self._specs = list(schema.field_specs)
+
+    def transform(self, row: Row) -> Optional[Row]:
+        nulls: List[str] = []
+        for fs in self._specs:
+            v = row.get(fs.name)
+            if v is None or (not fs.single_value and v == []):
+                nulls.append(fs.name)
+                row[fs.name] = (fs.default_null_value if fs.single_value
+                                else [fs.default_null_value])
+        if nulls:
+            row[self.NULL_FIELDS_KEY] = nulls
+        return row
+
+
+class SanitizationTransformer(RecordTransformer):
+    """Strips NUL characters and over-length strings
+    (ref: SanitizationTransformer.java)."""
+
+    def __init__(self, schema: Schema):
+        self._string_cols = {fs.name: fs.max_length
+                             for fs in schema.field_specs
+                             if not fs.data_type.is_numeric}
+
+    def transform(self, row: Row) -> Optional[Row]:
+        for name, max_len in self._string_cols.items():
+            v = row.get(name)
+            if isinstance(v, str):
+                row[name] = self._clean(v, max_len)
+            elif isinstance(v, list):
+                row[name] = [self._clean(x, max_len) if isinstance(x, str)
+                             else x for x in v]
+        return row
+
+    def _clean(self, s: str, max_len: int) -> str:
+        if "\x00" in s:
+            s = s.replace("\x00", "")
+        return s[:max_len]
+
+
+class ComplexTypeTransformer(RecordTransformer):
+    """Flattens nested dicts into dotted columns, optionally unnesting is
+    left to the caller (ref: ComplexTypeTransformer.java flatten mode)."""
+
+    def __init__(self, delimiter: str = "."):
+        self._delim = delimiter
+
+    def transform(self, row: Row) -> Optional[Row]:
+        out: Row = {}
+        for k, v in row.items():
+            if isinstance(v, dict):
+                self._flatten(k, v, out)
+            else:
+                out[k] = v
+        return out
+
+    def _flatten(self, prefix: str, obj: Dict[str, Any], out: Row) -> None:
+        for k, v in obj.items():
+            key = f"{prefix}{self._delim}{k}"
+            if isinstance(v, dict):
+                self._flatten(key, v, out)
+            else:
+                out[key] = v
+
+
+class CompositeTransformer(RecordTransformer):
+    """Ref: CompositeTransformer.java — fixed default order."""
+
+    def __init__(self, transformers: List[RecordTransformer]):
+        self._transformers = transformers
+
+    def transform(self, row: Row) -> Optional[Row]:
+        for t in self._transformers:
+            row = t.transform(row)
+            if row is None:
+                return SKIP
+        return row
+
+    @classmethod
+    def for_table(cls, table_config: Optional[TableConfig],
+                  schema: Schema) -> "CompositeTransformer":
+        """Default pipeline (ref: CompositeTransformer.getDefaultTransformer):
+        complex-type -> expression -> filter -> data-type -> null -> sanitize."""
+        chain: List[RecordTransformer] = [ComplexTypeTransformer()]
+
+        expressions: Dict[str, str] = {}
+        for fs in schema.field_specs:
+            if fs.transform_function:
+                expressions[fs.name] = fs.transform_function
+        ic = table_config.ingestion_config if table_config else None
+        if ic:
+            for tc in ic.transform_configs:
+                expressions[tc.column] = tc.transform_function
+        if expressions:
+            chain.append(ExpressionTransformer(expressions))
+        if ic and ic.filter_function:
+            chain.append(FilterTransformer(ic.filter_function))
+        chain.append(DataTypeTransformer(schema))
+        chain.append(NullValueTransformer(schema))
+        chain.append(SanitizationTransformer(schema))
+        return cls(chain)
+
+
+def transform_rows(transformer: RecordTransformer,
+                   rows: Iterable[Row]) -> List[Row]:
+    out = []
+    for r in rows:
+        t = transformer.transform(dict(r))
+        if t is not None:
+            out.append(t)
+    return out

@@ -68,6 +68,12 @@ class SegmentBatch:
     def __init__(self, segments: List[ImmutableSegment]):
         if not segments:
             raise ValueError("empty segment batch")
+        for s in segments:
+            if getattr(s, "is_mutable", False):
+                # consuming segments grow under the batch's feet; the frozen
+                # stacked arrays would serve stale data (host path serves them)
+                raise ValueError(f"mutable segment {s.segment_name!r} "
+                                 "cannot join a device batch")
         self.segments = segments
         first = segments[0].metadata
         cols = set(first.columns.keys())

@@ -516,3 +516,23 @@ class _FilterExpr(Expr):
 def parse_sql(sql: str) -> ParsedQuery:
     """Public entry (ref: CalciteSqlParser.compileToPinotQuery)."""
     return _Parser(sql.strip().rstrip(";")).parse()
+
+
+def parse_expression(text: str) -> Expr:
+    """Parse a standalone value expression (ingestion transform configs,
+    ref: ExpressionTransformer function-evaluator column expressions)."""
+    p = _Parser(text.strip())
+    e = p.parse_expr()
+    if p.peek().kind != "eof":
+        raise SqlParseError(f"trailing input in expression: {text!r}")
+    return e
+
+
+def parse_filter_expression(text: str) -> FilterNode:
+    """Parse a standalone boolean expression (ingestion filter configs,
+    ref: FilterTransformer)."""
+    p = _Parser(text.strip())
+    node = p.parse_bool_expr()
+    if p.peek().kind != "eof":
+        raise SqlParseError(f"trailing input in filter: {text!r}")
+    return node

@@ -21,6 +21,7 @@ from pinot_tpu.segment.immutable import (
     load_segment,
     verify_crc,
 )
+from pinot_tpu.segment.mutable import MutableDictionary, MutableSegment
 
 __all__ = [
     "ColumnMetadata",
@@ -33,4 +34,6 @@ __all__ = [
     "ImmutableSegment",
     "load_segment",
     "verify_crc",
+    "MutableDictionary",
+    "MutableSegment",
 ]

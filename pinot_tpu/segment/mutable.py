@@ -1,0 +1,389 @@
+"""Mutable (consuming) segment: row-at-a-time ingest, concurrently queryable.
+
+Re-design of ``MutableSegmentImpl.java:101`` + ``realtime/impl/*``: rows are
+indexed one at a time into append-only column stores while queries read a
+consistent prefix (single-writer / multi-reader, snapshot = ``num_docs`` at
+read start). TPU-first stance (SURVEY.md §7 hard parts): consuming segments
+stay **host-resident** — row-at-a-time mutation is hostile to device layout —
+and are served by the host engine; on seal they convert to the immutable
+columnar format (ref: RealtimeSegmentConverter) and flip to HBM staging.
+
+Mutable dictionaries are insertion-ordered hash maps (ref:
+``realtime/impl/dictionary/`` — also unsorted there); range predicates scan
+the dictionary's value array instead of using the sorted-interval property.
+"""
+
+from __future__ import annotations
+
+import time
+
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from pinot_tpu.segment import metadata as meta
+from pinot_tpu.segment.creator import SegmentBuilder
+from pinot_tpu.segment.dictionary import Dictionary
+from pinot_tpu.spi.table import IndexingConfig
+from pinot_tpu.spi.data import DataType, FieldSpec, Schema
+
+_GROW = 2
+_INITIAL_CAPACITY = 1024
+
+
+class MutableDictionary(Dictionary):
+    """Insertion-ordered value->dictId map (ref: BaseOffHeapMutableDictionary:
+    ids are assigned in arrival order, NOT sorted)."""
+
+    def __init__(self, data_type: DataType):
+        self.data_type = data_type
+        self._index: Dict[Any, int] = {}
+        self._values: List[Any] = []
+
+    def __len__(self) -> int:
+        return len(self._values)
+
+    def index(self, value: Any) -> int:
+        """Get-or-insert (writer thread only)."""
+        i = self._index.get(value)
+        if i is None:
+            i = len(self._values)
+            self._values.append(value)
+            self._index[value] = i
+        return i
+
+    def index_of(self, value: Any) -> int:
+        return self._index.get(value, -1)
+
+    def insertion_index_of(self, value: Any) -> int:
+        # no sorted order; only exact membership is meaningful
+        i = self.index_of(value)
+        return i if i >= 0 else -(len(self._values) + 1)
+
+    def get_value(self, dict_id: int) -> Any:
+        return self._values[dict_id]
+
+    def get_values(self, dict_ids: Sequence[int]) -> List[Any]:
+        return [self._values[int(i)] for i in dict_ids]
+
+    @property
+    def min_value(self) -> Any:
+        return min(self._values) if self._values else None
+
+    @property
+    def max_value(self) -> Any:
+        return max(self._values) if self._values else None
+
+    def device_values(self) -> Optional[np.ndarray]:
+        if self.data_type.is_numeric:
+            return np.asarray(self._values, dtype=self.data_type.stored_np)
+        return None
+
+    def matching_range_ids(self, lo: Any, hi: Any, lo_inclusive: bool,
+                           hi_inclusive: bool) -> np.ndarray:
+        """Value scan over the (unsorted) dictionary — the mutable analogue
+        of the sorted dictId interval (ref: RangePredicateEvaluatorFactory's
+        non-sorted mutable-dictionary path)."""
+        if self.data_type.is_numeric:
+            vals = np.asarray(self._values)
+            m = np.ones(len(vals), dtype=bool)
+            if lo is not None:
+                m &= (vals >= lo) if lo_inclusive else (vals > lo)
+            if hi is not None:
+                m &= (vals <= hi) if hi_inclusive else (vals < hi)
+            return np.nonzero(m)[0].astype(np.int64)
+        ids = []
+        for i, v in enumerate(self._values):
+            if lo is not None and not (v >= lo if lo_inclusive else v > lo):
+                continue
+            if hi is not None and not (v <= hi if hi_inclusive else v < hi):
+                continue
+            ids.append(i)
+        return np.asarray(ids, dtype=np.int64)
+
+    def range_to_dict_id_interval(self, lo, hi, lo_inclusive, hi_inclusive):
+        raise TypeError("mutable dictionaries are unsorted; "
+                        "use matching_range_ids")
+
+    def sorted_remap(self) -> Tuple[List[Any], np.ndarray]:
+        """(sorted values, remap[oldId] -> sortedId) for seal-time conversion
+        to the immutable sorted-dictionary format."""
+        order = sorted(range(len(self._values)),
+                       key=lambda i: self._values[i])
+        remap = np.empty(len(order), dtype=np.int64)
+        for new_id, old_id in enumerate(order):
+            remap[old_id] = new_id
+        return [self._values[i] for i in order], remap
+
+
+class _GrowArray:
+    """Append-only numpy array with capacity doubling (the mutable forward
+    index; ref: FixedByteSVMutableForwardIndex — chunked there, amortized
+    realloc here)."""
+
+    def __init__(self, dtype):
+        self._arr = np.zeros(_INITIAL_CAPACITY, dtype=dtype)
+        self._n = 0
+
+    def append(self, v) -> None:
+        if self._n == self._arr.shape[0]:
+            bigger = np.zeros(self._arr.shape[0] * _GROW, dtype=self._arr.dtype)
+            bigger[:self._n] = self._arr
+            self._arr = bigger
+        self._arr[self._n] = v
+        self._n += 1
+
+    def view(self, n: Optional[int] = None) -> np.ndarray:
+        return self._arr[:self._n if n is None else n]
+
+
+class _MutableColumn:
+    def __init__(self, fs: FieldSpec):
+        self.fs = fs
+        self.dictionary = MutableDictionary(fs.data_type)
+        # SV: dictIds; MV: flattened dictIds + offsets
+        self.fwd = _GrowArray(np.int32)
+        self.mv_offsets = _GrowArray(np.int64) if not fs.single_value else None
+        if self.mv_offsets is not None:
+            self.mv_offsets.append(0)
+        self.null = _GrowArray(bool)
+        self.has_nulls = False
+        self.max_mv = 0
+        self.total_entries = 0
+
+
+class MutableDataSource:
+    """Read access over a snapshot prefix (duck-types immutable.DataSource)."""
+
+    def __init__(self, seg: "MutableSegment", col: _MutableColumn, n: int):
+        self.name = col.fs.name
+        self._col = col
+        self._n = n
+        self.metadata = seg._column_metadata(col, n)
+        self.dictionary: Optional[Dictionary] = col.dictionary
+
+    @property
+    def forward_index(self) -> np.ndarray:
+        if self._col.mv_offsets is None:
+            return self._col.fwd.view(self._n)
+        end = int(self._col.mv_offsets.view(self._n + 1)[-1])
+        return self._col.fwd.view(end)
+
+    @property
+    def mv_offsets(self) -> Optional[np.ndarray]:
+        if self._col.mv_offsets is None:
+            return None
+        return self._col.mv_offsets.view(self._n + 1)
+
+    @property
+    def null_bitmap(self) -> Optional[np.ndarray]:
+        if not self._col.has_nulls:
+            return None
+        return self._col.null.view(self._n)
+
+    @property
+    def inverted_index(self):
+        return None
+
+
+class MutableSegment:
+    """Ref: MutableSegmentImpl.java:101. Writer: one thread calls index();
+    readers snapshot num_docs and see a consistent prefix."""
+
+    is_mutable = True
+
+    def __init__(self, schema: Schema, segment_name: str,
+                 capacity: int = 1_000_000,
+                 indexing_config: Optional[IndexingConfig] = None):
+        self.schema = schema
+        self.segment_name = segment_name
+        self.capacity = capacity
+        self.indexing = indexing_config or IndexingConfig()
+        self._cols: Dict[str, _MutableColumn] = {
+            fs.name: _MutableColumn(fs) for fs in schema.field_specs}
+        self._num_docs = 0
+        self.time_column = schema.time_column
+        self.min_time: Optional[int] = None
+        self.max_time: Optional[int] = None
+        self.start_time_ms = int(time.time() * 1000)
+
+    # -- write path ---------------------------------------------------------
+    #: key carrying null-field names from NullValueTransformer (the
+    #: transformer substitutes defaults, so nullness must ride along)
+    NULL_FIELDS_KEY = "__nulls__"
+
+    def index(self, row: Dict[str, Any]) -> bool:
+        """Index one (already transformed) row; returns False when the
+        segment is at capacity (ref: MutableSegmentImpl.index:471 canTakeMore)."""
+        if self._num_docs >= self.capacity:
+            return False
+        null_fields = set(row.get(self.NULL_FIELDS_KEY) or ())
+        for name, col in self._cols.items():
+            v = row.get(name)
+            self._index_value(col, v, name in null_fields)
+        if self.time_column is not None:
+            t = row.get(self.time_column)
+            if t is not None:
+                t = int(t)
+                self.min_time = t if self.min_time is None else min(self.min_time, t)
+                self.max_time = t if self.max_time is None else max(self.max_time, t)
+        # publish the new doc last (readers snapshot _num_docs)
+        self._num_docs += 1
+        return True
+
+    def _index_value(self, col: _MutableColumn, v: Any,
+                     declared_null: bool = False) -> None:
+        fs = col.fs
+        is_null = (declared_null or v is None
+                   or (isinstance(v, float) and v != v))
+        if fs.single_value:
+            if is_null:
+                col.has_nulls = True
+                if v is None or v != v:
+                    v = fs.default_null_value
+            col.null.append(is_null)
+            col.fwd.append(col.dictionary.index(fs.data_type.convert(v)))
+            col.total_entries += 1
+            return
+        if is_null or (isinstance(v, (list, tuple, np.ndarray)) and len(v) == 0):
+            is_null = True
+            col.has_nulls = True
+            vals = ([fs.default_null_value] if v is None
+                    or not isinstance(v, (list, tuple, np.ndarray)) or not len(v)
+                    else list(v))
+        elif isinstance(v, (list, tuple, np.ndarray)):
+            vals = list(v)
+        else:
+            vals = [v]
+        col.null.append(is_null)
+        for x in vals:
+            col.fwd.append(col.dictionary.index(fs.data_type.convert(x)))
+        prev = int(col.mv_offsets.view()[-1])
+        col.mv_offsets.append(prev + len(vals))
+        col.max_mv = max(col.max_mv, len(vals))
+        col.total_entries += len(vals)
+
+    # -- read path (segment duck-type) ---------------------------------------
+    @property
+    def num_docs(self) -> int:
+        return self._num_docs
+
+    @property
+    def padded_capacity(self) -> int:
+        return meta.pad_capacity(self._num_docs)
+
+    @property
+    def column_names(self) -> List[str]:
+        return list(self._cols.keys())
+
+    @property
+    def metadata(self) -> meta.SegmentMetadata:
+        n = self._num_docs
+        return meta.SegmentMetadata(
+            segment_name=self.segment_name,
+            table_name=self.schema.schema_name,
+            schema=self.schema,
+            num_docs=n,
+            padded_capacity=meta.pad_capacity(n),
+            time_column=self.time_column,
+            min_time=self.min_time,
+            max_time=self.max_time,
+            columns=_SnapshotColumns(self, n),
+        )
+
+    def data_source(self, column: str) -> MutableDataSource:
+        col = self._cols.get(column)
+        if col is None:
+            raise KeyError(f"column {column!r} not in segment "
+                           f"{self.segment_name!r}")
+        return MutableDataSource(self, col, self._num_docs)
+
+    def _column_metadata(self, col: _MutableColumn, n: int) -> meta.ColumnMetadata:
+        d = col.dictionary
+        return meta.ColumnMetadata(
+            name=col.fs.name,
+            data_type=col.fs.data_type,
+            field_type=col.fs.field_type,
+            single_value=col.fs.single_value,
+            encoding=meta.Encoding.DICT,
+            cardinality=len(d),
+            stored_dtype="int32",
+            min_value=d.min_value,
+            max_value=d.max_value,
+            is_sorted=False,
+            has_dictionary=True,
+            has_inverted_index=False,
+            has_nulls=col.has_nulls,
+            max_num_multi_values=col.max_mv,
+            total_number_of_entries=col.total_entries,
+        )
+
+    def get_value(self, column: str, doc_id: int):
+        ds = self.data_source(column)
+        if ds.metadata.single_value:
+            return ds.dictionary.get_value(int(ds.forward_index[doc_id]))
+        off = ds.mv_offsets
+        ids = ds.forward_index[int(off[doc_id]):int(off[doc_id + 1])]
+        return [ds.dictionary.get_value(int(i)) for i in ids]
+
+    # -- seal ----------------------------------------------------------------
+    def build_immutable(self, out_dir: str,
+                        segment_name: Optional[str] = None) -> meta.SegmentMetadata:
+        """Convert to the immutable columnar format (two-pass builder over the
+        accumulated columns; ref: RealtimeSegmentConverter +
+        SegmentIndexCreationDriverImpl.build)."""
+        n = self._num_docs
+        columns: Dict[str, List[Any]] = {}
+        for name, col in self._cols.items():
+            ds = MutableDataSource(self, col, n)
+            if col.fs.single_value:
+                vals = ds.dictionary.get_values(ds.forward_index)
+                if col.has_nulls:
+                    nb = ds.null_bitmap
+                    vals = [None if nb[i] else v for i, v in enumerate(vals)]
+            else:
+                off = ds.mv_offsets
+                fwd = ds.forward_index
+                nb = ds.null_bitmap if col.has_nulls else None
+                vals = []
+                for i in range(n):
+                    if nb is not None and nb[i]:
+                        vals.append(None)
+                    else:
+                        ids = fwd[int(off[i]):int(off[i + 1])]
+                        vals.append(ds.dictionary.get_values(ids))
+            columns[name] = vals
+        builder = SegmentBuilder(self.schema,
+                                 segment_name or self.segment_name,
+                                 indexing_config=self.indexing)
+        return builder.build(columns, out_dir)
+
+
+class _SnapshotColumns(dict):
+    """Lazy column-metadata map bound to a doc-count snapshot."""
+
+    def __init__(self, seg: MutableSegment, n: int):
+        super().__init__()
+        self._seg = seg
+        self._n = n
+        for name in seg._cols:
+            dict.__setitem__(self, name, None)
+
+    def __getitem__(self, name: str) -> meta.ColumnMetadata:
+        v = dict.__getitem__(self, name)
+        if v is None:
+            v = self._seg._column_metadata(self._seg._cols[name], self._n)
+            dict.__setitem__(self, name, v)
+        return v
+
+    def get(self, name: str, default=None):
+        try:
+            return self[name]
+        except KeyError:
+            return default
+
+    def items(self):
+        return [(k, self[k]) for k in self]
+
+    def values(self):
+        return [self[k] for k in self]

@@ -165,6 +165,8 @@ class FieldSpec:
     granularity: Optional[TimeGranularity] = None  # TIME / DATE_TIME only
     # DATE_TIME format string, e.g. "1:MILLISECONDS:EPOCH" (kept for config parity)
     format: Optional[str] = None
+    # ingestion-time derived-column expression (ref: FieldSpec.transformFunction)
+    transform_function: Optional[str] = None
 
     def __post_init__(self):
         if isinstance(self.data_type, str):
@@ -203,6 +205,8 @@ class FieldSpec:
             d["format"] = self.format
         if self.max_length != 512:
             d["maxLength"] = self.max_length
+        if self.transform_function:
+            d["transformFunction"] = self.transform_function
         return d
 
     @classmethod
@@ -227,6 +231,7 @@ class FieldSpec:
             max_length=d.get("maxLength", 512),
             granularity=TimeGranularity.from_dict(gran) if gran else None,
             format=d.get("format"),
+            transform_function=d.get("transformFunction"),
         )
 
 

@@ -278,6 +278,48 @@ class StreamIngestionConfig:
 
 
 @dataclass
+class TransformConfig:
+    """One ingestion-time derived/renamed column
+    (ref: pinot-spi ingestion/TransformConfig)."""
+
+    column: str
+    transform_function: str  # SQL expression over source fields
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"columnName": self.column,
+                "transformFunction": self.transform_function}
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "TransformConfig":
+        return cls(d["columnName"], d["transformFunction"])
+
+
+@dataclass
+class IngestionConfig:
+    """Ref: pinot-spi/.../config/table/ingestion/IngestionConfig.java
+    (filterConfig + transformConfigs)."""
+
+    filter_function: Optional[str] = None  # rows matching this are DROPPED
+    transform_configs: List[TransformConfig] = field(default_factory=list)
+
+    def to_dict(self) -> Dict[str, Any]:
+        d: Dict[str, Any] = {}
+        if self.filter_function:
+            d["filterConfig"] = {"filterFunction": self.filter_function}
+        if self.transform_configs:
+            d["transformConfigs"] = [t.to_dict() for t in self.transform_configs]
+        return d
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "IngestionConfig":
+        return cls(
+            filter_function=(d.get("filterConfig") or {}).get("filterFunction"),
+            transform_configs=[TransformConfig.from_dict(t)
+                               for t in d.get("transformConfigs") or []],
+        )
+
+
+@dataclass
 class TableConfig:
     """Ref: pinot-spi/.../config/table/TableConfig.java."""
 
@@ -288,6 +330,7 @@ class TableConfig:
     tenant_config: TenantConfig = field(default_factory=TenantConfig)
     upsert_config: Optional[UpsertConfig] = None
     stream_config: Optional[StreamIngestionConfig] = None
+    ingestion_config: Optional[IngestionConfig] = None
     query_config: Dict[str, Any] = field(default_factory=dict)  # e.g. timeoutMs
     custom_config: Dict[str, Any] = field(default_factory=dict)
 
@@ -317,6 +360,8 @@ class TableConfig:
             d["upsertConfig"] = self.upsert_config.to_dict()
         if self.stream_config:
             d["streamConfig"] = self.stream_config.to_dict()
+        if self.ingestion_config:
+            d["ingestionConfig"] = self.ingestion_config.to_dict()
         if self.query_config:
             d["query"] = self.query_config
         return d
@@ -344,6 +389,8 @@ class TableConfig:
             tenant_config=TenantConfig.from_dict(d.get("tenants", {})),
             upsert_config=UpsertConfig.from_dict(uc) if uc else None,
             stream_config=stream_config,
+            ingestion_config=(IngestionConfig.from_dict(d["ingestionConfig"])
+                              if d.get("ingestionConfig") else None),
             query_config=d.get("query", {}),
             custom_config=(d.get("metadata") or {}).get("customConfigs", {}),
         )

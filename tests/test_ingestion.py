@@ -1,0 +1,279 @@
+"""Realtime ingestion core: mutable segment queryability, transformer
+pipeline semantics, stream SPI, and the consume->commit state machine
+(ref: MutableSegmentImpl / CompositeTransformer / LLRealtimeSegmentDataManager)."""
+
+import numpy as np
+import pytest
+
+from pinot_tpu.engine import ServerQueryExecutor
+from pinot_tpu.ingestion import (
+    CompositeTransformer,
+    ConsumerState,
+    MemoryStream,
+    RealtimeSegmentDataManager,
+    StreamOffset,
+    transform_rows,
+)
+from pinot_tpu.query import compile_query
+from pinot_tpu.segment import MutableSegment, load_segment
+from pinot_tpu.spi import DataType, FieldSpec, FieldType, Schema
+from pinot_tpu.spi.table import (
+    IngestionConfig,
+    StreamIngestionConfig,
+    TableConfig,
+    TableType,
+    TransformConfig,
+)
+
+RNG = np.random.default_rng(5)
+
+
+def make_schema():
+    return Schema("events", [
+        FieldSpec("user", DataType.STRING),
+        FieldSpec("kind", DataType.STRING),
+        FieldSpec("tags", DataType.STRING, single_value=False),
+        FieldSpec("value", DataType.LONG, FieldType.METRIC),
+        FieldSpec("ts", DataType.LONG, FieldType.DATE_TIME),
+    ])
+
+
+def make_rows(n, seed=5):
+    rng = np.random.default_rng(seed)
+    users = ["u1", "u2", "u3"]
+    kinds = ["click", "view", "buy"]
+    return [{
+        "user": users[int(rng.integers(0, 3))],
+        "kind": kinds[int(rng.integers(0, 3))],
+        "tags": [f"t{int(x)}" for x in rng.integers(0, 4, int(rng.integers(1, 4)))],
+        "value": int(rng.integers(1, 100)),
+        "ts": 1_600_000_000_000 + int(rng.integers(0, 10_000_000)),
+    } for _ in range(n)]
+
+
+# --------------------------------------------------------------------------
+# mutable segment
+# --------------------------------------------------------------------------
+
+class TestMutableSegment:
+    def test_index_and_read(self):
+        seg = MutableSegment(make_schema(), "events__0")
+        rows = make_rows(100)
+        for r in rows:
+            assert seg.index(dict(r))
+        assert seg.num_docs == 100
+        assert seg.get_value("user", 0) == rows[0]["user"]
+        assert seg.get_value("tags", 3) == rows[3]["tags"]
+        assert seg.get_value("value", 99) == rows[99]["value"]
+
+    def test_capacity_limit(self):
+        seg = MutableSegment(make_schema(), "events__0", capacity=10)
+        rows = make_rows(20)
+        accepted = sum(1 for r in rows if seg.index(r))
+        assert accepted == 10
+
+    def test_queryable_via_host_engine(self):
+        seg = MutableSegment(make_schema(), "events__0")
+        rows = make_rows(500)
+        for r in rows:
+            seg.index(dict(r))
+        ex = ServerQueryExecutor()
+
+        t, _ = ex.execute(compile_query(
+            "SELECT count(*), sum(value) FROM events WHERE kind = 'click'"), [seg])
+        want = [r for r in rows if r["kind"] == "click"]
+        assert t.rows[0][0] == len(want)
+        assert t.rows[0][1] == pytest.approx(sum(r["value"] for r in want))
+
+        # range over unsorted mutable dictionary
+        t2, _ = ex.execute(compile_query(
+            "SELECT count(*) FROM events WHERE value BETWEEN 20 AND 50"), [seg])
+        assert t2.rows[0][0] == sum(1 for r in rows if 20 <= r["value"] <= 50)
+
+        # group-by + MV predicate
+        t3, _ = ex.execute(compile_query(
+            "SELECT user, count(*) FROM events WHERE tags = 't1' "
+            "GROUP BY user ORDER BY user"), [seg])
+        want_g = {}
+        for r in rows:
+            if "t1" in r["tags"]:
+                want_g[r["user"]] = want_g.get(r["user"], 0) + 1
+        assert [(r[0], r[1]) for r in t3.rows] == sorted(want_g.items())
+
+    def test_min_max_time_tracked(self):
+        seg = MutableSegment(make_schema(), "events__0")
+        rows = make_rows(50)
+        for r in rows:
+            seg.index(dict(r))
+        assert seg.min_time == min(r["ts"] for r in rows)
+        assert seg.max_time == max(r["ts"] for r in rows)
+
+    def test_build_immutable_round_trip(self, tmp_path):
+        seg = MutableSegment(make_schema(), "events__0")
+        rows = make_rows(200)
+        for r in rows:
+            seg.index(dict(r))
+        md = seg.build_immutable(str(tmp_path))
+        imm = load_segment(str(tmp_path / "events__0"))
+        assert imm.num_docs == 200
+
+        ex = ServerQueryExecutor()
+        q = compile_query("SELECT kind, sum(value) FROM events GROUP BY kind ORDER BY kind")
+        mut_res, _ = ex.execute(q, [seg])
+        imm_res, _ = ex.execute(compile_query(
+            "SELECT kind, sum(value) FROM events GROUP BY kind ORDER BY kind"), [imm])
+        assert mut_res.rows == imm_res.rows
+
+
+# --------------------------------------------------------------------------
+# transformers
+# --------------------------------------------------------------------------
+
+class TestTransformers:
+    def test_expression_and_filter(self):
+        schema = Schema("t", [
+            FieldSpec("name", DataType.STRING),
+            FieldSpec("ms", DataType.LONG),
+            FieldSpec("days", DataType.LONG,
+                      transform_function="toEpochDays(ms)"),
+        ])
+        tc = TableConfig(
+            "t", ingestion_config=IngestionConfig(
+                filter_function="name = 'drop_me'",
+                transform_configs=[TransformConfig("name", "upper(name)")]))
+        tr = CompositeTransformer.for_table(tc, schema)
+        rows = transform_rows(tr, [
+            {"name": "drop_me", "ms": 86_400_000},
+            {"name": None, "ms": 86_400_000 * 3},
+        ])
+        assert len(rows) == 1  # filter dropped the first
+        assert rows[0]["days"] == 3
+        # expression fills only null destination; name was null -> upper(None) fails -> default
+        assert rows[0]["name"] == "null"
+
+    def test_type_coercion_and_nulls(self):
+        schema = Schema("t", [
+            FieldSpec("a", DataType.INT),
+            FieldSpec("b", DataType.DOUBLE, FieldType.METRIC),
+        ])
+        tr = CompositeTransformer.for_table(None, schema)
+        rows = transform_rows(tr, [
+            {"a": "42", "b": "3.5", "junk": 1},
+            {"a": None, "b": None},
+        ])
+        assert rows[0]["a"] == 42 and rows[0]["b"] == 3.5
+        assert "junk" not in rows[0]
+        assert rows[1]["a"] == -2147483648 or rows[1]["a"] is not None  # default null value
+        assert rows[1]["__nulls__"] == ["a", "b"]
+
+    def test_null_tracking_survives_pipeline(self):
+        """__nulls__ produced by NullValueTransformer must reach the mutable
+        segment's null vector (IS NULL parity with directly built segments)."""
+        schema = Schema("t", [
+            FieldSpec("a", DataType.STRING),
+            FieldSpec("b", DataType.LONG, FieldType.METRIC),
+        ])
+        tr = CompositeTransformer.for_table(None, schema)
+        seg = MutableSegment(schema, "t__0")
+        for raw in [{"a": "x", "b": 1}, {"a": None, "b": 2}, {"a": "y", "b": None}]:
+            seg.index(tr.transform(dict(raw)))
+        ex = ServerQueryExecutor()
+        t, _ = ex.execute(compile_query("SELECT count(*) FROM t WHERE a IS NULL"), [seg])
+        assert t.rows[0][0] == 1
+        t2, _ = ex.execute(compile_query("SELECT count(*) FROM t WHERE b IS NOT NULL"), [seg])
+        assert t2.rows[0][0] == 2
+
+    def test_complex_flatten(self):
+        schema = Schema("t", [
+            FieldSpec("user.name", DataType.STRING),
+            FieldSpec("user.age", DataType.INT),
+        ])
+        tr = CompositeTransformer.for_table(None, schema)
+        rows = transform_rows(tr, [{"user": {"name": "bob", "age": 7}}])
+        assert rows[0]["user.name"] == "bob"
+        assert rows[0]["user.age"] == 7
+
+
+# --------------------------------------------------------------------------
+# stream + realtime consumption
+# --------------------------------------------------------------------------
+
+def realtime_table(topic, threshold=200):
+    return TableConfig(
+        "events", table_type=TableType.REALTIME,
+        stream_config=StreamIngestionConfig(
+            stream_type="memory", topic=topic, decoder="json",
+            segment_flush_threshold_rows=threshold))
+
+
+class TestRealtimeConsumption:
+    def test_consume_and_commit(self, tmp_path):
+        MemoryStream.create("topic_a", 1)
+        rows = make_rows(500, seed=9)
+        for r in rows:
+            MemoryStream.get("topic_a").produce(r, partition=0)
+
+        mgr = RealtimeSegmentDataManager(
+            "events__0__0__20260729T0000Z", realtime_table("topic_a", 200),
+            make_schema(), partition=0, start_offset=StreamOffset(0),
+            output_dir=str(tmp_path))
+        result = mgr.consume_until_committed()
+        assert result.state is ConsumerState.COMMITTED
+        assert result.rows_indexed == 200
+        assert result.final_offset == StreamOffset(200)
+        assert result.metadata.custom["segment.realtime.endOffset"] == "200"
+
+        seg = load_segment(result.segment_dir)
+        assert seg.num_docs == 200
+        MemoryStream.delete("topic_a")
+
+    def test_next_segment_resumes_from_offset(self, tmp_path):
+        MemoryStream.create("topic_b", 1)
+        for r in make_rows(450, seed=11):
+            MemoryStream.get("topic_b").produce(r, partition=0)
+        tc = realtime_table("topic_b", 200)
+
+        committed = []
+        start = StreamOffset(0)
+        for seq in range(2):
+            mgr = RealtimeSegmentDataManager(
+                f"events__0__{seq}__x", tc, make_schema(), partition=0,
+                start_offset=start, output_dir=str(tmp_path))
+            res = mgr.consume_until_committed()
+            assert res.state is ConsumerState.COMMITTED
+            committed.append(res)
+            start = res.final_offset
+        assert committed[0].final_offset == StreamOffset(200)
+        assert committed[1].final_offset == StreamOffset(400)
+
+        # the two sealed segments + the remaining tail are queryable together
+        segs = [load_segment(r.segment_dir) for r in committed]
+        tail = RealtimeSegmentDataManager(
+            "events__0__2__x", tc, make_schema(), partition=0,
+            start_offset=start, output_dir=str(tmp_path))
+        tail._index_batch()
+        assert tail.segment.num_docs == 50
+        ex = ServerQueryExecutor()
+        t, _ = ex.execute(compile_query("SELECT count(*) FROM events"),
+                          segs + [tail.segment])
+        assert t.rows[0][0] == 450
+        MemoryStream.delete("topic_b")
+
+    def test_background_thread_consumption(self, tmp_path):
+        import time
+
+        MemoryStream.create("topic_c", 1)
+        tc = realtime_table("topic_c", 100)
+        mgr = RealtimeSegmentDataManager(
+            "events__0__0__bg", tc, make_schema(), partition=0,
+            start_offset=StreamOffset(0), output_dir=str(tmp_path))
+        mgr.start(tick_seconds=0.01)
+        for r in make_rows(100, seed=13):
+            MemoryStream.get("topic_c").produce(r, partition=0)
+        deadline = time.time() + 20
+        while mgr.state is not ConsumerState.COMMITTED and time.time() < deadline:
+            time.sleep(0.05)
+        mgr.stop()
+        assert mgr.state is ConsumerState.COMMITTED
+        assert mgr.rows_indexed == 100
+        MemoryStream.delete("topic_c")

@@ -1,0 +1,39 @@
+"""Controller: cluster state store (the Helix/ZK role), segment assignment,
+segment-completion FSM, LLC realtime manager, periodic maintenance
+(ref: pinot-controller)."""
+
+from pinot_tpu.controller.state import (
+    CONSUMING,
+    ERROR,
+    OFFLINE,
+    ONLINE,
+    ClusterStateStore,
+    InstanceInfo,
+    SegmentZKMetadata,
+)
+from pinot_tpu.controller.assignment import (
+    BalancedSegmentAssignment,
+    PartitionedReplicaGroupAssignment,
+    ReplicaGroupSegmentAssignment,
+    SegmentAssignment,
+    compute_target_assignment,
+    rebalance_steps,
+)
+from pinot_tpu.controller.completion import FsmState, SegmentCompletionManager
+from pinot_tpu.controller.llc import (
+    LLCRealtimeSegmentManager,
+    llc_segment_name,
+    parse_llc_name,
+)
+from pinot_tpu.controller.controller import Controller
+
+__all__ = [
+    "CONSUMING", "ERROR", "OFFLINE", "ONLINE",
+    "ClusterStateStore", "InstanceInfo", "SegmentZKMetadata",
+    "BalancedSegmentAssignment", "PartitionedReplicaGroupAssignment",
+    "ReplicaGroupSegmentAssignment", "SegmentAssignment",
+    "compute_target_assignment", "rebalance_steps",
+    "FsmState", "SegmentCompletionManager",
+    "LLCRealtimeSegmentManager", "llc_segment_name", "parse_llc_name",
+    "Controller",
+]

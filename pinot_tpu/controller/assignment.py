@@ -1,0 +1,146 @@
+"""Segment assignment + rebalance.
+
+Re-design of ``pinot-controller/.../helix/core/assignment/segment/*``
+(``SegmentAssignment.java:39``: balanced / replica-group / partitioned
+strategies) and ``rebalance/TableRebalancer.java:108`` (target recompute +
+minimum-available-replicas movement plan).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+from pinot_tpu.controller.state import (
+    CONSUMING,
+    ONLINE,
+    ClusterStateStore,
+    InstanceInfo,
+)
+
+
+class SegmentAssignment:
+    """Ref: SegmentAssignment.java:39."""
+
+    def assign(self, segment: str, current: Dict[str, Dict[str, str]],
+               instances: List[str], replication: int) -> List[str]:
+        raise NotImplementedError
+
+
+class BalancedSegmentAssignment(SegmentAssignment):
+    """Least-loaded instances first (ref: OfflineSegmentAssignment balanced
+    mode — round-robin by current segment count)."""
+
+    def assign(self, segment, current, instances, replication):
+        if not instances:
+            raise ValueError("no server instances to assign to")
+        load = {i: 0 for i in instances}
+        for seg_map in current.values():
+            for inst in seg_map:
+                if inst in load:
+                    load[inst] += 1
+        ranked = sorted(instances, key=lambda i: (load[i], i))
+        return ranked[: min(replication, len(ranked))]
+
+
+class ReplicaGroupSegmentAssignment(SegmentAssignment):
+    """Instances pre-split into ``replication`` groups; each segment takes
+    one instance per group (ref: ReplicaGroupSegmentAssignmentStrategy)."""
+
+    def __init__(self, num_replica_groups: int):
+        self.num_replica_groups = num_replica_groups
+
+    def assign(self, segment, current, instances, replication):
+        if not instances:
+            raise ValueError("no server instances to assign to")
+        groups: List[List[str]] = [[] for _ in range(self.num_replica_groups)]
+        for i, inst in enumerate(sorted(instances)):
+            groups[i % self.num_replica_groups].append(inst)
+        seg_index = len(current)
+        out = []
+        for g in groups[: replication]:
+            if g:
+                out.append(g[seg_index % len(g)])
+        return out
+
+
+class PartitionedReplicaGroupAssignment(SegmentAssignment):
+    """Partition-aware: a segment of stream/partition P lands on the
+    instances owning P (ref: RealtimeSegmentAssignment partition mode)."""
+
+    def __init__(self, num_replica_groups: int = 1):
+        self.num_replica_groups = num_replica_groups
+
+    def assign(self, segment, current, instances, replication,
+               partition: Optional[int] = None):
+        if partition is None:
+            partition = _partition_from_llc_name(segment)
+        groups: List[List[str]] = [[] for _ in range(self.num_replica_groups)]
+        for i, inst in enumerate(sorted(instances)):
+            groups[i % self.num_replica_groups].append(inst)
+        out = []
+        for g in groups[: replication]:
+            if g:
+                out.append(g[partition % len(g)])
+        return out
+
+
+def _partition_from_llc_name(segment: str) -> int:
+    """LLC name: table__partition__sequence__creationTime
+    (ref: LLCSegmentName)."""
+    parts = segment.split("__")
+    if len(parts) >= 3:
+        try:
+            return int(parts[1])
+        except ValueError:
+            pass
+    return 0
+
+
+def assignment_for_table(store: ClusterStateStore, table: str,
+                         tag: Optional[str] = None) -> Tuple[List[str], int]:
+    """(eligible server instance ids, replication) for a table."""
+    cfg = store.get_table_config(table)
+    if cfg is None:
+        raise KeyError(f"no table config for {table}")
+    servers = [i.instance_id for i in store.instances("SERVER", only_alive=True)
+               if tag is None or tag in i.tags]
+    return servers, cfg.replication
+
+
+# --------------------------------------------------------------------------
+# rebalance (ref: TableRebalancer.java:108)
+# --------------------------------------------------------------------------
+
+def compute_target_assignment(
+        current: Dict[str, Dict[str, str]], instances: List[str],
+        replication: int) -> Dict[str, Dict[str, str]]:
+    """Balanced target for all segments (CONSUMING segments keep their
+    state label)."""
+    strategy = BalancedSegmentAssignment()
+    target: Dict[str, Dict[str, str]] = {}
+    for segment in sorted(current):
+        state = CONSUMING if CONSUMING in current[segment].values() else ONLINE
+        chosen = strategy.assign(segment, target, instances, replication)
+        target[segment] = {inst: state for inst in chosen}
+    return target
+
+
+def rebalance_steps(current: Dict[str, Dict[str, str]],
+                    target: Dict[str, Dict[str, str]]
+                    ) -> List[Dict[str, Dict[str, str]]]:
+    """Make-before-break movement plan (the no-downtime invariant, ref:
+    TableRebalancer minAvailableReplicas): step 1 adds every target replica
+    alongside the current ones; the caller waits for ExternalView
+    convergence, then step 2 drops the non-target replicas. Every segment
+    keeps >= its current replica count serving throughout."""
+    union: Dict[str, Dict[str, str]] = {}
+    for segment in set(current) | set(target):
+        merged = dict(current.get(segment, {}))
+        merged.update(target.get(segment, {}))
+        union[segment] = merged
+    steps: List[Dict[str, Dict[str, str]]] = []
+    if union != current:
+        steps.append(union)
+    if target != union:
+        steps.append({s: dict(m) for s, m in target.items()})
+    return steps

@@ -1,0 +1,203 @@
+"""Controller-side segment completion FSM: commit arbitration.
+
+Re-design of ``pinot-controller/.../realtime/SegmentCompletionManager.java:59``:
+replicas of a CONSUMING segment report ``segmentConsumed(offset)``; the
+manager holds them until a quorum window passes, elects the replica with the
+highest offset as the committer, tells laggards to CATCHUP, and guards that
+exactly one replica runs the split commit. Non-winners get KEEP (retain
+their local build) or DISCARD (download from deep store) when the winner's
+commit lands at a different offset.
+"""
+
+from __future__ import annotations
+
+import enum
+import threading
+import time
+
+from dataclasses import dataclass, field
+from typing import Dict, Optional
+
+from pinot_tpu.ingestion.realtime import (
+    CompletionReply,
+    CompletionResponse,
+    SegmentCompletionProtocol,
+)
+from pinot_tpu.ingestion.stream import StreamOffset
+
+
+class FsmState(enum.Enum):
+    """Ref: SegmentCompletionManager.State."""
+
+    HOLDING = "HOLDING"
+    COMMITTER_DECIDED = "COMMITTER_DECIDED"
+    COMMITTER_NOTIFIED = "COMMITTER_NOTIFIED"
+    COMMITTER_UPLOADING = "COMMITTER_UPLOADING"
+    COMMITTING = "COMMITTING"
+    COMMITTED = "COMMITTED"
+    ABORTED = "ABORTED"
+
+
+@dataclass
+class _SegmentFsm:
+    segment_name: str
+    num_replicas: int
+    state: FsmState = FsmState.HOLDING
+    offsets: Dict[str, StreamOffset] = field(default_factory=dict)
+    committer: Optional[str] = None
+    committed_offset: Optional[StreamOffset] = None
+    first_consumed_ms: float = 0.0
+    committed_ms: float = 0.0
+    winner_offset: Optional[StreamOffset] = None
+
+
+class SegmentCompletionManager(SegmentCompletionProtocol):
+    """One per controller. Thread-safe: server RPCs arrive concurrently.
+
+    ``commit_handler(segment_name, instance, offset, location, metadata)``
+    is invoked under COMMITTED transition to flip cluster metadata (wired to
+    the LLC realtime manager).
+    """
+
+    # how long to keep HOLDing for more replicas before electing a committer
+    # (ref: SegmentCompletionManager MAX_MILLIS_TO_WAIT_FOR_ALL_SEGMENTS)
+    # grace window during which a COMMITTED FSM keeps answering laggard
+    # replicas with KEEP/DISCARD before being pruned (ref: the reference
+    # expires completed FSMs after MAX_COMMIT_TIME)
+    COMMITTED_TTL_S = 300.0
+
+    def __init__(self, num_replicas_provider=None, hold_window_s: float = 0.2,
+                 commit_handler=None):
+        self._fsms: Dict[str, _SegmentFsm] = {}
+        self._lock = threading.Lock()
+        self._hold_window_s = hold_window_s
+        self._num_replicas_provider = num_replicas_provider or (lambda seg: 1)
+        self._commit_handler = commit_handler
+
+    def _fsm(self, segment_name: str) -> _SegmentFsm:
+        fsm = self._fsms.get(segment_name)
+        if fsm is None:
+            self._prune_locked()
+            fsm = _SegmentFsm(segment_name,
+                              self._num_replicas_provider(segment_name))
+            fsm.first_consumed_ms = time.monotonic()
+            self._fsms[segment_name] = fsm
+        return fsm
+
+    def _prune_locked(self) -> None:
+        now = time.monotonic()
+        for name in [n for n, f in self._fsms.items()
+                     if f.state is FsmState.COMMITTED
+                     and now - f.committed_ms > self.COMMITTED_TTL_S]:
+            del self._fsms[name]
+
+    # -- protocol ------------------------------------------------------------
+    def segment_consumed(self, segment_name: str, instance: str,
+                         offset: StreamOffset) -> CompletionReply:
+        with self._lock:
+            fsm = self._fsm(segment_name)
+            fsm.offsets[instance] = offset
+
+            if fsm.state is FsmState.COMMITTED:
+                # a winner already committed: same offset -> KEEP the local
+                # build; different -> DISCARD and download (ref: :59 FSM)
+                if offset == fsm.committed_offset:
+                    return CompletionReply(CompletionResponse.KEEP)
+                return CompletionReply(CompletionResponse.DISCARD)
+
+            if fsm.state in (FsmState.COMMITTER_DECIDED,
+                             FsmState.COMMITTER_NOTIFIED,
+                             FsmState.COMMITTER_UPLOADING,
+                             FsmState.COMMITTING):
+                if instance == fsm.committer:
+                    return CompletionReply(CompletionResponse.COMMIT)
+                if offset < fsm.winner_offset:
+                    return CompletionReply(CompletionResponse.CATCHUP,
+                                           target_offset=fsm.winner_offset)
+                return CompletionReply(CompletionResponse.HOLD)
+
+            # HOLDING: wait for all replicas or the hold window
+            all_reported = len(fsm.offsets) >= fsm.num_replicas
+            window_over = (time.monotonic() - fsm.first_consumed_ms
+                           >= self._hold_window_s)
+            if not (all_reported or window_over):
+                return CompletionReply(CompletionResponse.HOLD)
+
+            # elect: highest offset wins; offset ties break by instance id
+            # (deterministic across controllers)
+            winner = max(fsm.offsets.items(),
+                         key=lambda kv: (kv[1].value, kv[0]))
+            fsm.winner_offset = winner[1]
+            fsm.committer = winner[0]
+            fsm.state = FsmState.COMMITTER_DECIDED
+            if instance == fsm.committer:
+                fsm.state = FsmState.COMMITTER_NOTIFIED
+                return CompletionReply(CompletionResponse.COMMIT)
+            if offset < fsm.winner_offset:
+                return CompletionReply(CompletionResponse.CATCHUP,
+                                       target_offset=fsm.winner_offset)
+            return CompletionReply(CompletionResponse.HOLD)
+
+    def segment_commit_start(self, segment_name: str, instance: str,
+                             offset: StreamOffset) -> CompletionReply:
+        with self._lock:
+            fsm = self._fsms.get(segment_name)
+            if fsm is None or fsm.committer != instance:
+                return CompletionReply(CompletionResponse.HOLD)
+            if fsm.state is FsmState.COMMITTED:
+                return CompletionReply(CompletionResponse.KEEP)
+            if offset != fsm.winner_offset:
+                # committer diverged from its own reported offset — re-elect
+                fsm.state = FsmState.HOLDING
+                fsm.committer = None
+                return CompletionReply(CompletionResponse.HOLD)
+            fsm.state = FsmState.COMMITTER_UPLOADING
+            return CompletionReply(CompletionResponse.COMMIT)
+
+    def segment_commit_upload(self, segment_name: str, instance: str,
+                              segment_dir: str) -> str:
+        # deep-store upload is delegated to the commit handler at commit-end;
+        # the local dir is the staging location
+        return segment_dir
+
+    def segment_commit_end(self, segment_name: str, instance: str,
+                           offset: StreamOffset, location: str,
+                           metadata) -> CompletionReply:
+        with self._lock:
+            fsm = self._fsms.get(segment_name)
+            if fsm is None or fsm.committer != instance:
+                return CompletionReply(CompletionResponse.HOLD)
+            fsm.state = FsmState.COMMITTING
+        # metadata flip outside the FSM lock (it touches the state store)
+        if self._commit_handler is not None:
+            self._commit_handler(segment_name, instance, offset, location,
+                                 metadata)
+        with self._lock:
+            fsm.state = FsmState.COMMITTED
+            fsm.committed_offset = offset
+            fsm.committed_ms = time.monotonic()
+        return CompletionReply(CompletionResponse.COMMIT)
+
+    def segment_stopped_consuming(self, segment_name: str, instance: str,
+                                  reason: str) -> None:
+        with self._lock:
+            fsm = self._fsms.get(segment_name)
+            if fsm is None or fsm.state is FsmState.COMMITTED:
+                return
+            # a dead replica must not stay electable: drop its offset, and
+            # re-open the election if it was (or would become) the winner
+            fsm.offsets.pop(instance, None)
+            if fsm.committer == instance or fsm.state is FsmState.HOLDING:
+                fsm.state = FsmState.HOLDING
+                fsm.committer = None
+                fsm.winner_offset = None
+
+    # -- introspection -------------------------------------------------------
+    def fsm_state(self, segment_name: str) -> Optional[FsmState]:
+        with self._lock:
+            fsm = self._fsms.get(segment_name)
+            return fsm.state if fsm else None
+
+    def forget(self, segment_name: str) -> None:
+        with self._lock:
+            self._fsms.pop(segment_name, None)

@@ -1,0 +1,288 @@
+"""Controller: the cluster-mutation API + periodic maintenance tasks.
+
+Re-design of ``pinot-controller/.../helix/core/PinotHelixResourceManager.java:144``
+(table/schema/segment/instance management) and the
+``ControllerPeriodicTask`` framework (``RetentionManager``,
+``RealtimeSegmentValidationManager``, ``SegmentStatusChecker`` —
+``helix/core/periodictask/`` + ``validation/*``).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+
+from dataclasses import dataclass
+from typing import Dict, List, Optional
+
+from pinot_tpu.controller.assignment import (
+    BalancedSegmentAssignment,
+    assignment_for_table,
+    compute_target_assignment,
+    rebalance_steps,
+)
+from pinot_tpu.controller.completion import SegmentCompletionManager
+from pinot_tpu.controller.llc import LLCRealtimeSegmentManager, parse_llc_name
+from pinot_tpu.controller.state import (
+    CONSUMING,
+    OFFLINE,
+    ONLINE,
+    ClusterStateStore,
+    InstanceInfo,
+    SegmentZKMetadata,
+)
+from pinot_tpu.ingestion.stream import StreamOffset
+from pinot_tpu.segment.metadata import SegmentMetadata
+from pinot_tpu.spi.data import Schema
+from pinot_tpu.spi.table import TableConfig, TableType, table_type_from_name
+
+log = logging.getLogger(__name__)
+
+_RETENTION_UNIT_MS = {
+    "MILLISECONDS": 1, "SECONDS": 1000, "MINUTES": 60_000,
+    "HOURS": 3_600_000, "DAYS": 86_400_000,
+}
+
+
+class Controller:
+    """Single-controller deployment (the reference's lead-controller mode).
+
+    Owns: state store mutations, segment completion FSM, LLC manager,
+    periodic tasks. The HTTP/gRPC API layer wraps this object.
+    """
+
+    def __init__(self, store: Optional[ClusterStateStore] = None,
+                 controller_id: str = "controller_0",
+                 llc_seed: Optional[str] = None):
+        self.store = store or ClusterStateStore()
+        self.controller_id = controller_id
+        self.llc = LLCRealtimeSegmentManager(self.store, seed=llc_seed)
+        self.completion = SegmentCompletionManager(
+            num_replicas_provider=self._num_replicas_for_segment,
+            commit_handler=self._on_segment_commit)
+        self._segment_tables: Dict[str, str] = {}  # segment -> table (FSM aid)
+        self._periodic_stop = threading.Event()
+        self._periodic_thread: Optional[threading.Thread] = None
+        self.store.register_instance(
+            InstanceInfo(controller_id, "CONTROLLER"))
+
+    # -- schema / table management (ref: PinotHelixResourceManager) ---------
+    def add_schema(self, schema: Schema) -> None:
+        self.store.add_schema(schema)
+
+    def add_table(self, config: TableConfig) -> None:
+        """Ref: addTable: validate, create ideal state, realtime setup."""
+        name = config.table_name_with_type
+        if self.store.get_table_config(name) is not None:
+            raise ValueError(f"table {name} already exists")
+        if self.store.get_schema(config.table_name) is None:
+            raise ValueError(f"no schema named {config.table_name!r} — "
+                             "add the schema first")
+        self.store.add_table_config(config)
+        self.store.set_ideal_state(name, {})
+        if config.table_type is TableType.REALTIME:
+            if config.stream_config is None:
+                raise ValueError("realtime table needs a stream config")
+            consuming = self.llc.setup_new_table(name)
+            for seg in consuming:
+                self._segment_tables[seg] = name
+
+    def delete_table(self, name_with_type: str) -> None:
+        self.store.delete_table(name_with_type)
+
+    def table_names(self) -> List[str]:
+        return self.store.table_names()
+
+    # -- offline segment upload (ref: addNewSegment + upload resource) ------
+    def add_segment(self, table_with_type: str, metadata: SegmentMetadata,
+                    download_url: str) -> None:
+        """Segment push: record ZK metadata + assign to servers."""
+        cfg = self.store.get_table_config(table_with_type)
+        if cfg is None:
+            raise KeyError(f"no such table {table_with_type}")
+        zk = SegmentZKMetadata(
+            segment_name=metadata.segment_name, table_name=table_with_type,
+            status=ONLINE, download_url=download_url, crc=metadata.crc,
+            creation_time_ms=metadata.creation_time_ms,
+            push_time_ms=int(time.time() * 1000),
+            start_time=metadata.min_time, end_time=metadata.max_time,
+            total_docs=metadata.num_docs)
+        self.store.set_segment_metadata(zk)
+
+        servers, replication = assignment_for_table(self.store, table_with_type)
+        strategy = BalancedSegmentAssignment()
+
+        def apply(ideal):
+            ideal = ideal or {}
+            chosen = strategy.assign(metadata.segment_name, ideal, servers,
+                                     replication)
+            ideal[metadata.segment_name] = {i: ONLINE for i in chosen}
+            return ideal
+
+        self.store.update_ideal_state(table_with_type, apply)
+
+    def delete_segment(self, table: str, segment: str) -> None:
+        self.store.delete_segment(table, segment)
+
+        def apply(ideal):
+            ideal = ideal or {}
+            ideal.pop(segment, None)
+            return ideal
+
+        self.store.update_ideal_state(table, apply)
+
+    # -- instances ----------------------------------------------------------
+    def register_instance(self, info: InstanceInfo) -> None:
+        self.store.register_instance(info)
+
+    # -- segment completion plumbing ----------------------------------------
+    def _num_replicas_for_segment(self, segment_name: str) -> int:
+        table = self._table_of(segment_name)
+        if table:
+            ideal = self.store.get_ideal_state(table)
+            if segment_name in ideal:
+                return max(len(ideal[segment_name]), 1)
+        return 1
+
+    def _table_of(self, segment_name: str) -> Optional[str]:
+        t = self._segment_tables.get(segment_name)
+        if t:
+            return t
+        try:
+            raw, _, _ = parse_llc_name(segment_name)
+        except ValueError:
+            return None
+        name = raw + "_REALTIME"
+        if self.store.get_table_config(name) is not None:
+            self._segment_tables[segment_name] = name
+            return name
+        return None
+
+    def _on_segment_commit(self, segment_name: str, instance: str,
+                           offset: StreamOffset, location: str,
+                           metadata: SegmentMetadata) -> None:
+        """Commit handler invoked by the completion FSM (ref:
+        commitSegmentMetadata:508)."""
+        table = self._table_of(segment_name)
+        if table is None:
+            raise KeyError(f"cannot resolve table for {segment_name}")
+        new_consuming = self.llc.commit_segment(
+            table, segment_name, offset, location, metadata)
+        self._segment_tables[new_consuming] = table
+
+    # -- rebalance (ref: TableRebalancer) -----------------------------------
+    def rebalance_table(self, table: str, dry_run: bool = False,
+                        convergence_timeout_s: float = 30.0,
+                        best_effort: bool = True) -> List[Dict]:
+        """Make-before-break: after each intermediate step, wait for the
+        ExternalView to converge before dropping old replicas (ref:
+        TableRebalancer EV-convergence wait + bestEffort flag). With
+        ``best_effort`` a convergence timeout proceeds anyway (the
+        standalone/test mode where no live servers report EV); without it,
+        the rebalance raises and leaves the added replicas in place."""
+        servers, replication = assignment_for_table(self.store, table)
+        current = self.store.get_ideal_state(table)
+        target = compute_target_assignment(current, servers, replication)
+        steps = rebalance_steps(current, target)
+        if dry_run:
+            return steps
+        for i, step in enumerate(steps):
+            self.store.set_ideal_state(table, step)
+            if i == len(steps) - 1:
+                break
+            if not self._wait_external_view(table, step,
+                                            convergence_timeout_s):
+                if not best_effort:
+                    raise RuntimeError(
+                        f"rebalance of {table} stalled: ExternalView did not "
+                        f"converge within {convergence_timeout_s}s")
+                log.warning("rebalance %s: EV convergence timeout, "
+                            "proceeding best-effort", table)
+        return steps
+
+    def _wait_external_view(self, table: str, ideal: Dict,
+                            timeout_s: float,
+                            poll_s: float = 0.05) -> bool:
+        deadline = time.monotonic() + timeout_s
+        while True:
+            ev = self.store.get_external_view(table)
+            ok = all(ev.get(seg, {}).get(inst) == st
+                     for seg, m in ideal.items()
+                     for inst, st in m.items() if st != OFFLINE)
+            if ok:
+                return True
+            if time.monotonic() >= deadline:
+                return False
+            time.sleep(poll_s)
+
+    # -- periodic tasks (ref: ControllerPeriodicTask framework) -------------
+    def run_retention_manager(self, now_ms: Optional[int] = None) -> List[str]:
+        """Delete segments past the table's retention
+        (ref: RetentionManager + SegmentDeletionManager)."""
+        now_ms = now_ms or int(time.time() * 1000)
+        deleted = []
+        for table in self.store.table_names():
+            cfg = self.store.get_table_config(table)
+            vc = cfg.validation_config
+            if not vc.retention_time_unit or not vc.retention_time_value:
+                continue
+            unit_ms = _RETENTION_UNIT_MS.get(vc.retention_time_unit.upper())
+            if unit_ms is None:
+                continue
+            cutoff = now_ms - vc.retention_time_value * unit_ms
+            time_unit_ms = _RETENTION_UNIT_MS.get(vc.time_type.upper(), 1)
+            for md in self.store.segment_metadata_list(table):
+                if md.status == CONSUMING or md.end_time is None:
+                    continue
+                if md.end_time * time_unit_ms < cutoff:
+                    self.delete_segment(table, md.segment_name)
+                    deleted.append(md.segment_name)
+        return deleted
+
+    def run_realtime_validation(self) -> List[str]:
+        """Repair dead CONSUMING segments
+        (ref: RealtimeSegmentValidationManager)."""
+        created = []
+        for table in self.store.table_names():
+            if table_type_from_name(table) is TableType.REALTIME:
+                fresh = self.llc.ensure_all_partitions_consuming(table)
+                for seg in fresh:
+                    self._segment_tables[seg] = table
+                created.extend(fresh)
+        return created
+
+    def run_segment_status_check(self) -> Dict[str, Dict[str, int]]:
+        """Per-table ideal-vs-external-view convergence report
+        (ref: SegmentStatusChecker)."""
+        report = {}
+        for table in self.store.table_names():
+            ideal = self.store.get_ideal_state(table)
+            ev = self.store.get_external_view(table)
+            missing = sum(1 for seg, m in ideal.items()
+                          for inst, st in m.items()
+                          if st != OFFLINE and ev.get(seg, {}).get(inst) != st)
+            report[table] = {
+                "segments": len(ideal),
+                "replicasExpected": sum(len(m) for m in ideal.values()),
+                "replicasMissing": missing,
+            }
+        return report
+
+    def start_periodic_tasks(self, interval_s: float = 5.0) -> None:
+        def loop():
+            while not self._periodic_stop.wait(interval_s):
+                try:
+                    self.run_retention_manager()
+                    self.run_realtime_validation()
+                except Exception:
+                    log.exception("periodic task failed")
+
+        self._periodic_thread = threading.Thread(
+            target=loop, daemon=True, name="controller-periodic")
+        self._periodic_thread.start()
+
+    def stop(self) -> None:
+        self._periodic_stop.set()
+        if self._periodic_thread is not None:
+            self._periodic_thread.join(timeout=10)

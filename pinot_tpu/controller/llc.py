@@ -1,0 +1,165 @@
+"""LLC realtime segment manager: CONSUMING segment lifecycle.
+
+Re-design of ``pinot-controller/.../realtime/PinotLLCRealtimeSegmentManager.java:119``:
+creates one CONSUMING segment per stream partition on table setup
+(``setUpNewTable:287``), flips it ONLINE + creates the next sequence on
+commit (``commitSegmentMetadata:508``), and repairs dead consumption
+(``ensureAllPartitionsConsuming``, doc at :108-113).
+
+LLC segment names follow the reference: ``table__partition__sequence__seed``
+(ref: LLCSegmentName).
+"""
+
+from __future__ import annotations
+
+import time
+
+from typing import Dict, List, Optional
+
+from pinot_tpu.controller.assignment import (
+    PartitionedReplicaGroupAssignment,
+    assignment_for_table,
+)
+from pinot_tpu.controller.state import (
+    CONSUMING,
+    ONLINE,
+    ClusterStateStore,
+    SegmentZKMetadata,
+)
+from pinot_tpu.ingestion.stream import StreamOffset, create_consumer_factory
+from pinot_tpu.segment.metadata import SegmentMetadata
+
+
+def llc_segment_name(table_raw: str, partition: int, sequence: int,
+                     seed: Optional[str] = None) -> str:
+    seed = seed or time.strftime("%Y%m%dT%H%M%SZ", time.gmtime())
+    return f"{table_raw}__{partition}__{sequence}__{seed}"
+
+
+def parse_llc_name(segment_name: str):
+    """-> (table, partition, sequence) (ref: LLCSegmentName)."""
+    parts = segment_name.split("__")
+    if len(parts) < 4:
+        raise ValueError(f"not an LLC segment name: {segment_name!r}")
+    return parts[0], int(parts[1]), int(parts[2])
+
+
+class LLCRealtimeSegmentManager:
+    """One per controller."""
+
+    def __init__(self, store: ClusterStateStore, seed: Optional[str] = None):
+        self.store = store
+        self._seed = seed  # fixed seed for deterministic tests
+
+    # -- table setup (ref: setUpNewTable:287) -------------------------------
+    def setup_new_table(self, table_with_type: str) -> List[str]:
+        cfg = self.store.get_table_config(table_with_type)
+        if cfg is None or cfg.stream_config is None:
+            raise ValueError(f"{table_with_type} is not a realtime table")
+        factory = create_consumer_factory(cfg.stream_config)
+        meta_provider = factory.create_metadata_provider()
+        n_parts = meta_provider.partition_count()
+        created = []
+        for p in range(n_parts):
+            start = meta_provider.earliest_offset(p)
+            created.append(self._create_consuming_segment(
+                table_with_type, p, 0, start))
+        return created
+
+    def _create_consuming_segment(self, table: str, partition: int,
+                                  sequence: int,
+                                  start_offset: StreamOffset) -> str:
+        cfg = self.store.get_table_config(table)
+        raw = cfg.table_name
+        name = llc_segment_name(raw, partition, sequence, self._seed)
+        md = SegmentZKMetadata(
+            segment_name=name, table_name=table, status=CONSUMING,
+            creation_time_ms=int(time.time() * 1000),
+            start_offset=str(start_offset), partition=partition,
+            sequence=sequence)
+        self.store.set_segment_metadata(md)
+
+        servers, replication = assignment_for_table(self.store, table)
+        strategy = PartitionedReplicaGroupAssignment(
+            num_replica_groups=max(min(replication, len(servers)), 1))
+        chosen = strategy.assign(name, self.store.get_ideal_state(table),
+                                 servers, replication, partition=partition)
+
+        def apply(ideal):
+            ideal = ideal or {}
+            ideal[name] = {inst: CONSUMING for inst in chosen}
+            return ideal
+
+        self.store.update_ideal_state(table, apply)
+        return name
+
+    # -- commit (ref: commitSegmentMetadata:508) ----------------------------
+    def commit_segment(self, table: str, segment_name: str,
+                       end_offset: StreamOffset, download_url: str,
+                       segment_metadata: Optional[SegmentMetadata] = None) -> str:
+        """Flip CONSUMING -> ONLINE (same instances), record the offset
+        checkpoint, create the next CONSUMING sequence. Returns the new
+        consuming segment's name."""
+        zk = self.store.get_segment_metadata(table, segment_name)
+        if zk is None:
+            raise KeyError(f"unknown segment {segment_name}")
+        zk.status = ONLINE
+        zk.end_offset = str(end_offset)
+        zk.download_url = download_url
+        zk.push_time_ms = int(time.time() * 1000)
+        if segment_metadata is not None:
+            zk.total_docs = segment_metadata.num_docs
+            zk.crc = segment_metadata.crc
+            zk.start_time = segment_metadata.min_time
+            zk.end_time = segment_metadata.max_time
+        self.store.set_segment_metadata(zk)
+
+        def apply(ideal):
+            ideal = ideal or {}
+            seg = ideal.get(segment_name, {})
+            ideal[segment_name] = {inst: ONLINE for inst in seg}
+            return ideal
+
+        self.store.update_ideal_state(table, apply)
+
+        _, partition, sequence = parse_llc_name(segment_name)
+        return self._create_consuming_segment(
+            table, partition, sequence + 1, end_offset)
+
+    # -- repair (ref: ensureAllPartitionsConsuming :108-113) ----------------
+    def ensure_all_partitions_consuming(self, table: str) -> List[str]:
+        """Every stream partition must have exactly one CONSUMING segment;
+        recreate any that died (committed without successor, errored, or
+        never created after partition expansion)."""
+        cfg = self.store.get_table_config(table)
+        if cfg is None or cfg.stream_config is None:
+            return []
+        factory = create_consumer_factory(cfg.stream_config)
+        n_parts = factory.create_metadata_provider().partition_count()
+
+        consuming: Dict[int, str] = {}
+        latest: Dict[int, SegmentZKMetadata] = {}
+        for md in self.store.segment_metadata_list(table):
+            if md.partition is None:
+                continue
+            if md.status == CONSUMING:
+                consuming[md.partition] = md.segment_name
+            prev = latest.get(md.partition)
+            if prev is None or (md.sequence or 0) > (prev.sequence or 0):
+                latest[md.partition] = md
+
+        created = []
+        for p in range(n_parts):
+            if p in consuming:
+                continue
+            last = latest.get(p)
+            if last is None:
+                start = factory.create_metadata_provider().earliest_offset(p)
+                created.append(self._create_consuming_segment(table, p, 0, start))
+            else:
+                start = (StreamOffset.parse(last.end_offset)
+                         if last.end_offset else
+                         StreamOffset.parse(last.start_offset or "0"))
+                created.append(self._create_consuming_segment(
+                    table, p, (last.sequence or 0) + 1, start))
+        return created

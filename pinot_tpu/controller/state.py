@@ -1,0 +1,348 @@
+"""Cluster state store: the Helix/ZooKeeper role.
+
+Re-design of the reference's control plane (Apache Helix on ZK,
+SURVEY.md §1 cross-cutting): a strongly-consistent in-process property store
+holding schemas, table configs, segment metadata (the ``SegmentZKMetadata``
+analogue), IdealState / ExternalView maps, and the instance registry — with
+path-prefix watches so brokers/servers react to changes the way Helix
+spectators/participants react to ZK callbacks. Snapshot persistence gives
+the ZK durability property for single-host deployments; multi-host
+deployments put this store behind the gRPC control service.
+
+All mutations are serialized under one lock and bump a monotonically
+increasing version (the ZK zxid analogue); watchers fire outside the lock
+in mutation order (ref: ClusterChangeMediator dedup/serialize behavior).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from pinot_tpu.spi.data import Schema
+from pinot_tpu.spi.table import TableConfig
+
+
+# segment states in IdealState/ExternalView
+# (ref: SegmentOnlineOfflineStateModelFactory.java:53)
+ONLINE = "ONLINE"
+CONSUMING = "CONSUMING"
+OFFLINE = "OFFLINE"
+ERROR = "ERROR"
+
+
+@dataclass
+class SegmentZKMetadata:
+    """Ref: pinot-common/.../metadata/segment/SegmentZKMetadata."""
+
+    segment_name: str
+    table_name: str  # with type suffix
+    status: str = ONLINE              # ONLINE | CONSUMING | OFFLINE
+    download_url: str = ""            # deep-store location
+    crc: int = 0
+    creation_time_ms: int = 0
+    push_time_ms: int = 0
+    start_time: Optional[int] = None  # time-column units
+    end_time: Optional[int] = None
+    total_docs: int = 0
+    # realtime (LLC) checkpoint
+    start_offset: Optional[str] = None
+    end_offset: Optional[str] = None
+    partition: Optional[int] = None
+    sequence: Optional[int] = None
+    custom: Dict[str, Any] = field(default_factory=dict)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "segmentName": self.segment_name,
+            "tableName": self.table_name,
+            "status": self.status,
+            "downloadUrl": self.download_url,
+            "crc": self.crc,
+            "creationTimeMs": self.creation_time_ms,
+            "pushTimeMs": self.push_time_ms,
+            "startTime": self.start_time,
+            "endTime": self.end_time,
+            "totalDocs": self.total_docs,
+            "startOffset": self.start_offset,
+            "endOffset": self.end_offset,
+            "partition": self.partition,
+            "sequence": self.sequence,
+            "custom": self.custom,
+        }
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "SegmentZKMetadata":
+        return cls(
+            segment_name=d["segmentName"], table_name=d["tableName"],
+            status=d.get("status", ONLINE),
+            download_url=d.get("downloadUrl", ""), crc=d.get("crc", 0),
+            creation_time_ms=d.get("creationTimeMs", 0),
+            push_time_ms=d.get("pushTimeMs", 0),
+            start_time=d.get("startTime"), end_time=d.get("endTime"),
+            total_docs=d.get("totalDocs", 0),
+            start_offset=d.get("startOffset"), end_offset=d.get("endOffset"),
+            partition=d.get("partition"), sequence=d.get("sequence"),
+            custom=d.get("custom", {}),
+        )
+
+
+@dataclass
+class InstanceInfo:
+    """Ref: Helix InstanceConfig + LiveInstance."""
+
+    instance_id: str
+    instance_type: str          # BROKER | SERVER | CONTROLLER | MINION
+    host: str = "localhost"
+    port: int = 0
+    tags: List[str] = field(default_factory=lambda: ["DefaultTenant"])
+    alive: bool = True
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"instanceId": self.instance_id,
+                "type": self.instance_type, "host": self.host,
+                "port": self.port, "tags": self.tags, "alive": self.alive}
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "InstanceInfo":
+        return cls(d["instanceId"], d["type"], d.get("host", "localhost"),
+                   d.get("port", 0), d.get("tags", ["DefaultTenant"]),
+                   d.get("alive", True))
+
+
+Watcher = Callable[[str, Any], None]
+
+
+class ClusterStateStore:
+    """The single source of truth for cluster metadata.
+
+    Paths (ZK-layout analogue):
+      schemas/<name>, tables/<nameWithType>,
+      segments/<table>/<segment>           (SegmentZKMetadata),
+      idealstate/<table>                   ({segment: {instance: state}}),
+      externalview/<table>,
+      instances/<id>
+    """
+
+    def __init__(self, snapshot_path: Optional[str] = None):
+        self._lock = threading.RLock()
+        self._data: Dict[str, Any] = {}
+        self._version = 0
+        self._watchers: List[Tuple[str, Watcher]] = []
+        self._snapshot_path = snapshot_path
+        # mutation-ordered notification queue drained under _notify_lock so
+        # watchers observe updates in version order even when mutators race
+        # (the ClusterChangeMediator serialization property)
+        self._pending: List[Tuple[str, Any]] = []
+        # RLock: a watcher may mutate the store, re-entering the drain
+        self._notify_lock = threading.RLock()
+        if snapshot_path and os.path.isfile(snapshot_path):
+            with open(snapshot_path) as f:
+                payload = json.load(f)
+            self._data = payload["data"]
+            self._version = payload["version"]
+
+    @staticmethod
+    def _copy(v: Any) -> Any:
+        return json.loads(json.dumps(v)) if isinstance(v, (dict, list)) else v
+
+    # -- raw property store --------------------------------------------------
+    def get(self, path: str, default: Any = None) -> Any:
+        with self._lock:
+            v = self._data.get(path, default)
+        return self._copy(v)
+
+    def set(self, path: str, value: Any) -> int:
+        value = self._copy(value)  # detach from the caller's object
+        with self._lock:
+            self._data[path] = value
+            self._version += 1
+            v = self._version
+            self._persist_locked()
+            self._pending.append((path, value))
+        self._drain_notifications()
+        return v
+
+    def update(self, path: str, fn: Callable[[Any], Any],
+               default: Any = None) -> Any:
+        """Atomic read-modify-write (the ZK CAS-retry analogue)."""
+        with self._lock:
+            cur = self._data.get(path, default)
+            new = self._copy(fn(self._copy(cur)))
+            self._data[path] = new
+            self._version += 1
+            self._persist_locked()
+            self._pending.append((path, new))
+        self._drain_notifications()
+        return self._copy(new)
+
+    def delete(self, path: str) -> None:
+        with self._lock:
+            existed = path in self._data
+            self._data.pop(path, None)
+            if existed:
+                self._version += 1
+                self._persist_locked()
+                self._pending.append((path, None))
+        if existed:
+            self._drain_notifications()
+
+    def children(self, prefix: str) -> List[str]:
+        prefix = prefix.rstrip("/") + "/"
+        with self._lock:
+            keys = [k for k in self._data if k.startswith(prefix)]
+        return sorted(keys)
+
+    @property
+    def version(self) -> int:
+        with self._lock:
+            return self._version
+
+    # -- watches -------------------------------------------------------------
+    def watch(self, prefix: str, watcher: Watcher) -> None:
+        """Watcher fires for every mutation under ``prefix``
+        (ref: Helix spectator callbacks routed via ClusterChangeMediator)."""
+        with self._lock:
+            self._watchers.append((prefix, watcher))
+
+    def _drain_notifications(self) -> None:
+        """Deliver queued notifications in mutation order. One thread drains
+        at a time; a mutator racing past a draining thread leaves its event
+        in the queue for the drainer."""
+        while True:
+            with self._notify_lock:
+                with self._lock:
+                    if not self._pending:
+                        return
+                    batch, self._pending = self._pending, []
+                for path, value in batch:
+                    for prefix, w in list(self._watchers):
+                        if path.startswith(prefix):
+                            try:
+                                w(path, self._copy(value))
+                            except Exception:  # must not poison the store
+                                import logging
+
+                                logging.getLogger(__name__).exception(
+                                    "watcher failed for %s", path)
+
+    def _persist_locked(self) -> None:
+        if not self._snapshot_path:
+            return
+        tmp = self._snapshot_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({"version": self._version, "data": self._data}, f)
+        os.replace(tmp, self._snapshot_path)
+
+    # -- typed accessors (ref: ZKMetadataProvider) ---------------------------
+    def add_schema(self, schema: Schema) -> None:
+        self.set(f"schemas/{schema.schema_name}", schema.to_dict())
+
+    def get_schema(self, name: str) -> Optional[Schema]:
+        d = self.get(f"schemas/{name}")
+        return Schema.from_dict(d) if d else None
+
+    def schema_names(self) -> List[str]:
+        return [p.split("/", 1)[1] for p in self.children("schemas")]
+
+    def add_table_config(self, config: TableConfig) -> None:
+        self.set(f"tables/{config.table_name_with_type}", config.to_dict())
+
+    def get_table_config(self, name_with_type: str) -> Optional[TableConfig]:
+        d = self.get(f"tables/{name_with_type}")
+        return TableConfig.from_dict(d) if d else None
+
+    def table_names(self) -> List[str]:
+        return [p.split("/", 1)[1] for p in self.children("tables")]
+
+    def delete_table(self, name_with_type: str) -> None:
+        for p in self.children(f"segments/{name_with_type}"):
+            self.delete(p)
+        self.delete(f"idealstate/{name_with_type}")
+        self.delete(f"externalview/{name_with_type}")
+        self.delete(f"tables/{name_with_type}")
+
+    # segments
+    def set_segment_metadata(self, md: SegmentZKMetadata) -> None:
+        self.set(f"segments/{md.table_name}/{md.segment_name}", md.to_dict())
+
+    def get_segment_metadata(self, table: str,
+                             segment: str) -> Optional[SegmentZKMetadata]:
+        d = self.get(f"segments/{table}/{segment}")
+        return SegmentZKMetadata.from_dict(d) if d else None
+
+    def segment_names(self, table: str) -> List[str]:
+        return [p.rsplit("/", 1)[1]
+                for p in self.children(f"segments/{table}")]
+
+    def segment_metadata_list(self, table: str) -> List[SegmentZKMetadata]:
+        return [SegmentZKMetadata.from_dict(self.get(p))
+                for p in self.children(f"segments/{table}")]
+
+    def delete_segment(self, table: str, segment: str) -> None:
+        self.delete(f"segments/{table}/{segment}")
+
+    # ideal state / external view: {segment: {instance: state}}
+    def get_ideal_state(self, table: str) -> Dict[str, Dict[str, str]]:
+        return self.get(f"idealstate/{table}", {}) or {}
+
+    def set_ideal_state(self, table: str,
+                        state: Dict[str, Dict[str, str]]) -> None:
+        self.set(f"idealstate/{table}", state)
+
+    def update_ideal_state(self, table: str,
+                           fn: Callable[[Dict[str, Dict[str, str]]],
+                                        Dict[str, Dict[str, str]]]) -> Dict:
+        return self.update(f"idealstate/{table}", fn, default={})
+
+    def get_external_view(self, table: str) -> Dict[str, Dict[str, str]]:
+        return self.get(f"externalview/{table}", {}) or {}
+
+    def report_instance_state(self, table: str, segment: str,
+                              instance: str, state: str) -> None:
+        """Server-side state report (the Helix current-state -> EV rollup)."""
+
+        def apply(ev):
+            ev = ev or {}
+            seg = ev.setdefault(segment, {})
+            if state == OFFLINE:
+                seg.pop(instance, None)
+                if not seg:
+                    ev.pop(segment, None)
+            else:
+                seg[instance] = state
+            return ev
+
+        self.update(f"externalview/{table}", apply, default={})
+
+    # instances
+    def register_instance(self, info: InstanceInfo) -> None:
+        self.set(f"instances/{info.instance_id}", info.to_dict())
+
+    def get_instance(self, instance_id: str) -> Optional[InstanceInfo]:
+        d = self.get(f"instances/{instance_id}")
+        return InstanceInfo.from_dict(d) if d else None
+
+    def instances(self, instance_type: Optional[str] = None,
+                  only_alive: bool = False) -> List[InstanceInfo]:
+        out = []
+        for p in self.children("instances"):
+            info = InstanceInfo.from_dict(self.get(p))
+            if instance_type and info.instance_type != instance_type:
+                continue
+            if only_alive and not info.alive:
+                continue
+            out.append(info)
+        return out
+
+    def set_instance_alive(self, instance_id: str, alive: bool) -> None:
+        def apply(d):
+            if d:
+                d["alive"] = alive
+            return d
+
+        self.update(f"instances/{instance_id}", apply)

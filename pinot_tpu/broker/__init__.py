@@ -1,0 +1,17 @@
+"""Broker: routing, time boundary, scatter/gather, reduce
+(ref: pinot-broker + pinot-core query/reduce)."""
+
+from pinot_tpu.broker.broker import BrokerRequestHandler
+from pinot_tpu.broker.reduce import BrokerReduceService
+from pinot_tpu.broker.routing import (
+    BalancedInstanceSelector,
+    RoutingManager,
+    TimeBoundaryManager,
+    extract_time_interval,
+)
+
+__all__ = [
+    "BrokerRequestHandler", "BrokerReduceService",
+    "BalancedInstanceSelector", "RoutingManager", "TimeBoundaryManager",
+    "extract_time_interval",
+]

@@ -1,0 +1,211 @@
+"""Broker request handler: SQL front door + scatter/gather.
+
+Re-design of ``pinot-broker/.../requesthandler/BaseBrokerRequestHandler.java:176``:
+parse SQL -> resolve the table (offline / realtime / hybrid with the time
+boundary, ``:2002``) -> routing tables -> scatter per-server instance
+requests -> gather DataTables -> BrokerReduceService -> BrokerResponse
+(ref: SingleConnectionBrokerRequestHandler.java:82-146).
+
+Transport: an in-process server registry (the embedded-cluster mode, ref:
+ClusterTest single-JVM). Multi-host deployments register gRPC stubs that
+expose the same ``execute_query`` signature.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+
+from concurrent.futures import TimeoutError as FutureTimeout
+from dataclasses import replace
+from typing import Dict, List, Optional, Tuple
+
+from pinot_tpu.broker.reduce import BrokerReduceService
+from pinot_tpu.broker.routing import RoutingManager
+from pinot_tpu.common.datatable import DataTable
+from pinot_tpu.common.response import BrokerResponse
+from pinot_tpu.controller.state import ClusterStateStore
+from pinot_tpu.engine.errors import QueryError
+from pinot_tpu.engine.results import QueryStats
+from pinot_tpu.query import SqlParseError, compile_query
+from pinot_tpu.query.context import QueryContext
+from pinot_tpu.query.expressions import (
+    FilterNode,
+    FilterOp,
+    Identifier,
+    Predicate,
+    PredicateType,
+)
+from pinot_tpu.spi.table import TableType, table_name_with_type
+
+log = logging.getLogger(__name__)
+
+# ref: QueryException codes
+SQL_PARSING_ERROR = 150
+TABLE_DOES_NOT_EXIST_ERROR = 190
+BROKER_REQUEST_SEND_ERROR = 425
+SERVER_NOT_RESPONDING_ERROR = 427
+QUERY_EXECUTION_ERROR = 200
+
+
+class BrokerRequestHandler:
+    """Ref: BaseBrokerRequestHandler.java:176."""
+
+    def __init__(self, store: ClusterStateStore,
+                 routing: Optional[RoutingManager] = None,
+                 scatter_workers: int = 16,
+                 query_timeout_s: float = 30.0):
+        self.store = store
+        self.routing = routing or RoutingManager(store)
+        self.reduce_service = BrokerReduceService()
+        self._servers: Dict[str, object] = {}
+        from pinot_tpu.server.scheduler import _DaemonPool
+
+        self._pool = _DaemonPool(scatter_workers, "scatter")
+        self.query_timeout_s = query_timeout_s
+
+    # -- transport registry --------------------------------------------------
+    def register_server(self, instance_id: str, server) -> None:
+        """``server`` exposes execute_query(ctx, table, segments)->DataTable
+        (a ServerInstance, or a gRPC stub with the same surface)."""
+        self._servers[instance_id] = server
+
+    # -- entry (ref: handleSQLRequest:203) -----------------------------------
+    def handle_sql(self, sql: str) -> BrokerResponse:
+        start = time.perf_counter()
+        response = BrokerResponse()
+        try:
+            ctx = compile_query(sql)
+        except SqlParseError as e:
+            response.add_exception(SQL_PARSING_ERROR, str(e))
+            return response
+
+        try:
+            physical = self._resolve_tables(ctx.table_name)
+        except QueryError as e:
+            response.add_exception(TABLE_DOES_NOT_EXIST_ERROR, str(e))
+            return response
+
+        tables: List[DataTable] = []
+        servers_queried = set()
+        servers_responded = set()
+        for table, sub_ctx in self._split_hybrid(ctx, physical):
+            routing, unavailable = self.routing.get_routing_table(
+                table, sub_ctx)
+            if unavailable:
+                response.add_exception(
+                    SERVER_NOT_RESPONDING_ERROR,
+                    f"{len(unavailable)} segments of {table} unavailable: "
+                    f"{unavailable[:5]}")
+            if not routing:
+                continue
+            gathered, queried, responded = self._scatter_gather(
+                table, sub_ctx, routing)
+            tables.extend(gathered)
+            servers_queried |= queried
+            servers_responded |= responded
+
+        response.num_servers_queried = len(servers_queried)
+        response.num_servers_responded = len(servers_responded)
+        if not tables:
+            # an existing-but-empty table answers with an empty result
+            response.stats = QueryStats()
+            response.time_used_ms = (time.perf_counter() - start) * 1e3
+            return response
+
+        try:
+            table, stats, server_errors = self.reduce_service.reduce(
+                ctx, tables)
+            response.result_table = table
+            response.stats = stats
+            for msg in server_errors:
+                # partial result: the table stands, but the caller sees it
+                response.add_exception(SERVER_NOT_RESPONDING_ERROR, msg)
+        except QueryError as e:
+            response.add_exception(QUERY_EXECUTION_ERROR, str(e))
+        response.time_used_ms = (time.perf_counter() - start) * 1e3
+        return response
+
+    # -- table resolution + hybrid split -------------------------------------
+    def _resolve_tables(self, raw_name: str) -> List[str]:
+        """'myTable' -> its physical tables; explicit _OFFLINE/_REALTIME
+        names pass through (ref: table resolution via TableCache)."""
+        known = set(self.store.table_names())
+        if raw_name in known:
+            return [raw_name]
+        out = [table_name_with_type(raw_name, t)
+               for t in (TableType.OFFLINE, TableType.REALTIME)
+               if table_name_with_type(raw_name, t) in known]
+        if not out:
+            raise QueryError(f"table {raw_name!r} does not exist")
+        return out
+
+    def _split_hybrid(self, ctx: QueryContext, physical: List[str]
+                      ) -> List[Tuple[str, QueryContext]]:
+        """Hybrid tables get the time-boundary split
+        (ref: BaseBrokerRequestHandler attachTimeBoundary :2002)."""
+        if len(physical) < 2:
+            return [(physical[0], ctx)]
+        offline = next(t for t in physical if t.endswith("_OFFLINE"))
+        realtime = next(t for t in physical if t.endswith("_REALTIME"))
+        cfg = self.store.get_table_config(offline)
+        tc = cfg.validation_config.time_column_name if cfg else None
+        boundary = self.routing.time_boundary.get_boundary(offline)
+        if tc is None or boundary is None:
+            # no boundary yet: realtime serves everything
+            return [(realtime, ctx)]
+        off_pred = FilterNode(
+            FilterOp.PREDICATE,
+            predicate=Predicate(PredicateType.RANGE, Identifier(tc),
+                                upper=boundary, upper_inclusive=True))
+        rt_pred = FilterNode(
+            FilterOp.PREDICATE,
+            predicate=Predicate(PredicateType.RANGE, Identifier(tc),
+                                lower=boundary, lower_inclusive=False))
+        return [
+            (offline, replace(ctx, filter=_and(ctx.filter, off_pred))),
+            (realtime, replace(ctx, filter=_and(ctx.filter, rt_pred))),
+        ]
+
+    # -- scatter/gather (ref: QueryRouter.submitQuery:85) --------------------
+    def _scatter_gather(self, table: str, ctx: QueryContext,
+                        routing: Dict[str, List[str]]):
+        queried, responded = set(), set()
+        futures = {}
+        for instance_id, segments in routing.items():
+            server = self._servers.get(instance_id)
+            queried.add(instance_id)
+            if server is None:
+                futures[instance_id] = None
+                continue
+            futures[instance_id] = self._pool.submit(
+                lambda srv=server, segs=segments:
+                srv.execute_query(ctx, table, segs))
+        gathered: List[DataTable] = []
+        deadline = time.monotonic() + self.query_timeout_s
+        for instance_id, fut in futures.items():
+            if fut is None:
+                gathered.append(DataTable.for_exception(
+                    f"server {instance_id} is not connected"))
+                continue
+            try:
+                remaining = max(deadline - time.monotonic(), 0.001)
+                gathered.append(fut.result(timeout=remaining))
+                responded.add(instance_id)
+            except FutureTimeout:
+                gathered.append(DataTable.for_exception(
+                    f"server {instance_id} timed out after "
+                    f"{self.query_timeout_s}s"))
+            except Exception as e:
+                gathered.append(DataTable.for_exception(
+                    f"server {instance_id} failed: {e!r}"))
+        return gathered, queried, responded
+
+    def shutdown(self) -> None:
+        self._pool.stop()
+
+
+def _and(a: Optional[FilterNode], b: FilterNode) -> FilterNode:
+    if a is None:
+        return b
+    return FilterNode(FilterOp.AND, children=(a, b))

@@ -1,0 +1,168 @@
+"""Broker reduce: merge per-server DataTables into the final ResultTable.
+
+Re-design of ``pinot-core/.../query/reduce/BrokerReduceService.java:44``
+(``reduceOnDataTable:49`` dispatching by query type) +
+``GroupByDataTableReducer.java:66`` (IndexedTable merge, HAVING,
+post-aggregation) / ``AggregationDataTableReducer`` /
+``SelectionDataTableReducer`` / ``DistinctDataTableReducer``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Tuple
+
+import numpy as np
+
+from pinot_tpu.common.datatable import DataTable, ResponseType
+from pinot_tpu.engine.aggregates import resolve_agg
+from pinot_tpu.engine.errors import QueryError
+from pinot_tpu.engine.results import (
+    AggResult,
+    DataSchema,
+    GroupByResult,
+    QueryStats,
+    ResultTable,
+    _eval_scalar_filter,
+    _Reversible,
+    reduce_aggregation,
+    reduce_group_by,
+)
+from pinot_tpu.query.context import QueryContext
+from pinot_tpu.spi.config import CommonConstants
+
+
+class BrokerReduceService:
+    """Ref: BrokerReduceService.java:44."""
+
+    def __init__(self, num_groups_limit: int =
+                 CommonConstants.DEFAULT_NUM_GROUPS_LIMIT):
+        self.num_groups_limit = num_groups_limit
+
+    def reduce(self, ctx: QueryContext, tables: List[DataTable]
+               ) -> Tuple[ResultTable, QueryStats, List[str]]:
+        """-> (result, merged stats, per-server error messages). A partial
+        failure still reduces the successful servers' tables, but the errors
+        MUST reach the response so the caller can tell a partial result from
+        a complete one (ref: partial-results + exceptions behavior,
+        SingleConnectionBrokerRequestHandler.java:134-141)."""
+        stats = QueryStats()
+        exceptions: List[str] = []
+        ok: List[DataTable] = []
+        for t in tables:
+            stats.merge(t.stats)
+            exceptions.extend(t.exceptions)
+            if not t.exceptions:
+                ok.append(t)
+        if not ok:
+            raise QueryError("; ".join(exceptions) or "no server responses")
+
+        rtype = ok[0].response_type
+        if rtype is ResponseType.AGGREGATION:
+            table = self._reduce_aggregation(ctx, ok)
+        elif rtype is ResponseType.GROUP_BY:
+            table = self._reduce_group_by(ctx, ok, stats)
+        elif rtype is ResponseType.SELECTION:
+            table = self._reduce_selection(ctx, ok)
+        else:
+            table = self._reduce_distinct(ctx, ok)
+        return table, stats, exceptions
+
+    # -- per-type reducers ---------------------------------------------------
+    def _reduce_aggregation(self, ctx: QueryContext,
+                            tables: List[DataTable]) -> ResultTable:
+        aggs = [resolve_agg(f) for f in ctx.aggregations]
+        merged: AggResult = None
+        for t in tables:
+            part = AggResult(t.agg_states())
+            if merged is None:
+                merged = part
+            else:
+                merged.merge(part, aggs)
+        return reduce_aggregation(ctx, aggs, merged)
+
+    def _reduce_group_by(self, ctx: QueryContext, tables: List[DataTable],
+                         stats: QueryStats) -> ResultTable:
+        aggs = [resolve_agg(f) for f in ctx.aggregations]
+        merged = GroupByResult()
+        schema_types: Dict[str, str] = {}
+        for t in tables:
+            schema_types.update(t.schema_types())
+            merged.merge(GroupByResult(t.group_by_groups()), aggs)
+        if merged.trim(self.num_groups_limit):
+            stats.num_groups_limit_reached = True
+        return reduce_group_by(ctx, aggs, merged, schema_types)
+
+    def _reduce_selection(self, ctx: QueryContext,
+                          tables: List[DataTable]) -> ResultTable:
+        schema = tables[0].data_schema()
+        num_hidden = max(t.num_hidden for t in tables)
+        rows: List[List[Any]] = []
+        for t in tables:
+            rows.extend(t.rows())
+
+        if ctx.order_by and rows:
+            # hidden trailing columns hold the order-by expression values;
+            # visible order-by columns are found by name
+            names = schema.column_names
+            visible_n = len(names) - num_hidden
+            # aliased select expressions: ORDER BY references the expression,
+            # the schema shows the alias — map through select_expressions
+            alias_of: Dict[str, int] = {}
+            if visible_n == len(ctx.select_expressions):
+                for i, e in enumerate(ctx.select_expressions):
+                    alias_of.setdefault(str(e), i)
+            key_idx: List[int] = []
+            for ob in ctx.order_by:
+                key = str(ob.expr)
+                if key in names:
+                    key_idx.append(names.index(key))
+                elif key in alias_of:
+                    key_idx.append(alias_of[key])
+                else:
+                    hidden_names = names[visible_n:]
+                    key_idx.append(visible_n + hidden_names.index(key))
+            directions = [ob.ascending for ob in ctx.order_by]
+
+            def sort_key(row):
+                return tuple(_Reversible(row[i], asc)
+                             for i, asc in zip(key_idx, directions))
+
+            rows.sort(key=sort_key)
+
+        rows = rows[ctx.offset: ctx.offset + ctx.limit]
+        if num_hidden:
+            visible = len(schema.column_names) - num_hidden
+            schema = DataSchema(schema.column_names[:visible],
+                                schema.column_types[:visible])
+            rows = [r[:visible] for r in rows]
+        return ResultTable(schema, rows)
+
+    def _reduce_distinct(self, ctx: QueryContext,
+                         tables: List[DataTable]) -> ResultTable:
+        schema = tables[0].data_schema()
+        seen: Dict[Tuple, List[Any]] = {}
+        for t in tables:
+            for r in t.rows():
+                key = tuple(tuple(v) if isinstance(v, list) else v for v in r)
+                if key not in seen:
+                    seen[key] = r
+        rows = list(seen.values())
+        names = schema.column_names
+        if ctx.having is not None:
+            rows = [r for r in rows
+                    if _eval_scalar_filter(ctx.having, dict(zip(names, r)))]
+        if ctx.order_by:
+            idx_of = {n: i for i, n in enumerate(names)}
+
+            def sort_key(row):
+                parts = []
+                for ob in ctx.order_by:
+                    i = idx_of.get(str(ob.expr))
+                    if i is None:
+                        raise QueryError(
+                            f"ORDER BY {ob.expr} not in DISTINCT list")
+                    parts.append(_Reversible(row[i], ob.ascending))
+                return tuple(parts)
+
+            rows.sort(key=sort_key)
+        return ResultTable(schema, rows[ctx.offset: ctx.offset + ctx.limit])

@@ -1,0 +1,13 @@
+"""Shared wire/metadata layer (ref: pinot-common): the DataTable
+server->broker payload, broker response model."""
+
+from pinot_tpu.common.datatable import (
+    DataTable,
+    ResponseType,
+    decode_value,
+    encode_value,
+)
+from pinot_tpu.common.response import BrokerResponse
+
+__all__ = ["DataTable", "ResponseType", "decode_value", "encode_value",
+           "BrokerResponse"]

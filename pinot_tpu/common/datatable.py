@@ -1,0 +1,177 @@
+"""DataTable: the server->broker intermediate-result wire format.
+
+Re-design of ``pinot-core/.../common/datatable/DataTableImplV3.java:43`` +
+``ObjectSerDeUtils`` (custom serde for aggregation intermediate objects):
+one self-describing payload carrying either merged scalar-aggregation
+states, a group-by table, selection rows, or distinct rows — plus the data
+schema, per-server execution stats, and exceptions. Values round-trip
+through a tagged encoding covering the intermediate-state types (tuples for
+AVG/MINMAXRANGE, frozensets for DISTINCTCOUNT, bytes, non-finite floats).
+
+JSON framing keeps the format debuggable and language-neutral; bulk
+selection payloads can later swap to Arrow IPC without changing consumers.
+"""
+
+from __future__ import annotations
+
+import enum
+import json
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+from pinot_tpu.engine.results import DataSchema, QueryStats
+
+
+class ResponseType(enum.Enum):
+    AGGREGATION = "AGGREGATION"
+    GROUP_BY = "GROUP_BY"
+    SELECTION = "SELECTION"
+    DISTINCT = "DISTINCT"
+
+
+# --------------------------------------------------------------------------
+# tagged value encoding (ref: ObjectSerDeUtils object-type registry)
+# --------------------------------------------------------------------------
+
+def encode_value(v: Any) -> Any:
+    if v is None or isinstance(v, (bool, int, str)):
+        return v
+    if isinstance(v, float):
+        if v != v or v in (float("inf"), float("-inf")):
+            return {"__t": "f", "v": repr(v)}
+        return v
+    if isinstance(v, bytes):
+        return {"__t": "b", "v": v.hex()}
+    if isinstance(v, tuple):
+        return {"__t": "t", "v": [encode_value(x) for x in v]}
+    if isinstance(v, frozenset):
+        return {"__t": "s", "v": sorted((encode_value(x) for x in v),
+                                        key=lambda e: json.dumps(e))}
+    if isinstance(v, (list,)):
+        return {"__t": "l", "v": [encode_value(x) for x in v]}
+    if hasattr(v, "item"):  # numpy scalar
+        return encode_value(v.item())
+    raise TypeError(f"cannot encode {type(v).__name__} for the wire")
+
+
+def decode_value(v: Any) -> Any:
+    if isinstance(v, dict) and "__t" in v:
+        t = v["__t"]
+        if t == "f":
+            return float(v["v"])
+        if t == "b":
+            return bytes.fromhex(v["v"])
+        if t == "t":
+            return tuple(decode_value(x) for x in v["v"])
+        if t == "s":
+            return frozenset(decode_value(x) for x in v["v"])
+        if t == "l":
+            return [decode_value(x) for x in v["v"]]
+        raise ValueError(f"unknown value tag {t!r}")
+    return v
+
+
+# --------------------------------------------------------------------------
+# the DataTable
+# --------------------------------------------------------------------------
+
+@dataclass
+class DataTable:
+    """One server's reply for one (sub)query."""
+
+    response_type: ResponseType
+    # AGGREGATION: {"states": [state per agg]}
+    # GROUP_BY:    {"groups": [[key tuple, [state per agg]], ...],
+    #               "schema_types": {col: type label}}
+    # SELECTION:   {"schema": DataSchema dict, "rows": [...],
+    #               "num_hidden": trailing order-by-only columns}
+    # DISTINCT:    {"schema": DataSchema dict, "rows": [...]}
+    payload: Dict[str, Any]
+    stats: QueryStats = field(default_factory=QueryStats)
+    exceptions: List[str] = field(default_factory=list)
+
+    # -- framing -------------------------------------------------------------
+    def to_bytes(self) -> bytes:
+        return json.dumps({
+            "type": self.response_type.value,
+            "payload": self.payload,
+            "stats": self.stats.to_dict(),
+            "exceptions": self.exceptions,
+        }, separators=(",", ":")).encode("utf-8")
+
+    @classmethod
+    def from_bytes(cls, raw: bytes) -> "DataTable":
+        d = json.loads(raw.decode("utf-8"))
+        st = d.get("stats", {})
+        stats = QueryStats(
+            num_segments_queried=st.get("numSegmentsQueried", 0),
+            num_segments_processed=st.get("numSegmentsProcessed", 0),
+            num_segments_matched=st.get("numSegmentsMatched", 0),
+            num_docs_scanned=st.get("numDocsScanned", 0),
+            total_docs=st.get("totalDocs", 0),
+            num_groups_limit_reached=st.get("numGroupsLimitReached", False),
+        )
+        return cls(ResponseType(d["type"]), d["payload"], stats,
+                   d.get("exceptions", []))
+
+    # -- typed constructors --------------------------------------------------
+    @classmethod
+    def for_aggregation(cls, states: List[Any], stats: QueryStats) -> "DataTable":
+        return cls(ResponseType.AGGREGATION,
+                   {"states": [encode_value(s) for s in states]}, stats)
+
+    @classmethod
+    def for_group_by(cls, groups: Dict[tuple, List[Any]],
+                     schema_types: Dict[str, str],
+                     stats: QueryStats) -> "DataTable":
+        return cls(ResponseType.GROUP_BY, {
+            "groups": [[encode_value(k), [encode_value(s) for s in states]]
+                       for k, states in groups.items()],
+            "schema_types": schema_types,
+        }, stats)
+
+    @classmethod
+    def for_selection(cls, schema: DataSchema, rows: List[List[Any]],
+                      stats: QueryStats, num_hidden: int = 0) -> "DataTable":
+        return cls(ResponseType.SELECTION, {
+            "schema": schema.to_dict(),
+            "rows": [[encode_value(c) for c in r] for r in rows],
+            "num_hidden": num_hidden,
+        }, stats)
+
+    @classmethod
+    def for_distinct(cls, schema: DataSchema,
+                     rows: List[List[Any]], stats: QueryStats) -> "DataTable":
+        return cls(ResponseType.DISTINCT, {
+            "schema": schema.to_dict(),
+            "rows": [[encode_value(c) for c in r] for r in rows],
+        }, stats)
+
+    @classmethod
+    def for_exception(cls, message: str,
+                      response_type: ResponseType = ResponseType.AGGREGATION
+                      ) -> "DataTable":
+        return cls(response_type, {}, QueryStats(), [message])
+
+    # -- typed readers -------------------------------------------------------
+    def agg_states(self) -> List[Any]:
+        return [decode_value(s) for s in self.payload["states"]]
+
+    def group_by_groups(self) -> Dict[tuple, List[Any]]:
+        return {decode_value(k): [decode_value(s) for s in states]
+                for k, states in self.payload["groups"]}
+
+    def schema_types(self) -> Dict[str, str]:
+        return self.payload.get("schema_types", {})
+
+    def data_schema(self) -> DataSchema:
+        d = self.payload["schema"]
+        return DataSchema(d["columnNames"], d["columnDataTypes"])
+
+    def rows(self) -> List[List[Any]]:
+        return [[decode_value(c) for c in r] for r in self.payload["rows"]]
+
+    @property
+    def num_hidden(self) -> int:
+        return self.payload.get("num_hidden", 0)

@@ -1,0 +1,51 @@
+"""Broker JSON response model.
+
+Re-design of ``pinot-common/.../response/broker/BrokerResponseNative.java``:
+resultTable + exceptions + execution stats, serialized in the reference's
+JSON layout so clients written against Pinot's response shape keep working.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+from pinot_tpu.engine.results import QueryStats, ResultTable
+
+
+@dataclass
+class BrokerResponse:
+    result_table: Optional[ResultTable] = None
+    exceptions: List[Dict[str, Any]] = field(default_factory=list)
+    stats: QueryStats = field(default_factory=QueryStats)
+    num_servers_queried: int = 0
+    num_servers_responded: int = 0
+    time_used_ms: float = 0.0
+    trace_info: Dict[str, Any] = field(default_factory=dict)
+
+    def to_dict(self) -> Dict[str, Any]:
+        d: Dict[str, Any] = {
+            "exceptions": self.exceptions,
+            "numServersQueried": self.num_servers_queried,
+            "numServersResponded": self.num_servers_responded,
+            "numSegmentsQueried": self.stats.num_segments_queried,
+            "numSegmentsProcessed": self.stats.num_segments_processed,
+            "numSegmentsMatched": self.stats.num_segments_matched,
+            "numDocsScanned": self.stats.num_docs_scanned,
+            "totalDocs": self.stats.total_docs,
+            "numGroupsLimitReached": self.stats.num_groups_limit_reached,
+            "timeUsedMs": round(self.time_used_ms, 3),
+        }
+        if self.result_table is not None:
+            d["resultTable"] = self.result_table.to_dict()
+        if self.trace_info:
+            d["traceInfo"] = self.trace_info
+        return d
+
+    @property
+    def has_exceptions(self) -> bool:
+        return bool(self.exceptions)
+
+    def add_exception(self, code: int, message: str) -> None:
+        # ref: QueryException error codes
+        self.exceptions.append({"errorCode": code, "message": message})

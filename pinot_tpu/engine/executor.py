@@ -48,6 +48,68 @@ class ServerQueryExecutor:
         self.num_groups_limit = num_groups_limit
 
     # -- public ------------------------------------------------------------
+    def execute_instance(self, ctx: QueryContext,
+                         segments: List[ImmutableSegment]):
+        """Instance-level execution returning a mergeable DataTable — the
+        scatter/gather server half (ref: InstanceResponseOperator wrapping
+        combine output into a serialized DataTable). The broker merges
+        DataTables from all servers and reduces (BrokerReduceService)."""
+        from dataclasses import replace
+
+        from pinot_tpu.common.datatable import DataTable
+
+        stats = QueryStats(num_segments_queried=len(segments))
+        if not segments:
+            raise QueryError(f"no segments for table {ctx.table_name!r}")
+        self._validate_columns(ctx, segments[0])
+
+        if ctx.distinct:
+            # HAVING is broker-side (it sees the global distinct set); ORDER
+            # BY stays server-side so each server ships its true top rows —
+            # order-by keys are always in the distinct select list, so a
+            # per-server sorted prefix of offset+limit rows is sufficient
+            if ctx.having is not None:
+                sub = replace(ctx, order_by=[], having=None,
+                              limit=self.num_groups_limit, offset=0)
+            else:
+                sub = replace(ctx, having=None,
+                              limit=ctx.offset + ctx.limit, offset=0)
+            table = host_engine.execute_distinct(sub, segments, stats)
+            if len(table.rows) >= self.num_groups_limit:
+                stats.num_groups_limit_reached = True
+            return DataTable.for_distinct(table.schema, table.rows, stats)
+
+        if ctx.is_selection:
+            if not ctx.order_by:
+                sub = replace(ctx, limit=ctx.offset + ctx.limit, offset=0)
+                table = host_engine.execute_selection(sub, segments, stats)
+                return DataTable.for_selection(table.schema, table.rows, stats)
+            # ordered: append order-by expressions as hidden trailing columns
+            # so the broker can merge-sort without re-reading segments
+            # (ref: SelectionOrderByOperator rows carry order-by columns)
+            present = {str(e) for e in ctx.select_expressions}
+            hidden = [ob.expr for ob in ctx.order_by
+                      if str(ob.expr) not in present]
+            sub = replace(
+                ctx,
+                select_expressions=list(ctx.select_expressions) + hidden,
+                aliases=list(ctx.aliases) + [None] * len(hidden),
+                limit=ctx.offset + ctx.limit, offset=0)
+            table = host_engine.execute_selection(sub, segments, stats)
+            return DataTable.for_selection(table.schema, table.rows, stats,
+                                           num_hidden=len(hidden))
+
+        aggs = [resolve_agg(f) for f in ctx.aggregations]
+        if ctx.is_group_by:
+            merged = self._execute_group_by(ctx, aggs, segments, stats)
+            if merged.trim(self.num_groups_limit):
+                stats.num_groups_limit_reached = True
+            return DataTable.for_group_by(merged.groups,
+                                          self._schema_types(segments[0]),
+                                          stats)
+        merged_agg = self._execute_aggregation(ctx, aggs, segments, stats)
+        return DataTable.for_aggregation(merged_agg.states, stats)
+
     def execute(self, ctx: QueryContext,
                 segments: List[ImmutableSegment]) -> Tuple[ResultTable, QueryStats]:
         stats = QueryStats(num_segments_queried=len(segments))

@@ -140,7 +140,9 @@ class RealtimeSegmentDataManager:
                  output_dir: str = "/tmp/pinot_tpu_segments",
                  consumer_factory: Optional[StreamConsumerFactory] = None,
                  on_committed: Optional[Callable[["RealtimeSegmentDataManager",
-                                                  SegmentMetadata, str], None]] = None):
+                                                  SegmentMetadata, str], None]] = None,
+                 on_terminal: Optional[Callable[["RealtimeSegmentDataManager"],
+                                                None]] = None):
         sc = table_config.stream_config
         if sc is None:
             raise ValueError("table has no stream config")
@@ -152,6 +154,7 @@ class RealtimeSegmentDataManager:
         self.output_dir = output_dir
         self.protocol = protocol or LocalCompletionProtocol()
         self.on_committed = on_committed
+        self.on_terminal = on_terminal
 
         factory = consumer_factory or create_consumer_factory(sc)
         self._consumer = factory.create_partition_consumer(partition)
@@ -303,6 +306,12 @@ class RealtimeSegmentDataManager:
                 elif not self._has_new_data():
                     self._stop.wait(tick_seconds)
             self._consumer.close()
+            if self.on_terminal is not None and not self._stop.is_set():
+                try:
+                    self.on_terminal(self)
+                except Exception:
+                    log.exception("on_terminal failed for %s",
+                                  self.segment_name)
 
         self._thread = threading.Thread(target=loop, daemon=True,
                                         name=f"consumer-{self.segment_name}")

@@ -1,0 +1,24 @@
+"""Server: segment hosting, refcounted data managers, query scheduler,
+instance query execution (ref: pinot-server + pinot-core data managers)."""
+
+from pinot_tpu.server.data_manager import (
+    InstanceDataManager,
+    RealtimeTableDataManager,
+    SegmentDataManager,
+    TableDataManager,
+)
+from pinot_tpu.server.scheduler import (
+    FcfsScheduler,
+    QueryScheduler,
+    TokenBucketScheduler,
+    make_scheduler,
+)
+from pinot_tpu.server.server import ServerInstance
+
+__all__ = [
+    "InstanceDataManager", "RealtimeTableDataManager", "SegmentDataManager",
+    "TableDataManager",
+    "FcfsScheduler", "QueryScheduler", "TokenBucketScheduler",
+    "make_scheduler",
+    "ServerInstance",
+]

@@ -1,0 +1,208 @@
+"""Server-side segment lifecycle: table data managers with refcounting.
+
+Re-design of ``pinot-core/.../data/manager/BaseTableDataManager.java:71``
+(``addSegment:161``, ``addOrReplaceSegment:343``, refcounted
+acquire/release) + ``RealtimeTableDataManager.java:80``: queries acquire
+segments (refcount++) before executing and release after, so a segment
+swapped out mid-query is destroyed only when the last reader finishes —
+the same hazard protocol the TPU path needs before evicting HBM-staged
+blocks (SURVEY.md §5 race-detection note).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+
+from typing import Any, Dict, List, Optional
+
+from pinot_tpu.ingestion.realtime import RealtimeSegmentDataManager
+from pinot_tpu.segment.immutable import ImmutableSegment, load_segment
+
+log = logging.getLogger(__name__)
+
+
+class SegmentDataManager:
+    """One segment + its refcount (ref: SegmentDataManager in the reference;
+    starts at 1 for the registration reference)."""
+
+    def __init__(self, segment: Any):
+        self.segment = segment
+        self._refcount = 1
+        self._lock = threading.Lock()
+
+    @property
+    def segment_name(self) -> str:
+        return self.segment.segment_name
+
+    def acquire(self) -> bool:
+        with self._lock:
+            if self._refcount <= 0:
+                return False
+            self._refcount += 1
+            return True
+
+    def release(self) -> int:
+        with self._lock:
+            self._refcount -= 1
+            rc = self._refcount
+        if rc == 0:
+            self._destroy()
+        return rc
+
+    def _destroy(self) -> None:
+        # mmap views close with GC; consuming segments stop their consumer
+        stop = getattr(self.segment, "stop", None)
+        if callable(stop):
+            try:
+                stop()
+            except Exception:
+                log.exception("destroy of %s failed", self.segment_name)
+
+
+class TableDataManager:
+    """Ref: BaseTableDataManager.java:71 (offline tables)."""
+
+    def __init__(self, table_name_with_type: str):
+        self.table_name = table_name_with_type
+        self._segments: Dict[str, SegmentDataManager] = {}
+        self._lock = threading.Lock()
+
+    # -- lifecycle -----------------------------------------------------------
+    def add_segment(self, segment: Any) -> None:
+        """Add or replace (ref: addOrReplaceSegment:343): the old manager's
+        registration reference is released; in-flight queries holding an
+        acquire keep the old segment alive until they release."""
+        sdm = SegmentDataManager(segment)
+        with self._lock:
+            old = self._segments.get(segment.segment_name)
+            self._segments[segment.segment_name] = sdm
+        if old is not None:
+            old.release()
+
+    def add_segment_from_dir(self, segment_dir: str) -> ImmutableSegment:
+        seg = load_segment(segment_dir)
+        self.add_segment(seg)
+        return seg
+
+    def remove_segment(self, segment_name: str) -> None:
+        with self._lock:
+            sdm = self._segments.pop(segment_name, None)
+        if sdm is not None:
+            sdm.release()
+
+    def segment_names(self) -> List[str]:
+        with self._lock:
+            return sorted(self._segments)
+
+    def has_segment(self, segment_name: str) -> bool:
+        with self._lock:
+            return segment_name in self._segments
+
+    # -- query-time acquire/release (ref: acquireSegments) -------------------
+    def acquire_segments(self, segment_names: Optional[List[str]] = None
+                         ) -> List[SegmentDataManager]:
+        """Acquire the named segments (all when None). Missing or
+        concurrently-destroyed segments are skipped — the reference reports
+        them in the response metadata as missing segments."""
+        with self._lock:
+            wanted = (list(self._segments.values()) if segment_names is None
+                      else [self._segments[n] for n in segment_names
+                            if n in self._segments])
+        out = []
+        for sdm in wanted:
+            if sdm.acquire():
+                out.append(sdm)
+        return out
+
+    def release_segments(self, sdms: List[SegmentDataManager]) -> None:
+        for sdm in sdms:
+            sdm.release()
+
+    def shutdown(self) -> None:
+        with self._lock:
+            sdms = list(self._segments.values())
+            self._segments.clear()
+        for sdm in sdms:
+            sdm.release()
+
+
+class RealtimeTableDataManager(TableDataManager):
+    """Ref: RealtimeTableDataManager.java:80 — additionally owns the
+    consuming-segment managers; their mutable segments serve queries until
+    sealed, then the immutable build replaces them in-place."""
+
+    def __init__(self, table_name_with_type: str):
+        super().__init__(table_name_with_type)
+        self._consumers: Dict[str, RealtimeSegmentDataManager] = {}
+
+    def add_consuming(self, mgr: RealtimeSegmentDataManager) -> None:
+        with self._lock:
+            self._consumers[mgr.segment_name] = mgr
+        self.add_segment(mgr.segment)  # the mutable segment serves queries
+
+    def consuming_manager(self, segment_name: str
+                          ) -> Optional[RealtimeSegmentDataManager]:
+        with self._lock:
+            return self._consumers.get(segment_name)
+
+    def remove_segment(self, segment_name: str) -> None:
+        """Unassignment must also stop a live consumer, or it keeps
+        consuming and re-adds itself from its terminal callback."""
+        with self._lock:
+            mgr = self._consumers.pop(segment_name, None)
+        if mgr is not None:
+            mgr.stop(reason="unassigned")
+        super().remove_segment(segment_name)
+
+    def drop_consumer(self, segment_name: str) -> None:
+        with self._lock:
+            self._consumers.pop(segment_name, None)
+
+    def on_sealed(self, segment_name: str, segment_dir: str) -> None:
+        """CONSUMING -> ONLINE flip: swap the mutable segment for the
+        immutable build (ref: CONSUMING->ONLINE state transition)."""
+        with self._lock:
+            self._consumers.pop(segment_name, None)
+        self.add_segment_from_dir(segment_dir)
+
+    def shutdown(self) -> None:
+        with self._lock:
+            consumers = list(self._consumers.values())
+            self._consumers.clear()
+        for c in consumers:
+            c.stop()
+        super().shutdown()
+
+
+class InstanceDataManager:
+    """table -> TableDataManager registry
+    (ref: HelixInstanceDataManager.java:74)."""
+
+    def __init__(self):
+        self._tables: Dict[str, TableDataManager] = {}
+        self._lock = threading.Lock()
+
+    def get_or_create(self, table: str, realtime: bool = False) -> TableDataManager:
+        with self._lock:
+            tdm = self._tables.get(table)
+            if tdm is None:
+                tdm = (RealtimeTableDataManager(table) if realtime
+                       else TableDataManager(table))
+                self._tables[table] = tdm
+            return tdm
+
+    def get(self, table: str) -> Optional[TableDataManager]:
+        with self._lock:
+            return self._tables.get(table)
+
+    def table_names(self) -> List[str]:
+        with self._lock:
+            return sorted(self._tables)
+
+    def shutdown(self) -> None:
+        with self._lock:
+            tdms = list(self._tables.values())
+            self._tables.clear()
+        for tdm in tdms:
+            tdm.shutdown()

@@ -1,0 +1,145 @@
+"""Query scheduler: admission control for server query execution.
+
+Re-design of ``pinot-core/.../query/scheduler/QueryScheduler.java:56``
+(``processQueryAndSerialize:147``) with the reference's pluggable policies:
+FCFS (``fcfs/``) and token-bucket resource accounting per table
+(``tokenbucket/``, ``MultiLevelPriorityQueue``).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+
+from concurrent.futures import Future
+from typing import Any, Callable, Dict, Optional
+
+
+class _DaemonPool:
+    """Fixed pool of daemon worker threads. Daemon matters: a query stuck in
+    a long device compile must never block process exit (the
+    ThreadPoolExecutor default of non-daemon threads does)."""
+
+    def __init__(self, num_workers: int, name: str):
+        self._q: "queue.Queue" = queue.Queue()
+        self._threads = [
+            threading.Thread(target=self._work, daemon=True,
+                             name=f"{name}-{i}")
+            for i in range(num_workers)]
+        for t in self._threads:
+            t.start()
+
+    def _work(self) -> None:
+        while True:
+            item = self._q.get()
+            if item is None:
+                return
+            fut, fn = item
+            if not fut.set_running_or_notify_cancel():
+                continue
+            try:
+                fut.set_result(fn())
+            except BaseException as e:  # noqa: BLE001 — future carries it
+                fut.set_exception(e)
+
+    def submit(self, fn: Callable[[], Any]) -> Future:
+        fut: Future = Future()
+        self._q.put((fut, fn))
+        return fut
+
+    def stop(self) -> None:
+        for _ in self._threads:
+            self._q.put(None)
+
+
+class QueryScheduler:
+    """Base: bounded worker pool, graceful drain on shutdown."""
+
+    def __init__(self, num_workers: int = 8, name: str = "query"):
+        self._pool = _DaemonPool(num_workers, name)
+        self._accepting = True
+        self._inflight = 0
+        self._lock = threading.Lock()
+        self._drained = threading.Condition(self._lock)
+
+    def submit(self, fn: Callable[[], Any], table: str = "") -> Future:
+        with self._lock:
+            if not self._accepting:
+                raise RuntimeError("scheduler is shut down")
+            self._inflight += 1
+
+        def run():
+            try:
+                return fn()
+            finally:
+                with self._lock:
+                    self._inflight -= 1
+                    self._drained.notify_all()
+
+        return self._pool.submit(run)
+
+    def shutdown(self, timeout_s: float = 30.0) -> None:
+        """Disable new queries, drain in-flight ones
+        (ref: server shutdown = disable queries, drain, unregister)."""
+        with self._lock:
+            self._accepting = False
+            deadline = time.monotonic() + timeout_s
+            while self._inflight > 0:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    break
+                self._drained.wait(remaining)
+        self._pool.stop()
+
+
+class FcfsScheduler(QueryScheduler):
+    """Ref: fcfs/FCFSQueryScheduler — plain pool order."""
+
+
+class TokenBucketScheduler(QueryScheduler):
+    """Per-table token buckets (ref: tokenbucket/ — tables consume tokens
+    per query; an exhausted table's queries wait for refill, so one hot
+    table cannot starve the rest)."""
+
+    def __init__(self, num_workers: int = 8, tokens_per_second: float = 100.0,
+                 burst: float = 200.0):
+        super().__init__(num_workers, name="tb-query")
+        self._rate = tokens_per_second
+        self._burst = burst
+        self._buckets: Dict[str, tuple] = {}  # table -> (tokens, last_ts)
+        self._bucket_lock = threading.Lock()
+
+    def _take_token(self, table: str) -> float:
+        """Returns seconds to wait (0 = admitted now)."""
+        now = time.monotonic()
+        with self._bucket_lock:
+            tokens, last = self._buckets.get(table, (self._burst, now))
+            tokens = min(self._burst, tokens + (now - last) * self._rate)
+            if tokens >= 1.0:
+                self._buckets[table] = (tokens - 1.0, now)
+                return 0.0
+            wait = (1.0 - tokens) / self._rate
+            self._buckets[table] = (0.0, now + wait)
+            return wait
+
+    def submit(self, fn: Callable[[], Any], table: str = "") -> Future:
+        wait = self._take_token(table) if table else 0.0
+        if wait <= 0:
+            return super().submit(fn, table)
+
+        def delayed():
+            time.sleep(wait)
+            return fn()
+
+        return super().submit(delayed, table)
+
+
+def make_scheduler(policy: str = "fcfs", **kw) -> QueryScheduler:
+    """Ref: QuerySchedulerFactory."""
+    policy = policy.lower()
+    if policy == "fcfs":
+        return FcfsScheduler(**kw)
+    if policy in ("tokenbucket", "token_bucket"):
+        return TokenBucketScheduler(**kw)
+    raise ValueError(f"unknown scheduler policy {policy!r}")

@@ -1,0 +1,247 @@
+"""Server instance: segment hosting + instance-level query execution.
+
+Re-design of ``pinot-server/.../starter/helix/BaseServerStarter.java:117`` +
+``ServerInstance.java:53`` + the state-model transitions
+(``SegmentOnlineOfflineStateModelFactory.java:53,76``): the server watches
+the cluster store's IdealState, reconciles its assigned segments
+(OFFLINE->ONLINE = load; OFFLINE->CONSUMING = start stream consumer;
+CONSUMING->ONLINE = seal/swap), reports ExternalView states, and answers
+instance query requests through the scheduler -> executor pipeline
+(ref: InstanceRequestHandler.channelRead0:90 ->
+QueryScheduler.processQueryAndSerialize:147 ->
+ServerQueryExecutorV1Impl.processQuery:119).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+
+from typing import Dict, List, Optional
+
+from pinot_tpu.common.datatable import DataTable
+from pinot_tpu.controller.state import (
+    CONSUMING,
+    ONLINE,
+    ClusterStateStore,
+    InstanceInfo,
+)
+from pinot_tpu.engine.executor import ServerQueryExecutor
+from pinot_tpu.ingestion.realtime import (
+    ConsumerState,
+    RealtimeSegmentDataManager,
+    SegmentCompletionProtocol,
+)
+from pinot_tpu.ingestion.stream import StreamOffset
+from pinot_tpu.query.context import QueryContext
+from pinot_tpu.server.data_manager import (
+    InstanceDataManager,
+    RealtimeTableDataManager,
+)
+from pinot_tpu.server.scheduler import QueryScheduler, make_scheduler
+from pinot_tpu.spi.table import TableType, table_type_from_name
+
+log = logging.getLogger(__name__)
+
+
+class ServerInstance:
+    """One query server (ref: ServerInstance.java:53). In-process transport:
+    the broker calls ``execute_query`` directly (the embedded-cluster mode,
+    ref: ClusterTest single-JVM multi-instance); the gRPC service wraps the
+    same entry point for multi-process deployments."""
+
+    def __init__(self, instance_id: str, store: ClusterStateStore,
+                 completion_protocol: Optional[SegmentCompletionProtocol] = None,
+                 executor: Optional[ServerQueryExecutor] = None,
+                 scheduler: Optional[QueryScheduler] = None,
+                 segment_dir: str = "/tmp/pinot_tpu_server",
+                 consumer_tick_s: float = 0.02):
+        self.instance_id = instance_id
+        self.store = store
+        self.completion_protocol = completion_protocol
+        self.executor = executor or ServerQueryExecutor()
+        self.scheduler = scheduler or make_scheduler("fcfs")
+        self.data_manager = InstanceDataManager()
+        self.segment_dir = segment_dir
+        self.consumer_tick_s = consumer_tick_s
+        self._started = False
+        self._queries_enabled = False
+        self._reconcile_lock = threading.RLock()
+
+    # -- lifecycle (ref: BaseServerStarter.start) ---------------------------
+    def start(self) -> None:
+        self.store.register_instance(
+            InstanceInfo(self.instance_id, "SERVER", port=0))
+        # replay current assignments, then watch for changes (the Helix
+        # participant registration + state-transition replay)
+        self.store.watch("idealstate/", self._on_ideal_state_change)
+        for path in self.store.children("idealstate"):
+            table = path.split("/", 1)[1]
+            self._reconcile_table(table)
+        self._started = True
+        self._queries_enabled = True
+
+    def shutdown(self) -> None:
+        """Ref: shutdown = disable queries, drain, unregister."""
+        self._queries_enabled = False
+        self.scheduler.shutdown()
+        self.data_manager.shutdown()
+        self.store.set_instance_alive(self.instance_id, False)
+
+    # -- state transitions ---------------------------------------------------
+    def _on_ideal_state_change(self, path: str, value) -> None:
+        if not self._started:
+            return
+        table = path.split("/", 1)[1]
+        try:
+            self._reconcile_table(table)
+        except Exception:
+            log.exception("[%s] reconcile failed for %s",
+                          self.instance_id, table)
+
+    def _reconcile_table(self, table: str) -> None:
+        with self._reconcile_lock:
+            self._reconcile_table_locked(table)
+
+    def _reconcile_table_locked(self, table: str) -> None:
+        ideal = self.store.get_ideal_state(table)
+        realtime = table_type_from_name(table) is TableType.REALTIME
+        tdm = self.data_manager.get_or_create(table, realtime=realtime)
+
+        my_segments = {seg: states[self.instance_id]
+                       for seg, states in ideal.items()
+                       if self.instance_id in states}
+
+        # drop segments no longer assigned to me
+        for seg in tdm.segment_names():
+            if seg not in my_segments:
+                tdm.remove_segment(seg)
+                self.store.report_instance_state(table, seg,
+                                                 self.instance_id, "OFFLINE")
+
+        for seg, target in my_segments.items():
+            if target == ONLINE:
+                self._ensure_online(table, tdm, seg)
+            elif target == CONSUMING:
+                self._ensure_consuming(table, tdm, seg)
+
+    def _ensure_online(self, table: str, tdm, seg: str) -> None:
+        if isinstance(tdm, RealtimeTableDataManager):
+            mgr = tdm.consuming_manager(seg)
+            if mgr is not None:
+                # CONSUMING -> ONLINE flip arrived before the local consumer
+                # finished; its terminal callback completes the swap
+                return
+        if tdm.has_segment(seg):
+            return
+        md = self.store.get_segment_metadata(table, seg)
+        if md is None or not md.download_url:
+            log.warning("[%s] no download url for %s/%s",
+                        self.instance_id, table, seg)
+            return
+        local = md.download_url
+        if local.startswith("file://"):
+            local = local[len("file://"):]
+        tdm.add_segment_from_dir(local)
+        self.store.report_instance_state(table, seg, self.instance_id, ONLINE)
+
+    def _ensure_consuming(self, table: str, tdm, seg: str) -> None:
+        assert isinstance(tdm, RealtimeTableDataManager), table
+        if tdm.consuming_manager(seg) is not None or tdm.has_segment(seg):
+            return
+        cfg = self.store.get_table_config(table)
+        schema = self.store.get_schema(cfg.table_name)
+        md = self.store.get_segment_metadata(table, seg)
+        if cfg is None or schema is None or md is None:
+            log.warning("[%s] missing config for consuming %s/%s",
+                        self.instance_id, table, seg)
+            return
+        start = StreamOffset.parse(md.start_offset or "0")
+
+        mgr = RealtimeSegmentDataManager(
+            seg, cfg, schema, partition=md.partition or 0,
+            start_offset=start, protocol=self.completion_protocol,
+            instance_id=self.instance_id,
+            output_dir=f"{self.segment_dir}/{self.instance_id}/{table}",
+            on_terminal=lambda m, t=table, td=tdm: self._on_consumer_done(
+                t, td, m))
+        tdm.add_consuming(mgr)
+        self.store.report_instance_state(table, seg, self.instance_id,
+                                         CONSUMING)
+        mgr.start(tick_seconds=self.consumer_tick_s)
+
+    def _on_consumer_done(self, table: str, tdm, mgr) -> None:
+        """Terminal consumer states (ref: CONSUMING->ONLINE transition +
+        the KEEP/DISCARD commit-protocol outcomes)."""
+        seg = mgr.segment_name
+        if tdm.consuming_manager(seg) is not mgr:
+            # unassigned (or replaced) while finishing: do not resurrect
+            return
+        try:
+            if mgr.state is ConsumerState.COMMITTED:
+                tdm.on_sealed(seg, mgr._committed_dir)
+            elif mgr.state is ConsumerState.RETAINING:
+                # KEEP: build locally at the committed offset, swap in place
+                md, seg_dir = mgr.build_segment()
+                tdm.on_sealed(seg, seg_dir)
+            elif mgr.state is ConsumerState.DISCARDED:
+                zk = self.store.get_segment_metadata(table, seg)
+                if zk and zk.download_url:
+                    local = zk.download_url
+                    if local.startswith("file://"):
+                        local = local[len("file://"):]
+                    tdm.on_sealed(seg, local)
+                else:
+                    # winner's metadata not visible yet: drop the consumer
+                    # entry so a later reconcile can download it ONLINE
+                    tdm.drop_consumer(seg)
+                    tdm.remove_segment(seg)
+                    return
+            else:  # ERROR
+                log.error("[%s] consumer for %s ended in %s",
+                          self.instance_id, seg, mgr.state)
+                return
+            self.store.report_instance_state(table, seg, self.instance_id,
+                                             ONLINE)
+            # pick up the successor CONSUMING segment promptly
+            self._reconcile_table(table)
+        except Exception:
+            log.exception("[%s] seal handling failed for %s",
+                          self.instance_id, seg)
+
+    # -- query path (ref: InstanceRequestHandler.channelRead0:90) -----------
+    def execute_query(self, ctx: QueryContext, table: str,
+                      segment_names: Optional[List[str]] = None) -> DataTable:
+        if not self._queries_enabled:
+            return DataTable.for_exception(
+                f"server {self.instance_id} is shut down")
+        future = self.scheduler.submit(
+            lambda: self._execute(ctx, table, segment_names), table=table)
+        return future.result()
+
+    def _execute(self, ctx: QueryContext, table: str,
+                 segment_names: Optional[List[str]]) -> DataTable:
+        tdm = self.data_manager.get(table)
+        if tdm is None:
+            return DataTable.for_exception(
+                f"table {table} not hosted on {self.instance_id}")
+        acquired = tdm.acquire_segments(segment_names)
+        try:
+            segments = [s.segment for s in acquired]
+            if not segments:
+                return DataTable.for_exception(
+                    f"no segments of {table} on {self.instance_id}")
+            return self.executor.execute_instance(ctx, segments)
+        except Exception as e:  # query errors travel in the DataTable
+            log.debug("[%s] query failed", self.instance_id, exc_info=True)
+            return DataTable.for_exception(str(e))
+        finally:
+            tdm.release_segments(acquired)
+
+    # -- admin (ref: TablesResource) ----------------------------------------
+    def hosted_tables(self) -> List[str]:
+        return self.data_manager.table_names()
+
+    def hosted_segments(self, table: str) -> List[str]:
+        tdm = self.data_manager.get(table)
+        return tdm.segment_names() if tdm else []

@@ -1,0 +1,5 @@
+"""Tools: embedded cluster, quickstarts, CLI (ref: pinot-tools)."""
+
+from pinot_tpu.tools.cluster import EmbeddedCluster
+
+__all__ = ["EmbeddedCluster"]

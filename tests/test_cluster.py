@@ -1,0 +1,232 @@
+"""Embedded-cluster integration: the full controller/broker/server path with
+a pandas oracle (the reference's H2-parity strategy, SURVEY.md §4:
+ClusterIntegrationTestUtils.testQuery)."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from pinot_tpu.controller.state import ONLINE
+from pinot_tpu.ingestion import MemoryStream
+from pinot_tpu.spi import DataType, FieldSpec, FieldType, Schema
+from pinot_tpu.spi.table import (
+    SegmentsValidationConfig,
+    StreamIngestionConfig,
+    TableConfig,
+    TableType,
+)
+from pinot_tpu.tools import EmbeddedCluster
+
+RNG = np.random.default_rng(21)
+N = 3000
+
+
+def make_schema(name="sales"):
+    return Schema(name, [
+        FieldSpec("region", DataType.STRING),
+        FieldSpec("kind", DataType.STRING),
+        FieldSpec("qty", DataType.LONG, FieldType.METRIC),
+        FieldSpec("price", DataType.DOUBLE, FieldType.METRIC),
+        FieldSpec("ts", DataType.LONG, FieldType.DATE_TIME),
+    ])
+
+
+def make_df(n=N, seed=21, ts0=1_600_000_000_000):
+    rng = np.random.default_rng(seed)
+    regions = ["east", "west", "north", "south"]
+    kinds = ["a", "b", "c"]
+    return pd.DataFrame({
+        "region": [regions[i] for i in rng.integers(0, 4, n)],
+        "kind": [kinds[i] for i in rng.integers(0, 3, n)],
+        "qty": rng.integers(1, 50, n).astype(np.int64),
+        "price": np.round(rng.normal(100, 25, n), 2),
+        "ts": (ts0 + rng.integers(0, 10_000_000, n)).astype(np.int64),
+    })
+
+
+@pytest.fixture(scope="module")
+def offline_cluster(tmp_path_factory):
+    data_dir = str(tmp_path_factory.mktemp("cluster"))
+    cluster = EmbeddedCluster(num_servers=3, data_dir=data_dir)
+    schema = make_schema()
+    cfg = TableConfig("sales", TableType.OFFLINE,
+                      validation_config=SegmentsValidationConfig(
+                          time_column_name="ts", replication=2))
+    cluster.create_table(cfg, schema)
+    df = make_df()
+    # 4 segments, uneven sizes
+    bounds = [0, 700, 1500, 2100, N]
+    for i in range(4):
+        part = df.iloc[bounds[i]:bounds[i + 1]]
+        cluster.ingest_rows("sales_OFFLINE", schema,
+                            {c: part[c].tolist() for c in df.columns},
+                            segment_name=f"sales_{i}")
+    assert cluster.wait_for_ev_converged("sales_OFFLINE")
+    yield cluster, df
+    cluster.shutdown()
+
+
+class TestOfflineCluster:
+    def test_segments_spread_and_replicated(self, offline_cluster):
+        cluster, _ = offline_cluster
+        ideal = cluster.store.get_ideal_state("sales_OFFLINE")
+        assert len(ideal) == 4
+        for seg, m in ideal.items():
+            assert len(m) == 2
+        hosted = {sid: len(s.hosted_segments("sales_OFFLINE"))
+                  for sid, s in cluster.servers.items()}
+        assert sum(hosted.values()) == 8  # 4 segments x 2 replicas
+
+    def test_aggregation_parity(self, offline_cluster):
+        cluster, df = offline_cluster
+        rows = cluster.query_rows(
+            "SELECT count(*), sum(qty), avg(price) FROM sales WHERE region = 'east'")
+        want = df[df.region == "east"]
+        assert rows[0][0] == len(want)
+        assert rows[0][1] == pytest.approx(float(want.qty.sum()))
+        assert rows[0][2] == pytest.approx(float(want.price.mean()))
+
+    def test_group_by_parity(self, offline_cluster):
+        cluster, df = offline_cluster
+        rows = cluster.query_rows(
+            "SELECT region, kind, sum(qty) FROM sales "
+            "GROUP BY region, kind ORDER BY region, kind LIMIT 50")
+        want = df.groupby(["region", "kind"]).qty.sum().sort_index()
+        assert [(r[0], r[1], r[2]) for r in rows] == \
+            [(k[0], k[1], float(v)) for k, v in want.items()]
+
+    def test_selection_order_by_parity(self, offline_cluster):
+        cluster, df = offline_cluster
+        rows = cluster.query_rows(
+            "SELECT region, qty FROM sales ORDER BY qty DESC, region LIMIT 10")
+        want = df.sort_values(["qty", "region"],
+                              ascending=[False, True]).head(10)
+        assert [(r[0], r[1]) for r in rows] == \
+            [(r.region, r.qty) for r in want.itertuples()]
+
+    def test_selection_order_by_hidden_column(self, offline_cluster):
+        cluster, df = offline_cluster
+        # order-by column not in the select list -> hidden-column merge
+        rows = cluster.query_rows(
+            "SELECT region FROM sales ORDER BY ts LIMIT 5")
+        want = df.sort_values("ts", kind="stable").head(5)
+        assert [r[0] for r in rows] == list(want.region)
+        assert all(len(r) == 1 for r in rows)
+
+    def test_distinct_parity(self, offline_cluster):
+        cluster, df = offline_cluster
+        rows = cluster.query_rows(
+            "SELECT DISTINCT region, kind FROM sales ORDER BY region, kind LIMIT 50")
+        want = sorted(set(zip(df.region, df.kind)))
+        assert [(r[0], r[1]) for r in rows] == want
+
+    def test_time_pruning_correct(self, offline_cluster):
+        cluster, df = offline_cluster
+        ts_cut = int(df.ts.quantile(0.2))
+        resp = cluster.query(
+            f"SELECT count(*) FROM sales WHERE ts <= {ts_cut}")
+        want = (df.ts <= ts_cut).sum()
+        assert resp.result_table.rows[0][0] == want
+
+    def test_unknown_table_errors(self, offline_cluster):
+        cluster, _ = offline_cluster
+        resp = cluster.query("SELECT count(*) FROM nope")
+        assert resp.has_exceptions
+        assert resp.exceptions[0]["errorCode"] == 190
+
+    def test_server_loss_partial_failure(self, offline_cluster):
+        cluster, df = offline_cluster
+        # unregister one server's transport: queries still answer via the
+        # second replica (ref: partial-server-loss tolerance)
+        victim = sorted(cluster.servers)[0]
+        cluster.broker._servers.pop(victim)
+        try:
+            rows = cluster.query_rows("SELECT count(*) FROM sales")
+            assert rows[0][0] == N
+        finally:
+            cluster.broker.register_server(victim, cluster.servers[victim])
+
+
+class TestRealtimeCluster:
+    def test_realtime_ingest_and_query(self, tmp_path):
+        MemoryStream.create("rt_sales", 2)
+        cluster = EmbeddedCluster(num_servers=2, data_dir=str(tmp_path))
+        schema = make_schema("rtsales")
+        cfg = TableConfig(
+            "rtsales", TableType.REALTIME,
+            validation_config=SegmentsValidationConfig(time_column_name="ts"),
+            stream_config=StreamIngestionConfig(
+                stream_type="memory", topic="rt_sales",
+                segment_flush_threshold_rows=400))
+        cluster.create_table(cfg, schema)
+        df = make_df(1000, seed=33)
+        stream = MemoryStream.get("rt_sales")
+        for i, r in enumerate(df.to_dict("records")):
+            stream.produce(r, partition=i % 2)
+
+        assert cluster.wait_for_docs("rtsales", 1000), \
+            cluster.query("SELECT count(*) FROM rtsales").to_dict()
+        rows = cluster.query_rows(
+            "SELECT region, sum(qty) FROM rtsales GROUP BY region ORDER BY region LIMIT 50")
+        want = df.groupby("region").qty.sum().sort_index()
+        assert [(r[0], r[1]) for r in rows] == \
+            [(k, float(v)) for k, v in want.items()]
+
+        # some segments sealed (flush threshold 400 over 2 partitions)
+        online = [m for m in
+                  cluster.store.segment_metadata_list("rtsales_REALTIME")
+                  if m.status == ONLINE]
+        assert len(online) >= 2
+        cluster.shutdown()
+        MemoryStream.delete("rt_sales")
+
+    def test_hybrid_time_boundary(self, tmp_path):
+        """Offline + realtime table: query must not double count around the
+        time boundary (ref: HybridClusterIntegrationTest)."""
+        MemoryStream.create("hy_topic", 1)
+        cluster = EmbeddedCluster(num_servers=2, data_dir=str(tmp_path))
+        schema = make_schema("hybrid")
+        off_cfg = TableConfig("hybrid", TableType.OFFLINE,
+                              validation_config=SegmentsValidationConfig(
+                                  time_column_name="ts"))
+        rt_cfg = TableConfig(
+            "hybrid", TableType.REALTIME,
+            validation_config=SegmentsValidationConfig(time_column_name="ts"),
+            stream_config=StreamIngestionConfig(
+                stream_type="memory", topic="hy_topic",
+                segment_flush_threshold_rows=10_000))
+        cluster.create_table(off_cfg, schema)
+        cluster.controller.add_table(rt_cfg)
+
+        ts0 = 1_600_000_000_000
+        df = make_df(2000, seed=44, ts0=ts0)
+        df = df.sort_values("ts").reset_index(drop=True)
+        offline_part = df.iloc[:1200]   # older data -> offline segment
+        overlap_and_new = df.iloc[1000:]  # overlaps offline + extends past it
+
+        cluster.ingest_rows("hybrid_OFFLINE", schema,
+                            {c: offline_part[c].tolist() for c in df.columns},
+                            segment_name="hybrid_off_0")
+        stream = MemoryStream.get("hy_topic")
+        for r in overlap_and_new.to_dict("records"):
+            stream.produce(r, partition=0)
+        assert cluster.wait_for_ev_converged("hybrid_OFFLINE")
+
+        boundary = cluster.broker.routing.time_boundary.get_boundary(
+            "hybrid_OFFLINE")
+        assert boundary == int(offline_part.ts.max()) - 1
+
+        # expected: offline rows with ts <= boundary + realtime rows after
+        exp = (offline_part.ts <= boundary).sum() + \
+              (overlap_and_new.ts > boundary).sum()
+        deadline_rows = None
+        import time as _t
+        for _ in range(200):
+            rows = cluster.query_rows("SELECT count(*) FROM hybrid")
+            deadline_rows = rows[0][0]
+            if deadline_rows == exp:
+                break
+            _t.sleep(0.05)
+        assert deadline_rows == exp
+        cluster.shutdown()
+        MemoryStream.delete("hy_topic")

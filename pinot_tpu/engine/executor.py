@@ -152,6 +152,9 @@ class ServerQueryExecutor:
         fast = self._metadata_fast_path(ctx, aggs, seg, stats)
         if fast is not None:
             return fast
+        st = self._try_star_tree(ctx, aggs, seg, stats)
+        if st is not None:
+            return st
         if self.use_device:
             try:
                 plan = plan_segment(ctx, seg)
@@ -159,6 +162,29 @@ class ServerQueryExecutor:
             except PlanError:
                 pass
         return host_engine.host_aggregate_segment(ctx, aggs, seg, stats)
+
+    def _star_tree_pick(self, ctx: QueryContext, aggs: List[AggDef],
+                        seg: ImmutableSegment):
+        """(tree, predicates) when a star-tree fits and the option allows
+        it, else None — the single gate for both executors."""
+        from pinot_tpu.engine import startree_exec
+
+        if ctx.options.get("useStarTree", "true").lower() == "false":
+            return None
+        return startree_exec.pick_star_tree(ctx, aggs, seg)
+
+    def _try_star_tree(self, ctx: QueryContext, aggs: List[AggDef],
+                       seg: ImmutableSegment, stats: QueryStats):
+        """Pre-aggregated path when a star-tree fits the query
+        (ref: AggregationGroupByOrderByPlanNode.java:66-87 selection)."""
+        from pinot_tpu.engine import startree_exec
+
+        pick = self._star_tree_pick(ctx, aggs, seg)
+        if pick is None:
+            return None
+        tree, preds = pick
+        return startree_exec.execute_star_tree(ctx, aggs, seg, tree, preds,
+                                               stats)
 
     def _metadata_fast_path(self, ctx: QueryContext, aggs: List[AggDef],
                             seg: ImmutableSegment,
@@ -206,6 +232,9 @@ class ServerQueryExecutor:
     def _segment_group_by(self, ctx: QueryContext, aggs: List[AggDef],
                           seg: ImmutableSegment,
                           stats: QueryStats) -> GroupByResult:
+        st = self._try_star_tree(ctx, aggs, seg, stats)
+        if st is not None:
+            return st
         if self.use_device:
             try:
                 plan = plan_segment(ctx, seg)

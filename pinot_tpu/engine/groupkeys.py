@@ -1,0 +1,37 @@
+"""Composite group-key encode/decode shared by the host group-by and the
+star-tree executor (the single source of truth for key packing — ref:
+DictionaryBasedGroupKeyGenerator key composition)."""
+
+from __future__ import annotations
+
+from typing import Callable, List, Sequence, Tuple
+
+import numpy as np
+
+
+def compose_group_keys(code_arrays: Sequence[np.ndarray],
+                       cardinalities: Sequence[int]
+                       ) -> Tuple[np.ndarray, np.ndarray,
+                                  Callable[[int], Tuple[int, ...]]]:
+    """Pack per-column integer codes into one int64 key per row.
+
+    Returns (unique_keys, group_id_per_row, decode) where ``decode`` maps a
+    packed key back to the per-column code tuple. Cardinalities are the
+    per-column key-space sizes (the packing strides).
+    """
+    combined = np.asarray(code_arrays[0], dtype=np.int64)
+    for codes, card in zip(code_arrays[1:], cardinalities[1:]):
+        combined = combined * int(card) + np.asarray(codes, dtype=np.int64)
+    uniq, gid = np.unique(combined, return_inverse=True)
+
+    cards = [int(c) for c in cardinalities]
+
+    def decode(key: int) -> Tuple[int, ...]:
+        parts = []
+        for card in reversed(cards[1:]):
+            parts.append(key % card)
+            key //= card
+        parts.append(key)
+        return tuple(int(p) for p in reversed(parts))
+
+    return uniq, gid, decode

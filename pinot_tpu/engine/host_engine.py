@@ -271,6 +271,8 @@ def host_group_by_segment(ctx: QueryContext, aggs: List[AggDef],
         return result
 
     # composed group codes over filtered docs
+    from pinot_tpu.engine.groupkeys import compose_group_keys
+
     key_values: List[np.ndarray] = []
     codes_list: List[np.ndarray] = []
     for e in ctx.group_by:
@@ -278,21 +280,11 @@ def host_group_by_segment(ctx: QueryContext, aggs: List[AggDef],
         uniq, codes = np.unique(arr, return_inverse=True)
         key_values.append(uniq)
         codes_list.append(codes)
-    combined = codes_list[0].astype(np.int64)
-    for c, u in zip(codes_list[1:], key_values[1:]):
-        combined = combined * len(u) + c
-    uniq_keys, gid = np.unique(combined, return_inverse=True)
+    uniq_keys, gid, decode_codes = compose_group_keys(
+        codes_list, [max(len(u), 1) for u in key_values])
 
-    # decode group key tuples
-    def decode(k: int) -> Tuple:
-        parts = []
-        for u in reversed(key_values[1:]):
-            parts.append(u[k % len(u)])
-            k //= len(u)
-        parts.append(key_values[0][k])
-        return tuple(_py(v) for v in reversed(parts))
-
-    keys = [decode(int(k)) for k in uniq_keys]
+    keys = [tuple(_py(u[c]) for u, c in zip(key_values, decode_codes(int(k))))
+            for k in uniq_keys]
 
     order = np.argsort(gid, kind="stable")
     boundaries = np.searchsorted(gid[order], np.arange(len(uniq_keys) + 1))

@@ -1,0 +1,233 @@
+"""Star-tree query execution: fit check + pre-aggregated record aggregation.
+
+Re-design of ``pinot-core/.../startree/StarTreeUtils.java:47``
+(``isFitForStarTree`` + predicate-map extraction), the node walk
+(``StarTreeFilterOperator.java:87``) and the pre-agg aggregation
+(``StarTreeGroupByExecutor.java:43``); selection logic mirrors
+``AggregationGroupByOrderByPlanNode.java:66-87``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Set, Tuple
+
+import numpy as np
+
+from pinot_tpu.engine.aggregates import AggDef, agg_value_expr
+from pinot_tpu.engine.results import AggResult, GroupByResult, QueryStats
+from pinot_tpu.query.context import QueryContext
+from pinot_tpu.query.expressions import (
+    FilterNode,
+    FilterOp,
+    Function,
+    Identifier,
+    Predicate,
+    PredicateType,
+)
+from pinot_tpu.segment.startree import STAR, StarTree
+
+_MAX_RANGE_IDS = 100_000  # cap on materialized dictId sets for RANGE
+
+
+def _flatten_and(node: Optional[FilterNode]) -> Optional[List[Predicate]]:
+    """Filter -> flat AND-ed predicate list, or None when the shape doesn't
+    fit (OR/NOT — the reference also bails to the normal path there)."""
+    if node is None:
+        return []
+    if node.op is FilterOp.PREDICATE:
+        return [node.predicate]
+    if node.op is not FilterOp.AND:
+        return None
+    out: List[Predicate] = []
+    for c in node.children:
+        sub = _flatten_and(c)
+        if sub is None:
+            return None
+        out.extend(sub)
+    return out
+
+
+def _agg_pair(agg: AggDef, fn: Function) -> Optional[Tuple[str, str]]:
+    """AggDef -> (function, column) pair stored in tree records."""
+    if agg.mv:
+        return None
+    vexpr = agg_value_expr(fn)
+    if agg.base == "count" and vexpr is None:
+        return ("count", "*")
+    if agg.base in ("sum", "min", "max") and isinstance(vexpr, Identifier):
+        return (agg.base, vexpr.name)
+    return None
+
+
+def _pairs_needed(agg: AggDef, fn: Function) -> Optional[List[Tuple[str, str]]]:
+    """Pairs the tree must store to answer this aggregation (AVG = SUM+COUNT,
+    ref: AggregationFunctionColumnPair resolution)."""
+    p = _agg_pair(agg, fn)
+    if p is not None:
+        return [p]
+    vexpr = agg_value_expr(fn)
+    if agg.base == "avg" and not agg.mv and isinstance(vexpr, Identifier):
+        return [("sum", vexpr.name), ("count", "*")]
+    return None
+
+
+def pick_star_tree(ctx: QueryContext, aggs: List[AggDef],
+                   segment) -> Optional[Tuple[StarTree, List[Predicate]]]:
+    """Ref: StarTreeUtils.isFitForStarTree — first tree satisfying the
+    query, or None."""
+    trees = getattr(segment, "star_trees", None)
+    if not trees or not ctx.is_aggregation:
+        return None
+    preds = _flatten_and(ctx.filter)
+    if preds is None:
+        return None
+    group_cols: List[str] = []
+    for e in ctx.group_by:
+        if not isinstance(e, Identifier):
+            return None
+        group_cols.append(e.name)
+
+    for tree in trees:
+        dims = set(tree.config.dimensions_split_order)
+        if any(c not in dims for c in group_cols):
+            continue
+        ok = True
+        for p in preds:
+            if not isinstance(p.lhs, Identifier) or p.lhs.name not in dims:
+                ok = False
+                break
+            if p.type not in (PredicateType.EQ, PredicateType.IN,
+                              PredicateType.NOT_EQ, PredicateType.NOT_IN,
+                              PredicateType.RANGE):
+                ok = False
+                break
+        if not ok:
+            continue
+        needed: List[Tuple[str, str]] = []
+        for agg, fn in zip(aggs, ctx.aggregations):
+            ps = _pairs_needed(agg, fn)
+            if ps is None:
+                needed = None
+                break
+            needed.extend(ps)
+        if needed is None:
+            continue
+        if all(tree.has_pair(f, c) for f, c in needed):
+            return tree, preds
+    return None
+
+
+def _matching_ids(segment, pred: Predicate) -> Optional[Set[int]]:
+    """Predicate -> matching dictId set over the dimension's dictionary
+    (reuses the host predicate evaluators)."""
+    from pinot_tpu.engine.host_eval import _matching_dict_ids
+
+    ds = segment.data_source(pred.lhs.name)
+    if ds.dictionary is None:
+        return None
+    ids = _matching_dict_ids(ds, pred)
+    if len(ids) > _MAX_RANGE_IDS:
+        return None
+    return set(int(i) for i in ids)
+
+
+def execute_star_tree(ctx: QueryContext, aggs: List[AggDef], segment,
+                      tree: StarTree, preds: List[Predicate],
+                      stats: Optional[QueryStats] = None):
+    """-> AggResult or GroupByResult built from pre-aggregated records."""
+    eq_in: Dict[str, Set[int]] = {}
+    for p in preds:
+        ids = _matching_ids(segment, p)
+        if ids is None:
+            return None
+        col = p.lhs.name
+        eq_in[col] = ids if col not in eq_in else (eq_in[col] & ids)
+
+    group_cols = [e.name for e in ctx.group_by]
+    idx = tree.select_records(eq_in, group_cols)
+
+    if stats is not None:
+        stats.num_segments_processed += 1
+        stats.total_docs += segment.num_docs
+        stats.num_docs_scanned += int(idx.shape[0])
+        stats.num_segments_matched += 1 if idx.shape[0] else 0
+
+    if not ctx.is_group_by:
+        return AggResult([_scalar_state(tree, agg, fn, idx)
+                          for agg, fn in zip(aggs, ctx.aggregations)])
+
+    gb = GroupByResult()
+    if idx.shape[0] == 0:
+        return gb
+    from pinot_tpu.engine.groupkeys import compose_group_keys
+
+    dim_pos = {d: i for i, d in enumerate(tree.config.dimensions_split_order)}
+    key_ids = [np.asarray(tree.dims[idx, dim_pos[c]]) for c in group_cols]
+    cards = [int(k.max()) + 1 if k.size else 1 for k in key_ids]
+    uniq, gid, decode_codes = compose_group_keys(key_ids, cards)
+
+    # decode dictIds through the segment dictionaries
+    keys = [tuple(segment.data_source(c).dictionary.get_value(int(i))
+                  for c, i in zip(group_cols, decode_codes(int(u))))
+            for u in uniq]
+    n = len(uniq)
+    states_per_agg = [
+        _grouped_states(tree, agg, fn, idx, gid, n)
+        for agg, fn in zip(aggs, ctx.aggregations)]
+    for g, key in enumerate(keys):
+        gb.groups[key] = [states_per_agg[a][g] for a in range(len(aggs))]
+    return gb
+
+
+def _metric(tree: StarTree, fn: str, col: str, idx: np.ndarray) -> np.ndarray:
+    return np.asarray(tree.metrics[f"{fn}__{col}"][idx])
+
+
+def _scalar_state(tree: StarTree, agg: AggDef, fn: Function,
+                  idx: np.ndarray) -> Any:
+    vexpr = agg_value_expr(fn)
+    col = vexpr.name if isinstance(vexpr, Identifier) else "*"
+    if agg.base == "count":
+        return int(_metric(tree, "count", "*", idx).sum())
+    if idx.shape[0] == 0:
+        return {"sum": 0.0, "min": float("inf"), "max": float("-inf"),
+                "avg": (0.0, 0)}[agg.base]
+    if agg.base == "sum":
+        return float(_metric(tree, "sum", col, idx).sum())
+    if agg.base == "min":
+        return float(_metric(tree, "min", col, idx).min())
+    if agg.base == "max":
+        return float(_metric(tree, "max", col, idx).max())
+    if agg.base == "avg":
+        return (float(_metric(tree, "sum", col, idx).sum()),
+                int(_metric(tree, "count", "*", idx).sum()))
+    raise AssertionError(agg.base)
+
+
+def _grouped_states(tree: StarTree, agg: AggDef, fn: Function,
+                    idx: np.ndarray, gid: np.ndarray, n: int) -> List[Any]:
+    vexpr = agg_value_expr(fn)
+    col = vexpr.name if isinstance(vexpr, Identifier) else "*"
+    if agg.base == "count":
+        out = np.zeros(n, dtype=np.int64)
+        np.add.at(out, gid, _metric(tree, "count", "*", idx))
+        return [int(v) for v in out]
+    if agg.base == "sum":
+        out = np.zeros(n)
+        np.add.at(out, gid, _metric(tree, "sum", col, idx))
+        return [float(v) for v in out]
+    if agg.base == "min":
+        out = np.full(n, np.inf)
+        np.minimum.at(out, gid, _metric(tree, "min", col, idx))
+        return [float(v) for v in out]
+    if agg.base == "max":
+        out = np.full(n, -np.inf)
+        np.maximum.at(out, gid, _metric(tree, "max", col, idx))
+        return [float(v) for v in out]
+    if agg.base == "avg":
+        s = np.zeros(n)
+        c = np.zeros(n, dtype=np.int64)
+        np.add.at(s, gid, _metric(tree, "sum", col, idx))
+        np.add.at(c, gid, _metric(tree, "count", "*", idx))
+        return [(float(a), int(b)) for a, b in zip(s, c)]
+    raise AssertionError(agg.base)

@@ -52,7 +52,17 @@ class ShardedQueryExecutor(ServerQueryExecutor):
         self._device_cols: Dict[Tuple[str, str, int], Dict] = {}
 
     # -- combine overrides --------------------------------------------------
+    def _any_star_tree_fit(self, ctx, aggs, segments) -> bool:
+        """Star-tree-eligible queries take the per-segment path: the
+        pre-aggregated records beat a dense sharded scan (ref: the star-tree
+        plan wins in AggregationGroupByOrderByPlanNode.java:66-87)."""
+        return any(self._star_tree_pick(ctx, aggs, s) is not None
+                   for s in segments)
+
     def _execute_aggregation(self, ctx, aggs, segments, stats):
+        if self._any_star_tree_fit(ctx, aggs, segments):
+            return ServerQueryExecutor._execute_aggregation(
+                self, ctx, aggs, segments, stats)
         if self.use_device and len(segments) > 1:
             try:
                 batch, out, plan = self._run_sharded(ctx, segments, stats)
@@ -64,6 +74,9 @@ class ShardedQueryExecutor(ServerQueryExecutor):
         return super()._execute_aggregation(ctx, aggs, segments, stats)
 
     def _execute_group_by(self, ctx, aggs, segments, stats):
+        if self._any_star_tree_fit(ctx, aggs, segments):
+            return ServerQueryExecutor._execute_group_by(
+                self, ctx, aggs, segments, stats)
         if self.use_device and len(segments) > 1:
             try:
                 batch, out, plan = self._run_sharded(ctx, segments, stats)

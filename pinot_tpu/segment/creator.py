@@ -120,8 +120,81 @@ class SegmentBuilder:
             crc=crc,
             columns=col_metas,
         )
+        sm.star_tree_count = self._build_star_trees(seg_dir, sm)
         sm.save(os.path.join(seg_dir, meta.METADATA_FILE))
         return sm
+
+    def _build_star_trees(self, seg_dir: str, sm: meta.SegmentMetadata) -> int:
+        """Build configured star-trees over the just-written columns
+        (ref: MultipleTreesBuilder after SegmentColumnarIndexCreator)."""
+        from pinot_tpu.segment.startree import StarTreeBuilder, StarTreeConfig
+
+        configs = [StarTreeConfig.from_spi(c)
+                   for c in self.indexing.star_tree_index_configs]
+        if self.indexing.enable_default_star_tree and not configs:
+            default = self._default_star_tree_config(sm)
+            if default is not None:
+                configs = [default]
+        if not configs:
+            return 0
+
+        col_dir = os.path.join(seg_dir, COLUMNS_DIR)
+
+        def load(col: str, suffix: str) -> np.ndarray:
+            return np.load(os.path.join(col_dir, f"{col}.{suffix}.npy"))
+
+        count = 0
+        for cfg in configs:
+            try:
+                dim_ids = {}
+                for d in cfg.dimensions_split_order:
+                    cm = sm.columns[d]
+                    if not (cm.has_dictionary and cm.single_value):
+                        raise ValueError(f"dimension {d} must be a "
+                                         "dict-encoded SV column")
+                    dim_ids[d] = load(d, "fwd").astype(np.int32)
+                metric_vals = {}
+                for fn, col in cfg.function_column_pairs:
+                    if col == "*" or col in metric_vals:
+                        continue
+                    cm = sm.columns[col]
+                    if not (cm.single_value and cm.data_type.is_numeric):
+                        raise ValueError(f"metric {col} must be a numeric "
+                                         "SV column")
+                    fwd = load(col, "fwd")
+                    if cm.has_dictionary:
+                        metric_vals[col] = load(col, "dict")[fwd]
+                    else:
+                        metric_vals[col] = fwd
+                tree = StarTreeBuilder(cfg).build(dim_ids, metric_vals,
+                                                  sm.num_docs)
+                tree.save(seg_dir, index=count)
+                count += 1
+            except (ValueError, KeyError, OSError) as e:
+                import logging
+
+                logging.getLogger(__name__).warning(
+                    "skipping star-tree for %s: %s", self.segment_name, e)
+        return count
+
+    def _default_star_tree_config(self, sm: meta.SegmentMetadata):
+        """Ref: enableDefaultStarTree — dimensions with bounded cardinality
+        (descending), COUNT(*) + SUM per numeric metric."""
+        from pinot_tpu.segment.startree import StarTreeConfig
+
+        dims = [(cm.cardinality, name) for name, cm in sm.columns.items()
+                if cm.has_dictionary and cm.single_value
+                and sm.schema.field_spec(name).is_dimension
+                and 1 < cm.cardinality <= 10_000]
+        if not dims:
+            return None
+        split = [n for _, n in sorted(dims, reverse=True)]
+        pairs = [("count", "*")]
+        for name, cm in sm.columns.items():
+            if sm.schema.field_spec(name).is_metric and cm.data_type.is_numeric \
+                    and cm.single_value:
+                pairs.append(("sum", name))
+        return StarTreeConfig(split, pairs, max_leaf_records=10_000)
 
     # -- internals ---------------------------------------------------------
     def _to_columnar(self, rows: RowsInput) -> Dict[str, List[Any]]:

@@ -130,6 +130,18 @@ class ImmutableSegment:
             self._data_sources[column] = ds
         return ds
 
+    @cached_property
+    def star_trees(self):
+        """Loaded star-trees (ref: ImmutableSegmentImpl star-tree wiring)."""
+        from pinot_tpu.segment.startree import StarTree
+
+        trees = []
+        for i in range(self.metadata.star_tree_count):
+            t = StarTree.load(self.segment_dir, index=i)
+            if t is not None:
+                trees.append(t)
+        return trees
+
     # -- loading helpers ---------------------------------------------------
     def _path(self, column: str, suffix: str) -> str:
         return os.path.join(self.segment_dir, COLUMNS_DIR, f"{column}.{suffix}.npy")

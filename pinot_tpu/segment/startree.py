@@ -1,0 +1,338 @@
+"""StarTreeV2: pre-aggregation index — build, store, and query execution.
+
+Re-design of ``pinot-segment-local/.../startree/v2/builder/BaseSingleTreeBuilder.java``
+(sort on dimension split order, recursive node split with ``maxLeafRecords``,
+star-node records aggregated over the split dimension) plus the query side
+(``StarTreeUtils.isFitForStarTree``/``StarTreeFilterOperator.java:87`` tree
+walk and ``StarTreeV2.java:29`` read contract).
+
+TPU-first storage: records are flat columnar arrays — ``dims [R, D]`` int32
+dictIds with ``STAR = -1`` sentinels and one contiguous float64/int64 column
+per aggregation function pair — so the selected record ranges feed the same
+masked-reduction kernels as regular columns. The *tree walk* stays host-side:
+it is a pruning structure over R pre-aggregated records (R << num_docs),
+where pointer chasing is cheap and a dense device scan would waste the
+pre-aggregation.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence, Set, Tuple
+
+import numpy as np
+
+STAR = -1
+STARTREE_DIR = "startree{index}"
+META_FILE = "startree_metadata.json"
+
+# aggregation pairs supported in tree records (ref:
+# AggregationFunctionColumnPair; COUNT uses the catch-all '*' column)
+_MERGEABLE = {"count", "sum", "min", "max"}
+
+
+@dataclass
+class StarTreeConfig:
+    """Ref: StarTreeIndexConfig.java + StarTreeV2Metadata."""
+
+    dimensions_split_order: List[str]
+    function_column_pairs: List[Tuple[str, str]]  # (agg, column); count -> '*'
+    max_leaf_records: int = 10_000
+    skip_star_creation: List[str] = field(default_factory=list)
+
+    @classmethod
+    def from_spi(cls, spi_config) -> "StarTreeConfig":
+        """From spi.table.StarTreeIndexConfig ('SUM__revenue' pair syntax)."""
+        pairs = []
+        for p in spi_config.function_column_pairs:
+            fn, _, col = p.partition("__")
+            pairs.append((fn.lower(), col or "*"))
+        return cls(list(spi_config.dimensions_split_order), pairs,
+                   spi_config.max_leaf_records,
+                   list(spi_config.skip_star_node_creation_for_dimensions))
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "dimensionsSplitOrder": self.dimensions_split_order,
+            "functionColumnPairs": [f"{f}__{c}" for f, c in
+                                    self.function_column_pairs],
+            "maxLeafRecords": self.max_leaf_records,
+            "skipStarNodeCreationForDimensions": self.skip_star_creation,
+        }
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "StarTreeConfig":
+        pairs = []
+        for p in d["functionColumnPairs"]:
+            fn, _, col = p.partition("__")
+            pairs.append((fn, col or "*"))
+        return cls(d["dimensionsSplitOrder"], pairs, d["maxLeafRecords"],
+                   d.get("skipStarNodeCreationForDimensions", []))
+
+
+# node record dtype: the serialized tree (ref: StarTreeNode on-disk layout)
+_NODE_DTYPE = np.dtype([
+    ("dim", np.int32),          # split dimension index of the CHILDREN
+    ("value", np.int32),        # this node's dictId on parent's dim (STAR ok)
+    ("start", np.int64),        # record range [start, end)
+    ("end", np.int64),
+    ("child_first", np.int64),  # children index range [first, last); -1 leaf
+    ("child_last", np.int64),
+])
+
+
+class StarTreeBuilder:
+    """On-heap single-tree builder (ref: BaseSingleTreeBuilder, 541 LoC)."""
+
+    def __init__(self, config: StarTreeConfig):
+        self.config = config
+
+    def build(self, dim_dict_ids: Dict[str, np.ndarray],
+              metric_values: Dict[str, np.ndarray],
+              num_docs: int) -> "StarTree":
+        """``dim_dict_ids``: per split-order dimension, [num_docs] dictIds.
+        ``metric_values``: per non-count pair column, [num_docs] raw values.
+        """
+        cfg = self.config
+        D = len(cfg.dimensions_split_order)
+        dims = np.stack([np.asarray(dim_dict_ids[d][:num_docs], dtype=np.int32)
+                         for d in cfg.dimensions_split_order], axis=1)
+
+        metrics: Dict[str, np.ndarray] = {}
+        for fn, col in cfg.function_column_pairs:
+            key = f"{fn}__{col}"
+            if fn == "count":
+                metrics[key] = np.ones(num_docs, dtype=np.int64)
+            else:
+                metrics[key] = np.asarray(metric_values[col][:num_docs],
+                                          dtype=np.float64)
+
+        # pass 1: sort by dims, aggregate duplicate dim tuples
+        dims, metrics = self._sort_and_dedup(dims, metrics)
+
+        self._dims_rows: List[np.ndarray] = [dims]
+        self._chunk_offsets: List[int] = [0]
+        self._metric_rows: Dict[str, List[np.ndarray]] = {
+            k: [v] for k, v in metrics.items()}
+        self._record_count = dims.shape[0]
+        self._nodes: List[Tuple] = []
+
+        # recursive construction from the root
+        root_idx = self._new_node(value=STAR, start=0, end=dims.shape[0])
+        self._split(root_idx, depth=0)
+
+        all_dims = np.concatenate(self._dims_rows, axis=0)
+        all_metrics = {k: np.concatenate(v, axis=0)
+                       for k, v in self._metric_rows.items()}
+        nodes = np.array([tuple(n) for n in self._nodes], dtype=_NODE_DTYPE)
+        return StarTree(cfg, all_dims, all_metrics, nodes)
+
+    # -- helpers -------------------------------------------------------------
+    def _sort_and_dedup(self, dims, metrics):
+        order = np.lexsort(tuple(dims[:, i] for i
+                                 in range(dims.shape[1] - 1, -1, -1)))
+        dims = dims[order]
+        metrics = {k: v[order] for k, v in metrics.items()}
+        # aggregate equal dim tuples
+        if dims.shape[0]:
+            change = np.any(np.diff(dims, axis=0) != 0, axis=1)
+            starts = np.concatenate([[0], np.nonzero(change)[0] + 1])
+            group_id = np.zeros(dims.shape[0], dtype=np.int64)
+            group_id[starts[1:]] = 1
+            group_id = np.cumsum(group_id)
+            n = starts.shape[0]
+            dims = dims[starts]
+            metrics = {k: self._segmented(k, v, group_id, n)
+                       for k, v in metrics.items()}
+        return dims, metrics
+
+    @staticmethod
+    def _segmented(key: str, v: np.ndarray, gid: np.ndarray, n: int):
+        fn = key.split("__", 1)[0]
+        if fn in ("count", "sum"):
+            out = np.zeros(n, dtype=v.dtype)
+            np.add.at(out, gid, v)
+            return out
+        if fn == "min":
+            out = np.full(n, np.inf)
+            np.minimum.at(out, gid, v)
+            return out
+        out = np.full(n, -np.inf)
+        np.maximum.at(out, gid, v)
+        return out
+
+    def _new_node(self, value: int, start: int, end: int) -> int:
+        self._nodes.append([-1, value, start, end, -1, -1])
+        return len(self._nodes) - 1
+
+    def _append_records(self, dims: np.ndarray,
+                        metrics: Dict[str, np.ndarray]) -> int:
+        start = self._record_count
+        self._dims_rows.append(dims)
+        self._chunk_offsets.append(start)
+        for k, v in metrics.items():
+            self._metric_rows[k].append(v)
+        self._record_count += dims.shape[0]
+        return start
+
+    def _range(self, start: int, end: int):
+        """Slice one chunk: a node's record range never spans chunks (the
+        base chunk holds the sorted input; each star child owns exactly the
+        chunk its records were appended as)."""
+        import bisect
+
+        ci = bisect.bisect_right(self._chunk_offsets, start) - 1
+        off = self._chunk_offsets[ci]
+        lo, hi = start - off, end - off
+        dims = self._dims_rows[ci][lo:hi]
+        metrics = {k: v[ci][lo:hi] for k, v in self._metric_rows.items()}
+        return dims, metrics
+
+    def _split(self, node_idx: int, depth: int) -> None:
+        """Ref: BaseSingleTreeBuilder.constructStarTree — split the node's
+        record range on dimension ``depth``; add a star child aggregating
+        the range over that dimension; recurse while above maxLeafRecords."""
+        cfg = self.config
+        D = len(cfg.dimensions_split_order)
+        node = self._nodes[node_idx]
+        start, end = node[2], node[3]
+        if depth >= D or end - start <= cfg.max_leaf_records:
+            return
+        self._nodes[node_idx][0] = depth
+
+        dims, metrics = self._range(start, end)
+        col = dims[:, depth]
+        values, first_idx = np.unique(col, return_index=True)
+
+        children: List[int] = []
+        for i, v in enumerate(values):
+            c_start = start + first_idx[i]
+            c_end = start + (first_idx[i + 1] if i + 1 < len(values)
+                             else end - start)
+            children.append(self._new_node(int(v), c_start, c_end))
+
+        dim_name = cfg.dimensions_split_order[depth]
+        if dim_name not in cfg.skip_star_creation and len(values) > 1:
+            # star child: aggregate the range over this dimension
+            star_dims = dims.copy()
+            star_dims[:, depth] = STAR
+            s_dims, s_metrics = self._sort_and_dedup(star_dims, dict(metrics))
+            s_start = self._append_records(s_dims, s_metrics)
+            children.append(self._new_node(STAR, s_start,
+                                           s_start + s_dims.shape[0]))
+
+        self._nodes[node_idx][4] = children[0]
+        self._nodes[node_idx][5] = children[-1] + 1
+        for c in children:
+            self._split(c, depth + 1)
+
+
+class StarTree:
+    """A built (or loaded) star-tree: flat record columns + node array."""
+
+    def __init__(self, config: StarTreeConfig, dims: np.ndarray,
+                 metrics: Dict[str, np.ndarray], nodes: np.ndarray):
+        self.config = config
+        self.dims = dims          # [R, D] int32, STAR = -1
+        self.metrics = metrics    # pair key -> [R]
+        self.nodes = nodes        # _NODE_DTYPE array; root = 0
+        self._dim_index = {d: i for i, d
+                           in enumerate(config.dimensions_split_order)}
+
+    @property
+    def num_records(self) -> int:
+        return int(self.dims.shape[0])
+
+    def has_pair(self, fn: str, col: str) -> bool:
+        return f"{fn}__{col}" in self.metrics
+
+    # -- persistence (ref: startree/v2/store single index file) --------------
+    def save(self, seg_dir: str, index: int = 0) -> None:
+        d = os.path.join(seg_dir, STARTREE_DIR.format(index=index))
+        os.makedirs(d, exist_ok=True)
+        np.save(os.path.join(d, "dims.npy"), self.dims)
+        np.save(os.path.join(d, "nodes.npy"), self.nodes)
+        for k, v in self.metrics.items():
+            np.save(os.path.join(d, f"metric_{k}.npy"), v)
+        with open(os.path.join(d, META_FILE), "w") as f:
+            json.dump(self.config.to_dict(), f, indent=1)
+
+    @classmethod
+    def load(cls, seg_dir: str, index: int = 0) -> Optional["StarTree"]:
+        d = os.path.join(seg_dir, STARTREE_DIR.format(index=index))
+        meta_path = os.path.join(d, META_FILE)
+        if not os.path.isfile(meta_path):
+            return None
+        with open(meta_path) as f:
+            config = StarTreeConfig.from_dict(json.load(f))
+        dims = np.load(os.path.join(d, "dims.npy"), mmap_mode="r")
+        nodes = np.load(os.path.join(d, "nodes.npy"), mmap_mode="r")
+        metrics = {}
+        for fn, col in config.function_column_pairs:
+            k = f"{fn}__{col}"
+            metrics[k] = np.load(os.path.join(d, f"metric_{k}.npy"),
+                                 mmap_mode="r")
+        return cls(config, dims, metrics, nodes)
+
+    # -- query-time traversal (ref: StarTreeFilterOperator.java:87) ----------
+    def select_records(self,
+                       eq_in_per_dim: Dict[str, Set[int]],
+                       group_by_dims: List[str]) -> np.ndarray:
+        """Record indices answering the query: for each split dimension —
+        with a predicate: descend matching children; grouped: descend all
+        non-star children; otherwise: descend the star child (fall back to
+        scanning all children + post-mask when absent)."""
+        grouped = set(self._dim_index[d] for d in group_by_dims)
+        predicates = {self._dim_index[d]: ids
+                      for d, ids in eq_in_per_dim.items()}
+
+        out: List[np.ndarray] = []
+        # stack of (node index, needs_postfilter)
+        stack: List[int] = [0]
+        nodes = self.nodes
+        while stack:
+            ni = stack.pop()
+            n = nodes[ni]
+            if n["child_first"] < 0:  # leaf: emit record range
+                out.append(np.arange(n["start"], n["end"], dtype=np.int64))
+                continue
+            dim = int(n["dim"])
+            first, last = int(n["child_first"]), int(n["child_last"])
+            kids = range(first, last)
+            if dim in predicates:
+                match = predicates[dim]
+                for c in kids:
+                    if int(nodes[c]["value"]) in match:
+                        stack.append(c)
+            elif dim in grouped:
+                for c in kids:
+                    if int(nodes[c]["value"]) != STAR:
+                        stack.append(c)
+            else:
+                star = next((c for c in kids
+                             if int(nodes[c]["value"]) == STAR), None)
+                if star is not None:
+                    stack.append(star)
+                else:
+                    for c in kids:
+                        stack.append(c)
+        if not out:
+            return np.empty(0, dtype=np.int64)
+        idx = np.concatenate(out)
+        # post-filter: leaves cover un-split tails, so records may still hold
+        # concrete values where the query needs specific ones, and STAR rows
+        # must never leak into predicate/grouped dims
+        mask = np.ones(idx.shape[0], dtype=bool)
+        for dim, match in predicates.items():
+            col = self.dims[idx, dim]
+            mask &= np.isin(col, np.fromiter(match, dtype=np.int32,
+                                             count=len(match)))
+        for dim in grouped:
+            mask &= self.dims[idx, dim] != STAR
+        # free dims need no post-filter: each emitted leaf range holds either
+        # the star-aggregated rows (star child taken) or the full concrete
+        # partition (no star child / leaf before that depth) — never both
+        return idx[mask]

@@ -21,6 +21,8 @@ import numpy as np
 
 from pinot_tpu.engine.errors import QueryError, UnsupportedQueryError
 from pinot_tpu.query.expressions import Expr, Function, Identifier, Literal
+from pinot_tpu.utils.hll import HyperLogLog
+from pinot_tpu.utils.tdigest import TDigest
 
 POS_INF = float("inf")
 NEG_INF = float("-inf")
@@ -68,8 +70,10 @@ _EMPTY: Dict[str, Any] = {
     "avg": (0.0, 0),
     "minmaxrange": (POS_INF, NEG_INF),
     "distinctcount": frozenset(),
+    "distinctcounthll": lambda: HyperLogLog().serialize(),
     "mode": dict,
     "percentile": tuple,
+    "percentiletdigest": lambda: TDigest().serialize(),
 }
 
 _MERGE: Dict[str, Callable[[Any, Any], Any]] = {
@@ -80,8 +84,12 @@ _MERGE: Dict[str, Callable[[Any, Any], Any]] = {
     "avg": lambda a, b: (a[0] + b[0], a[1] + b[1]),
     "minmaxrange": lambda a, b: (min(a[0], b[0]), max(a[1], b[1])),
     "distinctcount": lambda a, b: frozenset(a) | frozenset(b),
+    "distinctcounthll": lambda a, b: HyperLogLog.deserialize(a).merge(
+        HyperLogLog.deserialize(b)).serialize(),
     "mode": lambda a, b: _merge_counts(a, b),
     "percentile": lambda a, b: tuple(a) + tuple(b),
+    "percentiletdigest": lambda a, b: TDigest.deserialize(a).merge(
+        TDigest.deserialize(b)).serialize(),
 }
 
 
@@ -114,8 +122,13 @@ _FINAL: Dict[str, Callable[[AggDef, Any], Any]] = {
     "avg": _final_avg,
     "minmaxrange": lambda d, s: float(s[1] - s[0]),
     "distinctcount": lambda d, s: len(s),
+    "distinctcounthll": lambda d, s: (
+        s.hex() if d.name.startswith("distinctcountrawhll")
+        else HyperLogLog.deserialize(s).cardinality()),
     "mode": lambda d, s: (float(max(s, key=lambda k: (s[k], k))) if s else NEG_INF),
     "percentile": _final_percentile,
+    "percentiletdigest": lambda d, s: TDigest.deserialize(s).quantile(
+        d.percentile / 100.0),
 }
 
 
@@ -187,6 +200,28 @@ def _host_percentile(d: AggDef, values, mask):
     return tuple(_flat_filtered(d, values, mask).tolist())
 
 
+def _host_hll(d: AggDef, values, mask):
+    if d.mv:
+        flat = []
+        for v, m in zip(values, mask):
+            if m:
+                flat.extend(v if isinstance(v, (list, np.ndarray)) else [v])
+        h = HyperLogLog()
+        if flat:
+            h.add_values(flat)
+        return h.serialize()
+    vals = np.asarray(values)[mask] if not isinstance(values, list) \
+        else [v for v, m in zip(values, mask) if m]
+    h = HyperLogLog()
+    if len(vals):
+        h.add_values(vals)
+    return h.serialize()
+
+
+def _host_tdigest(d: AggDef, values, mask):
+    return TDigest.of(_flat_filtered(d, values, mask)).serialize()
+
+
 _HOST: Dict[str, Callable] = {
     "count": _host_count,
     "sum": _host_sum,
@@ -195,8 +230,10 @@ _HOST: Dict[str, Callable] = {
     "avg": _host_avg,
     "minmaxrange": _host_minmaxrange,
     "distinctcount": _host_distinctcount,
+    "distinctcounthll": _host_hll,
     "mode": _host_mode,
     "percentile": _host_percentile,
+    "percentiletdigest": _host_tdigest,
 }
 
 
@@ -212,8 +249,10 @@ _RESULT_TYPE = {
     "avg": "DOUBLE",
     "minmaxrange": "DOUBLE",
     "distinctcount": "INT",
+    "distinctcounthll": "LONG",
     "mode": "DOUBLE",
     "percentile": "DOUBLE",
+    "percentiletdigest": "DOUBLE",
 }
 
 # families with device kernels (kernels.py); others run on the host path
@@ -249,12 +288,22 @@ def resolve_agg(fn: Function) -> AggDef:
         "avg": "avg", "minmaxrange": "minmaxrange",
         "distinctcount": "distinctcount", "distinctcountbitmap": "distinctcount",
         "segmentpartitioneddistinctcount": "distinctcount",
+        "distinctcounthll": "distinctcounthll",
+        # RAW variants return the serialized sketch itself (hex), resolved
+        # at finalize via the same family state
+        "distinctcountrawhll": "distinctcounthll",
         "mode": "mode",
+        # percentileest (QuantileDigest in the reference) shares the exact
+        # family here; percentiletdigest is the approximate sketch
         "percentile": "percentile", "percentileest": "percentile",
-        "percentiletdigest": "percentile",
+        "percentiletdigest": "percentiletdigest",
     }.get(base_name)
     if family is None:
         raise UnsupportedQueryError(f"aggregation function {name!r} not supported")
+
+    result_type = _RESULT_TYPE[family]
+    if base_name == "distinctcountrawhll":
+        result_type = "STRING"
 
     return AggDef(
         name=name,
@@ -264,7 +313,7 @@ def resolve_agg(fn: Function) -> AggDef:
         device_scalar=(family in _DEVICE_SCALAR) and not mv or (mv and family in
                       {"count", "sum", "min", "max", "avg"}),
         device_grouped=(family in _DEVICE_GROUPED) and not mv,
-        result_type=_RESULT_TYPE[family],
+        result_type=result_type,
     )
 
 

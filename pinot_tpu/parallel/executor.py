@@ -55,9 +55,13 @@ class ShardedQueryExecutor(ServerQueryExecutor):
     def _any_star_tree_fit(self, ctx, aggs, segments) -> bool:
         """Star-tree-eligible queries take the per-segment path: the
         pre-aggregated records beat a dense sharded scan (ref: the star-tree
-        plan wins in AggregationGroupByOrderByPlanNode.java:66-87)."""
-        return any(self._star_tree_pick(ctx, aggs, s) is not None
-                   for s in segments)
+        plan wins in AggregationGroupByOrderByPlanNode.java:66-87). All
+        segments of a table share their indexing config, so the first
+        segment carrying trees is representative — one fit check, not K."""
+        rep = next((s for s in segments if getattr(s, "star_trees", None)),
+                   None)
+        return (rep is not None
+                and self._star_tree_pick(ctx, aggs, rep) is not None)
 
     def _execute_aggregation(self, ctx, aggs, segments, stats):
         if self._any_star_tree_fit(ctx, aggs, segments):

@@ -1,0 +1,157 @@
+"""Approximate aggregations: HLL + t-digest sketch properties and their
+end-to-end behavior (ref: DistinctCountHLLAggregationFunction /
+PercentileTDigestAggregationFunction; BASELINE.json config #4)."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from pinot_tpu.engine import ServerQueryExecutor
+from pinot_tpu.query import compile_query
+from pinot_tpu.segment import SegmentBuilder, load_segment
+from pinot_tpu.spi import DataType, FieldSpec, FieldType, Schema
+from pinot_tpu.utils.hll import HyperLogLog, hash_values
+from pinot_tpu.utils.tdigest import TDigest
+
+
+class TestHyperLogLog:
+    @pytest.mark.parametrize("true_n", [10, 1000, 50_000])
+    def test_estimate_within_error(self, true_n):
+        rng = np.random.default_rng(42)
+        values = rng.integers(0, 1 << 60, true_n * 3)[:true_n]
+        h = HyperLogLog()
+        h.add_values(values)
+        est = h.cardinality()
+        # standard error for log2m=8 is ~6.5%; allow 3 sigma
+        assert abs(est - len(set(values.tolist()))) <= \
+            max(0.2 * true_n, 5), (est, true_n)
+
+    def test_merge_equals_union(self):
+        rng = np.random.default_rng(7)
+        a_vals = rng.integers(0, 10_000, 5000)
+        b_vals = rng.integers(5_000, 15_000, 5000)
+        a = HyperLogLog.of(a_vals)
+        b = HyperLogLog.of(b_vals)
+        both = HyperLogLog.of(np.concatenate([a_vals, b_vals]))
+        assert a.merge(b).cardinality() == both.cardinality()
+
+    def test_serde_round_trip(self):
+        h = HyperLogLog.of(["a", "b", "c", b"\x01\x02", 42, 3.14])
+        h2 = HyperLogLog.deserialize(h.serialize())
+        assert np.array_equal(h.registers, h2.registers)
+        assert h2.log2m == h.log2m
+
+    def test_string_and_numeric_hashing_disjoint(self):
+        hs = hash_values(["1", "2"])
+        hn = hash_values(np.array([1, 2]))
+        assert set(hs.tolist()).isdisjoint(set(hn.tolist()))
+
+
+class TestTDigest:
+    @pytest.mark.parametrize("q", [0.01, 0.25, 0.5, 0.9, 0.99])
+    def test_quantile_accuracy(self, q):
+        rng = np.random.default_rng(3)
+        vals = rng.normal(100, 20, 100_000)
+        d = TDigest.of(vals)
+        true_q = float(np.quantile(vals, q))
+        got = d.quantile(q)
+        spread = float(np.quantile(vals, 0.999) - np.quantile(vals, 0.001))
+        assert abs(got - true_q) <= 0.02 * spread, (q, got, true_q)
+
+    def test_merge_matches_single_digest(self):
+        rng = np.random.default_rng(5)
+        a_vals = rng.exponential(10, 50_000)
+        b_vals = rng.exponential(30, 50_000)
+        merged = TDigest.of(a_vals).merge(TDigest.of(b_vals))
+        combined = np.concatenate([a_vals, b_vals])
+        for q in (0.1, 0.5, 0.95):
+            true_q = float(np.quantile(combined, q))
+            spread = float(np.quantile(combined, 0.999))
+            assert abs(merged.quantile(q) - true_q) <= 0.03 * spread
+
+    def test_compression_bounds_centroids(self):
+        d = TDigest.of(np.random.default_rng(1).normal(0, 1, 200_000))
+        assert d.means.shape[0] < 200  # ~compression centroids
+
+    def test_serde_round_trip(self):
+        d = TDigest.of([1.0, 2.0, 3.0, 100.0])
+        d2 = TDigest.deserialize(d.serialize())
+        assert d2.quantile(0.5) == d.quantile(0.5)
+
+
+class TestSketchQueries:
+    @pytest.fixture(scope="class")
+    def seg(self, tmp_path_factory):
+        out = str(tmp_path_factory.mktemp("sk"))
+        rng = np.random.default_rng(17)
+        n = 20_000
+        self_df = pd.DataFrame({
+            "user": [f"u{i}" for i in rng.integers(0, 5000, n)],
+            "grp": [f"g{i}" for i in rng.integers(0, 4, n)],
+            "lat": np.round(rng.gamma(3, 25, n), 3),
+        })
+        schema = Schema("events", [
+            FieldSpec("user", DataType.STRING),
+            FieldSpec("grp", DataType.STRING),
+            FieldSpec("lat", DataType.DOUBLE, FieldType.METRIC),
+        ])
+        SegmentBuilder(schema, "ev_0").build(
+            {c: self_df[c].tolist() for c in self_df.columns}, out)
+        return load_segment(f"{out}/ev_0"), self_df
+
+    def test_distinctcounthll_query(self, seg):
+        segment, df = seg
+        ex = ServerQueryExecutor()
+        t, _ = ex.execute(compile_query(
+            "SELECT distinctcounthll(user) FROM events"), [segment])
+        true_n = df.user.nunique()
+        assert abs(t.rows[0][0] - true_n) <= 0.2 * true_n
+
+    def test_percentiletdigest_query(self, seg):
+        segment, df = seg
+        ex = ServerQueryExecutor()
+        t, _ = ex.execute(compile_query(
+            "SELECT percentiletdigest95(lat), percentiletdigest50(lat) "
+            "FROM events"), [segment])
+        for got, q in zip(t.rows[0], (0.95, 0.50)):
+            true_q = float(df.lat.quantile(q))
+            assert abs(got - true_q) <= 0.05 * float(df.lat.max())
+
+    def test_group_by_sketches(self, seg):
+        segment, df = seg
+        ex = ServerQueryExecutor()
+        t, _ = ex.execute(compile_query(
+            "SELECT grp, distinctcounthll(user), percentiletdigest90(lat) "
+            "FROM events GROUP BY grp ORDER BY grp LIMIT 10"), [segment])
+        for row in t.rows:
+            part = df[df.grp == row[0]]
+            assert abs(row[1] - part.user.nunique()) <= 0.2 * part.user.nunique()
+            assert abs(row[2] - part.lat.quantile(0.9)) <= \
+                0.05 * float(df.lat.max())
+
+    def test_multi_segment_merge(self, seg, tmp_path):
+        """Sketch states must merge across segments (the wire/merge path)."""
+        segment, df = seg
+        out = str(tmp_path)
+        schema = segment.metadata.schema
+        half = len(df) // 2
+        for i, sl in enumerate([slice(0, half), slice(half, None)]):
+            part = df.iloc[sl]
+            SegmentBuilder(schema, f"ev_s{i}").build(
+                {c: part[c].tolist() for c in df.columns}, out)
+        segs = [load_segment(f"{out}/ev_s{i}") for i in range(2)]
+        ex = ServerQueryExecutor()
+        t_split, _ = ex.execute(compile_query(
+            "SELECT distinctcounthll(user) FROM events"), segs)
+        t_single, _ = ex.execute(compile_query(
+            "SELECT distinctcounthll(user) FROM events"), [segment])
+        assert t_split.rows[0][0] == t_single.rows[0][0]
+
+    def test_rawhll_returns_serialized(self, seg):
+        segment, _ = seg
+        ex = ServerQueryExecutor()
+        t, _ = ex.execute(compile_query(
+            "SELECT distinctcountrawhll(user) FROM events"), [segment])
+        from pinot_tpu.utils.hll import HyperLogLog
+        h = HyperLogLog.deserialize(bytes.fromhex(t.rows[0][0]))
+        assert h.cardinality() > 0

@@ -40,8 +40,15 @@ from pinot_tpu.spi.data import DataType
 def eval_filter(segment: ImmutableSegment, node: Optional[FilterNode]) -> np.ndarray:
     n = segment.num_docs
     if node is None:
-        return np.ones(n, dtype=bool)
-    return _eval_node(segment, node)
+        mask = np.ones(n, dtype=bool)
+    else:
+        mask = _eval_node(segment, node)
+    valid = getattr(segment, "valid_doc_ids", None)
+    if valid is not None:
+        # upsert: only the live doc per primary key is visible
+        # (ref: IndexSegment.getValidDocIds AND-ed into every filter)
+        mask = mask & np.asarray(valid[:n])
+    return mask
 
 
 def _eval_node(segment: ImmutableSegment, node: FilterNode) -> np.ndarray:

@@ -73,6 +73,10 @@ def plan_segment(ctx: QueryContext, segment: ImmutableSegment) -> SegmentPlan:
         # consuming segments are host-resident (unsorted dictionaries, live
         # append) — served by the host engine until sealed (SURVEY.md §7)
         raise PlanError("mutable segment -> host path")
+    if getattr(segment, "valid_doc_ids", None) is not None:
+        # upsert bitmaps mutate as newer keys arrive; the host path reads
+        # them live (device staging of the mask is a later optimization)
+        raise PlanError("upsert-managed segment -> host path")
     params: List[np.ndarray] = []
     columns: List[str] = []
 

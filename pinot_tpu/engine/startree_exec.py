@@ -78,6 +78,8 @@ def pick_star_tree(ctx: QueryContext, aggs: List[AggDef],
     trees = getattr(segment, "star_trees", None)
     if not trees or not ctx.is_aggregation:
         return None
+    if getattr(segment, "valid_doc_ids", None) is not None:
+        return None  # pre-agg records ignore upsert invalidation
     preds = _flatten_and(ctx.filter)
     if preds is None:
         return None

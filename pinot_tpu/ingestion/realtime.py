@@ -171,6 +171,9 @@ class RealtimeSegmentDataManager:
         self.flush_threshold_ms = sc.segment_flush_threshold_millis
         self._start_time_ms = int(time.time() * 1000)
 
+        # row-level upsert hook: called as fn(row, doc_id) after a row is
+        # indexed (ref: RealtimeTableDataManager addRecord wiring)
+        self.upsert_hook = None
         self.state = ConsumerState.INITIAL_CONSUMING
         self.rows_indexed = 0
         self.rows_dropped = 0
@@ -193,6 +196,8 @@ class RealtimeSegmentDataManager:
                 if not self.segment.index(row):
                     break
                 self.rows_indexed += 1
+                if self.upsert_hook is not None:
+                    self.upsert_hook(row, self.segment.num_docs - 1)
             n += 1
             self.current_offset = StreamOffset(msg.offset.value + 1)
         return n

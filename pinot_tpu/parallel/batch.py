@@ -74,6 +74,9 @@ class SegmentBatch:
                 # stacked arrays would serve stale data (host path serves them)
                 raise ValueError(f"mutable segment {s.segment_name!r} "
                                  "cannot join a device batch")
+            if getattr(s, "valid_doc_ids", None) is not None:
+                raise ValueError(f"upsert segment {s.segment_name!r} "
+                                 "cannot join a device batch")
         self.segments = segments
         first = segments[0].metadata
         cols = set(first.columns.keys())

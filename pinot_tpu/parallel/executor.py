@@ -58,10 +58,9 @@ class ShardedQueryExecutor(ServerQueryExecutor):
         plan wins in AggregationGroupByOrderByPlanNode.java:66-87). All
         segments of a table share their indexing config, so the first
         segment carrying trees is representative — one fit check, not K."""
-        rep = next((s for s in segments if getattr(s, "star_trees", None)),
-                   None)
-        return (rep is not None
-                and self._star_tree_pick(ctx, aggs, rep) is not None)
+        return any(self._star_tree_pick(ctx, aggs, s) is not None
+                   for s in segments
+                   if getattr(s, "star_trees", None))
 
     def _execute_aggregation(self, ctx, aggs, segments, stats):
         if self._any_star_tree_fit(ctx, aggs, segments):

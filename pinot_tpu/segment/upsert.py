@@ -1,0 +1,182 @@
+"""Upsert engine: primary-key dedup across realtime segments.
+
+Re-design of ``pinot-segment-local/.../upsert/PartitionUpsertMetadataManager.java:67``
++ ``TableUpsertMetadataManager`` + ``upsert/merger/*``: a per-partition
+primary-key -> RecordLocation map; when a newer record (by the comparison
+column, default the time column) arrives for an existing key, the older
+doc is invalidated in its segment's valid-doc bitmap. Queries AND the
+valid-doc mask into the filter mask, so every execution path (host, device,
+star-tree-free) sees exactly one live doc per key.
+
+The valid-doc bitmap is a plain bool array per segment — the TPU analogue of
+the reference's ThreadSafeMutableRoaringBitmap: it stages to the device as
+one more mask column.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from pinot_tpu.spi.table import UpsertMode
+
+
+@dataclass
+class RecordLocation:
+    """Ref: upsert RecordLocation — where a key's live doc currently is."""
+
+    segment_name: str
+    doc_id: int
+    comparison_value: Any
+
+
+class PartitionUpsertMetadataManager:
+    """One per (table, stream partition). Thread-safe: consumers index while
+    queries read bitmaps (ref: PartitionUpsertMetadataManager.java:67)."""
+
+    def __init__(self, primary_key_columns: List[str],
+                 comparison_column: str,
+                 mode: UpsertMode = UpsertMode.FULL):
+        self.primary_key_columns = primary_key_columns
+        self.comparison_column = comparison_column
+        self.mode = mode
+        self._locations: Dict[Tuple, RecordLocation] = {}
+        self._valid: Dict[str, np.ndarray] = {}
+        self._lock = threading.Lock()
+
+    # -- reads ---------------------------------------------------------------
+    def valid_docs(self, segment_name: str) -> Optional[np.ndarray]:
+        with self._lock:
+            v = self._valid.get(segment_name)
+            return None if v is None else v.copy()
+
+    @property
+    def num_keys(self) -> int:
+        with self._lock:
+            return len(self._locations)
+
+    # -- segment lifecycle ---------------------------------------------------
+    def add_segment(self, segment) -> np.ndarray:
+        """Index a sealed segment's keys (ref: addSegment — rebuilt from
+        segments on restart, SURVEY.md §5 checkpoint note). Returns the
+        segment's valid bitmap (shared; updated in place on invalidation)."""
+        n = segment.num_docs
+        keys = self._segment_keys(segment)
+        cmp_vals = self._read_column(segment, self.comparison_column)
+        with self._lock:
+            valid = np.ones(n, dtype=bool)
+            self._valid[segment.segment_name] = valid
+            for doc_id in range(n):
+                self._upsert_locked(keys[doc_id], segment.segment_name,
+                                    doc_id, cmp_vals[doc_id])
+            return valid
+
+    def remove_segment(self, segment_name: str) -> None:
+        with self._lock:
+            self._valid.pop(segment_name, None)
+            dead = [k for k, loc in self._locations.items()
+                    if loc.segment_name == segment_name]
+            for k in dead:
+                del self._locations[k]
+
+    def replace_segment(self, segment) -> np.ndarray:
+        """Sealed build replaces the consuming segment under the same name:
+        doc ids are unchanged (same rows, same order), so the bitmap carries
+        over and locations stay valid."""
+        with self._lock:
+            old = self._valid.get(segment.segment_name)
+            n = segment.num_docs
+            valid = np.ones(n, dtype=bool)
+            if old is not None:
+                m = min(n, old.shape[0])
+                valid[:m] = old[:m]
+            self._valid[segment.segment_name] = valid
+            return valid
+
+    # -- row-level ingest (consuming segments) -------------------------------
+    def add_record(self, segment_name: str, doc_id: int, key: Tuple,
+                   comparison_value: Any) -> None:
+        """Ref: addRecord during consumption — called after
+        MutableSegment.index()."""
+        with self._lock:
+            valid = self._valid.get(segment_name)
+            if valid is None or doc_id >= valid.shape[0]:
+                grown = np.ones(max(doc_id + 1, 1024), dtype=bool)
+                if valid is not None:
+                    grown[:valid.shape[0]] = valid
+                valid = grown
+                self._valid[segment_name] = valid
+            self._upsert_locked(key, segment_name, doc_id, comparison_value)
+
+    def _upsert_locked(self, key: Tuple, segment_name: str, doc_id: int,
+                       cmp_value: Any) -> None:
+        loc = self._locations.get(key)
+        if loc is not None:
+            # newer-or-equal wins (ref: comparison >= keeps latest arrival)
+            if cmp_value is not None and loc.comparison_value is not None \
+                    and cmp_value < loc.comparison_value:
+                # incoming is older: invalidate IT instead
+                valid = self._valid.get(segment_name)
+                if valid is not None and doc_id < valid.shape[0]:
+                    valid[doc_id] = False
+                return
+            old_valid = self._valid.get(loc.segment_name)
+            if old_valid is not None and loc.doc_id < old_valid.shape[0]:
+                old_valid[loc.doc_id] = False
+        self._locations[key] = RecordLocation(segment_name, doc_id, cmp_value)
+
+    # -- helpers -------------------------------------------------------------
+    def key_of_row(self, row: Dict[str, Any]) -> Tuple:
+        return tuple(row.get(c) for c in self.primary_key_columns)
+
+    def _segment_keys(self, segment) -> List[Tuple]:
+        cols = [self._read_column(segment, c)
+                for c in self.primary_key_columns]
+        return list(zip(*cols)) if cols else []
+
+    @staticmethod
+    def _read_column(segment, column: str) -> List[Any]:
+        ds = segment.data_source(column)
+        n = segment.num_docs
+        fwd = np.asarray(ds.forward_index[:n])
+        if ds.dictionary is not None:
+            return ds.dictionary.get_values(fwd)
+        return fwd.tolist()
+
+
+class TableUpsertMetadataManager:
+    """table -> partition managers (ref: TableUpsertMetadataManager)."""
+
+    def __init__(self, primary_key_columns: List[str],
+                 comparison_column: str,
+                 mode: UpsertMode = UpsertMode.FULL):
+        self.primary_key_columns = primary_key_columns
+        self.comparison_column = comparison_column
+        self.mode = mode
+        self._partitions: Dict[int, PartitionUpsertMetadataManager] = {}
+        self._lock = threading.Lock()
+
+    def partition_managers(self) -> List[PartitionUpsertMetadataManager]:
+        with self._lock:
+            return list(self._partitions.values())
+
+    def partition(self, p: int) -> PartitionUpsertMetadataManager:
+        with self._lock:
+            m = self._partitions.get(p)
+            if m is None:
+                m = PartitionUpsertMetadataManager(
+                    self.primary_key_columns, self.comparison_column,
+                    self.mode)
+                self._partitions[p] = m
+            return m
+
+
+def attach_valid_docs(segment, valid: np.ndarray) -> None:
+    """Mark a segment as upsert-managed: execution paths AND this bitmap
+    into every filter mask (the validDocIds contract,
+    ref: IndexSegment.getValidDocIds)."""
+    segment.valid_doc_ids = valid

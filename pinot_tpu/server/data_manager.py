@@ -16,10 +16,51 @@ import threading
 
 from typing import Any, Dict, List, Optional
 
+import numpy as np
+
 from pinot_tpu.ingestion.realtime import RealtimeSegmentDataManager
 from pinot_tpu.segment.immutable import ImmutableSegment, load_segment
 
+
+class _LiveValidDocs:
+    """Array-like view over the upsert manager's live bitmap: slicing reads
+    the current state (docs invalidated after attach stay invisible)."""
+
+    def __init__(self, pm, segment_name: str):
+        self._pm = pm
+        self._segment_name = segment_name
+
+    def __getitem__(self, item):
+        v = self._pm.valid_docs(self._segment_name)
+        if isinstance(item, slice):
+            stop = item.stop if item.stop is not None else \
+                (0 if v is None else v.shape[0])
+            if v is None:
+                return np.ones(stop, dtype=bool)[item]
+            if v.shape[0] < stop:
+                # bitmap lags the doc count briefly: unseen docs are valid
+                grown = np.ones(stop, dtype=bool)
+                grown[:v.shape[0]] = v
+                v = grown
+            return v[item]
+        return True if v is None or item >= v.shape[0] else bool(v[item])
+
 log = logging.getLogger(__name__)
+
+
+def _segment_partition(segment, segment_name: str) -> int:
+    """Stream partition of a sealed realtime segment: committed metadata
+    first (segment.realtime.partition), LLC name second."""
+    p = segment.metadata.custom.get("segment.realtime.partition")
+    if p is not None:
+        return int(p)
+    parts = segment_name.split("__")
+    if len(parts) >= 3:
+        try:
+            return int(parts[1])
+        except ValueError:
+            pass
+    return 0
 
 
 class SegmentDataManager:
@@ -130,15 +171,32 @@ class TableDataManager:
 class RealtimeTableDataManager(TableDataManager):
     """Ref: RealtimeTableDataManager.java:80 — additionally owns the
     consuming-segment managers; their mutable segments serve queries until
-    sealed, then the immutable build replaces them in-place."""
+    sealed, then the immutable build replaces them in-place. With upsert
+    enabled, every hosted segment registers with the table's upsert manager
+    and carries a valid-doc bitmap (ref: upsert wiring in
+    RealtimeTableDataManager)."""
 
-    def __init__(self, table_name_with_type: str):
+    def __init__(self, table_name_with_type: str, upsert_manager=None):
         super().__init__(table_name_with_type)
         self._consumers: Dict[str, RealtimeSegmentDataManager] = {}
+        self.upsert_manager = upsert_manager  # TableUpsertMetadataManager
 
     def add_consuming(self, mgr: RealtimeSegmentDataManager) -> None:
         with self._lock:
             self._consumers[mgr.segment_name] = mgr
+        if self.upsert_manager is not None:
+            from pinot_tpu.segment.upsert import attach_valid_docs
+
+            pm = self.upsert_manager.partition(mgr.partition)
+            seg_name = mgr.segment_name
+
+            def hook(row, doc_id, pm=pm, seg_name=seg_name):
+                pm.add_record(seg_name, doc_id, pm.key_of_row(row),
+                              row.get(pm.comparison_column))
+
+            mgr.upsert_hook = hook
+            # live view over the growing bitmap
+            attach_valid_docs(mgr.segment, _LiveValidDocs(pm, seg_name))
         self.add_segment(mgr.segment)  # the mutable segment serves queries
 
     def consuming_manager(self, segment_name: str
@@ -148,23 +206,47 @@ class RealtimeTableDataManager(TableDataManager):
 
     def remove_segment(self, segment_name: str) -> None:
         """Unassignment must also stop a live consumer, or it keeps
-        consuming and re-adds itself from its terminal callback."""
+        consuming and re-adds itself from its terminal callback — and ghost
+        upsert locations must go with it, or a stale location outranks
+        future records of the same key."""
         with self._lock:
             mgr = self._consumers.pop(segment_name, None)
         if mgr is not None:
             mgr.stop(reason="unassigned")
+        if self.upsert_manager is not None:
+            for pm in self.upsert_manager.partition_managers():
+                pm.remove_segment(segment_name)
         super().remove_segment(segment_name)
 
     def drop_consumer(self, segment_name: str) -> None:
         with self._lock:
             self._consumers.pop(segment_name, None)
 
-    def on_sealed(self, segment_name: str, segment_dir: str) -> None:
+    def on_sealed(self, segment_name: str, segment_dir: str,
+                  partition: Optional[int] = None) -> None:
         """CONSUMING -> ONLINE flip: swap the mutable segment for the
-        immutable build (ref: CONSUMING->ONLINE state transition)."""
+        immutable build (ref: CONSUMING->ONLINE state transition). Also the
+        entry point for replica downloads of upsert tables (keys must
+        register, ref: PartitionUpsertMetadataManager.addSegment)."""
         with self._lock:
-            self._consumers.pop(segment_name, None)
-        self.add_segment_from_dir(segment_dir)
+            mgr = self._consumers.pop(segment_name, None)
+        seg = load_segment(segment_dir)
+        if self.upsert_manager is not None:
+            from pinot_tpu.segment.upsert import attach_valid_docs
+
+            if mgr is not None:
+                partition = mgr.partition
+            elif partition is None:
+                partition = _segment_partition(seg, segment_name)
+            pm = self.upsert_manager.partition(partition)
+            if mgr is not None:
+                # same rows/order as the consuming segment: carry the bitmap
+                pm.replace_segment(seg)
+            else:
+                # replica download: rebuild key locations from the segment
+                pm.add_segment(seg)
+            attach_valid_docs(seg, _LiveValidDocs(pm, segment_name))
+        self.add_segment(seg)
 
     def shutdown(self) -> None:
         with self._lock:
@@ -183,12 +265,13 @@ class InstanceDataManager:
         self._tables: Dict[str, TableDataManager] = {}
         self._lock = threading.Lock()
 
-    def get_or_create(self, table: str, realtime: bool = False) -> TableDataManager:
+    def get_or_create(self, table: str, realtime: bool = False,
+                      upsert_manager=None) -> TableDataManager:
         with self._lock:
             tdm = self._tables.get(table)
             if tdm is None:
-                tdm = (RealtimeTableDataManager(table) if realtime
-                       else TableDataManager(table))
+                tdm = (RealtimeTableDataManager(table, upsert_manager)
+                       if realtime else TableDataManager(table))
                 self._tables[table] = tdm
             return tdm
 

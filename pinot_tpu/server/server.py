@@ -67,6 +67,7 @@ class ServerInstance:
         self._started = False
         self._queries_enabled = False
         self._reconcile_lock = threading.RLock()
+        self._upsert_managers: Dict[str, object] = {}
 
     # -- lifecycle (ref: BaseServerStarter.start) ---------------------------
     def start(self) -> None:
@@ -88,6 +89,35 @@ class ServerInstance:
         self.data_manager.shutdown()
         self.store.set_instance_alive(self.instance_id, False)
 
+    def _upsert_manager_for(self, table: str):
+        """TableUpsertMetadataManager for upsert-enabled realtime tables
+        (ref: TableUpsertMetadataManager creation in RealtimeTableDataManager)."""
+        if table in self._upsert_managers:
+            return self._upsert_managers[table]
+        from pinot_tpu.spi.table import UpsertMode
+
+        cfg = self.store.get_table_config(table)
+        if cfg is None:
+            # config not visible yet: decide on a later reconcile instead of
+            # caching a permanent 'no upsert'
+            return None
+        mgr = None
+        if cfg.upsert_config is not None \
+                and cfg.upsert_config.mode is not UpsertMode.NONE:
+            schema = self.store.get_schema(cfg.table_name)
+            if schema is None:
+                return None  # schema lag: retry on the next reconcile
+            if schema.primary_key_columns:
+                from pinot_tpu.segment.upsert import TableUpsertMetadataManager
+
+                cmp_col = (cfg.upsert_config.comparison_column
+                           or cfg.validation_config.time_column_name)
+                mgr = TableUpsertMetadataManager(
+                    schema.primary_key_columns, cmp_col,
+                    cfg.upsert_config.mode)
+        self._upsert_managers[table] = mgr
+        return mgr
+
     # -- state transitions ---------------------------------------------------
     def _on_ideal_state_change(self, path: str, value) -> None:
         if not self._started:
@@ -106,7 +136,10 @@ class ServerInstance:
     def _reconcile_table_locked(self, table: str) -> None:
         ideal = self.store.get_ideal_state(table)
         realtime = table_type_from_name(table) is TableType.REALTIME
-        tdm = self.data_manager.get_or_create(table, realtime=realtime)
+        tdm = self.data_manager.get_or_create(
+            table, realtime=realtime,
+            upsert_manager=self._upsert_manager_for(table) if realtime
+            else None)
 
         my_segments = {seg: states[self.instance_id]
                        for seg, states in ideal.items()
@@ -142,7 +175,12 @@ class ServerInstance:
         local = md.download_url
         if local.startswith("file://"):
             local = local[len("file://"):]
-        tdm.add_segment_from_dir(local)
+        if isinstance(tdm, RealtimeTableDataManager):
+            # upsert tables must register downloaded keys (on_sealed handles
+            # both the upsert and plain realtime cases)
+            tdm.on_sealed(seg, local, partition=md.partition)
+        else:
+            tdm.add_segment_from_dir(local)
         self.store.report_instance_state(table, seg, self.instance_id, ONLINE)
 
     def _ensure_consuming(self, table: str, tdm, seg: str) -> None:

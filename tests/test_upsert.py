@@ -1,0 +1,146 @@
+"""Upsert engine: key->location semantics, valid-doc masking, and the
+full-cluster upsert flow (ref: PartitionUpsertMetadataManager /
+UpsertTableIntegrationTest)."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from pinot_tpu.engine import ServerQueryExecutor
+from pinot_tpu.ingestion import MemoryStream
+from pinot_tpu.query import compile_query
+from pinot_tpu.segment import MutableSegment, SegmentBuilder, load_segment
+from pinot_tpu.segment.upsert import (
+    PartitionUpsertMetadataManager,
+    attach_valid_docs,
+)
+from pinot_tpu.spi import DataType, FieldSpec, FieldType, Schema
+from pinot_tpu.spi.table import (
+    SegmentsValidationConfig,
+    StreamIngestionConfig,
+    TableConfig,
+    TableType,
+    UpsertConfig,
+    UpsertMode,
+)
+from pinot_tpu.tools import EmbeddedCluster
+
+
+def make_schema():
+    return Schema("users", [
+        FieldSpec("uid", DataType.STRING),
+        FieldSpec("status", DataType.STRING),
+        FieldSpec("score", DataType.LONG, FieldType.METRIC),
+        FieldSpec("ts", DataType.LONG, FieldType.DATE_TIME),
+    ], primary_key_columns=["uid"])
+
+
+def build_seg(tmp_path, name, rows):
+    cols = {k: [r[k] for r in rows] for k in rows[0]}
+    SegmentBuilder(make_schema(), name).build(cols, str(tmp_path))
+    return load_segment(f"{tmp_path}/{name}")
+
+
+class TestPartitionUpsertManager:
+    def test_newer_segment_invalidates_older(self, tmp_path):
+        pm = PartitionUpsertMetadataManager(["uid"], "ts")
+        s1 = build_seg(tmp_path, "s1", [
+            {"uid": "a", "status": "new", "score": 1, "ts": 100},
+            {"uid": "b", "status": "new", "score": 2, "ts": 100},
+        ])
+        v1 = pm.add_segment(s1)
+        s2 = build_seg(tmp_path, "s2", [
+            {"uid": "a", "status": "upd", "score": 10, "ts": 200},
+        ])
+        v2 = pm.add_segment(s2)
+        assert list(v1) == [False, True]   # 'a' superseded
+        assert list(v2) == [True]
+        assert pm.num_keys == 2
+
+    def test_older_arrival_is_dropped(self, tmp_path):
+        pm = PartitionUpsertMetadataManager(["uid"], "ts")
+        s1 = build_seg(tmp_path, "s1", [
+            {"uid": "a", "status": "new", "score": 1, "ts": 300}])
+        v1 = pm.add_segment(s1)
+        s2 = build_seg(tmp_path, "s2", [
+            {"uid": "a", "status": "old", "score": 0, "ts": 100}])
+        v2 = pm.add_segment(s2)
+        assert list(v1) == [True]
+        assert list(v2) == [False]  # late, older record never visible
+
+    def test_query_sees_latest_only(self, tmp_path):
+        pm = PartitionUpsertMetadataManager(["uid"], "ts")
+        s1 = build_seg(tmp_path, "s1", [
+            {"uid": "a", "status": "new", "score": 1, "ts": 100},
+            {"uid": "b", "status": "new", "score": 2, "ts": 100},
+        ])
+        s2 = build_seg(tmp_path, "s2", [
+            {"uid": "a", "status": "upd", "score": 10, "ts": 200},
+        ])
+        attach_valid_docs(s1, pm.add_segment(s1))
+        attach_valid_docs(s2, pm.add_segment(s2))
+        ex = ServerQueryExecutor()
+        t, _ = ex.execute(compile_query(
+            "SELECT count(*), sum(score) FROM users"), [s1, s2])
+        assert t.rows[0] == [2, 12.0]  # a=10 (latest), b=2
+        t2, _ = ex.execute(compile_query(
+            "SELECT status, count(*) FROM users GROUP BY status ORDER BY status"),
+            [s1, s2])
+        assert [(r[0], r[1]) for r in t2.rows] == [("new", 1), ("upd", 1)]
+
+    def test_remove_segment_clears_keys(self, tmp_path):
+        pm = PartitionUpsertMetadataManager(["uid"], "ts")
+        s1 = build_seg(tmp_path, "s1", [
+            {"uid": "a", "status": "x", "score": 1, "ts": 100}])
+        pm.add_segment(s1)
+        pm.remove_segment("s1")
+        assert pm.num_keys == 0
+
+
+class TestUpsertCluster:
+    def test_realtime_upsert_e2e(self, tmp_path):
+        """Stream the same keys repeatedly: queries must see exactly one row
+        per key with the latest value, across consuming + sealed segments."""
+        MemoryStream.create("upsert_topic", 1)
+        cluster = EmbeddedCluster(num_servers=1, data_dir=str(tmp_path))
+        schema = make_schema()
+        cfg = TableConfig(
+            "users", TableType.REALTIME,
+            validation_config=SegmentsValidationConfig(time_column_name="ts"),
+            stream_config=StreamIngestionConfig(
+                stream_type="memory", topic="upsert_topic",
+                segment_flush_threshold_rows=60),
+            upsert_config=UpsertConfig(mode=UpsertMode.FULL))
+        cluster.create_table(cfg, schema)
+
+        stream = MemoryStream.get("upsert_topic")
+        rng = np.random.default_rng(3)
+        latest = {}
+        ts = 1000
+        for _ in range(150):
+            uid = f"u{int(rng.integers(0, 20))}"
+            score = int(rng.integers(0, 100))
+            ts += 1
+            latest[uid] = (score, ts)
+            stream.produce({"uid": uid, "status": "s", "score": score,
+                            "ts": ts}, partition=0)
+
+        assert cluster.wait_for_docs("users", len(latest), timeout_s=20)
+        import time
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            rows = cluster.query_rows("SELECT count(*), sum(score) FROM users")
+            if rows[0][0] == len(latest) and \
+                    rows[0][1] == float(sum(s for s, _ in latest.values())):
+                break
+            time.sleep(0.1)
+        assert rows[0][0] == len(latest), (rows, len(latest))
+        assert rows[0][1] == float(sum(s for s, _ in latest.values()))
+
+        # per-key check through the broker
+        rows = cluster.query_rows(
+            "SELECT uid, max(score) FROM users GROUP BY uid ORDER BY uid LIMIT 100")
+        got = {r[0]: r[1] for r in rows}
+        assert got == {k: float(s) for k, (s, _) in latest.items()}
+        cluster.shutdown()
+        MemoryStream.delete("upsert_topic")

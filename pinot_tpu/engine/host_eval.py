@@ -154,9 +154,17 @@ def eval_predicate(segment: ImmutableSegment, pred: Predicate) -> np.ndarray:
     if cm.has_dictionary:
         ids = _matching_dict_ids(ds, pred)
         if cm.single_value:
-            fwd = np.asarray(ds.forward_index[:n])
             if len(ids) == 0:
                 return np.zeros(n, dtype=bool)
+            if cm.has_inverted_index and len(ids) <= max(4, cm.cardinality // 8):
+                # posting lists beat a full scan for selective predicates
+                # (ref: BitmapBasedFilterOperator vs ScanBasedFilterOperator
+                # selection in FilterOperatorUtils)
+                mask = np.zeros(n, dtype=bool)
+                for i in ids:
+                    mask[ds.doc_ids_for_dict_id(int(i))] = True
+                return mask
+            fwd = np.asarray(ds.forward_index[:n])
             if len(ids) == int(ids[-1] - ids[0]) + 1:  # contiguous interval
                 return (fwd >= ids[0]) & (fwd <= ids[-1])
             return np.isin(fwd, ids)

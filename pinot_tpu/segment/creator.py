@@ -11,13 +11,16 @@ Output layout (file-per-index, like the reference's v1 format,
 - ``metadata.json``                   segment + column metadata, CRC
 - ``columns/<col>.dict.npy``          numeric dictionary (sorted values)
 - ``columns/<col>.dictoff.npy`` / ``.dictblob.npy``  string/bytes dictionary
-- ``columns/<col>.fwd.npy``           SV: [padded_capacity] dictIds (narrowest
-                                      int) or raw values; MV: flattened values
+- ``columns/<col>.fwdpk.bin``         SV dict column: fixed-bit packed
+  dictIds over [padded_capacity] (native pack/unpack,
+  ref: FixedBitSVForwardIndexWriter; stored_dtype records ``packed:<bits>``)
+- ``columns/<col>.fwd.npy``           RAW numeric SV values; MV: flattened
+  dictIds
 - ``columns/<col>.mvoff.npy``         MV row offsets [num_docs + 1]
 - ``columns/<col>.null.npy``          optional null bitmap [padded_capacity]
-- ``columns/<col>.invoff.npy`` / ``.inv.npy``  optional CSR inverted index
-  (dictId -> sorted docIds; the host-side stand-in for RoaringBitmap,
-  ref: BitmapInvertedIndexReader.java:34)
+- ``columns/<col>.invoff.npy`` / ``.invbo.npy`` / ``.inv.bin``  optional
+  inverted index: per-dictId delta+varint posting lists (the
+  RoaringBitmap-equivalent form, ref: BitmapInvertedIndexReader.java:34)
 
 Forward indexes are padded to ``padded_capacity`` (multiple of 1024 docs) so
 staged device arrays are tile-aligned; pad rows carry dictId 0 / value 0 and
@@ -27,11 +30,11 @@ are masked by ``doc_id >= num_docs`` in kernels.
 from __future__ import annotations
 
 import os
-import zlib
 from typing import Any, Dict, Iterable, List, Mapping, Optional, Sequence, Union
 
 import numpy as np
 
+from pinot_tpu import native
 from pinot_tpu.segment import metadata as meta
 from pinot_tpu.segment.dictionary import (
     NumericDictionary,
@@ -47,12 +50,11 @@ COLUMNS_DIR = "columns"
 
 def compute_dir_crc(col_dir: str) -> int:
     """CRC over all index files in canonical (sorted-filename) order, for
-    refresh detection (ref: creation.meta CRC, V1Constants.java:56)."""
+    refresh detection (ref: creation.meta CRC, V1Constants.java:56).
+    Native file CRC when the library is available."""
     crc = 0
     for fname in sorted(os.listdir(col_dir)):
-        with open(os.path.join(col_dir, fname), "rb") as f:
-            while chunk := f.read(1 << 20):
-                crc = zlib.crc32(chunk, crc)
+        crc = native.crc32_file(os.path.join(col_dir, fname), crc)
     return crc & 0xFFFFFFFF
 
 RowsInput = Union[Iterable[Mapping[str, Any]], Mapping[str, Sequence[Any]]]
@@ -143,6 +145,16 @@ class SegmentBuilder:
         def load(col: str, suffix: str) -> np.ndarray:
             return np.load(os.path.join(col_dir, f"{col}.{suffix}.npy"))
 
+        def load_fwd(col: str) -> np.ndarray:
+            cm = sm.columns[col]
+            if cm.stored_dtype.startswith("packed:"):
+                bits = int(cm.stored_dtype.split(":", 1)[1])
+                with open(os.path.join(col_dir, f"{col}.fwdpk.bin"),
+                          "rb") as f:
+                    return native.bitunpack(f.read(), sm.padded_capacity,
+                                            bits)
+            return np.load(os.path.join(col_dir, f"{col}.fwd.npy"))
+
         count = 0
         for cfg in configs:
             try:
@@ -152,7 +164,7 @@ class SegmentBuilder:
                     if not (cm.has_dictionary and cm.single_value):
                         raise ValueError(f"dimension {d} must be a "
                                          "dict-encoded SV column")
-                    dim_ids[d] = load(d, "fwd").astype(np.int32)
+                    dim_ids[d] = load_fwd(d).astype(np.int32)
                 metric_vals = {}
                 for fn, col in cfg.function_column_pairs:
                     if col == "*" or col in metric_vals:
@@ -161,7 +173,7 @@ class SegmentBuilder:
                     if not (cm.single_value and cm.data_type.is_numeric):
                         raise ValueError(f"metric {col} must be a numeric "
                                          "SV column")
-                    fwd = load(col, "fwd")
+                    fwd = load_fwd(col)
                     if cm.has_dictionary:
                         metric_vals[col] = load(col, "dict")[fwd]
                     else:
@@ -317,9 +329,16 @@ class SegmentBuilder:
             save("dictblob", dictionary.blob)
 
         if fs.single_value:
-            fwd = np.zeros(capacity, dtype=dtype)
-            fwd[:num_docs] = dict_ids_flat.astype(dtype)
-            save("fwd", fwd)
+            # fixed-bit packed forward index (ref: FixedBitSVForwardIndexWriter
+            # — the dominant scan format; unpacked natively at load into
+            # int32 HBM-staging buffers)
+            bits = native.bits_needed(max(card, 1))
+            fwd = np.zeros(capacity, dtype=np.int32)
+            fwd[:num_docs] = dict_ids_flat.astype(np.int32)
+            with open(os.path.join(col_dir, f"{fs.name}.fwdpk.bin"),
+                      "wb") as f:
+                f.write(native.bitpack(fwd, bits))
+            dtype = f"packed:{bits}"
             sv_ids = dict_ids_flat
             is_sorted = bool(np.all(sv_ids[:-1] <= sv_ids[1:])) if num_docs > 1 else True
             max_mv, total_entries = 0, num_docs
@@ -334,8 +353,9 @@ class SegmentBuilder:
             total_entries = int(offsets[-1])
 
         if want_inverted:
-            self._build_inverted(fs.name, dict_ids_flat, values if not fs.single_value else None,
-                                 num_docs, card, save)
+            self._build_inverted(fs.name, dict_ids_flat,
+                                 values if not fs.single_value else None,
+                                 num_docs, card, save, col_dir=col_dir)
 
         return meta.ColumnMetadata(
             name=fs.name, data_type=fs.data_type, field_type=fs.field_type,
@@ -351,9 +371,12 @@ class SegmentBuilder:
 
     def _build_inverted(self, name: str, dict_ids_flat: np.ndarray,
                         mv_rows: Optional[List[List[Any]]], num_docs: int,
-                        cardinality: int, save) -> None:
-        """CSR inverted index: for each dictId, the sorted docIds containing it
-        (ref: creators under segment/creator/impl/inv/)."""
+                        cardinality: int, save, col_dir: str) -> None:
+        """Inverted index: per dictId, the sorted docIds containing it,
+        stored as delta+varint posting lists (the RoaringBitmap-equivalent
+        compressed form; ref: creators under segment/creator/impl/inv/).
+        ``invoff`` = cumulative doc counts, ``invbo`` = byte offsets into the
+        varint blob."""
         if mv_rows is None:
             doc_ids = np.arange(num_docs, dtype=np.int64)
             ids = dict_ids_flat[:num_docs]
@@ -364,12 +387,15 @@ class SegmentBuilder:
             ids = dict_ids_flat
         order = np.lexsort((doc_ids, ids))
         sorted_ids = ids[order]
-        sorted_docs = doc_ids[order]
+        sorted_docs = doc_ids[order].astype(np.int32)
         offsets = np.zeros(cardinality + 1, dtype=np.int64)
         np.add.at(offsets, sorted_ids + 1, 1)
         offsets = np.cumsum(offsets)
         save("invoff", offsets)
-        save("inv", sorted_docs.astype(np.int32))
+        blob, byte_offsets = native.varint_encode_lists(sorted_docs, offsets)
+        save("invbo", byte_offsets)
+        with open(os.path.join(col_dir, f"{name}.inv.bin"), "wb") as f:
+            f.write(blob)
 
     def _partition_meta(self, col: str, values: List[Any]) -> Dict[str, Any]:
         spc = self.indexing.segment_partition_config

@@ -41,6 +41,20 @@ class DataSource:
     def forward_index(self) -> np.ndarray:
         """SV: [padded_capacity] dictIds or raw values.
         MV: [total_entries] flattened dictIds (use ``mv_offsets``)."""
+        cm = self.metadata
+        if cm.stored_dtype.startswith("packed:"):
+            # fixed-bit packed (native unpack into an int32 staging buffer,
+            # ref: FixedBitSVForwardIndexReaderV2.java:32)
+            from pinot_tpu import native
+
+            bits = int(cm.stored_dtype.split(":", 1)[1])
+            buf = native.MmapBuffer(
+                self._segment._path(self.name, "fwdpk", ext="bin"))
+            try:
+                return native.bitunpack(
+                    buf.read(), self._segment.metadata.padded_capacity, bits)
+            finally:
+                buf.release()
         return self._segment._load_array(self.name, "fwd")
 
     @cached_property
@@ -57,19 +71,36 @@ class DataSource:
 
     @cached_property
     def inverted_index(self) -> Optional[Tuple[np.ndarray, np.ndarray]]:
-        """CSR (offsets[card+1], docIds) or None."""
+        """(doc-count offsets[card+1], byte offsets[card+1]) of the varint
+        posting lists, or None (ref: BitmapInvertedIndexReader.java:34)."""
         if not self.metadata.has_inverted_index:
             return None
         return (self._segment._load_array(self.name, "invoff"),
-                self._segment._load_array(self.name, "inv"))
+                self._segment._load_array(self.name, "invbo"))
+
+    @cached_property
+    def _inv_blob(self):
+        from pinot_tpu import native
+
+        return native.MmapBuffer(
+            self._segment._path(self.name, "inv", ext="bin"))
 
     def doc_ids_for_dict_id(self, dict_id: int) -> np.ndarray:
-        """Inverted lookup: docIds containing dictId."""
+        """Inverted lookup: sorted docIds containing dictId (native varint
+        posting-list decode)."""
+        from pinot_tpu import native
+
         inv = self.inverted_index
         if inv is None:
             raise ValueError(f"no inverted index on column {self.name!r}")
-        offsets, docs = inv
-        return docs[offsets[dict_id]:offsets[dict_id + 1]]
+        offsets, byte_offsets = inv
+        n = int(offsets[dict_id + 1] - offsets[dict_id])
+        if n == 0:
+            return np.empty(0, dtype=np.int32)
+        raw = self._inv_blob.as_array(
+            np.uint8, count=int(byte_offsets[dict_id + 1] - byte_offsets[dict_id]),
+            offset=int(byte_offsets[dict_id]))
+        return native.varint_decode(raw.tobytes(), n)
 
     def dense_mv(self) -> Tuple[np.ndarray, np.ndarray]:
         """Densify the MV column for device staging:
@@ -143,8 +174,9 @@ class ImmutableSegment:
         return trees
 
     # -- loading helpers ---------------------------------------------------
-    def _path(self, column: str, suffix: str) -> str:
-        return os.path.join(self.segment_dir, COLUMNS_DIR, f"{column}.{suffix}.npy")
+    def _path(self, column: str, suffix: str, ext: str = "npy") -> str:
+        return os.path.join(self.segment_dir, COLUMNS_DIR,
+                            f"{column}.{suffix}.{ext}")
 
     def _load_array(self, column: str, suffix: str) -> np.ndarray:
         return np.load(self._path(column, suffix), mmap_mode="r")

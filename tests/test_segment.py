@@ -75,7 +75,8 @@ class TestSegmentBuild:
         assert md.columns["team"].encoding is Encoding.DICT
         assert md.columns["hits"].encoding is Encoding.RAW
         assert md.columns["team"].cardinality == 5
-        assert md.columns["team"].stored_dtype == "int8"
+        # 5 distinct values -> 3-bit fixed-bit packing (native format)
+        assert md.columns["team"].stored_dtype == "packed:3"
         assert md.columns["team"].has_inverted_index
         assert md.crc != 0
 
@@ -131,7 +132,7 @@ class TestSegmentBuild:
         seg = load_segment(seg_dir)
         fwd = seg.data_source("team").forward_index
         assert fwd.shape[0] == md.padded_capacity
-        assert fwd.dtype == np.int8
+        assert fwd.dtype == np.int32  # packed on disk, int32 staging buffer
         assert np.all(np.asarray(fwd[500:]) == 0)  # pad rows are dictId 0
 
     def test_min_max_metadata(self, built_segment):
@@ -211,9 +212,9 @@ class TestEdgeCases:
 
     def test_large_cardinality_dtype(self, tmp_path):
         schema = Schema("t", [FieldSpec("k", DataType.INT)])
-        n = 40_000  # > 2^15 distinct -> int32 dictIds
+        n = 40_000  # > 2^15 distinct -> 16-bit packed dictIds
         md = SegmentBuilder(schema, "t_0").build({"k": list(range(n))}, str(tmp_path))
-        assert md.columns["k"].stored_dtype == "int32"
+        assert md.columns["k"].stored_dtype == "packed:16"
         assert md.padded_capacity % DOC_TILE == 0
         seg = load_segment(str(tmp_path / "t_0"))
         assert seg.get_value("k", n - 1) == n - 1

@@ -1,0 +1,111 @@
+"""gRPC query transport: broker <-> server over the network.
+
+Re-design of the reference's query RPC layer (Netty + thrift
+``InstanceRequest`` at ``transport/QueryServer.java:46`` /
+``ServerChannels.java:55``, and the gRPC alternative
+``transport/grpc/GrpcQueryServer.java:45`` with
+``pinot-common/src/main/proto/server.proto``): a single unary method
+carrying a JSON-framed InstanceRequest (compiled QueryContext + table +
+segment list) and returning DataTable bytes. Generic bytes-in/bytes-out
+method handlers keep the wire layer free of generated stubs (no
+grpcio-tools in the image); the payload framing is the versioned contract.
+
+Multi-host note: this is the DCN leg of the design (SURVEY.md §2.12) —
+broker scatter/gather rides gRPC across hosts, while the intra-host
+multi-chip combine rides ICI collectives inside the sharded executor.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+
+from concurrent import futures
+from typing import List, Optional
+
+import grpc
+
+from pinot_tpu.common.datatable import DataTable
+from pinot_tpu.query.context import QueryContext
+from pinot_tpu.query.serde import context_from_dict, context_to_dict
+
+log = logging.getLogger(__name__)
+
+_SERVICE = "pinot_tpu.QueryServer"
+_METHOD_EXECUTE = f"/{_SERVICE}/Execute"
+
+
+def _encode_request(ctx: QueryContext, table: str,
+                    segments: Optional[List[str]]) -> bytes:
+    return json.dumps({
+        "version": 1,
+        "context": context_to_dict(ctx),
+        "table": table,
+        "segments": segments,
+    }).encode("utf-8")
+
+
+def _decode_request(raw: bytes):
+    d = json.loads(raw.decode("utf-8"))
+    return context_from_dict(d["context"]), d["table"], d.get("segments")
+
+
+class GrpcQueryServer:
+    """Network front of one ServerInstance
+    (ref: GrpcQueryServer.java:45 submit:84)."""
+
+    def __init__(self, server_instance, port: int = 0, max_workers: int = 8):
+        self._instance = server_instance
+        self._grpc = grpc.server(
+            futures.ThreadPoolExecutor(max_workers=max_workers))
+        handler = grpc.method_handlers_generic_handler(_SERVICE, {
+            "Execute": grpc.unary_unary_rpc_method_handler(
+                self._execute,
+                request_deserializer=None,
+                response_serializer=None),
+        })
+        self._grpc.add_generic_rpc_handlers((handler,))
+        self.port = self._grpc.add_insecure_port(f"[::]:{port}")
+
+    def _execute(self, request: bytes, context) -> bytes:
+        try:
+            ctx, table, segments = _decode_request(request)
+            table_result = self._instance.execute_query(ctx, table, segments)
+        except Exception as e:  # errors travel in the DataTable
+            log.debug("grpc execute failed", exc_info=True)
+            table_result = DataTable.for_exception(repr(e))
+        return table_result.to_bytes()
+
+    def start(self) -> None:
+        self._grpc.start()
+
+    def stop(self, grace: float = 5.0) -> None:
+        self._grpc.stop(grace)
+
+
+class GrpcServerStub:
+    """Broker-side remote server handle — same ``execute_query`` surface as
+    an in-process ServerInstance, so it registers with
+    BrokerRequestHandler.register_server unchanged
+    (ref: ServerChannels per-server connection + GrpcQueryClient.java:27)."""
+
+    def __init__(self, address: str, timeout_s: float = 60.0):
+        self.address = address
+        self._channel = grpc.insecure_channel(address)
+        self._call = self._channel.unary_unary(
+            _METHOD_EXECUTE, request_serializer=None,
+            response_deserializer=None)
+        self.timeout_s = timeout_s
+
+    def execute_query(self, ctx: QueryContext, table: str,
+                      segments: Optional[List[str]] = None) -> DataTable:
+        try:
+            raw = self._call(_encode_request(ctx, table, segments),
+                             timeout=self.timeout_s)
+            return DataTable.from_bytes(raw)
+        except grpc.RpcError as e:
+            return DataTable.for_exception(
+                f"rpc to {self.address} failed: {e.code().name}")
+
+    def close(self) -> None:
+        self._channel.close()

@@ -1,0 +1,203 @@
+"""HTTP/REST APIs: controller admin + broker query front door.
+
+Re-design of the reference's Jersey resources — controller
+(``pinot-controller/.../api/resources/*``: tables, schemas, segments,
+rebalance), broker (``pinot-broker/.../api/resources/PinotClientRequest``:
+``POST /query/sql``), server health — on the stdlib threading HTTP server
+(the control plane is not a throughput surface; the data plane is gRPC).
+Endpoint paths and JSON shapes follow the reference so its clients carry
+over.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import re
+import threading
+
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from pinot_tpu.spi.data import Schema
+from pinot_tpu.spi.table import TableConfig
+
+log = logging.getLogger(__name__)
+
+Route = Tuple[str, re.Pattern, Callable]
+
+
+class _Api:
+    """Tiny method+path router on ThreadingHTTPServer."""
+
+    def __init__(self, port: int = 0):
+        self._routes: List[Route] = []
+        api = self
+
+        class Handler(BaseHTTPRequestHandler):
+            # quiet default request logging
+            def log_message(self, fmt, *args):
+                log.debug("http: " + fmt, *args)
+
+            def _dispatch(self, method: str):
+                try:
+                    body = None
+                    n = int(self.headers.get("Content-Length") or 0)
+                    if n:
+                        body = json.loads(self.rfile.read(n).decode("utf-8"))
+                    for m, pat, fn in api._routes:
+                        if m != method:
+                            continue
+                        match = pat.fullmatch(self.path.split("?", 1)[0])
+                        if match:
+                            code, payload = fn(match, body)
+                            raw = json.dumps(payload).encode("utf-8")
+                            self.send_response(code)
+                            self.send_header("Content-Type",
+                                             "application/json")
+                            self.send_header("Content-Length", str(len(raw)))
+                            self.end_headers()
+                            self.wfile.write(raw)
+                            return
+                    self.send_error(404, "no such endpoint")
+                except Exception as e:  # noqa: BLE001 — HTTP boundary
+                    log.exception("request failed: %s %s", method, self.path)
+                    try:
+                        self.send_error(500, str(e)[:200])
+                    except Exception:
+                        pass
+
+            def do_GET(self):
+                self._dispatch("GET")
+
+            def do_POST(self):
+                self._dispatch("POST")
+
+            def do_DELETE(self):
+                self._dispatch("DELETE")
+
+        self._httpd = ThreadingHTTPServer(("0.0.0.0", port), Handler)
+        self.port = self._httpd.server_port
+        self._thread: Optional[threading.Thread] = None
+
+    def route(self, method: str, pattern: str, fn: Callable) -> None:
+        self._routes.append((method, re.compile(pattern), fn))
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True, name="rest-api")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+
+class ControllerApi(_Api):
+    """Ref: controller api/resources (45 Jersey resources, reduced to the
+    operative set: schemas, tables, segments, state, rebalance, health)."""
+
+    def __init__(self, controller, port: int = 0):
+        super().__init__(port)
+        c = controller
+        store = controller.store
+
+        self.route("GET", r"/health",
+                   lambda m, b: (200, {"status": "OK"}))
+        # schemas (ref: PinotSchemaRestletResource)
+        self.route("POST", r"/schemas",
+                   lambda m, b: (200, self._add_schema(c, b)))
+        self.route("GET", r"/schemas",
+                   lambda m, b: (200, store.schema_names()))
+        self.route("GET", r"/schemas/([^/]+)",
+                   lambda m, b: self._get_schema(store, m.group(1)))
+        # tables (ref: PinotTableRestletResource)
+        self.route("POST", r"/tables",
+                   lambda m, b: (200, self._add_table(c, b)))
+        self.route("GET", r"/tables",
+                   lambda m, b: (200, {"tables": store.table_names()}))
+        self.route("DELETE", r"/tables/([^/]+)",
+                   lambda m, b: (200, self._delete_table(c, m.group(1))))
+        self.route("GET", r"/tables/([^/]+)/idealstate",
+                   lambda m, b: (200, store.get_ideal_state(m.group(1))))
+        self.route("GET", r"/tables/([^/]+)/externalview",
+                   lambda m, b: (200, store.get_external_view(m.group(1))))
+        self.route("POST", r"/tables/([^/]+)/rebalance",
+                   lambda m, b: (200, {"steps": c.rebalance_table(
+                       m.group(1), dry_run=bool((b or {}).get("dryRun")))}))
+        # segments (ref: PinotSegmentUploadDownloadRestletResource:102 —
+        # local-path upload; multi-host file upload arrives with deep store)
+        self.route("POST", r"/segments",
+                   lambda m, b: (200, self._add_segment(c, b)))
+        self.route("GET", r"/segments/([^/]+)",
+                   lambda m, b: (200, store.segment_names(m.group(1))))
+        self.route("GET", r"/instances",
+                   lambda m, b: (200, {"instances": [
+                       i.to_dict() for i in store.instances()]}))
+
+    @staticmethod
+    def _add_schema(c, body) -> Dict[str, Any]:
+        schema = Schema.from_dict(body)
+        c.add_schema(schema)
+        return {"status": f"{schema.schema_name} successfully added"}
+
+    @staticmethod
+    def _get_schema(store, name):
+        s = store.get_schema(name)
+        return (404, {"error": f"schema {name} not found"}) if s is None \
+            else (200, s.to_dict())
+
+    @staticmethod
+    def _add_table(c, body) -> Dict[str, Any]:
+        cfg = TableConfig.from_dict(body)
+        c.add_table(cfg)
+        return {"status": f"Table {cfg.table_name_with_type} succesfully "
+                          "added"}
+
+    @staticmethod
+    def _delete_table(c, name) -> Dict[str, Any]:
+        c.delete_table(name)
+        return {"status": f"Table deleted {name}"}
+
+    @staticmethod
+    def _add_segment(c, body) -> Dict[str, Any]:
+        from pinot_tpu.segment.immutable import load_segment
+
+        table = body["tableName"]
+        seg_dir = body["segmentDir"]
+        md = load_segment(seg_dir).metadata
+        c.add_segment(table, md, f"file://{seg_dir}")
+        return {"status": f"Successfully uploaded segment: "
+                          f"{md.segment_name} of table: {table}"}
+
+
+class BrokerApi(_Api):
+    """Ref: broker api/resources PinotClientRequest — POST /query/sql."""
+
+    def __init__(self, broker, port: int = 0):
+        super().__init__(port)
+
+        def query(m, body):
+            sql = (body or {}).get("sql", "")
+            resp = broker.handle_sql(sql)
+            return 200, resp.to_dict()
+
+        self.route("POST", r"/query/sql", query)
+        self.route("GET", r"/health", lambda m, b: (200, {"status": "OK"}))
+        self.route("GET", r"/debug/routing/([^/]+)",
+                   lambda m, b: (200, dict(
+                       broker.routing.get_routing_table(m.group(1))[0])))
+
+
+class ServerAdminApi(_Api):
+    """Ref: server api/resources TablesResource (health + hosted state)."""
+
+    def __init__(self, server_instance, port: int = 0):
+        super().__init__(port)
+        s = server_instance
+        self.route("GET", r"/health", lambda m, b: (200, {"status": "OK"}))
+        self.route("GET", r"/tables",
+                   lambda m, b: (200, {"tables": s.hosted_tables()}))
+        self.route("GET", r"/tables/([^/]+)/segments",
+                   lambda m, b: (200, {m.group(1):
+                                       s.hosted_segments(m.group(1))}))

@@ -13,8 +13,11 @@ host-path / device-path speedup (>1 means the TPU path is faster).
 from __future__ import annotations
 
 import json
+import os
+import sys
 import tempfile
 import time
+import traceback
 
 import numpy as np
 
@@ -85,7 +88,49 @@ def _time_suite(run, ctxs) -> float:
     return float(np.percentile(samples, 50))
 
 
+def _init_backend() -> str:
+    """Initialize a jax backend, surviving TPU-tunnel failures.
+
+    Round-1 postmortem: the bench's single shot at real hardware died in
+    ``jax.devices()`` and captured nothing — and backend init can either
+    raise (UNAVAILABLE) or hang outright, so the probe must run in a
+    subprocess with a hard timeout. If the preferred backend fails twice,
+    fall back to the host platform so a number is always produced (the
+    output records which backend ran).
+    """
+    import subprocess
+
+    for attempt in range(2):
+        try:
+            probe = subprocess.run(
+                [sys.executable, "-c",
+                 "import jax; jax.devices(); print(jax.default_backend())"],
+                capture_output=True, text=True, timeout=150)
+            if probe.returncode == 0:
+                break
+            print(f"bench: backend probe {attempt + 1} failed:\n"
+                  f"{probe.stderr[-500:]}", file=sys.stderr)
+        except subprocess.TimeoutExpired:
+            print(f"bench: backend probe {attempt + 1} timed out",
+                  file=sys.stderr)
+        time.sleep(5.0)
+    else:
+        print("bench: falling back to CPU host platform", file=sys.stderr)
+        os.environ["JAX_PLATFORMS"] = "cpu"
+
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+
+    import jax
+
+    jax.devices()
+    return jax.default_backend()
+
+
 def main() -> None:
+    backend = _init_backend()
+
     from pinot_tpu.engine import ServerQueryExecutor
     from pinot_tpu.parallel import ShardedQueryExecutor
     from pinot_tpu.query import compile_query
@@ -105,7 +150,8 @@ def main() -> None:
         for dr, hr in zip(dev.rows, host.rows):
             for d, h in zip(dr, hr):
                 if isinstance(h, float):
-                    assert abs(d - h) <= 1e-6 * max(1.0, abs(h)), (ctx.sql, d, h)
+                    # device float aggregation is f32 (v5e-shaped); host is f64
+                    assert abs(d - h) <= 1e-4 * max(1.0, abs(h)), (ctx.sql, d, h)
                 else:
                     assert d == h, (ctx.sql, d, h)
 
@@ -118,8 +164,20 @@ def main() -> None:
         "value": round(per_query_ms, 3),
         "unit": "ms/query",
         "vs_baseline": round(host_s / dev_s, 3),
+        "backend": backend,
     }))
 
 
 if __name__ == "__main__":
-    main()
+    try:
+        main()
+    except Exception as exc:  # never leave the round without a JSON line
+        traceback.print_exc(file=sys.stderr)
+        print(json.dumps({
+            "metric": "multi_segment_query_suite_p50_latency",
+            "value": None,
+            "unit": "ms/query",
+            "vs_baseline": None,
+            "error": f"{type(exc).__name__}: {exc}"[:500],
+        }))
+        sys.exit(0)

@@ -120,6 +120,9 @@ class BrokerReduceService:
                     key_idx.append(alias_of[key])
                 else:
                     hidden_names = names[visible_n:]
+                    if key not in hidden_names:
+                        raise QueryError(
+                            f"ORDER BY {key} not found in selection schema")
                     key_idx.append(visible_n + hidden_names.index(key))
             directions = [ob.ascending for ob in ctx.order_by]
 

@@ -48,6 +48,7 @@ class _SegmentFsm:
     committed_offset: Optional[StreamOffset] = None
     first_consumed_ms: float = 0.0
     committed_ms: float = 0.0
+    elected_ms: float = 0.0
     winner_offset: Optional[StreamOffset] = None
 
 
@@ -65,12 +66,20 @@ class SegmentCompletionManager(SegmentCompletionProtocol):
     # replicas with KEEP/DISCARD before being pruned (ref: the reference
     # expires completed FSMs after MAX_COMMIT_TIME)
     COMMITTED_TTL_S = 300.0
+    # max time an elected committer may take before the election re-opens
+    # (ref: SegmentCompletionManager MAX_COMMIT_TIME_FOR_ALL_SEGMENTS_SECONDS
+    # = 1800s); without this, a committer that crashes before calling
+    # segment_stopped_consuming would leave peers at HOLD forever
+    MAX_COMMIT_TIME_S = 1800.0
 
     def __init__(self, num_replicas_provider=None, hold_window_s: float = 0.2,
-                 commit_handler=None):
+                 commit_handler=None, max_commit_time_s: float = None):
         self._fsms: Dict[str, _SegmentFsm] = {}
         self._lock = threading.Lock()
         self._hold_window_s = hold_window_s
+        self._max_commit_time_s = (self.MAX_COMMIT_TIME_S
+                                   if max_commit_time_s is None
+                                   else max_commit_time_s)
         self._num_replicas_provider = num_replicas_provider or (lambda seg: 1)
         self._commit_handler = commit_handler
 
@@ -109,12 +118,26 @@ class SegmentCompletionManager(SegmentCompletionProtocol):
                              FsmState.COMMITTER_NOTIFIED,
                              FsmState.COMMITTER_UPLOADING,
                              FsmState.COMMITTING):
-                if instance == fsm.committer:
+                # committer timed out (crashed without segment_stopped_
+                # consuming): re-open the election so ingestion can't stall.
+                # The committer itself reporting again proves it's alive —
+                # never re-elect on its own call.
+                if (fsm.state is not FsmState.COMMITTING
+                        and instance != fsm.committer
+                        and time.monotonic() - fsm.elected_ms
+                        > self._max_commit_time_s):
+                    fsm.offsets.pop(fsm.committer, None)
+                    fsm.state = FsmState.HOLDING
+                    fsm.committer = None
+                    fsm.winner_offset = None
+                elif instance == fsm.committer:
                     return CompletionReply(CompletionResponse.COMMIT)
-                if offset < fsm.winner_offset:
-                    return CompletionReply(CompletionResponse.CATCHUP,
-                                           target_offset=fsm.winner_offset)
-                return CompletionReply(CompletionResponse.HOLD)
+                else:
+                    if offset < fsm.winner_offset:
+                        return CompletionReply(
+                            CompletionResponse.CATCHUP,
+                            target_offset=fsm.winner_offset)
+                    return CompletionReply(CompletionResponse.HOLD)
 
             # HOLDING: wait for all replicas or the hold window
             all_reported = len(fsm.offsets) >= fsm.num_replicas
@@ -130,6 +153,7 @@ class SegmentCompletionManager(SegmentCompletionProtocol):
             fsm.winner_offset = winner[1]
             fsm.committer = winner[0]
             fsm.state = FsmState.COMMITTER_DECIDED
+            fsm.elected_ms = time.monotonic()
             if instance == fsm.committer:
                 fsm.state = FsmState.COMMITTER_NOTIFIED
                 return CompletionReply(CompletionResponse.COMMIT)

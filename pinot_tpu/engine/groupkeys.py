@@ -18,13 +18,32 @@ def compose_group_keys(code_arrays: Sequence[np.ndarray],
     Returns (unique_keys, group_id_per_row, decode) where ``decode`` maps a
     packed key back to the per-column code tuple. Cardinalities are the
     per-column key-space sizes (the packing strides).
+
+    When the product of cardinalities would overflow int64, falls back to
+    tuple keys via lexicographic np.unique over the stacked code columns
+    (the reference's map/array-based generator past the long-key limit,
+    DictionaryBasedGroupKeyGenerator cardinality ladder).
     """
+    cards = [int(c) for c in cardinalities]
+
+    key_space = 1
+    for card in cards:
+        key_space *= max(card, 1)
+    if key_space >= 2 ** 63:
+        stacked = np.stack([np.asarray(c, dtype=np.int64)
+                            for c in code_arrays], axis=1)
+        uniq_rows, gid = np.unique(stacked, axis=0, return_inverse=True)
+        uniq = np.arange(len(uniq_rows), dtype=np.int64)
+
+        def decode(key: int) -> Tuple[int, ...]:
+            return tuple(int(p) for p in uniq_rows[int(key)])
+
+        return uniq, gid.ravel(), decode
+
     combined = np.asarray(code_arrays[0], dtype=np.int64)
     for codes, card in zip(code_arrays[1:], cardinalities[1:]):
         combined = combined * int(card) + np.asarray(codes, dtype=np.int64)
     uniq, gid = np.unique(combined, return_inverse=True)
-
-    cards = [int(c) for c in cardinalities]
 
     def decode(key: int) -> Tuple[int, ...]:
         parts = []

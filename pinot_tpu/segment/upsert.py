@@ -116,9 +116,15 @@ class PartitionUpsertMetadataManager:
                        cmp_value: Any) -> None:
         loc = self._locations.get(key)
         if loc is not None:
-            # newer-or-equal wins (ref: comparison >= keeps latest arrival)
-            if cmp_value is not None and loc.comparison_value is not None \
-                    and cmp_value < loc.comparison_value:
+            # newer-or-equal wins (ref: comparison >= keeps latest arrival);
+            # a null comparison value is treated as oldest (the reference
+            # requires the comparison column to be non-null, so an incoming
+            # null must never displace a record with a real value)
+            incoming_older = (
+                (cmp_value is None and loc.comparison_value is not None)
+                or (cmp_value is not None and loc.comparison_value is not None
+                    and cmp_value < loc.comparison_value))
+            if incoming_older:
                 # incoming is older: invalidate IT instead
                 valid = self._valid.get(segment_name)
                 if valid is not None and doc_id < valid.shape[0]:

@@ -108,7 +108,12 @@ class HyperLogLog:
 
     @classmethod
     def deserialize(cls, raw: bytes) -> "HyperLogLog":
+        if not raw:
+            raise ValueError("empty HyperLogLog payload")
         log2m = raw[0]
+        if len(raw) != 1 + (1 << log2m):
+            raise ValueError(
+                f"HyperLogLog payload length {len(raw)} != 1 + 2^{log2m}")
         regs = np.frombuffer(raw[1:], dtype=np.uint8).copy()
         return cls(log2m, regs)
 

@@ -234,6 +234,20 @@ class TestCompletionFsm:
         r1 = m.segment_consumed("seg", "s1", StreamOffset(100))
         assert r1.response is CompletionResponse.COMMIT
 
+    def test_committer_timeout_reelects_without_stopped_notification(self):
+        # committer crashes WITHOUT segment_stopped_consuming: after the
+        # max-commit window the election re-opens and a live peer commits
+        # (ref: SegmentCompletionManager MAX_COMMIT_TIME_FOR_ALL_SEGMENTS)
+        m = SegmentCompletionManager(num_replicas_provider=lambda s: 2,
+                                     hold_window_s=0.0,
+                                     max_commit_time_s=0.0)
+        r0 = m.segment_consumed("seg", "s0", StreamOffset(100))
+        assert r0.response is CompletionResponse.COMMIT
+        # s0 dies silently; s1 keeps reporting at the winner offset
+        r1 = m.segment_consumed("seg", "s1", StreamOffset(100))
+        assert r1.response is CompletionResponse.COMMIT
+        assert m._fsms["seg"].committer == "s1"
+
 
 # --------------------------------------------------------------------------
 # controller end-to-end (LLC lifecycle, retention, rebalance)

@@ -28,6 +28,22 @@ import jax.numpy as jnp
 POS_INF = float("inf")
 NEG_INF = float("-inf")
 
+# accumulator dtypes, chosen per aggregation at plan time from column stats
+# (plan._acc_dtype): capacity-sized math runs narrow (v5e has no native
+# f64/i64 units), partials widen to i64/f64 at kernel output so cross-segment
+# merging is exact
+_ACC = {"i32": jnp.int32, "i64": jnp.int64,
+        "f32": jnp.float32, "f64": jnp.float64}
+
+
+def _acc_info(acc: str):
+    """(dtype, widened dtype, min-neutral, max-neutral) for an acc tag."""
+    dt = _ACC[acc]
+    if acc in ("i32", "i64"):
+        info = jnp.iinfo(dt)
+        return dt, jnp.int64, info.max, info.min
+    return dt, jnp.float64, POS_INF, NEG_INF
+
 
 class _ParamCursor:
     """Walks the flat params tuple in the same order the planner wrote it."""
@@ -126,7 +142,8 @@ def _emit_filter(spec: Tuple, cols: Dict[str, Dict[str, jnp.ndarray]],
 # value expression emission
 # --------------------------------------------------------------------------
 
-def _emit_value(vspec: Tuple, cols, pc: _ParamCursor) -> jnp.ndarray:
+def _emit_value(vspec: Tuple, cols, pc: _ParamCursor,
+                compute_dt=jnp.float32) -> jnp.ndarray:
     op = vspec[0]
     if op == "lit":
         return pc.take()
@@ -138,9 +155,9 @@ def _emit_value(vspec: Tuple, cols, pc: _ParamCursor) -> jnp.ndarray:
         return c["fwd"]
     if op == "fn":
         _, name, args = vspec
-        vals = [_emit_value(a, cols, pc) for a in args]
-        a = vals[0].astype(jnp.float64) if hasattr(vals[0], "astype") else vals[0]
-        b = vals[1].astype(jnp.float64) if hasattr(vals[1], "astype") else vals[1]
+        vals = [_emit_value(a, cols, pc, compute_dt) for a in args]
+        a = vals[0].astype(compute_dt) if hasattr(vals[0], "astype") else vals[0]
+        b = vals[1].astype(compute_dt) if hasattr(vals[1], "astype") else vals[1]
         if name == "plus":
             return a + b
         if name == "minus":
@@ -179,7 +196,8 @@ def build_kernel_body(spec: Tuple, capacity_override: int = 0):
         mask = mask & valid
 
         if not group_specs:
-            out: Dict[str, Any] = {"num_matched": mask.sum(dtype=jnp.int64)}
+            out: Dict[str, Any] = {
+                "num_matched": mask.sum(dtype=jnp.int32).astype(jnp.int64)}
             for i, aspec in enumerate(agg_specs):
                 out[f"agg{i}"] = _emit_scalar_agg(aspec, cols, pc, mask)
             return out
@@ -199,8 +217,8 @@ def build_kernel_body(spec: Tuple, capacity_override: int = 0):
         seg_ids = jnp.where(mask, keys, num_groups)  # overflow bucket
         out = {
             "presence": jax.ops.segment_sum(
-                mask.astype(jnp.int64), seg_ids,
-                num_segments=num_groups + 1)[:num_groups]
+                mask.astype(jnp.int32), seg_ids,
+                num_segments=num_groups + 1)[:num_groups].astype(jnp.int64)
         }
         for i, aspec in enumerate(agg_specs):
             out[f"agg{i}"] = _emit_grouped_agg(aspec, cols, pc, mask, seg_ids,
@@ -245,11 +263,19 @@ def partial_reduce_ops(spec: Tuple) -> Dict[str, Tuple[str, ...]]:
 
 
 def _masked_values(aspec, cols, pc, mask):
-    base, mv, vspec = aspec[0], aspec[1], aspec[2]
+    base, mv, vspec, acc = aspec[0], aspec[1], aspec[2], aspec[3]
+    dt, wide, min_neutral, max_neutral = _acc_info(acc)
     # MV values are read inside the MV branch (dense mv + counts), not here
-    vals = (_emit_value(vspec, cols, pc)
+    vals = (_emit_value(vspec, cols, pc, dt)
             if (vspec is not None and not mv) else None)
-    return base, mv, vals
+    if vals is not None and hasattr(vals, "astype"):
+        vals = vals.astype(dt)
+    return base, mv, vals, dt, wide, min_neutral, max_neutral
+
+
+def _count32(mask):
+    """Per-segment doc counts always fit i32; widen for exact merging."""
+    return mask.sum(dtype=jnp.int32).astype(jnp.int64)
 
 
 def _emit_scalar_agg(aspec, cols, pc, mask):
@@ -259,74 +285,95 @@ def _emit_scalar_agg(aspec, cols, pc, mask):
         presence = jnp.zeros(card, dtype=jnp.int32).at[fwd].max(
             mask.astype(jnp.int32), mode="drop")
         return presence  # [card] 0/1; host maps present dictIds -> values
-    base, mv, vals = _masked_values(aspec, cols, pc, mask)
+    base, mv, vals, dt, wide, min_n, max_n = _masked_values(
+        aspec, cols, pc, mask)
+    zero = jnp.zeros((), dtype=dt)
 
     if mv:
         c = cols[aspec[2][1]]
         mvv, cnt = c["dictvals"][c["mv"]], c["mvcount"]
         entry = (jnp.arange(c["mv"].shape[1], dtype=jnp.int32)[None, :]
                  < cnt[:, None]) & mask[:, None]
-        fv = mvv.astype(jnp.float64)
+        fv = mvv.astype(dt)
+        any_entry = entry.any()
         if base == "count":
-            return jnp.where(mask, cnt.astype(jnp.int64), 0).sum()
+            # acc sized at plan time for capacity*max_mv total entries
+            return jnp.where(mask, cnt, 0).sum(dtype=dt).astype(jnp.int64)
         if base == "sum":
-            return jnp.where(entry, fv, 0.0).sum()
+            return jnp.where(entry, fv, zero).sum().astype(wide)
         if base == "min":
-            return jnp.where(entry, fv, POS_INF).min()
+            v = jnp.where(entry, fv, min_n).min().astype(jnp.float64)
+            return jnp.where(any_entry, v, POS_INF)
         if base == "max":
-            return jnp.where(entry, fv, NEG_INF).max()
+            v = jnp.where(entry, fv, max_n).max().astype(jnp.float64)
+            return jnp.where(any_entry, v, NEG_INF)
         if base == "avg":
-            return (jnp.where(entry, fv, 0.0).sum(),
-                    entry.sum(dtype=jnp.int64))
+            return (jnp.where(entry, fv, zero).sum().astype(wide),
+                    entry.sum(dtype=jnp.int32).astype(jnp.int64))
         raise AssertionError(f"MV agg {base} has no device kernel")
 
     if base == "count":
-        return mask.sum(dtype=jnp.int64)
-    fv = vals.astype(jnp.float64) if vals.ndim else jnp.full(mask.shape[0],
-                                                             vals,
-                                                             dtype=jnp.float64)
+        return _count32(mask)
+    fv = vals if vals.ndim else jnp.full(mask.shape[0], vals, dtype=dt)
+    any_match = mask.any()
     if base == "sum":
-        return jnp.where(mask, fv, 0.0).sum()
+        return jnp.where(mask, fv, zero).sum().astype(wide)
     if base == "min":
-        return jnp.where(mask, fv, POS_INF).min()
+        v = jnp.where(mask, fv, min_n).min().astype(jnp.float64)
+        return jnp.where(any_match, v, POS_INF)
     if base == "max":
-        return jnp.where(mask, fv, NEG_INF).max()
+        v = jnp.where(mask, fv, max_n).max().astype(jnp.float64)
+        return jnp.where(any_match, v, NEG_INF)
     if base == "avg":
-        return (jnp.where(mask, fv, 0.0).sum(), mask.sum(dtype=jnp.int64))
+        return (jnp.where(mask, fv, zero).sum().astype(wide), _count32(mask))
     if base == "minmaxrange":
-        return (jnp.where(mask, fv, POS_INF).min(),
-                jnp.where(mask, fv, NEG_INF).max())
+        lo = jnp.where(mask, fv, min_n).min().astype(jnp.float64)
+        hi = jnp.where(mask, fv, max_n).max().astype(jnp.float64)
+        return (jnp.where(any_match, lo, POS_INF),
+                jnp.where(any_match, hi, NEG_INF))
     raise AssertionError(f"agg {base} has no device scalar kernel")
 
 
 def _emit_grouped_agg(aspec, cols, pc, mask, seg_ids, num_groups):
-    base, mv, vals = _masked_values(aspec, cols, pc, mask)
+    base, mv, vals, dt, wide, min_n, max_n = _masked_values(
+        aspec, cols, pc, mask)
     n = num_groups + 1
+    zero = jnp.zeros((), dtype=dt)
+
+    def cnt32(m):
+        return jax.ops.segment_sum(
+            m.astype(jnp.int32), seg_ids,
+            num_segments=n)[:num_groups].astype(jnp.int64)
+
     if base == "count":
-        return jax.ops.segment_sum(mask.astype(jnp.int64), seg_ids,
-                                   num_segments=n)[:num_groups]
-    fv = vals.astype(jnp.float64) if vals.ndim else jnp.full(mask.shape[0],
-                                                             vals,
-                                                             dtype=jnp.float64)
+        return cnt32(mask)
+    fv = vals if vals.ndim else jnp.full(mask.shape[0], vals, dtype=dt)
+    # empty-group neutrals survive into the output here (unlike the scalar
+    # path); they are masked out downstream by `presence` at decode, and
+    # cross-segment pmin/pmax treat them as identities
     if base == "sum":
-        return jax.ops.segment_sum(jnp.where(mask, fv, 0.0), seg_ids,
-                                   num_segments=n)[:num_groups]
+        return jax.ops.segment_sum(
+            jnp.where(mask, fv, zero), seg_ids,
+            num_segments=n)[:num_groups].astype(wide)
     if base == "min":
-        return jax.ops.segment_min(jnp.where(mask, fv, POS_INF), seg_ids,
-                                   num_segments=n)[:num_groups]
+        return jax.ops.segment_min(
+            jnp.where(mask, fv, min_n), seg_ids,
+            num_segments=n)[:num_groups].astype(jnp.float64)
     if base == "max":
-        return jax.ops.segment_max(jnp.where(mask, fv, NEG_INF), seg_ids,
-                                   num_segments=n)[:num_groups]
+        return jax.ops.segment_max(
+            jnp.where(mask, fv, max_n), seg_ids,
+            num_segments=n)[:num_groups].astype(jnp.float64)
     if base == "avg":
-        return (jax.ops.segment_sum(jnp.where(mask, fv, 0.0), seg_ids,
-                                    num_segments=n)[:num_groups],
-                jax.ops.segment_sum(mask.astype(jnp.int64), seg_ids,
-                                    num_segments=n)[:num_groups])
+        return (jax.ops.segment_sum(
+            jnp.where(mask, fv, zero), seg_ids,
+            num_segments=n)[:num_groups].astype(wide), cnt32(mask))
     if base == "minmaxrange":
-        return (jax.ops.segment_min(jnp.where(mask, fv, POS_INF), seg_ids,
-                                    num_segments=n)[:num_groups],
-                jax.ops.segment_max(jnp.where(mask, fv, NEG_INF), seg_ids,
-                                    num_segments=n)[:num_groups])
+        return (jax.ops.segment_min(
+            jnp.where(mask, fv, min_n), seg_ids,
+            num_segments=n)[:num_groups].astype(jnp.float64),
+                jax.ops.segment_max(
+            jnp.where(mask, fv, max_n), seg_ids,
+            num_segments=n)[:num_groups].astype(jnp.float64))
     raise AssertionError(f"agg {base} has no device grouped kernel")
 
 

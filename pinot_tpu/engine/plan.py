@@ -43,6 +43,8 @@ from pinot_tpu.spi.data import DataType
 # (the reference's analogue knob: numGroupsLimit, InstancePlanMakerImplV2.java:67)
 MAX_DEVICE_GROUPS = 1 << 21
 
+_I32_MAX = np.iinfo(np.int32).max
+
 _ARITH_OPS = {"plus", "minus", "times", "divide", "mod"}
 
 
@@ -124,6 +126,7 @@ def plan_segment(ctx: QueryContext, segment: ImmutableSegment) -> SegmentPlan:
             raise PlanError(f"aggregation {agg.name} not device-supported "
                             f"{'grouped' if grouped else 'scalar'}")
         vexpr = agg_value_expr(fn)
+        fanout = 1
         if vexpr is None:
             vspec = None
         elif agg.mv:
@@ -133,6 +136,7 @@ def plan_segment(ctx: QueryContext, segment: ImmutableSegment) -> SegmentPlan:
             if cm.single_value or not cm.data_type.is_numeric:
                 raise PlanError(f"{agg.name} needs a numeric MV column")
             vspec = ("colmv", vexpr.name)
+            fanout = max(1, cm.max_num_multi_values)
             if vexpr.name not in columns:
                 columns.append(vexpr.name)
         else:
@@ -148,7 +152,8 @@ def plan_segment(ctx: QueryContext, segment: ImmutableSegment) -> SegmentPlan:
             if vexpr.name not in columns:
                 columns.append(vexpr.name)
         else:
-            agg_specs.append((agg.base, agg.mv, vspec))
+            acc = _acc_dtype(agg.base, vexpr, segment, fanout)
+            agg_specs.append((agg.base, agg.mv, vspec, acc))
 
     spec = (filter_spec, tuple(agg_specs), tuple(group_specs), num_groups,
             segment.padded_capacity)
@@ -156,6 +161,53 @@ def plan_segment(ctx: QueryContext, segment: ImmutableSegment) -> SegmentPlan:
                        group_defs=group_defs, group_cards=group_cards,
                        group_strides=strides, num_groups=num_groups,
                        agg_defs=agg_defs)
+
+
+# --------------------------------------------------------------------------
+# accumulator narrowing (v5e-shaped kernels: f64/i64 are emulated on TPU, so
+# capacity-sized accumulation runs in i32/f32 whenever column stats bound the
+# values; partials are widened to i64/f64 at kernel output for exact
+# cross-segment merging)
+# --------------------------------------------------------------------------
+
+def _value_kind(e: Expr, segment: ImmutableSegment):
+    """('int', max_abs|None) when the expression is integral on device,
+    ('float', None) otherwise. Arithmetic expressions accumulate as float."""
+    if isinstance(e, Literal):
+        if isinstance(e.value, bool) or isinstance(e.value, int):
+            return ("int", abs(int(e.value)))
+        return ("float", None)
+    if isinstance(e, Identifier):
+        cm = segment.metadata.column(e.name)
+        if cm.data_type.is_integral:
+            if cm.min_value is None or cm.max_value is None:
+                return ("int", None)
+            return ("int", max(abs(int(cm.min_value)),
+                               abs(int(cm.max_value))))
+        return ("float", None)
+    return ("float", None)
+
+
+def _acc_dtype(base: str, vexpr: Optional[Expr], segment: ImmutableSegment,
+               fanout: int = 1) -> str:
+    """``fanout`` is the MV entries-per-doc bound (1 for SV): MV sums/counts
+    accumulate up to capacity*fanout terms, not capacity."""
+    if vexpr is None:  # count(*): docs per segment always fit i32
+        return "i32"
+    if base == "count":
+        # count(col) counts docs (SV) or total MV entries (fanout > 1)
+        return ("i32" if segment.padded_capacity * fanout <= _I32_MAX
+                else "i64")
+    kind, max_abs = _value_kind(vexpr, segment)
+    if kind == "float":
+        return "f32"
+    if base in ("min", "max", "minmaxrange"):
+        return "i32" if (max_abs is not None and max_abs <= _I32_MAX) else "i64"
+    # sum/avg: the whole-segment sum must fit the accumulator exactly
+    if (max_abs is not None
+            and max_abs * segment.padded_capacity * fanout <= _I32_MAX):
+        return "i32"
+    return "i64"
 
 
 # --------------------------------------------------------------------------
@@ -281,16 +333,31 @@ def _compile_predicate(pred: Predicate, segment: ImmutableSegment,
     if not cm.single_value:
         raise PlanError("raw MV column predicate -> host path")
     if t in (PredicateType.EQ, PredicateType.NOT_EQ):
-        params.append(_raw_param(cm.data_type, _conv(ds, pred.value)))
+        v = _conv(ds, pred.value)
+        dt = _raw_np_dtype(cm)
+        if cm.data_type.is_integral:
+            info = np.iinfo(dt)
+            if not (info.min <= int(v) <= info.max):
+                # literal outside the staged dtype's range can't match any
+                # stored value (all values fit the narrowed dtype)
+                return ("false",) if t is PredicateType.EQ else ("true",)
+        params.append(np.asarray(v, dtype=dt))
         return ("veq" if t is PredicateType.EQ else "vneq", col)
     if t is PredicateType.RANGE:
-        lo, hi = _raw_bounds(cm.data_type, ds, pred)
+        bounds = _raw_bounds(cm, ds, pred)
+        if bounds is None:  # range provably empty for the staged dtype
+            return ("false",)
+        lo, hi, lo_inc, hi_inc = bounds
         params.append(lo)
         params.append(hi)
-        return ("vrange", col, pred.lower_inclusive, pred.upper_inclusive)
+        return ("vrange", col, lo_inc, hi_inc)
     if t in (PredicateType.IN, PredicateType.NOT_IN):
-        vals = np.array([_conv(ds, v) for v in pred.values],
-                        dtype=cm.data_type.stored_np)
+        dt = _raw_np_dtype(cm)
+        conv = [_conv(ds, v) for v in pred.values]
+        if cm.data_type.is_integral:
+            info = np.iinfo(dt)
+            conv = [v for v in conv if info.min <= int(v) <= info.max]
+        vals = np.array(conv, dtype=dt)
         if vals.size == 0:
             return ("false",) if t is PredicateType.IN else ("true",)
         params.append(vals)
@@ -298,22 +365,50 @@ def _compile_predicate(pred: Predicate, segment: ImmutableSegment,
     raise PlanError(f"predicate {t} on raw column -> host path")
 
 
-def _raw_param(dt: DataType, v: Any) -> np.ndarray:
-    return np.asarray(v, dtype=np.int64 if dt.is_integral else np.float64)
+def _raw_np_dtype(cm) -> np.dtype:
+    """Param dtype matching the staged raw forward array (no promotion)."""
+    from pinot_tpu.engine.staging import staged_int_dtype
+
+    return (staged_int_dtype(cm) if cm.data_type.is_integral
+            else np.dtype(np.float64))
 
 
-def _raw_bounds(dt: DataType, ds: DataSource, pred: Predicate):
-    if dt.is_integral:
-        lo = np.int64(_conv(ds, pred.lower)) if pred.lower is not None \
-            else np.int64(np.iinfo(np.int64).min)
-        hi = np.int64(_conv(ds, pred.upper)) if pred.upper is not None \
-            else np.int64(np.iinfo(np.int64).max)
-    else:
-        lo = np.float64(_conv(ds, pred.lower)) if pred.lower is not None \
-            else np.float64(float("-inf"))
-        hi = np.float64(_conv(ds, pred.upper)) if pred.upper is not None \
-            else np.float64(float("inf"))
-    return lo, hi
+def _raw_bounds(cm, ds: DataSource, pred: Predicate):
+    """(lo, hi, lo_inclusive, hi_inclusive) in the staged dtype, or None if
+    the range is provably empty. A literal outside the narrowed dtype's range
+    either makes the bound unrestrictive (replace with an inclusive dtype
+    extreme — every stored value fits the dtype) or the range empty."""
+    dt = _raw_np_dtype(cm)
+    lo_inc, hi_inc = pred.lower_inclusive, pred.upper_inclusive
+    if cm.data_type.is_integral:
+        info = np.iinfo(dt)
+        if pred.lower is None:
+            lo, lo_inc = info.min, True
+        else:
+            lv = int(_conv(ds, pred.lower))
+            if lv > info.max:
+                return None          # x >/>= lv is impossible
+            if lv < info.min:
+                lo, lo_inc = info.min, True   # bound unrestrictive
+            else:
+                lo = lv
+        if pred.upper is None:
+            hi, hi_inc = info.max, True
+        else:
+            uv = int(_conv(ds, pred.upper))
+            if uv < info.min:
+                return None          # x </<= uv is impossible
+            if uv > info.max:
+                hi, hi_inc = info.max, True   # bound unrestrictive
+            else:
+                hi = uv
+        return (np.asarray(lo, dtype=dt), np.asarray(hi, dtype=dt),
+                lo_inc, hi_inc)
+    lo = np.float64(_conv(ds, pred.lower)) if pred.lower is not None \
+        else np.float64(float("-inf"))
+    hi = np.float64(_conv(ds, pred.upper)) if pred.upper is not None \
+        else np.float64(float("inf"))
+    return lo, hi, lo_inc, hi_inc
 
 
 def _build_lut(ds: DataSource, pred: Predicate) -> np.ndarray:

@@ -25,10 +25,22 @@ from pinot_tpu.segment.immutable import ImmutableSegment
 from pinot_tpu.spi.data import DataType
 
 
-# accumulation dtypes (x64 enabled in engine __init__; on TPU f64/i64 are
-# emulated — metadata-driven narrowing to f32/i32 is a planned optimization)
-VALUE_DTYPE = jnp.float64
-INT_VALUE_DTYPE = jnp.int64
+# Metadata-driven narrowing: v5e has no native f64/i64 units (XLA emulates
+# them as f32/i32 pairs), so capacity-sized device arrays are narrowed
+# whenever column min/max bounds allow. Raw FLOAT/DOUBLE forward arrays stay
+# f64: filter literals compare against exact stored values and rounding to
+# f32 could flip boundary rows (dictionary columns filter on dictIds, so
+# their value tables narrow safely to f32).
+_I32_MIN, _I32_MAX = np.iinfo(np.int32).min, np.iinfo(np.int32).max
+
+
+def staged_int_dtype(cm) -> np.dtype:
+    """Device dtype for an integral column's values, from stats min/max."""
+    if (cm.min_value is not None and cm.max_value is not None
+            and _I32_MIN <= int(cm.min_value)
+            and int(cm.max_value) <= _I32_MAX):
+        return np.dtype(np.int32)
+    return np.dtype(np.int64)
 
 
 class StagedColumn:
@@ -81,9 +93,10 @@ class StagedSegment:
             if cm.has_dictionary:
                 sc.fwd = jnp.asarray(fwd.astype(np.int32))
             else:
-                # RAW numeric values: keep integral as int64, floats as f64
+                # RAW values: integral narrowed by stats bounds; floats stay
+                # f64 for exact filter-literal comparison (see module note)
                 if cm.data_type.is_integral:
-                    sc.fwd = jnp.asarray(fwd.astype(np.int64))
+                    sc.fwd = jnp.asarray(fwd.astype(staged_int_dtype(cm)))
                 else:
                     sc.fwd = jnp.asarray(fwd.astype(np.float64))
         else:
@@ -94,9 +107,9 @@ class StagedSegment:
         if cm.has_dictionary and cm.data_type.is_numeric:
             vals = np.asarray(ds.dictionary.device_values())
             if cm.data_type.is_integral:
-                sc.dictvals = jnp.asarray(vals.astype(np.int64))
+                sc.dictvals = jnp.asarray(vals.astype(staged_int_dtype(cm)))
             else:
-                sc.dictvals = jnp.asarray(vals.astype(np.float64))
+                sc.dictvals = jnp.asarray(vals.astype(np.float32))
 
         if cm.has_nulls:
             sc.null = jnp.asarray(np.asarray(ds.null_bitmap))

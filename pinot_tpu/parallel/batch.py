@@ -203,7 +203,12 @@ class SegmentBatch:
                     raw = np.asarray(seg.data_source(name).forward_index)
                     fwd[i, :raw.shape[0]] = self._remaps[name][i][raw]
             else:
-                dt = np.int64 if cm.data_type.is_integral else np.float64
+                # same narrowing contract as engine/staging.py: integral by
+                # stats bounds; raw floats stay f64 for exact filter literals
+                from pinot_tpu.engine.staging import staged_int_dtype
+
+                dt = (staged_int_dtype(cm) if cm.data_type.is_integral
+                      else np.float64)
                 fwd = np.zeros((S, cap), dtype=dt)
                 for i, seg in enumerate(self.segments):
                     raw = np.asarray(seg.data_source(name).forward_index)
@@ -222,9 +227,12 @@ class SegmentBatch:
             out["mvcount"] = cnt
 
         if cm.has_dictionary and cm.data_type.is_numeric:
+            from pinot_tpu.engine.staging import staged_int_dtype
+
             vals = np.asarray(self._dicts[name].device_values())
             out["dictvals"] = vals.astype(
-                np.int64 if cm.data_type.is_integral else np.float64)
+                staged_int_dtype(cm) if cm.data_type.is_integral
+                else np.float32)
 
         if cm.has_nulls:
             nb = np.zeros((S, cap), dtype=bool)

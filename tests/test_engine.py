@@ -93,6 +93,22 @@ def rows(executor, segments, sql):
     return run(executor, segments, sql).rows
 
 
+# device float aggregation is f32 (metadata-narrowed for v5e); the pandas /
+# host oracle is f64 — float parity is to f32-accumulation precision
+FLOAT_REL = 1e-5
+
+
+def assert_rows_close(got, want, rel=FLOAT_REL):
+    assert len(got) == len(want), (got, want)
+    for gr, wr in zip(got, want):
+        assert len(gr) == len(wr), (gr, wr)
+        for g, w in zip(gr, wr):
+            if isinstance(w, float):
+                assert g == pytest.approx(w, rel=rel, abs=1e-9), (gr, wr)
+            else:
+                assert g == w, (gr, wr)
+
+
 class TestAggregationParity:
     SQL = "SELECT count(*), sum(runs), min(score), max(score), avg(runs), minmaxrange(year) FROM stats WHERE team = 'BOS'"
 
@@ -108,11 +124,12 @@ class TestAggregationParity:
         exp = self._expected(df)
         assert got[0] == exp[0]
         for g, e in zip(got[1:], exp[1:]):
-            assert g == pytest.approx(e, rel=1e-12)
+            assert g == pytest.approx(e, rel=FLOAT_REL)
 
     def test_host_matches_device(self, setup, device_exec, host_exec):
         df, segs = setup
-        assert rows(host_exec, segs, self.SQL) == rows(device_exec, segs, self.SQL)
+        assert_rows_close(rows(device_exec, segs, self.SQL),
+                          rows(host_exec, segs, self.SQL))
 
 
 class TestFilters:
@@ -188,7 +205,8 @@ class TestGroupBy:
 
     def test_host_matches_device(self, setup, device_exec, host_exec):
         df, segs = setup
-        assert rows(host_exec, segs, self.SQL) == rows(device_exec, segs, self.SQL)
+        assert_rows_close(rows(device_exec, segs, self.SQL),
+                          rows(host_exec, segs, self.SQL))
 
     def test_multi_column_group(self, setup, device_exec):
         df, segs = setup
@@ -197,7 +215,7 @@ class TestGroupBy:
                    "GROUP BY league, team ORDER BY league, team LIMIT 100")
         g = df.groupby(["league", "team"]).score.mean().reset_index()
         g = g.sort_values(["league", "team"])
-        exp = [[r.league, r.team, pytest.approx(r.score, rel=1e-12)]
+        exp = [[r.league, r.team, pytest.approx(r.score, rel=FLOAT_REL)]
                for r in g.itertuples()]
         assert got == exp
 
@@ -222,7 +240,8 @@ class TestGroupBy:
         # salary is raw (no dictionary): host and device must agree
         sql = ("SELECT year, sum(salary) FROM stats GROUP BY year "
                "ORDER BY year LIMIT 40")
-        assert rows(device_exec, setup[1], sql) == rows(host_exec, setup[1], sql)
+        assert_rows_close(rows(device_exec, setup[1], sql),
+                          rows(host_exec, setup[1], sql))
 
     def test_post_aggregation(self, setup, device_exec):
         df, segs = setup
@@ -230,7 +249,7 @@ class TestGroupBy:
                    "SELECT team, sum(runs) / count(*) FROM stats GROUP BY team "
                    "ORDER BY team LIMIT 10")
         g = df.groupby("team").agg(s=("runs", "sum"), c=("runs", "size"))
-        exp = [[t, pytest.approx(r.s / r.c, rel=1e-12)] for t, r in
+        exp = [[t, pytest.approx(r.s / r.c, rel=FLOAT_REL)] for t, r in
                g.sort_index().iterrows()]
         assert got == exp
 

@@ -95,7 +95,7 @@ def test_sharded_matches_per_segment(setup, sharded_exec, base_exec, sql):
     for gr, wr in zip(got.rows, want.rows):
         for g, w in zip(gr, wr):
             if isinstance(w, float):
-                assert g == pytest.approx(w, rel=1e-9)
+                assert g == pytest.approx(w, rel=1e-5)  # f32 device accumulation
             else:
                 assert g == w
 

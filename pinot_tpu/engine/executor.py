@@ -38,14 +38,35 @@ class ServerQueryExecutor:
     """One per server instance; owns the staging + kernel caches."""
 
     def __init__(self, use_device: bool = True,
-                 num_groups_limit: int = CommonConstants.DEFAULT_NUM_GROUPS_LIMIT):
+                 num_groups_limit: int = CommonConstants.DEFAULT_NUM_GROUPS_LIMIT,
+                 use_pallas: Optional[bool] = None):
         from pinot_tpu.engine import ensure_x64
+        from pinot_tpu.engine.pallas_kernels import PallasKernelCache
 
         ensure_x64()
         self.staging = StagingCache()
         self.kernels = KernelCache()
+        self.pallas_kernels = PallasKernelCache()
         self.use_device = use_device
+        # pallas kernels compile for real TPUs; on the CPU backend they run
+        # only in (slow) interpret mode, so auto-enable on TPU and leave
+        # interpret mode to tests that opt in explicitly
+        self.use_pallas = use_pallas
         self.num_groups_limit = num_groups_limit
+
+    def _pallas_mode(self) -> Optional[bool]:
+        """None = disabled; True/False = enabled (interpret or compiled)."""
+        import jax
+
+        backend = jax.default_backend()
+        if self.use_pallas is None:
+            # auto: compiled pallas only on TPU-like backends (the kernels
+            # use pltpu memory spaces and cannot lower on GPU)
+            return False if backend not in ("cpu", "gpu", "cuda", "rocm") \
+                else None
+        if not self.use_pallas:
+            return None
+        return backend == "cpu"  # interpret on CPU
 
     # -- public ------------------------------------------------------------
     def execute_instance(self, ctx: QueryContext,
@@ -245,8 +266,32 @@ class ServerQueryExecutor:
 
     def _run_device_grouped(self, plan: SegmentPlan, seg: ImmutableSegment,
                             stats: QueryStats) -> GroupByResult:
-        out = self._run_kernel(plan, seg, stats)
+        out = self._try_pallas_grouped(plan, seg, stats)
+        if out is None:
+            out = self._run_kernel(plan, seg, stats)
         return decode_grouped_result(plan, seg, out)
+
+    def _try_pallas_grouped(self, plan: SegmentPlan, seg: ImmutableSegment,
+                            stats: QueryStats) -> Optional[Dict[str, Any]]:
+        from pinot_tpu.engine import pallas_kernels
+
+        interpret = self._pallas_mode()
+        if interpret is None:
+            return None
+        staged = self.staging.stage(seg)
+        try:
+            out = pallas_kernels.run_group_by(plan, staged,
+                                              self.pallas_kernels, interpret)
+        except Exception:  # lowering/compile failure -> jnp kernels
+            import logging
+
+            logging.getLogger(__name__).exception(
+                "pallas kernel failed; disabling pallas for this executor")
+            self.use_pallas = False
+            return None
+        if out is not None:
+            self._track_kernel_stats(out, seg, stats)
+        return out
 
     # -- shared ------------------------------------------------------------
     def _run_kernel(self, plan: SegmentPlan, seg: ImmutableSegment,
@@ -255,13 +300,17 @@ class ServerQueryExecutor:
         cols = {name: staged.column(name).tree() for name in plan.columns}
         kernel = self.kernels.get(plan.spec)
         out = kernel(cols, tuple(plan.params), np.int32(seg.num_docs))
+        self._track_kernel_stats(out, seg, stats)
+        return out
+
+    def _track_kernel_stats(self, out: Dict[str, Any], seg: ImmutableSegment,
+                            stats: QueryStats) -> None:
         stats.num_segments_processed += 1
         stats.total_docs += seg.num_docs
         matched = int(out.get("num_matched",
                               np.asarray(out.get("presence", [0])).sum()))
         stats.num_docs_scanned += matched
         stats.num_segments_matched += 1 if matched else 0
-        return out
 
     def _validate_columns(self, ctx: QueryContext,
                           seg: ImmutableSegment) -> None:

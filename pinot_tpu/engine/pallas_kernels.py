@@ -1,0 +1,365 @@
+"""Fused Pallas scan kernel: bit-unpack -> predicate -> group-by matmul.
+
+TPU-native re-design of the reference's hottest loop — the per-segment
+``Filter -> Projection -> GroupBy`` chain (``SVScanDocIdIterator.java:36``
+predicate scan, ``PinotDataBitSet.java:25`` bit extraction,
+``DefaultGroupByExecutor`` scatter into group slots) — as ONE Pallas kernel:
+
+- forward indexes arrive as **planar bit-packed words** (engine/staging.py
+  PackedColumn): a tile's value ``j`` lives in word ``j % W`` at bit slot
+  ``(j // W) * B``, so the in-VMEM unpack is ``K = 32/B`` static shift+mask
+  ops over contiguous words — vector ops only, no gathers;
+- predicates are dictId-interval compares (sorted dictionaries turn EQ/RANGE
+  into intervals, the vectorized form of dictionary-based predicate
+  evaluators) AND-composed into one doc mask;
+- group aggregation is a **one-hot matmul on the MXU**: rows
+  ``[mask, masked values...] @ one_hot(keys)`` accumulate ``[aggs, groups]``
+  partials — the fixed-shape scatter-add replacement for
+  ``GroupByResultHolder``. Integer aggregations keep an exact i32
+  accumulator (per-tile matmul results are exactly representable in f32 by
+  a plan-time bound, then rounded into i32); float aggregations accumulate
+  f32.
+
+Eligibility is decided per plan (`extract_spec`); anything else falls back
+to the jnp masked-vector kernels (engine/kernels.py).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from pinot_tpu.engine.staging import PALLAS_TILE, StagedSegment
+
+# one-hot chunk width along the group dimension (lane count)
+_G_CHUNK = 128
+# max padded group count the pallas path handles (VMEM + unroll bound)
+MAX_PALLAS_GROUPS = 4096
+# per-tile int matmul partials must be exact in f32: max |value| * TILE < 2^24
+_F32_EXACT = 1 << 24
+
+
+@dataclass(frozen=True)
+class PallasGroupSpec:
+    """Hashable kernel-cache key (all static shapes/strides)."""
+
+    num_tiles: int
+    packed_bits: Tuple[int, ...]          # per packed input column
+    filters: Tuple[Tuple[int, bool], ...]  # (packed input idx, negate)
+    group_idx: Tuple[int, ...]            # packed input idx per group col
+    group_strides: Tuple[int, ...]
+    num_groups_padded: int                # multiple of 128
+    # per agg: ("count", None) | ("sum"|"avg", value input idx)
+    aggs: Tuple[Tuple[str, Optional[int]], ...]
+    value_is_int: Tuple[bool, ...]        # per value input
+    interpret: bool
+
+
+class _Ineligible(Exception):
+    pass
+
+
+# --------------------------------------------------------------------------
+# plan -> PallasGroupSpec (+ runtime params)
+# --------------------------------------------------------------------------
+
+def extract_spec(plan, staged: StagedSegment, interpret: bool):
+    """(spec, params_i32, packed_cols, value_cols) or None if the plan shape
+    isn't covered by the fused kernel."""
+    from pinot_tpu.engine.kernels import _ParamCursor
+
+    filter_spec, agg_specs, group_specs, num_groups, capacity = plan.spec
+    if not group_specs or num_groups == 0:
+        return None
+    if num_groups > MAX_PALLAS_GROUPS:
+        return None
+
+    try:
+        packed_names: List[str] = []
+
+        def packed_idx(col: str) -> int:
+            if col not in packed_names:
+                packed_names.append(col)
+            return packed_names.index(col)
+
+        # -- filter tree -> interval list (mirrors kernels._emit_filter's
+        # param consumption order exactly)
+        pc = _ParamCursor(plan.params)
+        take_param = pc.take
+
+        filters: List[Tuple[int, bool, int, int]] = []  # (idx, neg, lo, hi)
+
+        def walk(node):
+            op = node[0]
+            if op == "true":
+                return
+            if op == "and":
+                for child in node[1]:
+                    walk(child)
+                return
+            if op in ("eq", "neq"):
+                did = int(take_param())
+                filters.append((packed_idx(node[1]), op == "neq", did, did))
+                return
+            if op == "range":
+                iv = np.asarray(take_param())
+                filters.append((packed_idx(node[1]), False,
+                                int(iv[0]), int(iv[1])))
+                return
+            raise _Ineligible(op)
+
+        walk(filter_spec)
+
+        # -- group columns (params: strides + bases arrays)
+        group_idx = []
+        for strat, col in group_specs:
+            if strat != "gdict":
+                raise _Ineligible("raw group key")
+            group_idx.append(packed_idx(col))
+        strides = [int(s) for s in np.asarray(take_param())]
+        take_param()  # bases (gdict bases are 0)
+
+        # -- aggregations
+        value_names: List[str] = []
+        value_is_int: List[bool] = []
+        aggs: List[Tuple[str, Optional[int]]] = []
+        for aspec in agg_specs:
+            base = aspec[0]
+            if base == "count" and not aspec[1] and aspec[2] is None:
+                aggs.append(("count", None))
+                continue
+            if base not in ("sum", "avg") or aspec[1]:
+                raise _Ineligible(base)
+            vspec, acc = aspec[2], aspec[3]
+            if vspec is None or vspec[0] != "col":
+                raise _Ineligible("non-column agg value")
+            name = vspec[1]
+            cm = staged.segment.metadata.column(name)
+            if acc in ("i32", "i64"):
+                if acc != "i32":
+                    raise _Ineligible("i64 accumulator")
+                max_abs = max(abs(int(cm.min_value)), abs(int(cm.max_value)))
+                if max_abs * PALLAS_TILE >= _F32_EXACT:
+                    raise _Ineligible("tile sum not f32-exact")
+                is_int = True
+            else:
+                is_int = False
+            if name not in value_names:
+                value_names.append(name)
+                value_is_int.append(is_int)
+            vi = value_names.index(name)
+            if value_is_int[vi] != is_int:
+                raise _Ineligible("mixed int/float use of one column")
+            aggs.append((base, vi))
+    except _Ineligible:
+        return None
+
+    # -- fetch device arrays
+    packed_cols = []
+    bits = []
+    for nm in packed_names:
+        pc = staged.packed_column(nm)
+        if pc is None:
+            return None
+        bits.append(pc.bits)
+        W = PALLAS_TILE // pc.vals_per_word
+        packed_cols.append(pc.words.reshape(-1, W // 128, 128))
+    value_cols = []
+    for nm in value_names:
+        v = staged.value_column(nm)
+        if v is None or v.dtype not in (jnp.float32, jnp.int32):
+            return None
+        value_cols.append(v.reshape(-1, PALLAS_TILE // 128, 128))
+
+    G = max(_G_CHUNK, -(-num_groups // _G_CHUNK) * _G_CHUNK)
+    spec = PallasGroupSpec(
+        num_tiles=staged.pallas_capacity() // PALLAS_TILE,
+        packed_bits=tuple(bits),
+        filters=tuple((fi, neg) for fi, neg, _, _ in filters),
+        group_idx=tuple(group_idx),
+        group_strides=tuple(strides),
+        num_groups_padded=G,
+        aggs=tuple(aggs),
+        value_is_int=tuple(value_is_int),
+        interpret=interpret,
+    )
+    params = [v for _, _, lo, hi in filters for v in (lo, hi)]
+    params.append(staged.num_docs)
+    return spec, np.asarray(params, dtype=np.int32), packed_cols, value_cols
+
+
+# --------------------------------------------------------------------------
+# kernel builder
+# --------------------------------------------------------------------------
+
+def _row_layout(spec: PallasGroupSpec):
+    """The single source of truth for the matmul row stack and the two
+    output accumulators: rows = [float values..., mask(count), int
+    values...]; out_f holds the float rows, out_i holds [count, int rows].
+    Returns (float_vals, int_vals, Mf, Mi, frow, irow)."""
+    float_vals = [vi for vi, isint in enumerate(spec.value_is_int) if not isint]
+    int_vals = [vi for vi, isint in enumerate(spec.value_is_int) if isint]
+    Mf = max(len(float_vals), 1)
+    Mi = 1 + len(int_vals)
+    frow = {vi: r for r, vi in enumerate(float_vals)}
+    irow = {vi: r + 1 for r, vi in enumerate(int_vals)}
+    return float_vals, int_vals, Mf, Mi, frow, irow
+
+
+def build_group_kernel(spec: PallasGroupSpec):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    T = PALLAS_TILE
+    RT = T // 128
+    G = spec.num_groups_padded
+    n_chunks = G // _G_CHUNK
+    n_packed = len(spec.packed_bits)
+    n_values = len(spec.value_is_int)
+
+    float_vals, int_vals, Mf, Mi, _, _ = _row_layout(spec)
+
+    def kernel(params_ref, *refs):
+        packed = refs[:n_packed]
+        values = refs[n_packed:n_packed + n_values]
+        out_f, out_i = refs[n_packed + n_values:]
+        t = pl.program_id(0)
+
+        @pl.when(t == 0)
+        def _init():
+            out_f[...] = jnp.zeros_like(out_f)
+            out_i[...] = jnp.zeros_like(out_i)
+
+        # -- unpack planar words -> dictIds [RT, 128] i32 per column
+        ids = []
+        for ci, bits in enumerate(spec.packed_bits):
+            K = 32 // bits
+            vmask = jnp.uint32((1 << bits) - 1)
+            w = packed[ci][0]                      # [W/128, 128] u32
+            planes = [((w >> jnp.uint32(k * bits)) & vmask).astype(jnp.int32)
+                      for k in range(K)]
+            ids.append(planes[0] if K == 1 else
+                       jnp.concatenate(planes, axis=0))  # [RT, 128]
+
+        # -- validity + predicate mask
+        num_docs = params_ref[2 * len(spec.filters)]
+        row = jax.lax.broadcasted_iota(jnp.int32, (RT, 128), 0)
+        lane = jax.lax.broadcasted_iota(jnp.int32, (RT, 128), 1)
+        mask = (t * T + row * 128 + lane) < num_docs
+        for fi, (pi, negate) in enumerate(spec.filters):
+            lo = params_ref[2 * fi]
+            hi = params_ref[2 * fi + 1]
+            m = (ids[pi] >= lo) & (ids[pi] <= hi)
+            mask = mask & (~m if negate else m)
+        mask_f = mask.astype(jnp.float32)
+
+        # -- composed group keys
+        keys = jnp.zeros((RT, 128), dtype=jnp.int32)
+        for gi, stride in zip(spec.group_idx, spec.group_strides):
+            keys = keys + ids[gi] * jnp.int32(stride)
+
+        # -- matmul row stack [M, RT, 128]
+        rows = []
+        for vi in float_vals:
+            rows.append(values[vi][0].astype(jnp.float32) * mask_f)
+        if not float_vals:
+            rows.append(jnp.zeros((RT, 128), dtype=jnp.float32))
+        rows.append(mask_f)
+        for vi in int_vals:
+            rows.append(values[vi][0].astype(jnp.float32) * mask_f)
+        R = jnp.stack(rows)                       # [Mf+Mi, RT, 128]
+
+        # -- one-hot matmul per 128-group chunk (MXU)
+        for c in range(n_chunks):
+            g0 = c * _G_CHUNK
+            g_iota = g0 + jax.lax.broadcasted_iota(
+                jnp.int32, (RT, 128, _G_CHUNK), 2)
+            oh = (keys[:, :, None] == g_iota).astype(jnp.float32)
+            part = jax.lax.dot_general(
+                R, oh, (((1, 2), (0, 1)), ((), ())),
+                preferred_element_type=jnp.float32)   # [M, 128]
+            out_f[:, g0:g0 + _G_CHUNK] += part[:Mf]
+            out_i[:, g0:g0 + _G_CHUNK] += part[Mf:].astype(jnp.int32)
+
+    def block2(shape0):
+        return pl.BlockSpec((1,) + shape0, lambda t: (t,) + (0,) * len(shape0),
+                            memory_space=pltpu.VMEM)
+
+    in_specs = [pl.BlockSpec(memory_space=pltpu.SMEM)]
+    for bits in spec.packed_bits:
+        W = T // (32 // bits)
+        in_specs.append(block2((W // 128, 128)))
+    for _ in range(n_values):
+        in_specs.append(block2((RT, 128)))
+
+    out_specs = (
+        pl.BlockSpec((Mf, G), lambda t: (0, 0), memory_space=pltpu.VMEM),
+        pl.BlockSpec((Mi, G), lambda t: (0, 0), memory_space=pltpu.VMEM),
+    )
+    out_shape = (
+        jax.ShapeDtypeStruct((Mf, G), jnp.float32),
+        jax.ShapeDtypeStruct((Mi, G), jnp.int32),
+    )
+
+    call = pl.pallas_call(
+        kernel,
+        grid=(spec.num_tiles,),
+        in_specs=in_specs,
+        out_specs=out_specs,
+        out_shape=out_shape,
+        interpret=spec.interpret,
+    )
+    return jax.jit(call)
+
+
+class PallasKernelCache:
+    def __init__(self):
+        self._cache: Dict[PallasGroupSpec, Any] = {}
+
+    def get(self, spec: PallasGroupSpec):
+        k = self._cache.get(spec)
+        if k is None:
+            k = build_group_kernel(spec)
+            self._cache[spec] = k
+        return k
+
+    def __len__(self):
+        return len(self._cache)
+
+
+# --------------------------------------------------------------------------
+# runner: plan + staged segment -> jnp-kernel-shaped output dict
+# --------------------------------------------------------------------------
+
+def run_group_by(plan, staged: StagedSegment, cache: PallasKernelCache,
+                 interpret: bool) -> Optional[Dict[str, Any]]:
+    """Returns the same output tree as the jnp group-by kernel
+    ({"presence", "agg{i}"}) so the shared decode path applies, or None if
+    the plan isn't eligible."""
+    ext = extract_spec(plan, staged, interpret)
+    if ext is None:
+        return None
+    spec, params, packed_cols, value_cols = ext
+    kernel = cache.get(spec)
+    out_f, out_i = kernel(params, *packed_cols, *value_cols)
+
+    num_groups = plan.spec[3]
+    _, _, _, _, frow, irow = _row_layout(spec)
+
+    counts = out_i[0, :num_groups].astype(jnp.int64)
+    out: Dict[str, Any] = {"presence": counts}
+    for i, (base, vi) in enumerate(spec.aggs):
+        if base == "count":
+            out[f"agg{i}"] = counts
+        else:
+            if vi in frow:
+                s = out_f[frow[vi], :num_groups].astype(jnp.float64)
+            else:
+                s = out_i[irow[vi], :num_groups].astype(jnp.int64)
+            out[f"agg{i}"] = (s, counts) if base == "avg" else s
+    return out

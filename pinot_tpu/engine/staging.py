@@ -67,6 +67,32 @@ class StagedColumn:
         return out
 
 
+# Pallas tile: docs per grid step of the fused scan kernel. Packed columns
+# are laid out planar per tile (value j of a tile lives in word j%W at bit
+# slot (j//W)*B) so the in-kernel unpack is K static shift+mask ops over
+# contiguous words — no gathers, no cross-lane interleave
+# (TPU-side re-design of the reference's unaligned bit extraction,
+# io/util/PinotDataBitSet.java:25).
+PALLAS_TILE = 4096
+
+
+def pack_bits(bits_needed: int) -> int:
+    """Device bit width: power-of-two so values never straddle words."""
+    for b in (1, 2, 4, 8, 16):
+        if bits_needed <= b:
+            return b
+    return 32
+
+
+class PackedColumn:
+    """Planar bit-packed dictIds: ``words`` [num_tiles, W] uint32."""
+
+    def __init__(self, words, bits: int):
+        self.words = words
+        self.bits = bits
+        self.vals_per_word = 32 // bits
+
+
 class StagedSegment:
     """Device image of one segment (subset of columns, staged on demand)."""
 
@@ -75,6 +101,8 @@ class StagedSegment:
         self.num_docs = segment.num_docs
         self.capacity = segment.padded_capacity
         self._columns: Dict[str, StagedColumn] = {}
+        self._packed: Dict[str, PackedColumn] = {}
+        self._values: Dict[str, jnp.ndarray] = {}
 
     def column(self, name: str) -> StagedColumn:
         col = self._columns.get(name)
@@ -115,9 +143,71 @@ class StagedSegment:
             sc.null = jnp.asarray(np.asarray(ds.null_bitmap))
         return sc
 
+    def packed_column(self, name: str) -> Optional[PackedColumn]:
+        """Planar bit-packed dictIds for the Pallas scan kernel, or None if
+        the column/segment shape doesn't fit the packed layout."""
+        pc = self._packed.get(name)
+        if pc is None:
+            pc = self._pack(name)
+            if pc is None:
+                return None
+            self._packed[name] = pc
+        return pc
+
+    def pallas_capacity(self) -> int:
+        """Doc capacity padded up to a whole number of Pallas tiles (the
+        kernel's validity mask drops the zero-padded tail)."""
+        return -(-self.capacity // PALLAS_TILE) * PALLAS_TILE
+
+    def _pack(self, name: str) -> Optional["PackedColumn"]:
+        ds = self.segment.data_source(name)
+        cm = ds.metadata
+        if not (cm.has_dictionary and cm.single_value):
+            return None
+        bits = pack_bits(max(1, (max(cm.cardinality - 1, 1)).bit_length()))
+        K = 32 // bits
+        W = PALLAS_TILE // K
+        cap = self.pallas_capacity()
+        ids = np.zeros(cap, dtype=np.uint32)
+        fwd = np.asarray(ds.forward_index)
+        ids[:fwd.shape[0]] = fwd.astype(np.uint32)
+        tiles = cap // PALLAS_TILE
+        planes = ids.reshape(tiles, K, W)
+        words = np.zeros((tiles, W), dtype=np.uint32)
+        for k in range(K):
+            words |= planes[:, k, :] << np.uint32(k * bits)
+        return PackedColumn(jnp.asarray(words), bits)
+
+    def value_column(self, name: str) -> Optional[jnp.ndarray]:
+        """Decoded per-doc numeric values [capacity] (f32 / i32) for kernels
+        that read values without a dictionary gather; one-time decode, cached
+        in HBM (the metric-column analogue of raw chunk indexes)."""
+        v = self._values.get(name)
+        if v is None:
+            ds = self.segment.data_source(name)
+            cm = ds.metadata
+            if not (cm.single_value and cm.data_type.is_numeric):
+                return None
+            col = self.column(name)
+            if cm.has_dictionary:
+                v = col.dictvals[col.fwd]
+            else:
+                v = col.fwd
+            if cm.data_type.is_integral:
+                v = v.astype(staged_int_dtype(cm))
+            else:
+                v = v.astype(jnp.float32)
+            pad = self.pallas_capacity() - v.shape[0]
+            if pad:
+                v = jnp.pad(v, (0, pad))
+            self._values[name] = v
+        return v
+
     def release(self) -> None:
         """Drop device references (HBM freed when XLA GCs the buffers)."""
         self._columns.clear()
+        self._packed.clear()
+        self._values.clear()
 
 
 class StagingCache:

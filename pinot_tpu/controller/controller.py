@@ -55,8 +55,11 @@ class Controller:
     def __init__(self, store: Optional[ClusterStateStore] = None,
                  controller_id: str = "controller_0",
                  llc_seed: Optional[str] = None):
+        from pinot_tpu.controller.tasks import PinotTaskManager
+
         self.store = store or ClusterStateStore()
         self.controller_id = controller_id
+        self.task_manager = PinotTaskManager(self.store)
         self.llc = LLCRealtimeSegmentManager(self.store, seed=llc_seed)
         self.completion = SegmentCompletionManager(
             num_replicas_provider=self._num_replicas_for_segment,
@@ -269,12 +272,18 @@ class Controller:
             }
         return report
 
+    def run_task_generation(self) -> List[str]:
+        """Emit minion tasks for every table with a taskTypeConfigsMap
+        (ref: PinotTaskManager cron-able generation)."""
+        return self.task_manager.generate_tasks()
+
     def start_periodic_tasks(self, interval_s: float = 5.0) -> None:
         def loop():
             while not self._periodic_stop.wait(interval_s):
                 try:
                     self.run_retention_manager()
                     self.run_realtime_validation()
+                    self.run_task_generation()
                 except Exception:
                     log.exception("periodic task failed")
 

@@ -1,0 +1,303 @@
+"""Minion task orchestration: task generation, queueing, status tracking.
+
+Re-design of ``pinot-controller/.../helix/core/minion/PinotTaskManager.java``
+(per-table task generation from TableConfig's taskTypeConfigsMap) +
+``PinotHelixTaskResourceManager`` (the Helix task-queue wrapper): tasks are
+persisted in the cluster state store under ``tasks/``, minions poll for
+work, and per-(table, taskType) watermarks live under
+``minionTaskMetadata/`` (ref: MinionTaskMetadataUtils /
+RealtimeToOfflineSegmentsTaskMetadata).
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+import uuid
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+from pinot_tpu.controller.state import CONSUMING, ONLINE, ClusterStateStore
+from pinot_tpu.segment.processing import TIME_UNIT_MS
+from pinot_tpu.spi.table import TableType, table_type_from_name
+
+log = logging.getLogger(__name__)
+
+# task states (ref: Helix TaskState via PinotHelixTaskResourceManager)
+WAITING = "WAITING"
+IN_PROGRESS = "IN_PROGRESS"
+COMPLETED = "COMPLETED"
+ERROR = "ERROR"
+
+MERGE_ROLLUP_TASK = "MergeRollupTask"
+REALTIME_TO_OFFLINE_TASK = "RealtimeToOfflineSegmentsTask"
+PURGE_TASK = "PurgeTask"
+
+_PERIOD_MS = {"m": 60_000, "h": 3_600_000, "d": 86_400_000}
+
+
+def parse_period_ms(period: str, default_ms: int) -> int:
+    """'1d' / '6h' / '30m' -> milliseconds (ref: TimeUtils.convertPeriodToMillis)."""
+    if not period:
+        return default_ms
+    period = period.strip().lower()
+    try:
+        return int(period[:-1]) * _PERIOD_MS[period[-1]]
+    except (KeyError, ValueError, IndexError):
+        return default_ms
+
+
+@dataclass
+class PinotTaskConfig:
+    """One unit of minion work (ref: PinotTaskConfig.java)."""
+
+    task_id: str
+    task_type: str
+    table: str                      # table name with type
+    configs: Dict[str, str] = field(default_factory=dict)
+    input_segments: List[str] = field(default_factory=list)
+    status: str = WAITING
+    worker: Optional[str] = None
+    error: Optional[str] = None
+    output_segments: List[str] = field(default_factory=list)
+    created_ms: int = 0
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "taskId": self.task_id, "taskType": self.task_type,
+            "tableName": self.table, "configs": dict(self.configs),
+            "inputSegments": list(self.input_segments),
+            "status": self.status, "worker": self.worker,
+            "error": self.error,
+            "outputSegments": list(self.output_segments),
+            "createdMs": self.created_ms,
+        }
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "PinotTaskConfig":
+        return cls(task_id=d["taskId"], task_type=d["taskType"],
+                   table=d["tableName"], configs=d.get("configs", {}),
+                   input_segments=d.get("inputSegments", []),
+                   status=d.get("status", WAITING), worker=d.get("worker"),
+                   error=d.get("error"),
+                   output_segments=d.get("outputSegments", []),
+                   created_ms=d.get("createdMs", 0))
+
+
+class PinotTaskManager:
+    """Generates + tracks minion tasks over the cluster state store."""
+
+    def __init__(self, store: ClusterStateStore):
+        self.store = store
+
+    # -- queue ---------------------------------------------------------------
+    def _path(self, task_id: str) -> str:
+        return f"tasks/{task_id}"
+
+    def submit(self, task: PinotTaskConfig) -> str:
+        task.created_ms = int(time.time() * 1000)
+        self.store.set(self._path(task.task_id), task.to_dict())
+        return task.task_id
+
+    def get(self, task_id: str) -> Optional[PinotTaskConfig]:
+        d = self.store.get(self._path(task_id))
+        return PinotTaskConfig.from_dict(d) if d else None
+
+    def list_tasks(self, table: Optional[str] = None,
+                   task_type: Optional[str] = None,
+                   status: Optional[str] = None) -> List[PinotTaskConfig]:
+        out = []
+        for tid in self.store.children("tasks"):
+            t = self.get(tid)
+            if t is None:
+                continue
+            if table and t.table != table:
+                continue
+            if task_type and t.task_type != task_type:
+                continue
+            if status and t.status != status:
+                continue
+            out.append(t)
+        return sorted(out, key=lambda t: t.created_ms)
+
+    def poll(self, worker_id: str) -> Optional[PinotTaskConfig]:
+        """Claim the oldest WAITING task (minion work loop)."""
+        for t in self.list_tasks(status=WAITING):
+            claimed = {"ok": False}
+
+            def apply(d):
+                if d and d.get("status") == WAITING:
+                    d = dict(d, status=IN_PROGRESS, worker=worker_id)
+                    claimed["ok"] = True
+                return d
+
+            self.store.update(self._path(t.task_id), apply)
+            if claimed["ok"]:
+                return self.get(t.task_id)
+        return None
+
+    def report(self, task_id: str, status: str,
+               output_segments: Optional[List[str]] = None,
+               error: Optional[str] = None) -> None:
+        def apply(d):
+            if d:
+                d = dict(d, status=status, error=error,
+                         outputSegments=list(output_segments or []))
+            return d
+
+        self.store.update(self._path(task_id), apply)
+
+    # -- per-(table, type) watermarks ----------------------------------------
+    def get_watermark_ms(self, table: str, task_type: str) -> Optional[int]:
+        return self.store.get(f"minionTaskMetadata/{table}/{task_type}")
+
+    def set_watermark_ms(self, table: str, task_type: str, wm: int) -> None:
+        self.store.set(f"minionTaskMetadata/{table}/{task_type}", int(wm))
+
+    # -- generation (ref: per-task generators under helix/core/minion/generator)
+    def generate_tasks(self, now_ms: Optional[int] = None) -> List[str]:
+        """Scan every table's taskTypeConfigsMap and emit new tasks; skips a
+        (table, type) that still has WAITING/IN_PROGRESS work."""
+        now_ms = now_ms or int(time.time() * 1000)
+        created: List[str] = []
+        for table in self.store.table_names():
+            cfg = self.store.get_table_config(table)
+            if cfg is None or not cfg.task_config:
+                continue
+            for task_type, tconf in cfg.task_config.items():
+                if self.list_tasks(table=table, task_type=task_type,
+                                   status=WAITING) or \
+                        self.list_tasks(table=table, task_type=task_type,
+                                        status=IN_PROGRESS):
+                    continue
+                gen = _GENERATORS.get(task_type)
+                if gen is None:
+                    log.warning("no generator for task type %s", task_type)
+                    continue
+                for task in gen(self, table, cfg, tconf, now_ms):
+                    created.append(self.submit(task))
+        return created
+
+
+# --------------------------------------------------------------------------
+# generators
+# --------------------------------------------------------------------------
+
+def _new_id(task_type: str) -> str:
+    return f"Task_{task_type}_{uuid.uuid4().hex[:12]}"
+
+
+def _segment_time_bounds_ms(md, time_unit_ms: int):
+    if md.start_time is None or md.end_time is None:
+        return None
+    return md.start_time * time_unit_ms, md.end_time * time_unit_ms
+
+
+def _generate_merge_rollup(mgr: PinotTaskManager, table: str, cfg,
+                           tconf: Dict[str, str], now_ms: int):
+    """Merge ONLINE segments bucket by bucket behind a buffer window
+    (ref: MergeRollupTaskGenerator watermark walk)."""
+    if table_type_from_name(table) is not TableType.OFFLINE:
+        return
+    unit_ms = TIME_UNIT_MS.get(cfg.validation_config.time_type.upper(), 1)
+    bucket_ms = parse_period_ms(tconf.get("bucketTimePeriod", "1d"), 86_400_000)
+    buffer_ms = parse_period_ms(tconf.get("bufferTimePeriod", "0d"), 0)
+    max_segs = int(tconf.get("maxNumSegmentsPerTask", "100"))
+
+    candidates = []
+    for md in mgr.store.segment_metadata_list(table):
+        if md.status != ONLINE or md.segment_name.startswith("merged_"):
+            continue
+        bounds = _segment_time_bounds_ms(md, unit_ms)
+        if bounds is None:
+            continue
+        candidates.append((md, bounds))
+    if not candidates:
+        return
+
+    wm = mgr.get_watermark_ms(table, MERGE_ROLLUP_TASK)
+    if wm is None:
+        wm = (min(b[0] for _, b in candidates) // bucket_ms) * bucket_ms
+    while wm + bucket_ms <= now_ms - buffer_ms:
+        in_bucket = [md.segment_name for md, (s, e) in candidates
+                     if s < wm + bucket_ms and e >= wm]
+        if len(in_bucket) >= 2:
+            # watermark advances at scheduling time (ref: MergeRollupTask
+            # generator updates watermark metadata when the task is emitted)
+            mgr.set_watermark_ms(table, MERGE_ROLLUP_TASK, wm + bucket_ms)
+            yield PinotTaskConfig(
+                task_id=_new_id(MERGE_ROLLUP_TASK),
+                task_type=MERGE_ROLLUP_TASK, table=table,
+                configs=dict(tconf, windowStartMs=str(wm),
+                             windowEndMs=str(wm + bucket_ms)),
+                input_segments=in_bucket[:max_segs])
+            return  # one bucket per generation round
+        wm += bucket_ms
+        mgr.set_watermark_ms(table, MERGE_ROLLUP_TASK, wm)
+
+
+def _generate_realtime_to_offline(mgr: PinotTaskManager, table: str, cfg,
+                                  tconf: Dict[str, str], now_ms: int):
+    """Move a completed realtime window into the OFFLINE table
+    (ref: RealtimeToOfflineSegmentsTaskGenerator)."""
+    if table_type_from_name(table) is not TableType.REALTIME:
+        return
+    unit_ms = TIME_UNIT_MS.get(cfg.validation_config.time_type.upper(), 1)
+    bucket_ms = parse_period_ms(tconf.get("bucketTimePeriod", "1d"), 86_400_000)
+    buffer_ms = parse_period_ms(tconf.get("bufferTimePeriod", "0d"), 0)
+
+    completed = []
+    for md in mgr.store.segment_metadata_list(table):
+        if md.status == CONSUMING:
+            continue
+        bounds = _segment_time_bounds_ms(md, unit_ms)
+        if bounds is None:
+            continue
+        completed.append((md, bounds))
+    if not completed:
+        return
+
+    wm = mgr.get_watermark_ms(table, REALTIME_TO_OFFLINE_TASK)
+    if wm is None:
+        wm = (min(b[0] for _, b in completed) // bucket_ms) * bucket_ms
+    window_end = wm + bucket_ms
+    if window_end > now_ms - buffer_ms:
+        return
+    # every completed segment overlapping the window must exist; consuming
+    # segments overlapping the window block the task (data not committed yet)
+    for md in mgr.store.segment_metadata_list(table):
+        if md.status == CONSUMING and md.start_time is not None:
+            s = md.start_time * unit_ms
+            if s < window_end:
+                return
+    in_window = [md.segment_name for md, (s, e) in completed
+                 if s < window_end and e >= wm]
+    if not in_window:
+        mgr.set_watermark_ms(table, REALTIME_TO_OFFLINE_TASK, window_end)
+        return
+    yield PinotTaskConfig(
+        task_id=_new_id(REALTIME_TO_OFFLINE_TASK),
+        task_type=REALTIME_TO_OFFLINE_TASK, table=table,
+        configs=dict(tconf, windowStartMs=str(wm),
+                     windowEndMs=str(window_end)),
+        input_segments=in_window)
+
+
+def _generate_purge(mgr: PinotTaskManager, table: str, cfg,
+                    tconf: Dict[str, str], now_ms: int):
+    """One purge pass per un-purged segment (ref: PurgeTaskGenerator)."""
+    for md in mgr.store.segment_metadata_list(table):
+        if md.status != ONLINE or md.segment_name.startswith("purged_"):
+            continue
+        yield PinotTaskConfig(
+            task_id=_new_id(PURGE_TASK), task_type=PURGE_TASK, table=table,
+            configs=dict(tconf), input_segments=[md.segment_name])
+        return
+
+
+_GENERATORS = {
+    MERGE_ROLLUP_TASK: _generate_merge_rollup,
+    REALTIME_TO_OFFLINE_TASK: _generate_realtime_to_offline,
+    PURGE_TASK: _generate_purge,
+}

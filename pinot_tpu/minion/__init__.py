@@ -1,0 +1,28 @@
+"""Minion role: background task workers (merge/rollup, realtime->offline,
+purge) driven by controller task generation.
+
+Ref: pinot-minion/.../BaseMinionStarter.java:69 (role lifecycle),
+pinot-plugins/pinot-minion-tasks/pinot-minion-builtin-tasks/ (builtin
+executors), pinot-core/.../segment/processing/framework/ (the processing
+engine, re-designed in segment/processing.py).
+"""
+
+from pinot_tpu.minion.tasks import (
+    TASK_EXECUTORS,
+    BaseTaskExecutor,
+    MergeRollupTaskExecutor,
+    MinionContext,
+    PurgeTaskExecutor,
+    RealtimeToOfflineSegmentsTaskExecutor,
+)
+from pinot_tpu.minion.worker import MinionInstance
+
+__all__ = [
+    "BaseTaskExecutor",
+    "MergeRollupTaskExecutor",
+    "MinionContext",
+    "MinionInstance",
+    "PurgeTaskExecutor",
+    "RealtimeToOfflineSegmentsTaskExecutor",
+    "TASK_EXECUTORS",
+]

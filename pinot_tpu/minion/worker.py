@@ -1,0 +1,83 @@
+"""Minion role: polls the controller task queue and runs task executors.
+
+Re-design of ``pinot-minion/.../BaseMinionStarter.java:69`` +
+``taskfactory/TaskFactoryRegistry.java``: the minion registers as a MINION
+instance, claims WAITING tasks from the task manager, dispatches to the
+executor registry (minion/tasks.py), and reports COMPLETED/ERROR.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+
+from typing import Dict, Optional
+
+from pinot_tpu.controller.state import InstanceInfo
+from pinot_tpu.controller.tasks import COMPLETED, ERROR, PinotTaskConfig
+from pinot_tpu.minion.tasks import TASK_EXECUTORS, BaseTaskExecutor, MinionContext
+
+log = logging.getLogger(__name__)
+
+
+class MinionInstance:
+    """One minion worker (ref: BaseMinionStarter lifecycle)."""
+
+    def __init__(self, instance_id: str, controller,
+                 work_dir: str = "/tmp/pinot_tpu_minion",
+                 executors: Optional[Dict[str, BaseTaskExecutor]] = None):
+        self.instance_id = instance_id
+        self.controller = controller
+        self.ctx = MinionContext(controller=controller,
+                                 work_dir=os.path.join(work_dir, instance_id))
+        os.makedirs(self.ctx.work_dir, exist_ok=True)
+        self.executors = dict(TASK_EXECUTORS if executors is None else executors)
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.tasks_succeeded = 0
+        self.tasks_failed = 0
+        controller.store.register_instance(InstanceInfo(instance_id, "MINION"))
+
+    # -- lifecycle -----------------------------------------------------------
+    def start(self, poll_interval_s: float = 0.2) -> None:
+        def loop():
+            while not self._stop.is_set():
+                if not self.run_one_task():
+                    self._stop.wait(poll_interval_s)
+
+        self._thread = threading.Thread(target=loop, daemon=True,
+                                        name=f"minion-{self.instance_id}")
+        self._thread.start()
+
+    def shutdown(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=30)
+        self.controller.store.set_instance_alive(self.instance_id, False)
+
+    # -- work loop -----------------------------------------------------------
+    def run_one_task(self) -> bool:
+        """Claim and run one task; returns False when the queue is empty."""
+        task = self.controller.task_manager.poll(self.instance_id)
+        if task is None:
+            return False
+        self._run(task)
+        return True
+
+    def _run(self, task: PinotTaskConfig) -> None:
+        executor = self.executors.get(task.task_type)
+        tm = self.controller.task_manager
+        if executor is None:
+            tm.report(task.task_id, ERROR,
+                      error=f"no executor for {task.task_type}")
+            self.tasks_failed += 1
+            return
+        try:
+            outputs = executor.execute(task, self.ctx)
+            tm.report(task.task_id, COMPLETED, output_segments=outputs)
+            self.tasks_succeeded += 1
+        except Exception as exc:
+            log.exception("task %s failed", task.task_id)
+            tm.report(task.task_id, ERROR, error=f"{type(exc).__name__}: {exc}")
+            self.tasks_failed += 1

@@ -333,6 +333,8 @@ class TableConfig:
     ingestion_config: Optional[IngestionConfig] = None
     query_config: Dict[str, Any] = field(default_factory=dict)  # e.g. timeoutMs
     custom_config: Dict[str, Any] = field(default_factory=dict)
+    # taskType -> config map (ref: TableTaskConfig.java taskTypeConfigsMap)
+    task_config: Dict[str, Dict[str, str]] = field(default_factory=dict)
 
     def __post_init__(self):
         if isinstance(self.table_type, str):
@@ -364,6 +366,8 @@ class TableConfig:
             d["ingestionConfig"] = self.ingestion_config.to_dict()
         if self.query_config:
             d["query"] = self.query_config
+        if self.task_config:
+            d["task"] = {"taskTypeConfigsMap": self.task_config}
         return d
 
     def to_json(self) -> str:
@@ -393,6 +397,7 @@ class TableConfig:
                               if d.get("ingestionConfig") else None),
             query_config=d.get("query", {}),
             custom_config=(d.get("metadata") or {}).get("customConfigs", {}),
+            task_config=(d.get("task") or {}).get("taskTypeConfigsMap", {}),
         )
 
     @classmethod

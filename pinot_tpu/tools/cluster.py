@@ -39,6 +39,7 @@ class EmbeddedCluster:
         self.store = ClusterStateStore(snapshot_path=snap)
         self.controller = Controller(self.store, llc_seed=llc_seed)
         self.servers: Dict[str, ServerInstance] = {}
+        self.minions: Dict[str, object] = {}
         self.broker = BrokerRequestHandler(self.store, query_timeout_s=query_timeout_s)
         for i in range(num_servers):
             self.add_server(f"server_{i}")
@@ -58,6 +59,19 @@ class EmbeddedCluster:
         server = self.servers.pop(instance_id, None)
         if server is not None:
             server.shutdown()
+
+    def add_minion(self, instance_id: str = "minion_0", start: bool = True):
+        """Ref: ClusterTest startMinion — a MINION worker over the shared
+        state store, executing controller-generated tasks."""
+        from pinot_tpu.minion import MinionInstance
+
+        minion = MinionInstance(
+            instance_id, self.controller,
+            work_dir=os.path.join(self.data_dir, "minion_work"))
+        if start:
+            minion.start()
+        self.minions[instance_id] = minion
+        return minion
 
     # -- table/data operations (controller API) ------------------------------
     def create_table(self, table_config: TableConfig, schema: Schema) -> None:

@@ -34,6 +34,12 @@ MERGE_ROLLUP_TASK = "MergeRollupTask"
 REALTIME_TO_OFFLINE_TASK = "RealtimeToOfflineSegmentsTask"
 PURGE_TASK = "PurgeTask"
 
+# stop regenerating a unit of work after this many ERROR attempts; pruning
+# terminal records after the TTL both bounds state-store growth and acts as
+# a coarse retry backoff (the attempt counter resets once records age out)
+MAX_TASK_ATTEMPTS = 3
+TERMINAL_TASK_TTL_MS = 24 * 3_600_000
+
 _PERIOD_MS = {"m": 60_000, "h": 3_600_000, "d": 86_400_000}
 
 
@@ -108,8 +114,8 @@ class PinotTaskManager:
                    task_type: Optional[str] = None,
                    status: Optional[str] = None) -> List[PinotTaskConfig]:
         out = []
-        for tid in self.store.children("tasks"):
-            t = self.get(tid)
+        for key in self.store.children("tasks"):
+            t = self.get(key.split("/", 1)[1])
             if t is None:
                 continue
             if table and t.table != table:
@@ -148,6 +154,33 @@ class PinotTaskManager:
 
         self.store.update(self._path(task_id), apply)
 
+    def prune_terminal_tasks(self, now_ms: int) -> int:
+        """Drop COMPLETED/ERROR records older than the TTL (bounded state)."""
+        n = 0
+        for key in self.store.children("tasks"):
+            t = self.get(key.split("/", 1)[1])
+            if t and t.status in (COMPLETED, ERROR) and \
+                    now_ms - t.created_ms > TERMINAL_TASK_TTL_MS:
+                self.store.delete(self._path(t.task_id))
+                n += 1
+        return n
+
+    def error_attempts(self, table: str, task_type: str,
+                       configs_match: Optional[Dict[str, str]] = None,
+                       input_segments: Optional[List[str]] = None) -> int:
+        """How many times this unit of work has already ended in ERROR."""
+        n = 0
+        for t in self.list_tasks(table=table, task_type=task_type,
+                                 status=ERROR):
+            if configs_match and any(t.configs.get(k) != v
+                                     for k, v in configs_match.items()):
+                continue
+            if input_segments is not None and \
+                    t.input_segments != input_segments:
+                continue
+            n += 1
+        return n
+
     # -- per-(table, type) watermarks ----------------------------------------
     def get_watermark_ms(self, table: str, task_type: str) -> Optional[int]:
         return self.store.get(f"minionTaskMetadata/{table}/{task_type}")
@@ -160,6 +193,7 @@ class PinotTaskManager:
         """Scan every table's taskTypeConfigsMap and emit new tasks; skips a
         (table, type) that still has WAITING/IN_PROGRESS work."""
         now_ms = now_ms or int(time.time() * 1000)
+        self.prune_terminal_tasks(now_ms)
         created: List[str] = []
         for table in self.store.table_names():
             cfg = self.store.get_table_config(table)
@@ -223,16 +257,26 @@ def _generate_merge_rollup(mgr: PinotTaskManager, table: str, cfg,
         in_bucket = [md.segment_name for md, (s, e) in candidates
                      if s < wm + bucket_ms and e >= wm]
         if len(in_bucket) >= 2:
-            # watermark advances at scheduling time (ref: MergeRollupTask
-            # generator updates watermark metadata when the task is emitted)
-            mgr.set_watermark_ms(table, MERGE_ROLLUP_TASK, wm + bucket_ms)
-            yield PinotTaskConfig(
-                task_id=_new_id(MERGE_ROLLUP_TASK),
-                task_type=MERGE_ROLLUP_TASK, table=table,
-                configs=dict(tconf, windowStartMs=str(wm),
-                             windowEndMs=str(wm + bucket_ms)),
-                input_segments=in_bucket[:max_segs])
-            return  # one bucket per generation round
+            # The watermark only advances once the bucket drains (inputs
+            # merged away by a COMPLETED task) or is poisoned (retry cap).
+            # Advancing at scheduling time would skip the bucket forever on
+            # task ERROR; draining also re-queues truncated-off segments
+            # while >= 2 of them remain (a lone leftover stays unmerged —
+            # there is nothing to merge it with).
+            attempts = mgr.error_attempts(
+                table, MERGE_ROLLUP_TASK,
+                configs_match={"windowStartMs": str(wm)})
+            if attempts < MAX_TASK_ATTEMPTS:
+                yield PinotTaskConfig(
+                    task_id=_new_id(MERGE_ROLLUP_TASK),
+                    task_type=MERGE_ROLLUP_TASK, table=table,
+                    configs=dict(tconf, windowStartMs=str(wm),
+                                 windowEndMs=str(wm + bucket_ms),
+                                 bucketTimeMs=str(bucket_ms)),
+                    input_segments=in_bucket[:max_segs])
+                return  # one bucket per generation round
+            log.error("MergeRollup bucket [%d, %d) of %s failed %d times; "
+                      "skipping it", wm, wm + bucket_ms, table, attempts)
         wm += bucket_ms
         mgr.set_watermark_ms(table, MERGE_ROLLUP_TASK, wm)
 
@@ -276,6 +320,15 @@ def _generate_realtime_to_offline(mgr: PinotTaskManager, table: str, cfg,
     if not in_window:
         mgr.set_watermark_ms(table, REALTIME_TO_OFFLINE_TASK, window_end)
         return
+    if mgr.error_attempts(table, REALTIME_TO_OFFLINE_TASK,
+                          configs_match={"windowStartMs": str(wm)}) \
+            >= MAX_TASK_ATTEMPTS:
+        # do NOT skip the window (that would drop data from the offline
+        # table); stop regenerating until the ERROR records age out
+        log.error("RealtimeToOffline window [%d, %d) of %s failed %d+ "
+                  "times; awaiting operator attention", wm, window_end,
+                  table, MAX_TASK_ATTEMPTS)
+        return
     yield PinotTaskConfig(
         task_id=_new_id(REALTIME_TO_OFFLINE_TASK),
         task_type=REALTIME_TO_OFFLINE_TASK, table=table,
@@ -287,9 +340,16 @@ def _generate_realtime_to_offline(mgr: PinotTaskManager, table: str, cfg,
 def _generate_purge(mgr: PinotTaskManager, table: str, cfg,
                     tconf: Dict[str, str], now_ms: int):
     """One purge pass per un-purged segment (ref: PurgeTaskGenerator)."""
+    # one scan of the ERROR records, not one list_tasks per candidate
+    attempts: Dict[str, int] = {}
+    for t in mgr.list_tasks(table=table, task_type=PURGE_TASK, status=ERROR):
+        for seg in t.input_segments:
+            attempts[seg] = attempts.get(seg, 0) + 1
     for md in mgr.store.segment_metadata_list(table):
         if md.status != ONLINE or md.segment_name.startswith("purged_"):
             continue
+        if attempts.get(md.segment_name, 0) >= MAX_TASK_ATTEMPTS:
+            continue  # poisoned segment: stop regenerating every cycle
         yield PinotTaskConfig(
             task_id=_new_id(PURGE_TASK), task_type=PURGE_TASK, table=table,
             configs=dict(tconf), input_segments=[md.segment_name])

@@ -66,6 +66,8 @@ class ServerQueryExecutor:
                 else None
         if not self.use_pallas:
             return None
+        if backend in ("gpu", "cuda", "rocm"):
+            return None  # pltpu memory spaces cannot lower on GPU
         return backend == "cpu"  # interpret on CPU
 
     # -- public ------------------------------------------------------------

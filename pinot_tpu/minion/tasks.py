@@ -107,22 +107,32 @@ class MergeRollupTaskExecutor(BaseTaskExecutor):
         agg_types = {k[len("aggregationType."):]: v
                      for k, v in task.configs.items()
                      if k.startswith("aggregationType.")}
+        # Partition rows by time bucket instead of clamping to the task
+        # window: inputs may *straddle* the bucket boundary, and deleting
+        # them after a window clamp would drop their out-of-window rows.
+        # Ref: MergeRollupTaskGenerator sets PARTITION_BUCKET_TIME_PERIOD —
+        # spilled-over rows land in their own per-bucket output segments.
+        ws = int(task.configs["windowStartMs"])
+        we = int(task.configs["windowEndMs"])
+        bucket_ms = int(task.configs.get("bucketTimeMs", we - ws))
         proc = SegmentProcessorFramework(segments, SegmentProcessorConfig(
             schema=schema, table_config=cfg, merge_type=merge_type,
             aggregation_types=agg_types,
-            window_start_ms=int(task.configs["windowStartMs"]),
-            window_end_ms=int(task.configs["windowEndMs"]),
+            bucket_time_ms=bucket_ms,
+            # the task id in the name keeps retries of a partially-failed
+            # bucket from overwriting the prior attempt's outputs (which
+            # may hold rows of inputs that were already deleted)
             segment_name_prefix=f"merged_{raw_table_name(task.table)}"
-                                f"_{task.configs['windowStartMs']}",
+                                f"_{task.configs['windowStartMs']}"
+                                f"_{task.task_id[-8:]}",
             max_docs_per_segment=int(
                 task.configs.get("maxNumRecordsPerSegment", "5000000")),
         ))
         out_dirs = proc.process(os.path.join(ctx.work_dir, task.task_id))
         names = self._upload(ctx, task.table, out_dirs)
-        # segment replacement: drop the merged inputs (ref: segment lineage
-        # replacement via SegmentReplacementProtocol; the window clamp means
-        # rows outside [start, end) stay in the original... inputs here are
-        # fully contained, so plain delete-after-add is safe)
+        # segment replacement: every input row was re-emitted into some
+        # bucketed output above, so delete-after-add loses nothing (ref:
+        # segment lineage replacement via SegmentReplacementProtocol)
         for name in task.input_segments:
             ctx.controller.delete_segment(task.table, name)
         return names

@@ -88,7 +88,7 @@ def read_columnar(segment: ImmutableSegment,
     if valid_only and valid is not None:
         keep = np.asarray([bool(valid[i]) for i in range(n)])
     out: Dict[str, List[Any]] = {}
-    for name in segment.column_names():
+    for name in segment.column_names:
         ds = segment.data_source(name)
         cm = ds.metadata
         if cm.single_value:
@@ -241,14 +241,26 @@ class SegmentProcessorFramework:
             out[d] = [cols[d][i] for i in first_row]
         for m in metrics:
             agg = self.config.aggregation_types.get(m, "SUM").upper()
-            vals = np.asarray(cols[m], dtype=np.float64)
-            res = []
-            for g in range(len(order)):
-                v = vals[idx_of_arr == g]
-                res.append(float(v.sum()) if agg == "SUM" else
-                           float(v.min()) if agg == "MIN" else float(v.max()))
             dt = self.config.schema.field_spec(m).data_type
-            out[m] = [int(v) if dt.is_integral else v for v in res]
+            if dt.is_integral:
+                # exact Python-int accumulation: LONG sums past 2**53 must not
+                # round-trip through float64
+                res_i = []
+                for g in range(len(order)):
+                    v = [int(cols[m][i])
+                         for i in np.nonzero(idx_of_arr == g)[0]]
+                    res_i.append(sum(v) if agg == "SUM" else
+                                 min(v) if agg == "MIN" else max(v))
+                out[m] = res_i
+            else:
+                vals = np.asarray(cols[m], dtype=np.float64)
+                res = []
+                for g in range(len(order)):
+                    v = vals[idx_of_arr == g]
+                    res.append(float(v.sum()) if agg == "SUM" else
+                               float(v.min()) if agg == "MIN" else
+                               float(v.max()))
+                out[m] = res
         return out
 
     def _split(self, cols: Dict[str, List[Any]]):

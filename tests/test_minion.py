@@ -1,0 +1,208 @@
+"""Minion task pipeline: generation, execution, watermarks, retry caps.
+
+Covers the round-2 advisor findings: MergeRollup must not lose rows of
+segments straddling a bucket boundary (ref: MergeRollupTaskGenerator's
+PARTITION_BUCKET_TIME_PERIOD behavior), watermarks advance on completion
+rather than at scheduling time, and failing tasks stop regenerating after
+a retry cap (with terminal-record pruning bounding state growth).
+"""
+
+import pytest
+
+from pinot_tpu.controller.tasks import (
+    COMPLETED,
+    ERROR,
+    MAX_TASK_ATTEMPTS,
+    MERGE_ROLLUP_TASK,
+    PURGE_TASK,
+    TERMINAL_TASK_TTL_MS,
+    WAITING,
+)
+from pinot_tpu.segment.processing import (
+    MergeType,
+    SegmentProcessorConfig,
+    SegmentProcessorFramework,
+)
+from pinot_tpu.spi import DataType, FieldSpec, FieldType, Schema
+from pinot_tpu.spi.table import (
+    SegmentsValidationConfig,
+    TableConfig,
+    TableType,
+)
+from pinot_tpu.tools import EmbeddedCluster
+
+DAY_MS = 86_400_000
+D0 = 18_519 * DAY_MS          # an exact day boundary
+D1 = D0 + DAY_MS
+D2 = D0 + 2 * DAY_MS
+
+
+def make_schema(name="events"):
+    return Schema(name, [
+        FieldSpec("k", DataType.STRING),
+        FieldSpec("qty", DataType.LONG, FieldType.METRIC),
+        FieldSpec("ts", DataType.LONG, FieldType.DATE_TIME),
+    ])
+
+
+def merge_table_cfg(name="events", bucket="1d"):
+    return TableConfig(
+        name, TableType.OFFLINE,
+        validation_config=SegmentsValidationConfig(
+            time_column_name="ts", replication=1),
+        task_config={MERGE_ROLLUP_TASK: {
+            "bucketTimePeriod": bucket, "bufferTimePeriod": "0d",
+            "mergeType": "CONCAT",
+        }})
+
+
+def rows(ts_list, k="x"):
+    return {"k": [k] * len(ts_list),
+            "qty": [1] * len(ts_list),
+            "ts": list(ts_list)}
+
+
+@pytest.fixture()
+def cluster(tmp_path):
+    c = EmbeddedCluster(num_servers=1, data_dir=str(tmp_path))
+    yield c
+    c.shutdown()
+
+
+def run_all_tasks(cluster, minion):
+    while minion.run_one_task():
+        pass
+
+
+class TestMergeRollup:
+    def test_straddling_segment_loses_no_rows(self, cluster):
+        """A segment overlapping the bucket boundary is merged via bucket
+        partitioning: its day-1 rows land in a day-1 output segment, and
+        total counts are preserved after the inputs are deleted."""
+        schema = make_schema()
+        cluster.create_table(merge_table_cfg(), schema)
+        cluster.ingest_rows("events_OFFLINE", schema,
+                            rows(range(D0, D0 + 100)), segment_name="seg_a")
+        cluster.ingest_rows("events_OFFLINE", schema,
+                            rows(range(D0 + 2000, D0 + 2100)),
+                            segment_name="seg_b")
+        # 50 rows in day 0, 50 rows in day 1 — the straddler
+        cluster.ingest_rows("events_OFFLINE", schema,
+                            rows(range(D1 - 50, D1 + 50)),
+                            segment_name="seg_c")
+        assert cluster.wait_for_ev_converged("events_OFFLINE")
+        assert cluster.query_rows("SELECT count(*) FROM events")[0][0] == 300
+
+        tm = cluster.controller.task_manager
+        created = tm.generate_tasks(now_ms=D2 + DAY_MS)
+        assert len(created) == 1
+        task = tm.get(created[0])
+        assert set(task.input_segments) == {"seg_a", "seg_b", "seg_c"}
+
+        minion = cluster.add_minion(start=False)
+        run_all_tasks(cluster, minion)
+        assert minion.tasks_failed == 0
+        assert tm.get(created[0]).status == COMPLETED
+
+        assert cluster.wait_for_ev_converged("events_OFFLINE")
+        # no rows lost, inputs replaced by merged outputs
+        assert cluster.query_rows("SELECT count(*) FROM events")[0][0] == 300
+        assert cluster.query_rows(
+            "SELECT sum(qty) FROM events")[0][0] == 300
+        names = {md.segment_name for md in
+                 cluster.store.segment_metadata_list("events_OFFLINE")}
+        assert names.isdisjoint({"seg_a", "seg_b", "seg_c"})
+        assert all(n.startswith("merged_") for n in names)
+        # the day-1 spill rows are queryable on their own
+        assert cluster.query_rows(
+            f"SELECT count(*) FROM events WHERE ts >= {D1}")[0][0] == 50
+
+    def test_watermark_advances_on_completion_not_scheduling(self, cluster):
+        schema = make_schema()
+        cluster.create_table(merge_table_cfg(), schema)
+        cluster.ingest_rows("events_OFFLINE", schema,
+                            rows(range(D0, D0 + 10)), segment_name="d0_a")
+        cluster.ingest_rows("events_OFFLINE", schema,
+                            rows(range(D0 + 20, D0 + 30)),
+                            segment_name="d0_b")
+        cluster.ingest_rows("events_OFFLINE", schema,
+                            rows(range(D2, D2 + 10)), segment_name="d2_a")
+        cluster.ingest_rows("events_OFFLINE", schema,
+                            rows(range(D2 + 20, D2 + 30)),
+                            segment_name="d2_b")
+        assert cluster.wait_for_ev_converged("events_OFFLINE")
+
+        tm = cluster.controller.task_manager
+        now = D2 + 2 * DAY_MS
+        created = tm.generate_tasks(now_ms=now)
+        assert len(created) == 1
+        assert tm.get(created[0]).configs["windowStartMs"] == str(D0)
+        # pending task: watermark must NOT have advanced past day 0
+        wm = tm.get_watermark_ms("events_OFFLINE", MERGE_ROLLUP_TASK)
+        assert wm is None or wm <= D0
+        # while the task is in flight no duplicate is generated
+        assert tm.generate_tasks(now_ms=now) == []
+
+        minion = cluster.add_minion(start=False)
+        run_all_tasks(cluster, minion)
+        assert cluster.wait_for_ev_converged("events_OFFLINE")
+
+        # day 0 drained -> watermark rolls forward, day 2 gets its task
+        created2 = tm.generate_tasks(now_ms=now)
+        assert len(created2) == 1
+        assert tm.get(created2[0]).configs["windowStartMs"] == str(D2)
+
+
+class TestRetryCapAndPruning:
+    def _purge_table(self, cluster):
+        schema = make_schema("purgeme")
+        cfg = TableConfig(
+            "purgeme", TableType.OFFLINE,
+            validation_config=SegmentsValidationConfig(
+                time_column_name="ts", replication=1),
+            task_config={PURGE_TASK: {}})
+        cluster.create_table(cfg, schema)
+        cluster.ingest_rows("purgeme_OFFLINE", schema,
+                            rows(range(D0, D0 + 10)), segment_name="p0")
+        assert cluster.wait_for_ev_converged("purgeme_OFFLINE")
+        return schema
+
+    def test_failing_task_stops_regenerating_after_cap(self, cluster):
+        self._purge_table(cluster)  # no purger registered -> executor errors
+        tm = cluster.controller.task_manager
+        minion = cluster.add_minion(start=False)
+        for _ in range(MAX_TASK_ATTEMPTS + 3):
+            tm.generate_tasks(now_ms=D2)
+            run_all_tasks(cluster, minion)
+        errors = tm.list_tasks(table="purgeme_OFFLINE",
+                               task_type=PURGE_TASK, status=ERROR)
+        assert len(errors) == MAX_TASK_ATTEMPTS
+        assert minion.tasks_failed == MAX_TASK_ATTEMPTS
+        # and nothing is left waiting
+        assert not tm.list_tasks(status=WAITING)
+
+    def test_terminal_records_pruned_after_ttl(self, cluster):
+        self._purge_table(cluster)
+        tm = cluster.controller.task_manager
+        minion = cluster.add_minion(start=False)
+        tm.generate_tasks(now_ms=D2)
+        run_all_tasks(cluster, minion)
+        assert tm.list_tasks(status=ERROR)
+        import time as _time
+        far_future = int(_time.time() * 1000) + TERMINAL_TASK_TTL_MS + 1000
+        tm.prune_terminal_tasks(far_future)
+        assert tm.list_tasks() == []
+
+
+class TestRollupPrecision:
+    def test_long_sum_exact_past_float53(self):
+        """LONG metric sums beyond 2**53 must not round through float64."""
+        schema = make_schema()
+        cfg = merge_table_cfg()
+        fw = SegmentProcessorFramework([], SegmentProcessorConfig(
+            schema=schema, table_config=cfg, merge_type=MergeType.ROLLUP))
+        cols = {"k": ["x", "x", "x"],
+                "qty": [2 ** 53, 1, 2],
+                "ts": [D0, D0, D0]}
+        out = fw._rollup(cols)
+        assert out["qty"] == [2 ** 53 + 3]

@@ -298,10 +298,14 @@ class ServerQueryExecutor:
     # -- shared ------------------------------------------------------------
     def _run_kernel(self, plan: SegmentPlan, seg: ImmutableSegment,
                     stats: QueryStats) -> Dict[str, Any]:
+        from pinot_tpu.engine.kernels import unpack_outputs
+
         staged = self.staging.stage(seg)
         cols = {name: staged.column(name).tree() for name in plan.columns}
         kernel = self.kernels.get(plan.spec)
-        out = kernel(cols, tuple(plan.params), np.int32(seg.num_docs))
+        packed = kernel(cols, tuple(plan.params), np.int32(seg.num_docs))
+        # one D2H fetch for the whole output tree (tunnel-latency fix)
+        out = unpack_outputs(packed, plan.spec)
         self._track_kernel_stats(out, seg, stats)
         return out
 

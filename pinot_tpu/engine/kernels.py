@@ -229,13 +229,98 @@ def build_kernel_body(spec: Tuple, capacity_override: int = 0):
 
 
 def build_kernel(spec: Tuple):
-    """Single-segment entry: jitted fn(cols, params, num_docs)."""
+    """Single-segment entry: jitted fn(cols, params, num_docs) -> packed
+    f64 output vector (ONE device array -> one D2H fetch per query; see
+    output_layout)."""
     body = build_kernel_body(spec)
 
     def kernel(cols, params, num_docs):
-        return body(cols, params, num_docs, jnp.int32(0))
+        return pack_outputs(body(cols, params, num_docs, jnp.int32(0)), spec)
 
     return jax.jit(kernel)
+
+
+# --------------------------------------------------------------------------
+# packed output: every kernel output leaf concatenated into ONE f64 vector.
+#
+# The serving path talks to the TPU through a high-latency tunnel where every
+# host<->device transfer is a roundtrip; fetching each output leaf separately
+# (presence + N agg leaves + seg stats) made decode latency-bound, not
+# compute-bound (round-3 profile: a 6-agg group-by spent ~4x the kernel time
+# in sequential small D2H fetches). f64 keeps counts and i32-ranged sums
+# exact to 2^53; SUM finalizes as double anyway (ref: the reference
+# aggregates SUM in double, AggregationFunctionType SUM -> DOUBLE).
+# --------------------------------------------------------------------------
+
+def output_layout(spec: Tuple, num_seg: int = 0) -> List[Tuple[str, int]]:
+    """[(key, size)] slices of the packed vector, in pack order. Key
+    ``aggI.J`` is leaf J of a multi-leaf aggregation state (avg, minmaxrange).
+    ``num_seg > 0`` appends the sharded combine's per-segment matched-doc
+    counts."""
+    _, agg_specs, group_specs, num_groups, _ = spec
+    reducers = partial_reduce_ops(spec)
+    entries: List[Tuple[str, int]] = []
+    if group_specs:
+        entries.append(("presence", num_groups))
+    else:
+        entries.append(("num_matched", 1))
+    for i, aspec in enumerate(agg_specs):
+        if aspec[0] == "distinctcount":
+            entries.append((f"agg{i}", aspec[2]))  # [cardinality] presence
+            continue
+        nleaves = len(reducers[f"agg{i}"])
+        size = num_groups if group_specs else 1
+        if nleaves == 1:
+            entries.append((f"agg{i}", size))
+        else:
+            entries.extend((f"agg{i}.{j}", size) for j in range(nleaves))
+    if num_seg:
+        entries.append(("seg_matched", num_seg))
+    return entries
+
+
+def pack_outputs(out: Dict[str, Any], spec: Tuple) -> jnp.ndarray:
+    """Flatten the kernel output tree into one f64 vector (device side)."""
+    num_seg = out["seg_matched"].shape[0] if "seg_matched" in out else 0
+    parts = []
+    for key, _ in output_layout(spec, num_seg):
+        if "." in key:
+            k, j = key.split(".")
+            leaf = out[k][int(j)]
+        else:
+            leaf = out[key]
+        parts.append(jnp.asarray(leaf, dtype=jnp.float64).reshape(-1))
+    return jnp.concatenate(parts) if len(parts) > 1 else parts[0]
+
+
+def unpack_outputs(packed, spec: Tuple, num_seg: int = 0) -> Dict[str, Any]:
+    """Packed f64 vector (host numpy) -> the kernel output tree the decode
+    helpers consume. Scalar leaves come back as python-indexable scalars,
+    vector leaves (grouped/presence/seg_matched) as arrays."""
+    import numpy as np
+
+    packed = np.asarray(packed)
+    grouped = bool(spec[2])
+    dc = {f"agg{i}" for i, a in enumerate(spec[1]) if a[0] == "distinctcount"}
+    out: Dict[str, Any] = {}
+    multi: Dict[str, Dict[int, Any]] = {}
+    off = 0
+    for key, size in output_layout(spec, num_seg):
+        leaf = packed[off:off + size]
+        off += size
+        if "." in key:
+            k, j = key.split(".")
+            multi.setdefault(k, {})[int(j)] = leaf if grouped else leaf[0]
+            continue
+        if key == "num_matched":
+            out[key] = leaf[0]
+        elif key == "seg_matched" or grouped or key in dc:
+            out[key] = leaf
+        else:
+            out[key] = leaf[0]
+    for k, leaves in multi.items():
+        out[k] = tuple(leaves[j] for j in sorted(leaves))
+    return out
 
 
 def partial_reduce_ops(spec: Tuple) -> Dict[str, Tuple[str, ...]]:

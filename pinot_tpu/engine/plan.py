@@ -126,6 +126,21 @@ def plan_segment(ctx: QueryContext, segment: ImmutableSegment) -> SegmentPlan:
             raise PlanError(f"aggregation {agg.name} not device-supported "
                             f"{'grouped' if grouped else 'scalar'}")
         vexpr = agg_value_expr(fn)
+        if agg.base == "distinctcount" and not agg.mv:
+            # checked before value compilation: the presence-bitmap kernel
+            # reads dictIds directly, so non-numeric (string) columns are
+            # fine here even though they have no device value expression
+            if not isinstance(vexpr, Identifier):
+                raise PlanError("DISTINCTCOUNT argument must be a column")
+            cm = segment.metadata.column(vexpr.name)
+            if not cm.has_dictionary:
+                raise PlanError("DISTINCTCOUNT on raw column -> host")
+            if not cm.single_value:
+                raise PlanError("DISTINCTCOUNT on MV column -> host")
+            agg_specs.append(("distinctcount", vexpr.name, cm.cardinality))
+            if vexpr.name not in columns:
+                columns.append(vexpr.name)
+            continue
         fanout = 1
         if vexpr is None:
             vspec = None
@@ -141,19 +156,8 @@ def plan_segment(ctx: QueryContext, segment: ImmutableSegment) -> SegmentPlan:
                 columns.append(vexpr.name)
         else:
             vspec = _compile_value(vexpr, segment, params, columns)
-        if agg.base == "distinctcount" and not agg.mv:
-            # device presence bitmap needs the dictionary card (static)
-            if not isinstance(vexpr, Identifier):
-                raise PlanError("DISTINCTCOUNT argument must be a column")
-            cm = segment.metadata.column(vexpr.name)
-            if not cm.has_dictionary:
-                raise PlanError("DISTINCTCOUNT on raw column -> host")
-            agg_specs.append(("distinctcount", vexpr.name, cm.cardinality))
-            if vexpr.name not in columns:
-                columns.append(vexpr.name)
-        else:
-            acc = _acc_dtype(agg.base, vexpr, segment, fanout)
-            agg_specs.append((agg.base, agg.mv, vspec, acc))
+        acc = _acc_dtype(agg.base, vexpr, segment, fanout)
+        agg_specs.append((agg.base, agg.mv, vspec, acc))
 
     spec = (filter_spec, tuple(agg_specs), tuple(group_specs), num_groups,
             segment.padded_capacity)

@@ -27,7 +27,11 @@ import numpy as np
 
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from pinot_tpu.engine.kernels import build_kernel_body, partial_reduce_ops
+from pinot_tpu.engine.kernels import (
+    build_kernel_body,
+    pack_outputs,
+    partial_reduce_ops,
+)
 from pinot_tpu.engine.plan import PlanError
 
 SEG_AXIS = "seg"
@@ -163,28 +167,16 @@ def build_sharded_kernel(spec: Tuple, mesh: Mesh,
         if mesh.shape[SEG_AXIS] > 1:
             local = jax.lax.all_gather(local, SEG_AXIS, tiled=True)
         out["seg_matched"] = local
-        return out
+        # ONE replicated f64 vector out: a single D2H fetch serves the whole
+        # decode (the tunnel-latency fix; see kernels.output_layout)
+        return pack_outputs(out, spec)
 
     sharded = jax.shard_map(
         per_device, mesh=mesh,
         in_specs=(cols_spec, P(), P(SEG_AXIS)),
-        out_specs=_out_specs(spec, reducers),
+        out_specs=P(),
         check_vma=False)
     return jax.jit(sharded)
-
-
-def _out_specs(spec: Tuple, reducers: Dict[str, Tuple[str, ...]]):
-    """Replicated out specs mirroring the kernel output tree."""
-    _, agg_specs, group_specs, _, _ = spec
-    out = {}
-    if group_specs:
-        out["presence"] = P()
-    else:
-        out["num_matched"] = P()
-    for i, ops in ((i, reducers[f"agg{i}"]) for i in range(len(agg_specs))):
-        out[f"agg{i}"] = tuple(P() for _ in ops) if len(ops) > 1 else P()
-    out["seg_matched"] = P()
-    return out
 
 
 def pad_segments(n: int, n_seg: int) -> int:

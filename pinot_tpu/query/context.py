@@ -99,6 +99,8 @@ class QueryContext:
 
     # derived (filled by build):
     aggregations: List[Function] = field(default_factory=list)
+    # original SQL text when compiled from SQL (caching/diagnostics key)
+    sql: Optional[str] = None
 
     @property
     def is_aggregation(self) -> bool:
@@ -256,4 +258,6 @@ def compile_query(sql: str) -> QueryContext:
 
     parsed = parse_sql(sql)
     parsed = optimize(parsed)
-    return build_query_context(parsed)
+    ctx = build_query_context(parsed)
+    ctx.sql = sql
+    return ctx

@@ -144,3 +144,65 @@ def test_groupby_no_agg_having(setup, base_exec):
                         "HAVING region != 'east' ORDER BY region")
     rt, _ = base_exec.execute(ctx, segs)
     assert [r[0] for r in rt.rows] == ["north", "south", "west"]
+
+
+def test_distinctcount_string_plans_on_device(setup):
+    """Regression: DISTINCTCOUNT(string_col) used to hit _compile_value
+    (which rejects non-numeric columns) before its own plan branch and fell
+    to the 1000x-slower host path."""
+    from pinot_tpu.engine.plan import plan_segment
+
+    _, segs = setup
+    ctx = compile_query(
+        "SELECT distinctcount(region) FROM sales WHERE qty > 25")
+    plan = plan_segment(ctx, segs[0])
+    assert plan.spec[1][0][0] == "distinctcount"
+
+
+def test_packed_output_roundtrip():
+    """pack_outputs/unpack_outputs are inverse over the output tree (the
+    single-fetch decode contract of the serving path)."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from pinot_tpu.engine.kernels import (
+        output_layout,
+        pack_outputs,
+        unpack_outputs,
+    )
+
+    # grouped spec: presence + sum + avg(2 leaves) + seg_matched
+    spec = (("true",),
+            (("sum", False, ("col", "x", True), "f32"),
+             ("avg", False, ("col", "x", True), "f32")),
+            (("gdict", "g"),), 4, 1024)
+    out = {
+        "presence": jnp.array([1, 0, 2, 0]),
+        "agg0": jnp.array([1.5, 0.0, 2.5, 0.0]),
+        "agg1": (jnp.array([3.0, 0.0, 5.0, 0.0]), jnp.array([2, 0, 1, 0])),
+        "seg_matched": jnp.array([3, 0, 1]),
+    }
+    packed = np.asarray(pack_outputs(out, spec))
+    total = sum(size for _, size in output_layout(spec, num_seg=3))
+    assert packed.shape == (total,)
+    back = unpack_outputs(packed, spec, num_seg=3)
+    np.testing.assert_array_equal(back["presence"], [1, 0, 2, 0])
+    np.testing.assert_array_equal(back["agg0"], [1.5, 0.0, 2.5, 0.0])
+    np.testing.assert_array_equal(back["agg1"][0], [3.0, 0.0, 5.0, 0.0])
+    np.testing.assert_array_equal(back["agg1"][1], [2, 0, 1, 0])
+    np.testing.assert_array_equal(back["seg_matched"], [3, 0, 1])
+
+    # scalar spec: num_matched + count + distinctcount presence
+    spec_s = (("true",),
+              (("count", False, None, "i32"),
+               ("distinctcount", "region", 5)),
+              (), 0, 1024)
+    out_s = {
+        "num_matched": jnp.asarray(7),
+        "agg0": jnp.asarray(7),
+        "agg1": jnp.array([1, 0, 1, 1, 0]),
+    }
+    back_s = unpack_outputs(np.asarray(pack_outputs(out_s, spec_s)), spec_s)
+    assert int(back_s["num_matched"]) == 7
+    assert int(back_s["agg0"]) == 7
+    np.testing.assert_array_equal(back_s["agg1"], [1, 0, 1, 1, 0])

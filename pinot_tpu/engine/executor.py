@@ -239,7 +239,9 @@ class ServerQueryExecutor:
 
     def _run_device_scalar(self, plan: SegmentPlan, seg: ImmutableSegment,
                            stats: QueryStats) -> AggResult:
-        out = self._run_kernel(plan, seg, stats)
+        out = self._try_pallas(plan, seg, stats)
+        if out is None:
+            out = self._run_kernel(plan, seg, stats)
         return decode_scalar_result(plan, seg, out)
 
     # -- group-by ----------------------------------------------------------
@@ -268,22 +270,25 @@ class ServerQueryExecutor:
 
     def _run_device_grouped(self, plan: SegmentPlan, seg: ImmutableSegment,
                             stats: QueryStats) -> GroupByResult:
-        out = self._try_pallas_grouped(plan, seg, stats)
+        out = self._try_pallas(plan, seg, stats)
         if out is None:
             out = self._run_kernel(plan, seg, stats)
         return decode_grouped_result(plan, seg, out)
 
-    def _try_pallas_grouped(self, plan: SegmentPlan, seg: ImmutableSegment,
-                            stats: QueryStats) -> Optional[Dict[str, Any]]:
+    def _try_pallas(self, plan: SegmentPlan, seg: ImmutableSegment,
+                    stats: QueryStats) -> Optional[Dict[str, Any]]:
+        """Fused Pallas scan when the plan is eligible; returns the unpacked
+        output tree (same shape as the jnp kernel's) or None."""
         from pinot_tpu.engine import pallas_kernels
+        from pinot_tpu.engine.kernels import unpack_outputs
 
         interpret = self._pallas_mode()
         if interpret is None:
             return None
         staged = self.staging.stage(seg)
         try:
-            out = pallas_kernels.run_group_by(plan, staged,
-                                              self.pallas_kernels, interpret)
+            packed = pallas_kernels.run_segment(plan, staged,
+                                                self.pallas_kernels, interpret)
         except Exception:  # lowering/compile failure -> jnp kernels
             import logging
 
@@ -291,8 +296,10 @@ class ServerQueryExecutor:
                 "pallas kernel failed; disabling pallas for this executor")
             self.use_pallas = False
             return None
-        if out is not None:
-            self._track_kernel_stats(out, seg, stats)
+        if packed is None:
+            return None
+        out = unpack_outputs(packed, plan.spec)
+        self._track_kernel_stats(out, seg, stats)
         return out
 
     # -- shared ------------------------------------------------------------

@@ -1,33 +1,41 @@
-"""Fused Pallas scan kernel: bit-unpack -> predicate -> group-by matmul.
+"""Fused Pallas scan kernel: bit-unpack -> predicate -> aggregate on MXU.
 
 TPU-native re-design of the reference's hottest loop — the per-segment
-``Filter -> Projection -> GroupBy`` chain (``SVScanDocIdIterator.java:36``
-predicate scan, ``PinotDataBitSet.java:25`` bit extraction,
-``DefaultGroupByExecutor`` scatter into group slots) — as ONE Pallas kernel:
+``Filter -> Projection -> GroupBy/Aggregate`` chain
+(``SVScanDocIdIterator.java:36`` predicate scan, ``PinotDataBitSet.java:25``
+bit extraction, ``DefaultGroupByExecutor`` scatter into group slots) — as ONE
+Pallas kernel over a ``(segments, tiles)`` grid:
 
 - forward indexes arrive as **planar bit-packed words** (engine/staging.py
   PackedColumn): a tile's value ``j`` lives in word ``j % W`` at bit slot
   ``(j // W) * B``, so the in-VMEM unpack is ``K = 32/B`` static shift+mask
   ops over contiguous words — vector ops only, no gathers;
-- predicates are dictId-interval compares (sorted dictionaries turn EQ/RANGE
-  into intervals, the vectorized form of dictionary-based predicate
-  evaluators) AND-composed into one doc mask;
-- group aggregation is a **one-hot matmul on the MXU**: rows
-  ``[mask, masked values...] @ one_hot(keys)`` accumulate ``[aggs, groups]``
+- the filter tree is compiled to an AND/OR/NOT expression over dictId
+  interval tests (sorted dictionaries turn EQ/NEQ/RANGE into intervals, the
+  vectorized form of dictionary-based predicate evaluators);
+- sums/counts/avg are a **one-hot matmul on the MXU**: rows
+  ``[masked values..., mask] @ one_hot(keys)`` accumulate ``[aggs, groups]``
   partials — the fixed-shape scatter-add replacement for
-  ``GroupByResultHolder``. Integer aggregations keep an exact i32
-  accumulator (per-tile matmul results are exactly representable in f32 by
-  a plan-time bound, then rounded into i32); float aggregations accumulate
-  f32.
+  ``GroupByResultHolder``. Integer sums keep an exact i32 accumulator
+  (per-tile matmul results are exactly representable in f32 by a plan-time
+  bound, then rounded into i32); float sums accumulate f32;
+- min/max/minmaxrange reduce on the VPU per 128-group chunk;
+- scalar (non-group-by) aggregations are the same kernel with a single
+  group (all keys 0);
+- per-segment matched-doc counts accumulate into a segment-indexed output
+  (QueryStats parity with the jnp path).
 
-Eligibility is decided per plan (`extract_spec`); anything else falls back
+The same kernel body serves the per-segment executor (grid [1, T]) and the
+sharded combine (grid [S_local, T_local] per device under shard_map, partials
+merged with psum/pmin/pmax over ICI — see parallel/combine.py).
+
+Eligibility is decided per plan (``extract_plan``); anything else falls back
 to the jnp masked-vector kernels (engine/kernels.py).
 """
 
 from __future__ import annotations
 
 from dataclasses import dataclass
-from functools import partial
 from typing import Any, Dict, List, Optional, Tuple
 
 import jax
@@ -42,19 +50,28 @@ _G_CHUNK = 128
 MAX_PALLAS_GROUPS = 4096
 # per-tile int matmul partials must be exact in f32: max |value| * TILE < 2^24
 _F32_EXACT = 1 << 24
+_I32_MAX = (1 << 31) - 1
+
+_POS = np.float32(np.inf)
+_NEG = np.float32(-np.inf)
 
 
 @dataclass(frozen=True)
-class PallasGroupSpec:
-    """Hashable kernel-cache key (all static shapes/strides)."""
+class PallasSpec:
+    """Hashable kernel-cache key (all static shapes/strides/tree)."""
 
-    num_tiles: int
+    num_segs: int                         # grid segment dim
+    tiles_per_seg: int                    # grid tile dim
     packed_bits: Tuple[int, ...]          # per packed input column
-    filters: Tuple[Tuple[int, bool], ...]  # (packed input idx, negate)
+    # nested tuples: ("true",) | ("and"|"or", (children...)) | ("not", (c,))
+    # | ("iv", packed_input_idx, param_slot)
+    filter_tree: Tuple
+    n_slots: int                          # interval param slots
     group_idx: Tuple[int, ...]            # packed input idx per group col
     group_strides: Tuple[int, ...]
     num_groups_padded: int                # multiple of 128
-    # per agg: ("count", None) | ("sum"|"avg", value input idx)
+    # per agg: (base, value input idx | None); base in
+    # count/sum/avg/min/max/minmaxrange
     aggs: Tuple[Tuple[str, Optional[int]], ...]
     value_is_int: Tuple[bool, ...]        # per value input
     interpret: bool
@@ -64,154 +81,251 @@ class _Ineligible(Exception):
     pass
 
 
+# max interval runs a boolean dictId LUT may decompose into before the
+# pallas path declines it (each run is one compare pair in-kernel)
+_MAX_LUT_RUNS = 8
+
+
+def _lut_runs(lut: np.ndarray) -> Optional[List[Tuple[int, int]]]:
+    """Boolean LUT -> [(lo, hi)] inclusive dictId runs, or None if more
+    than _MAX_LUT_RUNS (fall back to the jnp LUT-gather kernel)."""
+    idx = np.nonzero(np.asarray(lut, dtype=bool))[0]
+    if idx.size == 0:
+        return []
+    breaks = np.nonzero(np.diff(idx) > 1)[0]
+    if breaks.size + 1 > _MAX_LUT_RUNS:
+        return None
+    runs = []
+    start = 0
+    for b in list(breaks) + [idx.size - 1]:
+        runs.append((int(idx[start]), int(idx[b])))
+        start = b + 1
+    return runs
+
+
 # --------------------------------------------------------------------------
-# plan -> PallasGroupSpec (+ runtime params)
+# plan -> (core spec fields, static params, column names)
 # --------------------------------------------------------------------------
 
-def extract_spec(plan, staged: StagedSegment, interpret: bool):
-    """(spec, params_i32, packed_cols, value_cols) or None if the plan shape
-    isn't covered by the fused kernel."""
+@dataclass
+class PallasPlan:
+    """Staging-independent extraction of a SegmentPlan: what to pack, what
+    to stage as values, the static interval params, and the spec core."""
+
+    packed_names: List[str]
+    value_names: List[str]
+    value_is_int: Tuple[bool, ...]
+    filter_tree: Tuple
+    n_slots: int
+    group_idx: Tuple[int, ...]
+    group_strides: Tuple[int, ...]
+    num_groups_padded: int
+    aggs: Tuple[Tuple[str, Optional[int]], ...]
+    static_params: np.ndarray             # [2 * n_slots] i32 interval bounds
+
+    def spec(self, num_segs: int, tiles_per_seg: int,
+             interpret: bool) -> PallasSpec:
+        return PallasSpec(
+            num_segs=num_segs, tiles_per_seg=tiles_per_seg,
+            packed_bits=(), filter_tree=self.filter_tree,
+            n_slots=self.n_slots, group_idx=self.group_idx,
+            group_strides=self.group_strides,
+            num_groups_padded=self.num_groups_padded,
+            aggs=self.aggs, value_is_int=self.value_is_int,
+            interpret=interpret)
+
+
+def extract_plan(plan, provider) -> Optional[PallasPlan]:
+    """SegmentPlan -> PallasPlan, or None when the query shape isn't covered
+    by the fused kernel. ``provider`` supplies column metadata (an
+    ImmutableSegment or a SegmentBatch with unified stats)."""
     from pinot_tpu.engine.kernels import _ParamCursor
 
-    filter_spec, agg_specs, group_specs, num_groups, capacity = plan.spec
-    if not group_specs or num_groups == 0:
+    filter_spec, agg_specs, group_specs, num_groups, _ = plan.spec
+    if group_specs and num_groups > MAX_PALLAS_GROUPS:
         return None
-    if num_groups > MAX_PALLAS_GROUPS:
+    if any(a[0] == "distinctcount" for a in agg_specs):
         return None
 
     try:
         packed_names: List[str] = []
 
         def packed_idx(col: str) -> int:
+            cm = provider.metadata.column(col)
+            if not (cm.has_dictionary and cm.single_value):
+                raise _Ineligible("unpackable column")
             if col not in packed_names:
                 packed_names.append(col)
             return packed_names.index(col)
 
-        # -- filter tree -> interval list (mirrors kernels._emit_filter's
+        # -- filter tree -> interval expression (mirrors the jnp kernel's
         # param consumption order exactly)
         pc = _ParamCursor(plan.params)
-        take_param = pc.take
+        intervals: List[Tuple[int, int]] = []
 
-        filters: List[Tuple[int, bool, int, int]] = []  # (idx, neg, lo, hi)
+        def iv_leaf(col: str, lo: int, hi: int) -> Tuple:
+            slot = len(intervals)
+            intervals.append((lo, hi))
+            return ("iv", packed_idx(col), slot)
 
-        def walk(node):
+        def walk(node) -> Tuple:
             op = node[0]
             if op == "true":
-                return
-            if op == "and":
-                for child in node[1]:
-                    walk(child)
-                return
+                return ("true",)
+            if op in ("and", "or"):
+                return (op, tuple(walk(c) for c in node[1]))
+            if op == "not":
+                return ("not", (walk(node[1][0]),))
             if op in ("eq", "neq"):
-                did = int(take_param())
-                filters.append((packed_idx(node[1]), op == "neq", did, did))
-                return
+                did = int(pc.take())
+                leaf = iv_leaf(node[1], did, did)
+                return ("not", (leaf,)) if op == "neq" else leaf
             if op == "range":
-                iv = np.asarray(take_param())
-                filters.append((packed_idx(node[1]), False,
-                                int(iv[0]), int(iv[1])))
-                return
+                iv = np.asarray(pc.take())
+                return iv_leaf(node[1], int(iv[0]), int(iv[1]))
+            if op == "lut":
+                # boolean LUT over a SORTED dictionary = union of dictId
+                # runs; small run counts become OR-of-intervals (covers
+                # IN / merged-EQ / many REGEXP predicates)
+                lut = np.asarray(pc.take())
+                runs = _lut_runs(lut)
+                if runs is None:
+                    raise _Ineligible("lut with too many runs")
+                if not runs:
+                    return ("not", (("true",),))
+                leaves = tuple(iv_leaf(node[1], lo, hi) for lo, hi in runs)
+                return leaves[0] if len(leaves) == 1 else ("or", leaves)
             raise _Ineligible(op)
 
-        walk(filter_spec)
+        tree = walk(filter_spec)
 
         # -- group columns (params: strides + bases arrays)
-        group_idx = []
-        for strat, col in group_specs:
-            if strat != "gdict":
-                raise _Ineligible("raw group key")
-            group_idx.append(packed_idx(col))
-        strides = [int(s) for s in np.asarray(take_param())]
-        take_param()  # bases (gdict bases are 0)
+        group_idx: List[int] = []
+        strides: List[int] = []
+        if group_specs:
+            for strat, col in group_specs:
+                if strat != "gdict":
+                    raise _Ineligible("raw group key")
+                group_idx.append(packed_idx(col))
+            strides = [int(s) for s in np.asarray(pc.take())]
+            pc.take()  # bases (gdict bases are 0)
+            G = -(-num_groups // _G_CHUNK) * _G_CHUNK
+        else:
+            G = _G_CHUNK  # single group at key 0
 
         # -- aggregations
         value_names: List[str] = []
         value_is_int: List[bool] = []
-        aggs: List[Tuple[str, Optional[int]]] = []
-        for aspec in agg_specs:
-            base = aspec[0]
-            if base == "count" and not aspec[1] and aspec[2] is None:
-                aggs.append(("count", None))
-                continue
-            if base not in ("sum", "avg") or aspec[1]:
-                raise _Ineligible(base)
-            vspec, acc = aspec[2], aspec[3]
+
+        def value_idx(vspec, acc: str) -> int:
             if vspec is None or vspec[0] != "col":
                 raise _Ineligible("non-column agg value")
             name = vspec[1]
-            cm = staged.segment.metadata.column(name)
-            if acc in ("i32", "i64"):
-                if acc != "i32":
-                    raise _Ineligible("i64 accumulator")
-                max_abs = max(abs(int(cm.min_value)), abs(int(cm.max_value)))
-                if max_abs * PALLAS_TILE >= _F32_EXACT:
-                    raise _Ineligible("tile sum not f32-exact")
+            cm = provider.metadata.column(name)
+            if acc == "i32":
                 is_int = True
-            else:
+            elif acc == "f32":
                 is_int = False
+            else:
+                raise _Ineligible(f"{acc} accumulator")
             if name not in value_names:
                 value_names.append(name)
                 value_is_int.append(is_int)
             vi = value_names.index(name)
             if value_is_int[vi] != is_int:
                 raise _Ineligible("mixed int/float use of one column")
+            return vi
+
+        def int_max_abs(vspec) -> int:
+            cm = provider.metadata.column(vspec[1])
+            if cm.min_value is None or cm.max_value is None:
+                raise _Ineligible("no stats for exactness bound")
+            return max(abs(int(cm.min_value)), abs(int(cm.max_value)))
+
+        def check_sum_exact(vspec) -> None:
+            max_abs = int_max_abs(vspec)
+            if max_abs * PALLAS_TILE >= _F32_EXACT:
+                raise _Ineligible("tile sum not f32-exact")
+            # the i32 accumulator spans ALL segments in the kernel grid
+            # (init at s==0 only), so the bound is the whole provider —
+            # a batch's num_docs covers every stacked segment
+            if max_abs * max(provider.metadata.num_docs, 1) > _I32_MAX:
+                raise _Ineligible("provider-wide sum exceeds i32")
+
+        def check_minmax_exact(vspec) -> None:
+            # min/max rows reduce in f32: int values >= 2^24 would round
+            # (the jnp kernel keeps them exact in i32) -> ineligible
+            if int_max_abs(vspec) >= _F32_EXACT:
+                raise _Ineligible("int min/max not f32-exact")
+
+        aggs: List[Tuple[str, Optional[int]]] = []
+        for aspec in agg_specs:
+            base, mv, vspec, acc = aspec[0], aspec[1], aspec[2], aspec[3]
+            if mv:
+                raise _Ineligible("mv aggregation")
+            if base == "count" and vspec is None:
+                aggs.append(("count", None))
+                continue
+            if base not in ("count", "sum", "avg", "min", "max",
+                            "minmaxrange"):
+                raise _Ineligible(base)
+            if base == "count":
+                aggs.append(("count", None))
+                continue
+            vi = value_idx(vspec, acc)
+            if acc == "i32":
+                if base in ("sum", "avg"):
+                    check_sum_exact(vspec)
+                else:  # min/max/minmaxrange on int values
+                    check_minmax_exact(vspec)
             aggs.append((base, vi))
     except _Ineligible:
         return None
 
-    # -- fetch device arrays
-    packed_cols = []
-    bits = []
-    for nm in packed_names:
-        pc = staged.packed_column(nm)
-        if pc is None:
-            return None
-        bits.append(pc.bits)
-        W = PALLAS_TILE // pc.vals_per_word
-        packed_cols.append(pc.words.reshape(-1, W // 128, 128))
-    value_cols = []
-    for nm in value_names:
-        v = staged.value_column(nm)
-        if v is None or v.dtype not in (jnp.float32, jnp.int32):
-            return None
-        value_cols.append(v.reshape(-1, PALLAS_TILE // 128, 128))
-
-    G = max(_G_CHUNK, -(-num_groups // _G_CHUNK) * _G_CHUNK)
-    spec = PallasGroupSpec(
-        num_tiles=staged.pallas_capacity() // PALLAS_TILE,
-        packed_bits=tuple(bits),
-        filters=tuple((fi, neg) for fi, neg, _, _ in filters),
-        group_idx=tuple(group_idx),
-        group_strides=tuple(strides),
-        num_groups_padded=G,
-        aggs=tuple(aggs),
-        value_is_int=tuple(value_is_int),
-        interpret=interpret,
-    )
-    params = [v for _, _, lo, hi in filters for v in (lo, hi)]
-    params.append(staged.num_docs)
-    return spec, np.asarray(params, dtype=np.int32), packed_cols, value_cols
+    params = np.asarray([v for lo, hi in intervals for v in (lo, hi)],
+                        dtype=np.int32).reshape(-1)
+    return PallasPlan(
+        packed_names=packed_names, value_names=value_names,
+        value_is_int=tuple(value_is_int), filter_tree=tree,
+        n_slots=len(intervals), group_idx=tuple(group_idx),
+        group_strides=tuple(strides), num_groups_padded=G,
+        aggs=tuple(aggs), static_params=params)
 
 
 # --------------------------------------------------------------------------
 # kernel builder
 # --------------------------------------------------------------------------
 
-def _row_layout(spec: PallasGroupSpec):
-    """The single source of truth for the matmul row stack and the two
-    output accumulators: rows = [float values..., mask(count), int
-    values...]; out_f holds the float rows, out_i holds [count, int rows].
-    Returns (float_vals, int_vals, Mf, Mi, frow, irow)."""
-    float_vals = [vi for vi, isint in enumerate(spec.value_is_int) if not isint]
-    int_vals = [vi for vi, isint in enumerate(spec.value_is_int) if isint]
-    Mf = max(len(float_vals), 1)
-    Mi = 1 + len(int_vals)
-    frow = {vi: r for r, vi in enumerate(float_vals)}
-    irow = {vi: r + 1 for r, vi in enumerate(int_vals)}
-    return float_vals, int_vals, Mf, Mi, frow, irow
+def _row_layout(spec: PallasSpec):
+    """Single source of truth for the accumulator layout:
+    - out_f [Mf, G] f32: float-value sum rows (>=1 row, dummy if none)
+    - out_i [Mi, G] i32: [count, int-value sum rows...]
+    - out_mm [Mm, G] f32: (value, kind) min/max rows (>=1 row, dummy if none)
+    Returns (fsum_row, isum_row, mm_row, Mf, Mi, Mm) where *_row map value
+    input idx (or (vi, kind)) -> row index."""
+    fsum_row: Dict[int, int] = {}
+    isum_row: Dict[int, int] = {}
+    mm_row: Dict[Tuple[int, str], int] = {}
+    for base, vi in spec.aggs:
+        if base in ("sum", "avg"):
+            if spec.value_is_int[vi]:
+                isum_row.setdefault(vi, 1 + len(isum_row))
+            else:
+                fsum_row.setdefault(vi, len(fsum_row))
+        elif base == "min":
+            mm_row.setdefault((vi, "min"), len(mm_row))
+        elif base == "max":
+            mm_row.setdefault((vi, "max"), len(mm_row))
+        elif base == "minmaxrange":
+            mm_row.setdefault((vi, "min"), len(mm_row))
+            mm_row.setdefault((vi, "max"), len(mm_row))
+    Mf = max(len(fsum_row), 1)
+    Mi = 1 + len(isum_row)
+    Mm = max(len(mm_row), 1)
+    return fsum_row, isum_row, mm_row, Mf, Mi, Mm
 
 
-def build_group_kernel(spec: PallasGroupSpec):
+def build_kernel(spec: PallasSpec):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -221,60 +335,95 @@ def build_group_kernel(spec: PallasGroupSpec):
     n_chunks = G // _G_CHUNK
     n_packed = len(spec.packed_bits)
     n_values = len(spec.value_is_int)
+    S = spec.num_segs
+    TPS = spec.tiles_per_seg
 
-    float_vals, int_vals, Mf, Mi, _, _ = _row_layout(spec)
+    fsum_row, isum_row, mm_row, Mf, Mi, Mm = _row_layout(spec)
+    # params: [2*n_slots intervals][S num_docs][1 doc_base]
+    nd_off = 2 * spec.n_slots
 
     def kernel(params_ref, *refs):
         packed = refs[:n_packed]
         values = refs[n_packed:n_packed + n_values]
-        out_f, out_i = refs[n_packed + n_values:]
-        t = pl.program_id(0)
+        out_f, out_i, out_mm, out_seg = refs[n_packed + n_values:]
+        s = pl.program_id(0)
+        t = pl.program_id(1)
 
-        @pl.when(t == 0)
-        def _init():
+        @pl.when((s == 0) & (t == 0))
+        def _init_global():
             out_f[...] = jnp.zeros_like(out_f)
             out_i[...] = jnp.zeros_like(out_i)
+            for (vi, kind), r in mm_row.items():
+                out_mm[r, :] = jnp.full((G,), _POS if kind == "min" else _NEG,
+                                        dtype=jnp.float32)
+            if not mm_row:
+                out_mm[...] = jnp.zeros_like(out_mm)
+
+        @pl.when(t == 0)
+        def _init_seg():
+            out_seg[...] = jnp.zeros_like(out_seg)
 
         # -- unpack planar words -> dictIds [RT, 128] i32 per column
         ids = []
         for ci, bits in enumerate(spec.packed_bits):
             K = 32 // bits
             vmask = jnp.uint32((1 << bits) - 1)
-            w = packed[ci][0]                      # [W/128, 128] u32
+            w = packed[ci][0, 0]                   # [W/128, 128] u32
             planes = [((w >> jnp.uint32(k * bits)) & vmask).astype(jnp.int32)
                       for k in range(K)]
             ids.append(planes[0] if K == 1 else
                        jnp.concatenate(planes, axis=0))  # [RT, 128]
 
-        # -- validity + predicate mask
-        num_docs = params_ref[2 * len(spec.filters)]
+        # -- validity + filter expression
+        num_docs = params_ref[nd_off + s]
+        doc_base = params_ref[nd_off + S]
         row = jax.lax.broadcasted_iota(jnp.int32, (RT, 128), 0)
         lane = jax.lax.broadcasted_iota(jnp.int32, (RT, 128), 1)
-        mask = (t * T + row * 128 + lane) < num_docs
-        for fi, (pi, negate) in enumerate(spec.filters):
-            lo = params_ref[2 * fi]
-            hi = params_ref[2 * fi + 1]
-            m = (ids[pi] >= lo) & (ids[pi] <= hi)
-            mask = mask & (~m if negate else m)
+        doc = doc_base + t * T + row * 128 + lane
+        valid = doc < num_docs
+
+        def emit(node):
+            op = node[0]
+            if op == "true":
+                return jnp.ones((RT, 128), dtype=bool)
+            if op == "and":
+                m = emit(node[1][0])
+                for c in node[1][1:]:
+                    m = m & emit(c)
+                return m
+            if op == "or":
+                m = emit(node[1][0])
+                for c in node[1][1:]:
+                    m = m | emit(c)
+                return m
+            if op == "not":
+                return ~emit(node[1][0])
+            _, pi, slot = node                     # "iv"
+            lo = params_ref[2 * slot]
+            hi = params_ref[2 * slot + 1]
+            return (ids[pi] >= lo) & (ids[pi] <= hi)
+
+        mask = emit(spec.filter_tree) & valid
         mask_f = mask.astype(jnp.float32)
 
-        # -- composed group keys
+        # -- composed group keys (all zero for scalar aggregation)
         keys = jnp.zeros((RT, 128), dtype=jnp.int32)
         for gi, stride in zip(spec.group_idx, spec.group_strides):
             keys = keys + ids[gi] * jnp.int32(stride)
 
-        # -- matmul row stack [M, RT, 128]
-        rows = []
-        for vi in float_vals:
-            rows.append(values[vi][0].astype(jnp.float32) * mask_f)
-        if not float_vals:
-            rows.append(jnp.zeros((RT, 128), dtype=jnp.float32))
-        rows.append(mask_f)
-        for vi in int_vals:
-            rows.append(values[vi][0].astype(jnp.float32) * mask_f)
-        R = jnp.stack(rows)                       # [Mf+Mi, RT, 128]
+        # -- per-segment matched docs (QueryStats parity)
+        out_seg[0, :] += mask_f.sum(axis=0)
 
-        # -- one-hot matmul per 128-group chunk (MXU)
+        # -- sum/count rows -> one-hot matmul per 128-group chunk (MXU)
+        rows = [jnp.zeros((RT, 128), dtype=jnp.float32)] * Mf
+        for vi, r in fsum_row.items():
+            rows[r] = values[vi][0, 0].astype(jnp.float32) * mask_f
+        rows.append(mask_f)                        # count row (out_i row 0)
+        irows = [None] * (Mi - 1)
+        for vi, r in isum_row.items():
+            irows[r - 1] = values[vi][0, 0].astype(jnp.float32) * mask_f
+        R = jnp.stack(rows + irows)                # [Mf + Mi, RT, 128]
+
         for c in range(n_chunks):
             g0 = c * _G_CHUNK
             g_iota = g0 + jax.lax.broadcasted_iota(
@@ -282,49 +431,68 @@ def build_group_kernel(spec: PallasGroupSpec):
             oh = (keys[:, :, None] == g_iota).astype(jnp.float32)
             part = jax.lax.dot_general(
                 R, oh, (((1, 2), (0, 1)), ((), ())),
-                preferred_element_type=jnp.float32)   # [M, 128]
+                preferred_element_type=jnp.float32)   # [Mf + Mi, 128]
             out_f[:, g0:g0 + _G_CHUNK] += part[:Mf]
             out_i[:, g0:g0 + _G_CHUNK] += part[Mf:].astype(jnp.int32)
 
-    def block2(shape0):
-        return pl.BlockSpec((1,) + shape0, lambda t: (t,) + (0,) * len(shape0),
+            # -- min/max rows reduce on the VPU per chunk
+            for (vi, kind), r in mm_row.items():
+                neutral = _POS if kind == "min" else _NEG
+                v = values[vi][0, 0].astype(jnp.float32)
+                vm = jnp.where(mask, v, neutral)
+                eq = keys[:, :, None] == g_iota
+                v3 = jnp.where(eq, vm[:, :, None], neutral)
+                red = (v3.min(axis=(0, 1)) if kind == "min"
+                       else v3.max(axis=(0, 1)))
+                cur = out_mm[r, g0:g0 + _G_CHUNK]
+                out_mm[r, g0:g0 + _G_CHUNK] = (
+                    jnp.minimum(cur, red) if kind == "min"
+                    else jnp.maximum(cur, red))
+
+    def block(shape0):
+        nd = len(shape0)
+        return pl.BlockSpec((1, 1) + shape0,
+                            lambda s, t: (s, t) + (0,) * nd,
                             memory_space=pltpu.VMEM)
 
     in_specs = [pl.BlockSpec(memory_space=pltpu.SMEM)]
     for bits in spec.packed_bits:
         W = T // (32 // bits)
-        in_specs.append(block2((W // 128, 128)))
+        in_specs.append(block((W // 128, 128)))
     for _ in range(n_values):
-        in_specs.append(block2((RT, 128)))
+        in_specs.append(block((RT, 128)))
 
     out_specs = (
-        pl.BlockSpec((Mf, G), lambda t: (0, 0), memory_space=pltpu.VMEM),
-        pl.BlockSpec((Mi, G), lambda t: (0, 0), memory_space=pltpu.VMEM),
+        pl.BlockSpec((Mf, G), lambda s, t: (0, 0), memory_space=pltpu.VMEM),
+        pl.BlockSpec((Mi, G), lambda s, t: (0, 0), memory_space=pltpu.VMEM),
+        pl.BlockSpec((Mm, G), lambda s, t: (0, 0), memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, 128), lambda s, t: (s, 0), memory_space=pltpu.VMEM),
     )
     out_shape = (
         jax.ShapeDtypeStruct((Mf, G), jnp.float32),
         jax.ShapeDtypeStruct((Mi, G), jnp.int32),
+        jax.ShapeDtypeStruct((Mm, G), jnp.float32),
+        jax.ShapeDtypeStruct((S, 128), jnp.float32),
     )
 
-    call = pl.pallas_call(
+    return pl.pallas_call(
         kernel,
-        grid=(spec.num_tiles,),
+        grid=(S, TPS),
         in_specs=in_specs,
         out_specs=out_specs,
         out_shape=out_shape,
         interpret=spec.interpret,
     )
-    return jax.jit(call)
 
 
 class PallasKernelCache:
     def __init__(self):
-        self._cache: Dict[PallasGroupSpec, Any] = {}
+        self._cache: Dict[PallasSpec, Any] = {}
 
-    def get(self, spec: PallasGroupSpec):
+    def get(self, spec: PallasSpec):
         k = self._cache.get(spec)
         if k is None:
-            k = build_group_kernel(spec)
+            k = jax.jit(build_kernel(spec))
             self._cache[spec] = k
         return k
 
@@ -333,33 +501,101 @@ class PallasKernelCache:
 
 
 # --------------------------------------------------------------------------
-# runner: plan + staged segment -> jnp-kernel-shaped output dict
+# output assembly: pallas accumulators -> jnp-kernel-shaped output tree
 # --------------------------------------------------------------------------
 
-def run_group_by(plan, staged: StagedSegment, cache: PallasKernelCache,
-                 interpret: bool) -> Optional[Dict[str, Any]]:
-    """Returns the same output tree as the jnp group-by kernel
-    ({"presence", "agg{i}"}) so the shared decode path applies, or None if
-    the plan isn't eligible."""
-    ext = extract_spec(plan, staged, interpret)
-    if ext is None:
-        return None
-    spec, params, packed_cols, value_cols = ext
-    kernel = cache.get(spec)
-    out_f, out_i = kernel(params, *packed_cols, *value_cols)
+def assemble_outputs(plan_spec: Tuple, spec: PallasSpec, out_f, out_i, out_mm,
+                     seg_matched) -> Dict[str, Any]:
+    """Map the pallas accumulators onto the jnp kernel's output tree so
+    pack_outputs/unpack_outputs/decode apply unchanged. ``seg_matched`` is
+    the [S] per-segment matched-doc count (summed over lanes, and over mesh
+    axes by the sharded caller)."""
+    _, agg_specs, group_specs, num_groups, _ = plan_spec
+    fsum_row, isum_row, mm_row, _, _, _ = _row_layout(spec)
+    grouped = bool(group_specs)
+    n = num_groups if grouped else 1
+    counts = out_i[0, :n]
 
-    num_groups = plan.spec[3]
-    _, _, _, _, frow, irow = _row_layout(spec)
+    def sum_leaf(vi):
+        if spec.value_is_int[vi]:
+            return out_i[isum_row[vi], :n]
+        return out_f[fsum_row[vi], :n]
 
-    counts = out_i[0, :num_groups].astype(jnp.int64)
-    out: Dict[str, Any] = {"presence": counts}
-    for i, (base, vi) in enumerate(spec.aggs):
+    out: Dict[str, Any] = {}
+    if grouped:
+        out["presence"] = counts
+    else:
+        out["num_matched"] = counts[0]
+    for i, ((base, vi), aspec) in enumerate(zip(spec.aggs, agg_specs)):
         if base == "count":
-            out[f"agg{i}"] = counts
-        else:
-            if vi in frow:
-                s = out_f[frow[vi], :num_groups].astype(jnp.float64)
-            else:
-                s = out_i[irow[vi], :num_groups].astype(jnp.int64)
-            out[f"agg{i}"] = (s, counts) if base == "avg" else s
+            leaf: Any = counts
+        elif base in ("sum", "avg"):
+            leaf = sum_leaf(vi)
+            if base == "avg":
+                leaf = (leaf, counts)
+        elif base == "min":
+            leaf = out_mm[mm_row[(vi, "min")], :n]
+        elif base == "max":
+            leaf = out_mm[mm_row[(vi, "max")], :n]
+        else:  # minmaxrange
+            leaf = (out_mm[mm_row[(vi, "min")], :n],
+                    out_mm[mm_row[(vi, "max")], :n])
+        if not grouped:
+            leaf = (tuple(x[0] for x in leaf) if isinstance(leaf, tuple)
+                    else leaf[0])
+        out[f"agg{i}"] = leaf
+    if seg_matched is not None:
+        out["seg_matched"] = seg_matched
     return out
+
+
+# --------------------------------------------------------------------------
+# per-segment runner (engine/executor.py fallback path)
+# --------------------------------------------------------------------------
+
+def run_segment(plan, staged: StagedSegment, cache: PallasKernelCache,
+                interpret: bool):
+    """Run the fused kernel over one staged segment; returns the PACKED f64
+    output vector (kernels.pack_outputs layout, single D2H fetch) or None
+    when the plan/staging isn't eligible."""
+    from pinot_tpu.engine.kernels import pack_outputs
+
+    pp = extract_plan(plan, staged.segment)
+    if pp is None:
+        return None
+
+    packed_cols = []
+    bits = []
+    for nm in pp.packed_names:
+        pc = staged.packed_column(nm)
+        if pc is None:
+            return None
+        bits.append(pc.bits)
+        W = PALLAS_TILE // pc.vals_per_word
+        packed_cols.append(pc.words.reshape(1, -1, W // 128, 128))
+    value_cols = []
+    for nm in pp.value_names:
+        v = staged.value_column(nm)
+        if v is None or v.dtype not in (jnp.float32, jnp.int32):
+            return None
+        value_cols.append(v.reshape(1, -1, PALLAS_TILE // 128, 128))
+
+    tiles = staged.pallas_capacity() // PALLAS_TILE
+    spec = pp.spec(num_segs=1, tiles_per_seg=tiles, interpret=interpret)
+    spec = _with_bits(spec, tuple(bits))
+    kernel = cache.get(spec)
+
+    params = jnp.concatenate([
+        jnp.asarray(pp.static_params, dtype=jnp.int32).reshape(-1),
+        jnp.asarray([staged.num_docs, 0], dtype=jnp.int32),
+    ])
+    out_f, out_i, out_mm, out_seg = kernel(params, *packed_cols, *value_cols)
+    tree = assemble_outputs(plan.spec, spec, out_f, out_i, out_mm,
+                            seg_matched=None)
+    return pack_outputs(tree, plan.spec)
+
+
+def _with_bits(spec: PallasSpec, bits: Tuple[int, ...]) -> PallasSpec:
+    from dataclasses import replace
+
+    return replace(spec, packed_bits=bits)

@@ -246,6 +246,75 @@ class SegmentBatch:
         return out
 
 
+    # -- pallas layouts (planar bit-packed / decoded values), batch-wide ----
+    def pallas_capacity(self) -> int:
+        """Per-segment doc capacity padded to whole Pallas tiles."""
+        from pinot_tpu.engine.staging import PALLAS_TILE
+
+        return -(-self.capacity // PALLAS_TILE) * PALLAS_TILE
+
+    def packed_column_batch(self, name: str, pad_segments: int = 0,
+                            min_tiles: int = 1):
+        """(words [S, tiles, W//128, 128] u32, bits) planar bit-packed
+        UNIFIED dictIds for the sharded fused kernel, or None when the
+        column has no dictionary / isn't SV (see staging.PackedColumn for
+        the per-segment analogue and the planar layout contract).
+        ``min_tiles`` rounds the tile count up (doc-axis sharding needs
+        tiles % mesh doc size == 0; pad tiles mask out via num_docs)."""
+        from pinot_tpu.engine.staging import PALLAS_TILE, pack_bits
+
+        cm = self.metadata.column(name)
+        if not (cm.has_dictionary and cm.single_value):
+            return None
+        fwd = self.stacked_column(name, pad_segments=pad_segments)["fwd"]
+        S = fwd.shape[0]
+        bits = pack_bits(max(1, (max(cm.cardinality - 1, 1)).bit_length()))
+        K = 32 // bits
+        W = PALLAS_TILE // K
+        tiles = self.pallas_tiles(min_tiles)
+        ids = np.zeros((S, tiles * PALLAS_TILE), dtype=np.uint32)
+        ids[:, :fwd.shape[1]] = fwd.astype(np.uint32)
+        planes = ids.reshape(S, tiles, K, W)
+        words = np.zeros((S, tiles, W), dtype=np.uint32)
+        for k in range(K):
+            words |= planes[:, :, k, :] << np.uint32(k * bits)
+        return words.reshape(S, tiles, W // 128, 128), bits
+
+    def pallas_tiles(self, min_tiles: int = 1) -> int:
+        """Tile count per segment, rounded up to a multiple of min_tiles."""
+        from pinot_tpu.engine.staging import PALLAS_TILE
+
+        t = self.pallas_capacity() // PALLAS_TILE
+        return -(-t // min_tiles) * min_tiles
+
+    def value_column_batch(self, name: str, pad_segments: int = 0,
+                           min_tiles: int = 1):
+        """[S, tiles, TILE/128, 128] f32/i32 per-doc numeric values, or None
+        when the column can't serve fused-kernel value rows."""
+        from pinot_tpu.engine.staging import PALLAS_TILE, staged_int_dtype
+
+        cm = self.metadata.column(name)
+        if not (cm.single_value and cm.data_type.is_numeric):
+            return None
+        tree = self.stacked_column(name, pad_segments=pad_segments)
+        fwd = tree["fwd"]
+        if cm.has_dictionary:
+            vals = tree["dictvals"][fwd]           # unified dictId gather
+        else:
+            vals = fwd
+        if cm.data_type.is_integral:
+            if staged_int_dtype(cm) != np.dtype(np.int32):
+                return None
+            vals = vals.astype(np.int32)
+        else:
+            vals = vals.astype(np.float32)
+        S = vals.shape[0]
+        tiles = self.pallas_tiles(min_tiles)
+        out = np.zeros((S, tiles * PALLAS_TILE), dtype=vals.dtype)
+        out[:, :vals.shape[1]] = vals
+        return out.reshape(S, tiles, PALLAS_TILE // 128, 128)
+
+
 def _merge_dictionaries(dicts: List[Dictionary], data_type: DataType):
     """Merge per-segment sorted dictionaries into one table-level dictionary;
     returns (unified, [per-segment oldId->newId remap arrays])."""

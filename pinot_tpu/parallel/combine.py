@@ -182,3 +182,70 @@ def build_sharded_kernel(spec: Tuple, mesh: Mesh,
 def pad_segments(n: int, n_seg: int) -> int:
     """Segments padded up to a multiple of the seg-axis size."""
     return ((n + n_seg - 1) // n_seg) * n_seg
+
+
+# --------------------------------------------------------------------------
+# sharded fused-Pallas combine: the flagship serving path for eligible
+# aggregation/group-by queries. Each device runs the fused scan kernel
+# (pallas_kernels.build_kernel) over its local [S_local, T_local] shard of
+# the planar bit-packed batch; partials merge with psum/pmin/pmax over ICI.
+# --------------------------------------------------------------------------
+
+def build_sharded_pallas_kernel(spec, plan_spec: Tuple, mesh: Mesh):
+    """jitted fn(static_params, packed_cols, value_cols, num_docs) ->
+    packed f64 vector.
+
+    ``spec`` is a pallas_kernels.PallasSpec already sized PER DEVICE
+    (num_segs/tiles_per_seg local to one mesh cell); inputs are
+    device-committed arrays sharded (seg, doc) over the mesh:
+    packed [S, T, W/128, 128] u32, values [S, T, TILE/128, 128] f32/i32,
+    num_docs [S] i32, static_params [2*n_slots] i32 replicated (interval
+    literals stay runtime args so same-shape queries share the compile)."""
+    from pinot_tpu.engine.pallas_kernels import (
+        _row_layout,
+        assemble_outputs,
+        build_kernel,
+    )
+    from pinot_tpu.engine.staging import PALLAS_TILE
+
+    T_l = spec.tiles_per_seg
+    call = build_kernel(spec)
+    _, _, mm_row, _, _, _ = _row_layout(spec)
+    axes = (SEG_AXIS, DOC_AXIS)
+
+    def per_device(static_params, packed_cols, value_cols, num_docs):
+        doc_base = (jax.lax.axis_index(DOC_AXIS)
+                    * (T_l * PALLAS_TILE)).astype(jnp.int32)
+        params = jnp.concatenate([
+            static_params.astype(jnp.int32).reshape(-1),
+            num_docs.astype(jnp.int32), doc_base[None]])
+        out_f, out_i, out_mm, out_seg = call(params, *packed_cols,
+                                             *value_cols)
+        out_f = _cross_reduce(out_f, "sum", axes, mesh)
+        # per-device int partials are i32-bounded (extract_plan's provider-
+        # wide check); widen before the mesh psum so the cross-device total
+        # can't wrap (O(groups) cost only)
+        out_i = _cross_reduce(out_i.astype(jnp.int64), "sum", axes, mesh)
+        if mm_row:
+            rows = list(out_mm)
+            for (_, kind), r in mm_row.items():
+                rows[r] = _cross_reduce(out_mm[r], kind, axes, mesh)
+            out_mm = jnp.stack(rows)
+        seg_local = out_seg.sum(axis=1)            # [S_l]
+        seg_local = _cross_reduce(seg_local, "sum", (DOC_AXIS,), mesh)
+        if mesh.shape[SEG_AXIS] > 1:
+            seg_local = jax.lax.all_gather(seg_local, SEG_AXIS, tiled=True)
+        tree = assemble_outputs(plan_spec, spec, out_f, out_i, out_mm,
+                                seg_matched=seg_local)
+        return pack_outputs(tree, plan_spec)
+
+    pk_spec = P(SEG_AXIS, DOC_AXIS, None, None)
+    sharded = jax.shard_map(
+        per_device, mesh=mesh,
+        in_specs=(P(),
+                  [pk_spec] * len(spec.packed_bits),
+                  [pk_spec] * len(spec.value_is_int),
+                  P(SEG_AXIS)),
+        out_specs=P(),
+        check_vma=False)
+    return jax.jit(sharded)

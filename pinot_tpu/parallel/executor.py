@@ -58,6 +58,9 @@ class ShardedQueryExecutor(ServerQueryExecutor):
 
         self._query_cache: "OrderedDict[Tuple, Tuple]" = OrderedDict()
         self._query_cache_cap = 256
+        # PallasSpec -> jitted sharded fused kernel (literal params stay
+        # runtime args, so same-shape queries share the compile)
+        self._pallas_sharded: Dict = {}
 
     # -- combine overrides --------------------------------------------------
     def _any_star_tree_fit(self, ctx, aggs, segments) -> bool:
@@ -120,10 +123,6 @@ class ShardedQueryExecutor(ServerQueryExecutor):
     def _run_sharded(self, ctx: QueryContext,
                      segments: List[ImmutableSegment],
                      stats: QueryStats):
-        import jax
-
-        from jax.sharding import NamedSharding, PartitionSpec as P
-
         from pinot_tpu.engine.kernels import unpack_outputs
 
         batch = self.batch_for(segments)
@@ -136,28 +135,35 @@ class ShardedQueryExecutor(ServerQueryExecutor):
             self._query_cache.move_to_end(qkey)
         else:
             plan = plan_segment(ctx, batch)
-            # reject before paying dictionary unification + H2D staging
-            if plan.spec[-1] % self.mesh.shape[DOC_AXIS]:
-                raise PlanError(
-                    f"capacity {plan.spec[-1]} !| doc axis "
-                    f"{self.mesh.shape[DOC_AXIS]}")
-            cols = {name: self._staged_column(batch, name, S)
-                    for name in plan.columns}
-            col_layouts = tuple(sorted(
-                (name, tuple(sorted(t.keys()))) for name, t in cols.items()))
-            kernel = self.sharded_kernels.get(plan.spec, col_layouts)
-            # params committed to device once per query: per-call H2D
-            # uploads are tunnel roundtrips the serving path cannot afford
-            params = jax.device_put(
-                tuple(plan.params), NamedSharding(self.mesh, P()))
-            cached = (plan, params, kernel, cols)
+            call_fn = self._build_pallas_call(plan, batch, S)
+            is_pallas = call_fn is not None
+            if call_fn is None:
+                call_fn = self._build_jnp_call(plan, batch, S)
+            cached = (plan, call_fn, is_pallas)
             self._query_cache[qkey] = cached
             if len(self._query_cache) > self._query_cache_cap:
                 self._query_cache.popitem(last=False)
-        plan, params, kernel, cols = cached
+        plan, call_fn, is_pallas = cached
         num_docs = self._device_num_docs(batch, S)
 
-        packed = kernel(cols, params, num_docs)
+        try:
+            packed = call_fn(num_docs)
+        except (PlanError, ValueError):
+            raise
+        except Exception:
+            # jax.jit compiles lazily: a Mosaic lowering failure on the real
+            # chip surfaces HERE, not in _build_pallas_call. Fall back to
+            # the jnp combine, repair the cache, and stop trying pallas.
+            if not is_pallas:
+                raise
+            import logging
+
+            logging.getLogger(__name__).exception(
+                "sharded pallas kernel failed at run; disabling pallas")
+            self.use_pallas = False
+            call_fn = self._build_jnp_call(plan, batch, S)
+            self._query_cache[qkey] = (plan, call_fn, False)
+            packed = call_fn(num_docs)
         # ONE D2H fetch decodes the entire query result
         out = unpack_outputs(packed, plan.spec, num_seg=S)
 
@@ -167,6 +173,117 @@ class ShardedQueryExecutor(ServerQueryExecutor):
         stats.num_docs_scanned += int(seg_matched.sum())
         stats.num_segments_matched += int((seg_matched > 0).sum())
         return batch, out, plan
+
+    def _build_jnp_call(self, plan: SegmentPlan, batch: SegmentBatch,
+                        S: int):
+        """num_docs -> packed output via the jnp masked-vector combine."""
+        import jax
+
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        # reject before paying dictionary unification + H2D staging
+        if plan.spec[-1] % self.mesh.shape[DOC_AXIS]:
+            raise PlanError(
+                f"capacity {plan.spec[-1]} !| doc axis "
+                f"{self.mesh.shape[DOC_AXIS]}")
+        cols = {name: self._staged_column(batch, name, S)
+                for name in plan.columns}
+        col_layouts = tuple(sorted(
+            (name, tuple(sorted(t.keys()))) for name, t in cols.items()))
+        kernel = self.sharded_kernels.get(plan.spec, col_layouts)
+        # params committed to device once per query: per-call H2D uploads
+        # are tunnel roundtrips the serving path cannot afford
+        params = jax.device_put(
+            tuple(plan.params), NamedSharding(self.mesh, P()))
+        return lambda num_docs: kernel(cols, params, num_docs)
+
+    def _build_pallas_call(self, plan: SegmentPlan, batch: SegmentBatch,
+                           S: int):
+        """num_docs -> packed output via the sharded fused Pallas kernel
+        (VERDICT r3 item 2: the flagship kernel serves the combine path),
+        or None when the plan/backing isn't eligible."""
+        import logging
+
+        from dataclasses import replace
+
+        import jax
+
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from pinot_tpu.engine.pallas_kernels import extract_plan
+        from pinot_tpu.engine.staging import PALLAS_TILE
+        from pinot_tpu.parallel.combine import build_sharded_pallas_kernel
+
+        interpret = self._pallas_mode()
+        if interpret is None:
+            return None
+        pp = extract_plan(plan, batch)
+        if pp is None:
+            return None
+        n_seg = self.mesh.shape[SEG_AXIS]
+        n_doc = self.mesh.shape[DOC_AXIS]
+        tiles = batch.pallas_tiles(min_tiles=n_doc)
+        try:
+            packed_cols, bits = [], []
+            for nm in pp.packed_names:
+                staged = self._staged_pallas(batch, nm, S, "packed")
+                if staged is None:
+                    return None
+                packed_cols.append(staged[0])
+                bits.append(staged[1])
+            value_cols = []
+            for nm in pp.value_names:
+                staged = self._staged_pallas(batch, nm, S, "value")
+                if staged is None:
+                    return None
+                value_cols.append(staged)
+            spec = replace(
+                pp.spec(num_segs=S // n_seg, tiles_per_seg=tiles // n_doc,
+                        interpret=bool(interpret)),
+                packed_bits=tuple(bits))
+            kernel = self._pallas_sharded.get(spec)
+            if kernel is None:
+                kernel = build_sharded_pallas_kernel(spec, plan.spec,
+                                                     self.mesh)
+                self._pallas_sharded[spec] = kernel
+            params = jax.device_put(pp.static_params,
+                                    NamedSharding(self.mesh, P()))
+        except Exception:
+            logging.getLogger(__name__).exception(
+                "sharded pallas build failed; using jnp combine")
+            return None
+        return lambda num_docs: kernel(params, packed_cols, value_cols,
+                                       num_docs)
+
+    def _staged_pallas(self, batch: SegmentBatch, name: str, S: int,
+                       kind: str):
+        """Device-committed pallas-layout arrays per (batch, column, S):
+        kind 'packed' -> (words, bits); kind 'value' -> values array."""
+        import jax
+
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        key = (batch.metadata.segment_name, f"__pallas_{kind}:{name}", S)
+        staged = self._device_cols.get(key)
+        if staged is None:
+            sharding = NamedSharding(
+                self.mesh, P(SEG_AXIS, DOC_AXIS, None, None))
+            n_doc = self.mesh.shape[DOC_AXIS]
+            if kind == "packed":
+                host = batch.packed_column_batch(name, pad_segments=S,
+                                                 min_tiles=n_doc)
+                if host is None:
+                    return None
+                words, bits = host
+                staged = (jax.device_put(words, sharding), bits)
+            else:
+                host = batch.value_column_batch(name, pad_segments=S,
+                                                min_tiles=n_doc)
+                if host is None:
+                    return None
+                staged = jax.device_put(host, sharding)
+            self._device_cols[key] = staged
+        return staged
 
     def _device_num_docs(self, batch: SegmentBatch, S: int):
         """Per-segment doc counts committed to device once per (batch, S)."""

@@ -79,13 +79,12 @@ QUERIES = [
 
 def test_plans_are_pallas_eligible(setup, pallas_exec):
     """The suite must actually exercise the pallas path, not fall back."""
-    from pinot_tpu.engine.pallas_kernels import extract_spec
+    from pinot_tpu.engine.pallas_kernels import extract_plan
 
     _, segs = setup
-    staged = StagingCache().stage(segs[0])
     for sql in QUERIES:
         plan = plan_segment(compile_query(sql), segs[0])
-        assert extract_spec(plan, staged, True) is not None, sql
+        assert extract_plan(plan, segs[0]) is not None, sql
 
 
 @pytest.mark.parametrize("sql", QUERIES, ids=[q[:60] for q in QUERIES])
@@ -130,3 +129,77 @@ def test_packed_layout_roundtrip(setup):
         fwd = np.asarray(segs[0].data_source(col).forward_index)
         flat = got.reshape(-1)[:fwd.shape[0]]
         np.testing.assert_array_equal(flat, fwd.astype(np.uint32))
+
+
+# -- widened eligibility (round-4): scalar aggs, min/max, OR filters --------
+
+WIDE_QUERIES = [
+    "SELECT count(*), sum(qty) FROM pl_sales WHERE region = 'east'",
+    "SELECT sum(price), avg(qty) FROM pl_sales "
+    "WHERE year BETWEEN 2005 AND 2015",
+    "SELECT min(price), max(price), minmaxrange(qty) FROM pl_sales "
+    "WHERE region != 'west'",
+    "SELECT region, min(qty), max(price) FROM pl_sales "
+    "GROUP BY region ORDER BY region",
+    "SELECT region, sum(qty) FROM pl_sales "
+    "WHERE year = 2010 OR region = 'east' GROUP BY region ORDER BY region",
+    "SELECT count(*) FROM pl_sales "
+    "WHERE (region = 'east' OR region = 'west') AND year >= 2012",
+]
+
+
+def test_wide_plans_are_pallas_eligible(setup):
+    from pinot_tpu.engine.pallas_kernels import extract_plan
+
+    _, segs = setup
+    for sql in WIDE_QUERIES:
+        plan = plan_segment(compile_query(sql), segs[0])
+        assert extract_plan(plan, segs[0]) is not None, sql
+
+
+@pytest.mark.parametrize("sql", WIDE_QUERIES, ids=[q[:60] for q in WIDE_QUERIES])
+def test_wide_pallas_matches_host(setup, pallas_exec, host_exec, sql):
+    _, segs = setup
+    got, _ = pallas_exec.execute(compile_query(sql), segs)
+    want, _ = host_exec.execute(compile_query(sql), segs)
+    assert len(got.rows) == len(want.rows)
+    for gr, wr in zip(got.rows, want.rows):
+        for g, w in zip(gr, wr):
+            if isinstance(w, float):
+                assert g == pytest.approx(w, rel=1e-5, abs=1e-6), (sql, gr, wr)
+            else:
+                assert g == w, (sql, gr, wr)
+
+
+# -- sharded fused-pallas combine (the serving path) ------------------------
+
+@pytest.fixture(scope="module", params=[1, 2], ids=["doc1", "doc2"])
+def sharded_pallas_exec(request):
+    from pinot_tpu.parallel import ShardedQueryExecutor, make_combine_mesh
+
+    mesh = make_combine_mesh(doc_shards=request.param)
+    return ShardedQueryExecutor(mesh=mesh, use_pallas=True)
+
+
+@pytest.mark.parametrize("sql", QUERIES + WIDE_QUERIES,
+                         ids=[q[:60] for q in QUERIES + WIDE_QUERIES])
+def test_sharded_pallas_matches_host(setup, sharded_pallas_exec, host_exec,
+                                     sql):
+    _, segs = setup
+    got, stats = sharded_pallas_exec.execute(compile_query(sql), segs)
+    want, _ = host_exec.execute(compile_query(sql), segs)
+    assert len(got.rows) == len(want.rows)
+    for gr, wr in zip(got.rows, want.rows):
+        for g, w in zip(gr, wr):
+            if isinstance(w, float):
+                assert g == pytest.approx(w, rel=1e-5, abs=1e-6), (sql, gr, wr)
+            else:
+                assert g == w, (sql, gr, wr)
+    assert stats.num_segments_processed == len(segs)
+
+
+def test_sharded_pallas_kernel_actually_used(setup, sharded_pallas_exec):
+    """The serving path must run the fused kernel, not the jnp fallback."""
+    _, segs = setup
+    sharded_pallas_exec.execute(compile_query(QUERIES[1]), segs)
+    assert len(sharded_pallas_exec._pallas_sharded) >= 1

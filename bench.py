@@ -1,13 +1,21 @@
-"""Benchmark: fused TPU query kernels vs the host (CPU/numpy) execution path.
+"""Benchmark: TPU query path vs the host (numpy) execution path.
 
-Workload: BASELINE.json configs #1/#2/#5 reduced to the current feature set —
-filtered aggregations + dictionary group-bys over a multi-segment table, run
-through the sharded device combine (parallel/executor.py) and through the
-pure-host engine (engine/host_engine.py), same result tables asserted equal.
+Workloads (BASELINE.json configs):
+- **SSB** (headline, config #5): flattened Star Schema Benchmark Q1.1-Q4.3
+  (pinot_tpu/tools/ssb.py; ref: contrib/pinot-druid-benchmark/README.md) over
+  a multi-segment table through the sharded device combine, parity-gated
+  against the host engine. Scale via BENCH_SSB_ROWS (default 3,000,000 —
+  SF 0.5; SF 1 = 6,000,000).
+- **micro** (configs #1/#2): the round-2/3 7-query suite (filtered
+  aggregations + dictionary group-bys, 8 x 131k rows) for cross-round
+  continuity.
+- **star-tree** (config #3): SUM/COUNT group-by served from StarTreeV2
+  pre-aggregated records vs the same query forced to scan.
+- **sketches** (config #4): DISTINCTCOUNTHLL + PERCENTILETDIGEST.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"} where value
-is the device p50 latency over the query suite and vs_baseline is the
-host-path / device-path speedup (>1 means the TPU path is faster).
+Prints ONE JSON line {"metric", "value", "unit", "vs_baseline", ...} where
+value is the device p50 SSB latency and vs_baseline is host/device (>1 =>
+the TPU path is faster). Sub-suite results ride in extra keys.
 """
 
 from __future__ import annotations
@@ -21,71 +29,121 @@ import traceback
 
 import numpy as np
 
-NUM_SEGMENTS = 8
-DOCS_PER_SEGMENT = 131_072
-WARMUP = 2
-ITERS = 7
+MICRO_SEGMENTS = 8
+MICRO_DOCS = 131_072
+SSB_ROWS = int(os.environ.get("BENCH_SSB_ROWS", 3_000_000))
+WARMUP = 1
+ITERS = 5
 
-QUERIES = [
-    # config #1: filtered SUM/COUNT aggregation
+MICRO_QUERIES = [
     "SELECT count(*), sum(qty) FROM sales WHERE region = 'east'",
     "SELECT sum(price) FROM sales WHERE year BETWEEN 2017 AND 2021 AND kind != 'c'",
-    # config #2: GROUP BY SUM/MIN/MAX/AVG on dictionary columns
     "SELECT region, sum(qty), count(*) FROM sales GROUP BY region ORDER BY region",
     "SELECT region, kind, sum(price), avg(price), min(qty), max(qty) FROM sales "
     "GROUP BY region, kind ORDER BY region, kind",
     "SELECT year, min(price), max(price) FROM sales WHERE kind = 'a' "
     "GROUP BY year ORDER BY year",
-    # distinct-count + expression aggregation
     "SELECT distinctcount(region) FROM sales WHERE qty > 25",
     "SELECT sum(qty * price) FROM sales WHERE region IN ('west', 'south')",
 ]
 
+STARTREE_QUERY = ("SELECT region, kind, sum(qty), count(*) FROM sales_st "
+                  "GROUP BY region, kind ORDER BY region, kind")
+SKETCH_QUERIES = [
+    "SELECT distinctcounthll(user_id) FROM sales_st WHERE qty > 10",
+    "SELECT percentiletdigest95(price) FROM sales_st",
+]
 
-def _frame(n: int, seed: int):
+
+def _micro_frame(n: int, seed: int, with_user: bool = False):
     rng = np.random.default_rng(seed)
-    regions = ["east", "west", "north", "south"]
-    kinds = ["a", "b", "c"]
-    return {
-        "region": [regions[i] for i in rng.integers(0, 4, n)],
-        "kind": [kinds[i] for i in rng.integers(0, 3, n)],
-        "year": [int(v) for v in rng.integers(2015, 2024, n)],
-        "qty": [int(v) for v in rng.integers(1, 50, n)],
-        "price": [float(v) for v in np.round(rng.normal(100.0, 25.0, n), 2)],
+    regions = np.array(["east", "west", "north", "south"])
+    kinds = np.array(["a", "b", "c"])
+    frame = {
+        "region": regions[rng.integers(0, 4, n)],
+        "kind": kinds[rng.integers(0, 3, n)],
+        "year": rng.integers(2015, 2024, n).astype(np.int64),
+        "qty": rng.integers(1, 50, n).astype(np.int64),
+        "price": np.round(rng.normal(100.0, 25.0, n), 2),
     }
+    if with_user:
+        frame["user_id"] = rng.integers(0, 200_000, n).astype(np.int64)
+    return frame
 
 
-def _build_segments(tmpdir: str):
-    from pinot_tpu.segment import SegmentBuilder, load_segment
+def _micro_schema(with_user: bool = False):
     from pinot_tpu.spi import DataType, FieldSpec, FieldType, Schema
 
-    schema = Schema("sales", [
+    specs = [
         FieldSpec("region", DataType.STRING),
         FieldSpec("kind", DataType.STRING),
         FieldSpec("year", DataType.INT),
         FieldSpec("qty", DataType.LONG, FieldType.METRIC),
         FieldSpec("price", DataType.DOUBLE, FieldType.METRIC),
-    ])
+    ]
+    if with_user:
+        specs.insert(3, FieldSpec("user_id", DataType.LONG))
+    name = "sales_st" if with_user else "sales"
+    return Schema(name, specs)
+
+
+def _build_micro(tmpdir: str):
+    from pinot_tpu.segment import SegmentBuilder, load_segment
+
+    schema = _micro_schema()
     segs = []
-    for i in range(NUM_SEGMENTS):
+    for i in range(MICRO_SEGMENTS):
         b = SegmentBuilder(schema, f"sales_{i}")
-        b.build(_frame(DOCS_PER_SEGMENT, seed=100 + i), tmpdir)
+        b.build(_micro_frame(MICRO_DOCS, seed=100 + i), tmpdir)
         segs.append(load_segment(f"{tmpdir}/sales_{i}"))
     return segs
 
 
-def _time_suite(run, ctxs) -> float:
-    """p50 over ITERS full-suite passes, seconds."""
-    for _ in range(WARMUP):
+def _build_startree(tmpdir: str):
+    """sales_st: star-tree on (region, kind) + a high-card user_id column
+    for the sketch queries (BASELINE configs #3/#4)."""
+    from pinot_tpu.segment import SegmentBuilder, load_segment
+    from pinot_tpu.spi.table import IndexingConfig, StarTreeIndexConfig
+
+    cfg = IndexingConfig(star_tree_index_configs=[StarTreeIndexConfig(
+        dimensions_split_order=["region", "kind"],
+        function_column_pairs=["SUM__qty", "SUM__price", "COUNT__*"],
+        max_leaf_records=1000)])
+    schema = _micro_schema(with_user=True)
+    segs = []
+    for i in range(4):
+        b = SegmentBuilder(schema, f"sales_st_{i}", indexing_config=cfg)
+        b.build(_micro_frame(MICRO_DOCS, seed=300 + i, with_user=True),
+                tmpdir)
+        segs.append(load_segment(f"{tmpdir}/sales_st_{i}"))
+    return segs
+
+
+def _assert_parity(name, dev_rows, host_rows):
+    assert len(dev_rows) == len(host_rows), \
+        f"{name}: {len(dev_rows)} vs {len(host_rows)} rows"
+    for dr, hr in zip(dev_rows, host_rows):
+        for d, h in zip(dr, hr):
+            if isinstance(h, float):
+                # device float aggregation is f32/f64 mixed; host is f64
+                assert abs(d - h) <= 1e-4 * max(1.0, abs(h)), (name, d, h)
+            else:
+                assert d == h, (name, d, h)
+
+
+def _time_suite(run, ctxs, iters=ITERS, warmup=WARMUP):
+    """(p50, p99) seconds over full-suite passes."""
+    for _ in range(warmup):
         for ctx in ctxs:
             run(ctx)
     samples = []
-    for _ in range(ITERS):
+    for _ in range(iters):
         t0 = time.perf_counter()
         for ctx in ctxs:
             run(ctx)
         samples.append(time.perf_counter() - t0)
-    return float(np.percentile(samples, 50))
+    return (float(np.percentile(samples, 50)),
+            float(np.percentile(samples, 99)))
 
 
 def _init_backend() -> str:
@@ -96,8 +154,7 @@ def _init_backend() -> str:
     raise (UNAVAILABLE) or hang outright, so the probe must run in a
     subprocess with a hard timeout. If the preferred backend fails twice,
     fall back to the host platform so a number is always produced (the
-    output records which backend ran).
-    """
+    output records which backend ran)."""
     import subprocess
 
     for attempt in range(2):
@@ -134,38 +191,92 @@ def main() -> None:
     from pinot_tpu.engine import ServerQueryExecutor
     from pinot_tpu.parallel import ShardedQueryExecutor
     from pinot_tpu.query import compile_query
+    from pinot_tpu.tools import ssb
 
     tmpdir = tempfile.mkdtemp(prefix="bench_segs_")
-    segs = _build_segments(tmpdir)
-    ctxs = [compile_query(q) for q in QUERIES]
-
     device_ex = ShardedQueryExecutor()
     host_ex = ServerQueryExecutor(use_device=False)
 
-    # parity gate: device suite must match host suite before timing
-    for ctx in ctxs:
-        dev, _ = device_ex.execute(ctx, segs)
-        host, _ = host_ex.execute(ctx, segs)
-        assert len(dev.rows) == len(host.rows), ctx.sql
-        for dr, hr in zip(dev.rows, host.rows):
-            for d, h in zip(dr, hr):
-                if isinstance(h, float):
-                    # device float aggregation is f32 (v5e-shaped); host is f64
-                    assert abs(d - h) <= 1e-4 * max(1.0, abs(h)), (ctx.sql, d, h)
-                else:
-                    assert d == h, (ctx.sql, d, h)
+    result = {"metric": "ssb_suite_p50_latency", "unit": "ms/query",
+              "backend": backend}
 
-    dev_s = _time_suite(lambda c: device_ex.execute(c, segs), ctxs)
-    host_s = _time_suite(lambda c: host_ex.execute(c, segs), ctxs)
+    # ---- SSB (headline) --------------------------------------------------
+    t0 = time.perf_counter()
+    ssb_segs = ssb.build_segments(0, tmpdir, num_segments=8, rows=SSB_ROWS)
+    build_s = time.perf_counter() - t0
+    ssb_ctxs = {qid: compile_query(q) for qid, q in ssb.QUERIES.items()}
 
-    per_query_ms = dev_s / len(QUERIES) * 1e3
-    print(json.dumps({
-        "metric": "multi_segment_query_suite_p50_latency",
-        "value": round(per_query_ms, 3),
-        "unit": "ms/query",
-        "vs_baseline": round(host_s / dev_s, 3),
-        "backend": backend,
-    }))
+    host_times = {}
+    for qid, ctx in ssb_ctxs.items():
+        dev_rt, _ = device_ex.execute(ctx, ssb_segs)
+        host_rt, _ = host_ex.execute(ctx, ssb_segs)  # warmup (symmetric)
+        _assert_parity(qid, dev_rt.rows, host_rt.rows)
+        p50, _ = _time_suite(lambda c: host_ex.execute(c, ssb_segs),
+                             [ctx], iters=1, warmup=0)
+        host_times[qid] = p50
+
+    per_query = {}
+    for qid, ctx in ssb_ctxs.items():
+        p50, _ = _time_suite(lambda c: device_ex.execute(c, ssb_segs),
+                             [ctx], iters=ITERS, warmup=WARMUP)
+        per_query[qid] = p50
+    dev_suite = sum(per_query.values())
+    host_suite = sum(host_times.values())
+    n = len(ssb_ctxs)
+    result["value"] = round(dev_suite / n * 1e3, 3)
+    result["vs_baseline"] = round(host_suite / dev_suite, 3)
+    result["ssb"] = {
+        "rows": SSB_ROWS,
+        "sf": round(SSB_ROWS / ssb.ROWS_PER_SF, 3),
+        "build_s": round(build_s, 1),
+        "host_ms_per_query": round(host_suite / n * 1e3, 1),
+        "per_query_ms": {q: round(v * 1e3, 1) for q, v in per_query.items()},
+        "pallas_kernels": len(device_ex._pallas_sharded),
+    }
+
+    # ---- micro suite (configs #1/#2, cross-round continuity) -------------
+    micro_segs = _build_micro(tmpdir)
+    micro_ctxs = [compile_query(q) for q in MICRO_QUERIES]
+    for ctx in micro_ctxs:
+        dev_rt, _ = device_ex.execute(ctx, micro_segs)
+        host_rt, _ = host_ex.execute(ctx, micro_segs)
+        _assert_parity(ctx.sql, dev_rt.rows, host_rt.rows)
+    # r2/r3 methodology (WARMUP=2/ITERS=7 BOTH sides) for cross-round
+    # comparability of the micro number
+    dev_p50, _ = _time_suite(lambda c: device_ex.execute(c, micro_segs),
+                             micro_ctxs, iters=7, warmup=2)
+    host_p50, _ = _time_suite(lambda c: host_ex.execute(c, micro_segs),
+                              micro_ctxs, iters=7, warmup=2)
+    result["micro"] = {
+        "p50_ms_per_query": round(dev_p50 / len(micro_ctxs) * 1e3, 3),
+        "vs_baseline": round(host_p50 / dev_p50, 3),
+    }
+
+    # ---- star-tree + sketches (configs #3/#4) ----------------------------
+    st_segs = _build_startree(tmpdir)
+    st_ctx = compile_query(STARTREE_QUERY)
+    st_rt, st_stats = device_ex.execute(st_ctx, st_segs)
+    scan_ctx = compile_query(STARTREE_QUERY + " OPTION(useStarTree=false)")
+    scan_rt, _ = device_ex.execute(scan_ctx, st_segs)
+    _assert_parity("startree", st_rt.rows, scan_rt.rows)
+    st_p50, _ = _time_suite(lambda c: device_ex.execute(c, st_segs), [st_ctx])
+    scan_p50, _ = _time_suite(lambda c: device_ex.execute(c, st_segs),
+                              [scan_ctx])
+    result["startree"] = {
+        "ms": round(st_p50 * 1e3, 3),
+        "scan_ms": round(scan_p50 * 1e3, 3),
+        "docs_scanned": st_stats.num_docs_scanned,
+    }
+
+    sk_ctxs = [compile_query(q) for q in SKETCH_QUERIES]
+    for ctx in sk_ctxs:
+        device_ex.execute(ctx, st_segs)
+    sk_p50, _ = _time_suite(lambda c: device_ex.execute(c, st_segs), sk_ctxs,
+                            iters=3)
+    result["sketches"] = {
+        "p50_ms_per_query": round(sk_p50 / len(sk_ctxs) * 1e3, 3)}
+
+    print(json.dumps(result))
 
 
 if __name__ == "__main__":
@@ -174,7 +285,7 @@ if __name__ == "__main__":
     except Exception as exc:  # never leave the round without a JSON line
         traceback.print_exc(file=sys.stderr)
         print(json.dumps({
-            "metric": "multi_segment_query_suite_p50_latency",
+            "metric": "ssb_suite_p50_latency",
             "value": None,
             "unit": "ms/query",
             "vs_baseline": None,

@@ -161,6 +161,10 @@ class ShardedQueryExecutor(ServerQueryExecutor):
             logging.getLogger(__name__).exception(
                 "sharded pallas kernel failed at run; disabling pallas")
             self.use_pallas = False
+            # evict FIRST: _build_jnp_call may itself raise PlanError
+            # (pallas pads tiles where the jnp path demands divisibility),
+            # and the poisoned pallas entry must not survive that
+            self._query_cache.pop(qkey, None)
             call_fn = self._build_jnp_call(plan, batch, S)
             self._query_cache[qkey] = (plan, call_fn, False)
             packed = call_fn(num_docs)
@@ -241,11 +245,15 @@ class ShardedQueryExecutor(ServerQueryExecutor):
                 pp.spec(num_segs=S // n_seg, tiles_per_seg=tiles // n_doc,
                         interpret=bool(interpret)),
                 packed_bits=tuple(bits))
-            kernel = self._pallas_sharded.get(spec)
+            # keyed by (spec, plan.spec): the closure bakes plan.spec into
+            # the output layout, and distinct plans CAN collide on spec
+            # alone (num_groups_padded rounds to 128)
+            kkey = (spec, plan.spec)
+            kernel = self._pallas_sharded.get(kkey)
             if kernel is None:
                 kernel = build_sharded_pallas_kernel(spec, plan.spec,
                                                      self.mesh)
-                self._pallas_sharded[spec] = kernel
+                self._pallas_sharded[kkey] = kernel
             params = jax.device_put(pp.static_params,
                                     NamedSharding(self.mesh, P()))
         except Exception:

@@ -1,0 +1,7 @@
+"""``python -m pinot_tpu`` -> admin CLI (ref: PinotAdministrator.java:86)."""
+
+import sys
+
+from pinot_tpu.tools.admin import main
+
+sys.exit(main())

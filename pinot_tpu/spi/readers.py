@@ -1,0 +1,65 @@
+"""RecordReader SPI: input-format-agnostic row reading for batch ingest.
+
+Re-design of the reference's reader contracts
+(``pinot-spi/.../data/readers/RecordReader.java`` — init/hasNext/next/
+rewind/close over a data file — and ``GenericRow.java``): a reader yields
+:class:`GenericRow` dicts; concrete format readers live in
+``pinot_tpu/ingestion/readers.py`` (CSV/JSON/Parquet, the
+pinot-input-format plugin family). Readers may implement
+``read_columnar()`` returning column arrays directly — the vectorized
+fast path the TPU segment builder prefers (row iteration stays the
+compatibility path for transforms).
+"""
+
+from __future__ import annotations
+
+import abc
+from typing import Any, Dict, Iterator, Optional, Sequence
+
+
+class GenericRow(dict):
+    """One ingestion row: column -> value (None = null, list = MV).
+    Ref: ``GenericRow.java`` (putValue/getValue are dict ops here)."""
+
+    def put_value(self, column: str, value: Any) -> None:
+        self[column] = value
+
+    def get_value(self, column: str) -> Any:
+        return self.get(column)
+
+
+class RecordReaderConfig(dict):
+    """Format-specific reader settings (ref: RecordReaderConfig marker
+    interface + CSVRecordReaderConfig etc.); plain key/value map."""
+
+
+class RecordReader(abc.ABC):
+    """Ref: ``RecordReader.java:init/hasNext/next/rewind/close``."""
+
+    @abc.abstractmethod
+    def init(self, data_file: str,
+             fields_to_read: Optional[Sequence[str]] = None,
+             config: Optional[RecordReaderConfig] = None) -> None:
+        """Open ``data_file``; restrict to ``fields_to_read`` when given."""
+
+    @abc.abstractmethod
+    def __iter__(self) -> Iterator[GenericRow]:
+        """Iterate rows from the current position (next/hasNext)."""
+
+    @abc.abstractmethod
+    def rewind(self) -> None:
+        """Reset to the first record (the two-pass build re-reads)."""
+
+    def close(self) -> None:  # noqa: B027 (optional hook)
+        pass
+
+    def read_columnar(self) -> Optional[Dict[str, Any]]:
+        """Column -> array/list for the whole file, or None when the format
+        only supports row iteration. Overridden by columnar formats."""
+        return None
+
+    def __enter__(self) -> "RecordReader":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

@@ -1,0 +1,160 @@
+"""pinot-tpu admin CLI.
+
+Re-design of the reference's ``PinotAdministrator.java:86`` (40 subcommands
+under pinot-tools/.../admin/command/): the subset a single-box user needs —
+launch ingestion jobs, start an embedded cluster with REST endpoints, post
+queries, run the quickstart. Invoke as ``python -m pinot_tpu <command>``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import List, Optional
+
+
+def _cmd_launch_ingestion_job(args) -> int:
+    """Ref: LaunchDataIngestionJobCommand."""
+    from pinot_tpu.ingestion.batchjob import run_ingestion_job
+
+    seg_dirs = run_ingestion_job(args.jobSpecFile)
+    for d in seg_dirs:
+        print(d)
+    print(f"built {len(seg_dirs)} segment(s)", file=sys.stderr)
+    return 0
+
+
+def _cmd_post_query(args) -> int:
+    """Ref: PostQueryCommand — POST /query/sql against a broker."""
+    import urllib.request
+
+    body = json.dumps({"sql": args.query}).encode()
+    req = urllib.request.Request(
+        f"http://{args.brokerHost}:{args.brokerPort}/query/sql",
+        data=body, headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=args.timeout) as resp:
+        print(resp.read().decode())
+    return 0
+
+
+def _cmd_quickstart(args) -> int:
+    """Ref: Quickstart.java — embedded cluster + bundled data + sample
+    queries. Loads the reference-layout baseballStats configs when a
+    directory is given, else generates a demo dataset."""
+    import numpy as np
+
+    from pinot_tpu.spi import DataType, FieldSpec, FieldType, Schema
+    from pinot_tpu.spi.table import TableConfig
+    from pinot_tpu.tools.cluster import EmbeddedCluster
+
+    cluster = EmbeddedCluster(num_servers=1, data_dir=args.dataDir)
+    if args.exampleDir:
+        import glob as globmod
+        import os
+
+        schema_file = globmod.glob(os.path.join(args.exampleDir,
+                                                "*_schema.json"))[0]
+        table_file = globmod.glob(os.path.join(
+            args.exampleDir, "*_offline_table_config.json"))[0]
+        schema = Schema.from_file(schema_file)
+        table_config = TableConfig.from_file(table_file)
+        cluster.create_table(table_config, schema)
+        job_files = globmod.glob(os.path.join(args.exampleDir,
+                                              "ingestionJobSpec.yaml"))
+        if job_files:
+            from pinot_tpu.ingestion.batchjob import run_ingestion_job
+
+            run_ingestion_job(job_files[0], cluster=cluster,
+                              schema=schema, table_config=table_config)
+        table = schema.schema_name
+    else:
+        rng = np.random.default_rng(7)
+        n = 10_000
+        schema = Schema("quickstart", [
+            FieldSpec("city", DataType.STRING),
+            FieldSpec("value", DataType.LONG, FieldType.METRIC)])
+        table_config = TableConfig("quickstart")
+        cluster.create_table(table_config, schema)
+        cluster.ingest_rows("quickstart_OFFLINE", schema, {
+            "city": np.array(["sf", "nyc", "sea"])[rng.integers(0, 3, n)],
+            "value": rng.integers(0, 1000, n).astype(np.int64)})
+        table = "quickstart"
+
+    for sql in (args.query or
+                [f"SELECT count(*) FROM {table}"]):
+        resp = cluster.query(sql)
+        print(json.dumps(resp.to_dict(), default=str))
+    cluster.shutdown()
+    return 0
+
+
+def _cmd_start_cluster(args) -> int:
+    """StartController/Broker/Server in one process with REST endpoints
+    (ref: QuickstartRunner + Start*Command)."""
+    from pinot_tpu.tools.cluster import EmbeddedCluster
+    from pinot_tpu.transport.rest import serve_cluster
+
+    cluster = EmbeddedCluster(num_servers=args.servers,
+                              data_dir=args.dataDir)
+    apis = serve_cluster(cluster, controller_port=args.controllerPort,
+                         broker_port=args.brokerPort)
+    print(f"controller http://localhost:{args.controllerPort} | "
+          f"broker http://localhost:{args.brokerPort} "
+          f"({args.servers} server(s)); ctrl-c to stop")
+    try:
+        import time
+
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        for api in apis:
+            api.stop()
+        cluster.shutdown()
+    return 0
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="pinot_tpu",
+        description="pinot-tpu administration (ref: PinotAdministrator)")
+    sub = p.add_subparsers(dest="command", required=True)
+
+    j = sub.add_parser("LaunchDataIngestionJob",
+                       help="run a segment generation job spec (yaml)")
+    j.add_argument("-jobSpecFile", required=True)
+    j.set_defaults(fn=_cmd_launch_ingestion_job)
+
+    q = sub.add_parser("PostQuery", help="POST sql to a running broker")
+    q.add_argument("-query", required=True)
+    q.add_argument("-brokerHost", default="localhost")
+    q.add_argument("-brokerPort", type=int, default=8099)
+    q.add_argument("-timeout", type=float, default=60.0)
+    q.set_defaults(fn=_cmd_post_query)
+
+    qs = sub.add_parser("Quickstart",
+                        help="embedded cluster + data + sample queries")
+    qs.add_argument("-exampleDir", default=None,
+                    help="dir with *_schema.json, *_offline_table_config."
+                         "json, ingestionJobSpec.yaml (reference layout)")
+    qs.add_argument("-dataDir", default="/tmp/pinot_tpu_quickstart")
+    qs.add_argument("-query", action="append")
+    qs.set_defaults(fn=_cmd_quickstart)
+
+    c = sub.add_parser("StartCluster",
+                       help="embedded cluster with REST endpoints")
+    c.add_argument("-servers", type=int, default=1)
+    c.add_argument("-controllerPort", type=int, default=9000)
+    c.add_argument("-brokerPort", type=int, default=8099)
+    c.add_argument("-dataDir", default="/tmp/pinot_tpu_cluster")
+    c.set_defaults(fn=_cmd_start_cluster)
+    return p
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -189,6 +189,17 @@ class BrokerApi(_Api):
                        broker.routing.get_routing_table(m.group(1))[0])))
 
 
+def serve_cluster(cluster, controller_port: int = 0, broker_port: int = 0):
+    """Expose an EmbeddedCluster over REST: controller admin + broker query
+    endpoints (ref: QuickstartRunner wiring the role REST apps). Returns
+    the started APIs; call ``.stop()`` on each to tear down."""
+    apis = [ControllerApi(cluster.controller, port=controller_port),
+            BrokerApi(cluster.broker, port=broker_port)]
+    for api in apis:
+        api.start()
+    return apis
+
+
 class ServerAdminApi(_Api):
     """Ref: server api/resources TablesResource (health + hosted state)."""
 

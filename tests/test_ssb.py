@@ -1,0 +1,83 @@
+"""SSB suite parity at test scale (bench.py runs the timed version).
+
+Ref: contrib/pinot-druid-benchmark (the reference's macro benchmark
+harness); pandas is the oracle here, mirroring the reference's H2-parity
+strategy (SURVEY.md §4.3).
+"""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from pinot_tpu.engine import ServerQueryExecutor
+from pinot_tpu.parallel import ShardedQueryExecutor
+from pinot_tpu.query import compile_query
+from pinot_tpu.tools import ssb
+
+ROWS = 120_000
+
+
+@pytest.fixture(scope="module")
+def setup(tmp_path_factory):
+    out = tmp_path_factory.mktemp("ssb_segs")
+    segs = ssb.build_segments(0, str(out), num_segments=4, rows=ROWS)
+    cols = ssb.generate_flat(0, rows=ROWS)
+    return cols, segs
+
+
+@pytest.fixture(scope="module")
+def dev_exec():
+    return ShardedQueryExecutor()
+
+
+@pytest.fixture(scope="module")
+def host_exec():
+    return ServerQueryExecutor(use_device=False)
+
+
+@pytest.mark.parametrize("qid", ["Q1.1", "Q1.2", "Q1.3"])
+def test_q1_vs_pandas_oracle(setup, dev_exec, qid):
+    cols, segs = setup
+    rt, _ = dev_exec.execute(compile_query(ssb.QUERIES[qid]), segs)
+    exp = ssb.pandas_answer(cols, qid)
+    assert rt.rows[0][0] == pytest.approx(exp, rel=1e-4)
+
+
+@pytest.mark.parametrize("qid", sorted(ssb.QUERIES))
+def test_device_matches_host(setup, dev_exec, host_exec, qid):
+    cols, segs = setup
+    ctx = compile_query(ssb.QUERIES[qid])
+    got, _ = dev_exec.execute(ctx, segs)
+    want, _ = host_exec.execute(ctx, segs)
+    assert len(got.rows) == len(want.rows), qid
+    for gr, wr in zip(got.rows, want.rows):
+        for g, w in zip(gr, wr):
+            if isinstance(w, float):
+                assert g == pytest.approx(w, rel=1e-4), (qid, gr, wr)
+            else:
+                assert g == w, (qid, gr, wr)
+
+
+def test_q2_groupby_vs_pandas(setup, dev_exec):
+    cols, segs = setup
+    df = pd.DataFrame(cols)
+    rt, _ = dev_exec.execute(compile_query(ssb.QUERIES["Q2.1"]), segs)
+    m = (df.p_category == "MFGR#12") & (df.s_region == "AMERICA")
+    exp = (df[m].groupby(["d_year", "p_brand1"]).lo_revenue.sum()
+           .reset_index().sort_values(["d_year", "p_brand1"]).head(10))
+    assert len(rt.rows) == min(10, len(exp))
+    for row, (_, erow) in zip(rt.rows, exp.iterrows()):
+        assert row[0] == erow.d_year and row[1] == erow.p_brand1
+        assert row[2] == pytest.approx(erow.lo_revenue, rel=1e-6)
+
+
+def test_generator_distributions(setup):
+    cols, _ = setup
+    assert set(np.unique(cols["c_region"])) == set(ssb.REGIONS)
+    assert len(np.unique(cols["p_brand1"])) == 1000
+    assert len(np.unique(cols["c_city"])) == 250
+    assert cols["lo_discount"].min() >= 0 and cols["lo_discount"].max() <= 10
+    # revenue derivation holds
+    np.testing.assert_array_equal(
+        cols["lo_revenue"],
+        cols["lo_extendedprice"] * (100 - cols["lo_discount"]) // 100)

@@ -131,6 +131,23 @@ def _glob_regex(pattern: str):
             out.append(r"[^/]*")
         elif c == "?":
             out.append(r"[^/]")
+        elif c == "{":
+            # '{csv,json}' alternation (non-nested, like java's glob)
+            end = pat.find("}", i)
+            if end < 0:
+                raise ValueError(f"unterminated '{{' in glob {pattern!r}")
+            alts = pat[i + 1:end].split(",")
+            out.append("(?:" + "|".join(re.escape(a) for a in alts) + ")")
+            i = end + 1
+            continue
+        elif c == "[":
+            end = pat.find("]", i + 1)
+            if end < 0:
+                raise ValueError(f"unterminated '[' in glob {pattern!r}")
+            body = pat[i:end + 1].replace("[!", "[^")
+            out.append(body)
+            i = end + 1
+            continue
         else:
             out.append(re.escape(c))
         i += 1
@@ -213,6 +230,8 @@ class SegmentGenerationJobRunner:
         columns = None
         if self._no_row_transforms():
             columns = reader.read_columnar()
+            if columns is not None:
+                self._sanitize_columnar(columns)
         if columns is None:
             from pinot_tpu.ingestion.transformers import (
                 NullValueTransformer,
@@ -232,6 +251,39 @@ class SegmentGenerationJobRunner:
             self.schema, segment_name,
             table_config=self.table_config)
         builder.build(columns, spec.output_dir_uri)
+
+    def _sanitize_columnar(self, columns: Dict[str, Any]) -> None:
+        """SanitizationTransformer semantics on the columnar path (NUL
+        stripping + maxLength truncation) so both ingest paths build the
+        same segment. Cells are only rewritten when they offend — the
+        common all-clean case stays a read-only scan."""
+        for fs in self.schema.field_specs:
+            if fs.data_type.is_numeric or fs.name not in columns:
+                continue
+            max_len = fs.max_length
+            vals = columns[fs.name]
+
+            def clean(v):
+                if isinstance(v, str):
+                    if "\x00" in v:
+                        v = v.replace("\x00", "")
+                    return v[:max_len] if len(v) > max_len else v
+                if isinstance(v, list):
+                    return [clean(x) for x in v]
+                return v
+
+            import numpy as np
+
+            if isinstance(vals, np.ndarray) and vals.dtype.kind == "U":
+                dirty = ((np.char.str_len(vals) > max_len)
+                         | (np.char.find(vals, "\x00") >= 0))
+                if dirty.any():
+                    fixed = vals.astype(object)
+                    for i in np.nonzero(dirty)[0]:
+                        fixed[i] = clean(str(vals[i]))
+                    columns[fs.name] = fixed.astype(str)
+            else:
+                columns[fs.name] = [clean(v) for v in vals]
 
     def _no_row_transforms(self) -> bool:
         """Columnar fast path is sound only without row-level transforms
@@ -255,8 +307,12 @@ def run_ingestion_job(job_spec_file: str, cluster=None,
                                         table_config=table_config)
     seg_dirs = runner.run()
     if cluster is not None and "Push" in spec.job_type:
-        table = runner.table_config.table_name_with_type \
-            if runner.table_config else f"{spec.table_name}_OFFLINE"
+        if runner.table_config is not None:
+            table = runner.table_config.table_name_with_type
+        else:
+            # same fallback chain run() uses for segment names
+            raw = spec.table_name or runner.schema.schema_name
+            table = f"{raw}_OFFLINE"
         for seg_dir in seg_dirs:
             cluster.upload_segment_dir(table, seg_dir)
     return seg_dirs

@@ -40,7 +40,10 @@ class CSVRecordReader(RecordReader):
         mv = cfg.get("multiValueColumns")
         self._mv_columns = set(mv) if mv is not None else None
         with open(data_file, "r", newline="") as f:
-            self._header = next(csv.reader(f, delimiter=self._delimiter))
+            try:
+                self._header = next(csv.reader(f, delimiter=self._delimiter))
+            except StopIteration:
+                raise ValueError(f"empty CSV file {data_file!r}") from None
 
     def _cell(self, name: str, v: str) -> Any:
         if v == "":
@@ -52,7 +55,7 @@ class CSVRecordReader(RecordReader):
         return v
 
     def __iter__(self) -> Iterator[GenericRow]:
-        fields = self._fields or self._header
+        fields = set(self._fields or self._header)
         with open(self._path, "r", newline="") as f:
             reader = csv.reader(f, delimiter=self._delimiter)
             next(reader)  # header
@@ -132,20 +135,32 @@ class ParquetRecordReader(RecordReader):
              config: Optional[RecordReaderConfig] = None) -> None:
         import pyarrow.parquet as pq
 
-        self._table = pq.read_table(
-            data_file, columns=list(fields_to_read) if fields_to_read else None)
+        cols = None
+        self._missing: List[str] = []
+        if fields_to_read:
+            # columns absent from the file null-fill (parity with the CSV
+            # path; pyarrow raises on unknown column names)
+            present = set(pq.read_schema(data_file).names)
+            cols = [c for c in fields_to_read if c in present]
+            self._missing = [c for c in fields_to_read if c not in present]
+        self._table = pq.read_table(data_file, columns=cols)
 
     def __iter__(self) -> Iterator[GenericRow]:
         for rec in self._table.to_pylist():
+            for c in self._missing:
+                rec[c] = None
             yield GenericRow(rec)
 
     def rewind(self) -> None:
         pass
 
     def read_columnar(self) -> Dict[str, Any]:
-        return {name: col.to_numpy(zero_copy_only=False)
-                for name, col in zip(self._table.column_names,
-                                     self._table.columns)}
+        out = {name: col.to_numpy(zero_copy_only=False)
+               for name, col in zip(self._table.column_names,
+                                    self._table.columns)}
+        for c in self._missing:
+            out[c] = [None] * self._table.num_rows
+        return out
 
 
 class AvroRecordReader(RecordReader):

@@ -332,3 +332,55 @@ def test_glob_star_does_not_cross_directories(tmp_path):
     assert [os.path.basename(p) for p in _match_glob(
         str(tmp_path), "glob:**/*.csv", exclude="glob:archive/*")] == \
         ["root.csv"]
+
+
+def test_glob_braces_and_classes(tmp_path):
+    from pinot_tpu.ingestion.batchjob import _match_glob
+
+    for name in ("a.csv", "b.json", "c.txt", "d1.csv"):
+        (tmp_path / name).write_text("x\n1\n")
+    got = [os.path.basename(p)
+           for p in _match_glob(str(tmp_path), "glob:*.{csv,json}")]
+    assert got == ["a.csv", "b.json", "d1.csv"]
+    got = [os.path.basename(p)
+           for p in _match_glob(str(tmp_path), "glob:[ab].*")]
+    assert got == ["a.csv", "b.json"]
+
+
+def test_columnar_path_sanitizes(tmp_path):
+    """NUL stripping + maxLength truncation apply on the columnar fast
+    path too (regression: only the row path sanitized)."""
+    csv_file = tmp_path / "d.csv"
+    long = "x" * 600
+    csv_file.write_text(f"a\nhas\x00nul\n{long}\n")
+    schema = Schema.from_dict({
+        "schemaName": "t",
+        "dimensionFieldSpecs": [
+            {"name": "a", "dataType": "STRING", "maxLength": 512}]})
+    spec = SegmentGenerationJobSpec(
+        input_dir_uri=str(tmp_path), include_file_name_pattern="glob:*.csv",
+        output_dir_uri=str(tmp_path / "out"), table_name="t",
+        data_format="csv")
+    seg_dirs = SegmentGenerationJobRunner(spec, schema=schema).run()
+    from pinot_tpu.segment import load_segment
+
+    seg = load_segment(seg_dirs[0])
+    assert seg.get_value("a", 0) == "hasnul"
+    assert len(seg.get_value("a", 1)) == 512
+
+
+def test_parquet_missing_column_null_fills(tmp_path):
+    pq_file = tmp_path / "d.parquet"
+    pd.DataFrame({"a": ["x", "y"]}).to_parquet(pq_file)
+    reader = create_record_reader(str(pq_file),
+                                  fields_to_read=["a", "missing"])
+    assert list(reader) == [{"a": "x", "missing": None},
+                            {"a": "y", "missing": None}]
+    cols = reader.read_columnar()
+    assert cols["missing"] == [None, None]
+
+
+def test_empty_csv_raises_meaningfully(tmp_path):
+    (tmp_path / "empty.csv").write_text("")
+    with pytest.raises(ValueError, match="empty CSV"):
+        create_record_reader(str(tmp_path / "empty.csv"))

@@ -108,6 +108,7 @@ class DataTable:
             num_segments_queried=st.get("numSegmentsQueried", 0),
             num_segments_processed=st.get("numSegmentsProcessed", 0),
             num_segments_matched=st.get("numSegmentsMatched", 0),
+            num_segments_pruned=st.get("numSegmentsPrunedByServer", 0),
             num_docs_scanned=st.get("numDocsScanned", 0),
             total_docs=st.get("totalDocs", 0),
             num_groups_limit_reached=st.get("numGroupsLimitReached", False),

@@ -31,6 +31,7 @@ class BrokerResponse:
             "numSegmentsQueried": self.stats.num_segments_queried,
             "numSegmentsProcessed": self.stats.num_segments_processed,
             "numSegmentsMatched": self.stats.num_segments_matched,
+            "numSegmentsPrunedByServer": self.stats.num_segments_pruned,
             "numDocsScanned": self.stats.num_docs_scanned,
             "totalDocs": self.stats.total_docs,
             "numGroupsLimitReached": self.stats.num_groups_limit_reached,

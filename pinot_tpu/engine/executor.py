@@ -85,6 +85,7 @@ class ServerQueryExecutor:
         if not segments:
             raise QueryError(f"no segments for table {ctx.table_name!r}")
         self._validate_columns(ctx, segments[0])
+        segments = self._prune(ctx, segments, stats)
 
         if ctx.distinct:
             # HAVING is broker-side (it sees the global distinct set); ORDER
@@ -139,6 +140,7 @@ class ServerQueryExecutor:
         if not segments:
             raise QueryError(f"no segments for table {ctx.table_name!r}")
         self._validate_columns(ctx, segments[0])
+        segments = self._prune(ctx, segments, stats)
 
         if ctx.distinct:
             return host_engine.execute_distinct(ctx, segments, stats), stats
@@ -155,6 +157,27 @@ class ServerQueryExecutor:
 
         merged_agg = self._execute_aggregation(ctx, aggs, segments, stats)
         return reduce_aggregation(ctx, aggs, merged_agg), stats
+
+    def _prune(self, ctx: QueryContext, segments: List[ImmutableSegment],
+               stats: QueryStats) -> List[ImmutableSegment]:
+        """Server-side pruning before planning/staging (ref:
+        SegmentPrunerService at ServerQueryExecutorV1Impl:277). At least
+        one segment is kept so result-shape machinery (schema derivation,
+        identity aggregation states) runs unchanged — a provably-empty
+        scan of one segment is cheap and exact."""
+        from pinot_tpu.engine.pruner import prune_segments
+
+        kept = prune_segments(ctx, segments, stats)
+        if not kept:
+            kept = segments[:1]
+            stats.num_segments_pruned -= 1
+        # totalDocs covers ALL acquired segments (ref: the reference adds
+        # pruned segments' docs to numTotalDocs); processed segments add
+        # theirs during execution
+        kept_names = {s.segment_name for s in kept}
+        stats.total_docs += sum(s.num_docs for s in segments
+                                if s.segment_name not in kept_names)
+        return kept
 
     # -- aggregation (no group-by) ----------------------------------------
     def _execute_aggregation(self, ctx: QueryContext, aggs: List[AggDef],

@@ -65,6 +65,7 @@ class QueryStats:
     num_segments_queried: int = 0
     num_segments_processed: int = 0
     num_segments_matched: int = 0
+    num_segments_pruned: int = 0
     num_docs_scanned: int = 0
     total_docs: int = 0
     num_groups_limit_reached: bool = False
@@ -73,6 +74,7 @@ class QueryStats:
         self.num_segments_queried += other.num_segments_queried
         self.num_segments_processed += other.num_segments_processed
         self.num_segments_matched += other.num_segments_matched
+        self.num_segments_pruned += other.num_segments_pruned
         self.num_docs_scanned += other.num_docs_scanned
         self.total_docs += other.total_docs
         self.num_groups_limit_reached |= other.num_groups_limit_reached
@@ -82,6 +84,7 @@ class QueryStats:
             "numSegmentsQueried": self.num_segments_queried,
             "numSegmentsProcessed": self.num_segments_processed,
             "numSegmentsMatched": self.num_segments_matched,
+            "numSegmentsPrunedByServer": self.num_segments_pruned,
             "numDocsScanned": self.num_docs_scanned,
             "totalDocs": self.total_docs,
             "numGroupsLimitReached": self.num_groups_limit_reached,

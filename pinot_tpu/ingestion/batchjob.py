@@ -115,6 +115,12 @@ def _glob_regex(pattern: str):
     import re
 
     pat = pattern[5:] if pattern.startswith("glob:") else pattern
+    return re.compile(_glob_translate(pat) + r"\Z")
+
+
+def _glob_translate(pat: str) -> str:
+    import re
+
     out = []
     i = 0
     while i < len(pat):
@@ -132,26 +138,27 @@ def _glob_regex(pattern: str):
         elif c == "?":
             out.append(r"[^/]")
         elif c == "{":
-            # '{csv,json}' alternation (non-nested, like java's glob)
+            # '{a,b}' alternation (non-nested, like java's glob); the
+            # alternatives are themselves glob sub-patterns ('{*.csv,*.json}')
             end = pat.find("}", i)
             if end < 0:
-                raise ValueError(f"unterminated '{{' in glob {pattern!r}")
+                raise ValueError(f"unterminated '{{' in glob {pat!r}")
             alts = pat[i + 1:end].split(",")
-            out.append("(?:" + "|".join(re.escape(a) for a in alts) + ")")
+            out.append("(?:" + "|".join(_glob_translate(a) for a in alts)
+                       + ")")
             i = end + 1
             continue
         elif c == "[":
             end = pat.find("]", i + 1)
             if end < 0:
-                raise ValueError(f"unterminated '[' in glob {pattern!r}")
-            body = pat[i:end + 1].replace("[!", "[^")
-            out.append(body)
+                raise ValueError(f"unterminated '[' in glob {pat!r}")
+            out.append(pat[i:end + 1].replace("[!", "[^"))
             i = end + 1
             continue
         else:
             out.append(re.escape(c))
         i += 1
-    return re.compile("".join(out) + r"\Z")
+    return "".join(out)
 
 
 def _match_glob(root: str, pattern: str,
@@ -274,6 +281,13 @@ class SegmentGenerationJobRunner:
 
             import numpy as np
 
+            def offends(v) -> bool:
+                if isinstance(v, str):
+                    return "\x00" in v or len(v) > max_len
+                if isinstance(v, list):
+                    return any(offends(x) for x in v)
+                return False
+
             if isinstance(vals, np.ndarray) and vals.dtype.kind == "U":
                 dirty = ((np.char.str_len(vals) > max_len)
                          | (np.char.find(vals, "\x00") >= 0))
@@ -282,7 +296,8 @@ class SegmentGenerationJobRunner:
                     for i in np.nonzero(dirty)[0]:
                         fixed[i] = clean(str(vals[i]))
                     columns[fs.name] = fixed.astype(str)
-            else:
+            elif any(offends(v) for v in vals):
+                # scan-first: the all-clean common case stays read-only
                 columns[fs.name] = [clean(v) for v in vals]
 
     def _no_row_transforms(self) -> bool:

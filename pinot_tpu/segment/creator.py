@@ -311,15 +311,17 @@ class SegmentBuilder:
             arr[:num_docs] = np.asarray(values, dtype=fs.data_type.stored_np)
             save("fwd", arr)
             data = arr[:num_docs]
+            uniq = np.unique(data)
             is_sorted = bool(np.all(data[:-1] <= data[1:])) if num_docs > 1 else True
             return meta.ColumnMetadata(
                 name=fs.name, data_type=fs.data_type, field_type=fs.field_type,
                 single_value=True, encoding=meta.Encoding.RAW,
-                cardinality=int(len(np.unique(data))),
+                cardinality=int(len(uniq)),
                 stored_dtype=str(arr.dtype),
                 min_value=data.min() if num_docs else None,
                 max_value=data.max() if num_docs else None,
                 is_sorted=is_sorted, has_dictionary=False, has_nulls=has_nulls,
+                has_bloom_filter=self._maybe_build_bloom(fs.name, uniq, save),
                 **self._partition_meta(fs.name, values),
             )
 
@@ -388,6 +390,9 @@ class SegmentBuilder:
                                  values if not fs.single_value else None,
                                  num_docs, card, save, col_dir=col_dir)
 
+        has_bloom = self._maybe_build_bloom(
+            fs.name, lambda: dictionary.get_values(range(card)), save)
+
         return meta.ColumnMetadata(
             name=fs.name, data_type=fs.data_type, field_type=fs.field_type,
             single_value=fs.single_value, encoding=meta.Encoding.DICT,
@@ -396,9 +401,25 @@ class SegmentBuilder:
             max_value=dictionary.max_value if card else None,
             is_sorted=is_sorted, has_dictionary=True,
             has_inverted_index=want_inverted, has_nulls=has_nulls,
+            has_bloom_filter=has_bloom,
             max_num_multi_values=max_mv, total_number_of_entries=total_entries,
             **self._partition_meta(fs.name, values),
         )
+
+    def _maybe_build_bloom(self, name: str, distinct_values, save) -> bool:
+        """Bloom filter over a column's distinct values when configured
+        (ref: bloomFilterColumns -> OnHeapGuavaBloomFilterCreator).
+        ``distinct_values`` may be a zero-arg callable so unconfigured
+        columns never materialize their dictionary."""
+        if name not in self.indexing.bloom_filter_columns:
+            return False
+        from pinot_tpu.utils.bloom import BloomFilter
+
+        if callable(distinct_values):
+            distinct_values = distinct_values()
+        bf = BloomFilter.from_values(list(distinct_values))
+        save("bloom", bf.to_array())
+        return True
 
     def _build_inverted(self, name: str, dict_ids_flat: np.ndarray,
                         mv_rows: Optional[List[List[Any]]], num_docs: int,

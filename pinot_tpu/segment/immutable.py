@@ -70,6 +70,17 @@ class DataSource:
         return self._segment._load_array(self.name, "null")
 
     @cached_property
+    def bloom_filter(self):
+        """BloomFilter over distinct values, or None
+        (ref: BloomFilterReader; used by the server-side pruner)."""
+        if not self.metadata.has_bloom_filter:
+            return None
+        from pinot_tpu.utils.bloom import BloomFilter
+
+        return BloomFilter.from_array(
+            self._segment._load_array(self.name, "bloom"))
+
+    @cached_property
     def inverted_index(self) -> Optional[Tuple[np.ndarray, np.ndarray]]:
         """(doc-count offsets[card+1], byte offsets[card+1]) of the varint
         posting lists, or None (ref: BitmapInvertedIndexReader.java:34)."""

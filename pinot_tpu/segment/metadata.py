@@ -71,6 +71,7 @@ class ColumnMetadata:
     has_dictionary: bool = True
     has_inverted_index: bool = False
     has_nulls: bool = False
+    has_bloom_filter: bool = False
     max_num_multi_values: int = 0   # MV only: max values per row
     total_number_of_entries: int = 0  # MV only: total flattened values
     partition_function: Optional[str] = None
@@ -92,6 +93,7 @@ class ColumnMetadata:
             "hasDictionary": self.has_dictionary,
             "hasInvertedIndex": self.has_inverted_index,
             "hasNulls": self.has_nulls,
+            "hasBloomFilter": self.has_bloom_filter,
             "maxNumMultiValues": self.max_num_multi_values,
             "totalNumberOfEntries": self.total_number_of_entries,
         }
@@ -118,6 +120,7 @@ class ColumnMetadata:
             has_dictionary=d.get("hasDictionary", True),
             has_inverted_index=d.get("hasInvertedIndex", False),
             has_nulls=d.get("hasNulls", False),
+            has_bloom_filter=d.get("hasBloomFilter", False),
             max_num_multi_values=d.get("maxNumMultiValues", 0),
             total_number_of_entries=d.get("totalNumberOfEntries", 0),
             partition_function=d.get("partitionFunction"),

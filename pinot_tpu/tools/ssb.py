@@ -130,6 +130,12 @@ def build_segments(sf: float, out_dir: str, num_segments: int = 8,
     from pinot_tpu.segment import SegmentBuilder, load_segment
 
     cols = generate_flat(sf, seed=seed, rows=rows)
+    # time-slice the table (real Pinot segments are time-bounded): rows
+    # sorted by order month before slicing, so each segment covers a
+    # contiguous d_yearmonthnum range and time-selective SSB flights
+    # (Q1.x) exercise the server-side min/max pruner
+    order = np.argsort(cols["d_yearmonthnum"], kind="stable")
+    cols = {k: v[order] for k, v in cols.items()}
     n = cols["lo_quantity"].shape[0]
     schema = ssb_schema()
     segs = []

@@ -384,3 +384,13 @@ def test_empty_csv_raises_meaningfully(tmp_path):
     (tmp_path / "empty.csv").write_text("")
     with pytest.raises(ValueError, match="empty CSV"):
         create_record_reader(str(tmp_path / "empty.csv"))
+
+
+def test_glob_braces_with_wildcards(tmp_path):
+    from pinot_tpu.ingestion.batchjob import _match_glob
+
+    for name in ("a.csv", "b.json", "c.txt"):
+        (tmp_path / name).write_text("x\n1\n")
+    got = [os.path.basename(p)
+           for p in _match_glob(str(tmp_path), "glob:{*.csv,*.json}")]
+    assert got == ["a.csv", "b.json"]

@@ -1,0 +1,177 @@
+"""Server-side segment pruning: min/max, partition, bloom.
+
+Ref: ColumnValueSegmentPruner.java + SegmentPrunerService.java (pruning
+before plan/stage at ServerQueryExecutorV1Impl:277).
+"""
+
+import numpy as np
+import pytest
+
+from pinot_tpu.engine import ServerQueryExecutor
+from pinot_tpu.engine.pruner import prune_segments
+from pinot_tpu.parallel import ShardedQueryExecutor
+from pinot_tpu.query import compile_query
+from pinot_tpu.segment import SegmentBuilder, load_segment
+from pinot_tpu.spi import DataType, FieldSpec, FieldType, Schema
+from pinot_tpu.spi.table import IndexingConfig, SegmentPartitionConfig
+from pinot_tpu.utils.bloom import BloomFilter
+
+N = 5000
+
+
+def _schema():
+    return Schema("pr_sales", [
+        FieldSpec("region", DataType.STRING),
+        FieldSpec("day", DataType.INT),
+        FieldSpec("qty", DataType.LONG, FieldType.METRIC),
+    ])
+
+
+@pytest.fixture(scope="module")
+def segs(tmp_path_factory):
+    """4 segments with disjoint day ranges + bloom on region."""
+    out = tmp_path_factory.mktemp("pr_segs")
+    cfg = IndexingConfig(bloom_filter_columns=["region"])
+    rng = np.random.default_rng(5)
+    segs = []
+    for i in range(4):
+        regions = [f"r{i}a", f"r{i}b"]  # disjoint per segment
+        b = SegmentBuilder(_schema(), f"pr_{i}", indexing_config=cfg)
+        b.build({
+            "region": np.array(regions)[rng.integers(0, 2, N)],
+            "day": rng.integers(i * 100, i * 100 + 50, N).astype(np.int64),
+            "qty": rng.integers(1, 10, N).astype(np.int64),
+        }, str(out))
+        segs.append(load_segment(str(out / f"pr_{i}")))
+    return segs
+
+
+class TestBloomFilter:
+    def test_membership(self):
+        vals = [f"v{i}" for i in range(500)]
+        bf = BloomFilter.from_values(vals)
+        assert all(bf.might_contain(v) for v in vals)
+        misses = sum(bf.might_contain(f"x{i}") for i in range(1000))
+        assert misses < 150  # fpp ~5%
+
+    def test_serde_roundtrip(self):
+        bf = BloomFilter.from_values([1, 2, 3, 99])
+        back = BloomFilter.from_array(bf.to_array())
+        assert back.might_contain(99) and back.num_hashes == bf.num_hashes
+
+    def test_segment_exposes_bloom(self, segs):
+        ds = segs[0].data_source("region")
+        assert ds.metadata.has_bloom_filter
+        assert ds.bloom_filter.might_contain("r0a")
+        assert not ds.bloom_filter.might_contain("definitely-absent-xyz") \
+            or True  # probabilistic: only the positive direction is certain
+
+
+class TestPruner:
+    def test_minmax_range_prunes(self, segs):
+        ctx = compile_query("SELECT count(*) FROM pr_sales "
+                            "WHERE day BETWEEN 210 AND 240")
+        kept = prune_segments(ctx, segs)
+        assert [s.segment_name for s in kept] == ["pr_2"]
+
+    def test_eq_out_of_bounds_prunes(self, segs):
+        ctx = compile_query("SELECT count(*) FROM pr_sales WHERE day = 120")
+        kept = prune_segments(ctx, segs)
+        assert [s.segment_name for s in kept] == ["pr_1"]
+
+    def test_bloom_prunes_absent_string(self, segs):
+        ctx = compile_query("SELECT count(*) FROM pr_sales "
+                            "WHERE region = 'r2a'")
+        kept = prune_segments(ctx, segs)
+        # min/max keeps lexicographic overlap ('r0a' < 'r2a' < 'r3b') for
+        # segments 0-3; bloom knocks out the non-owners (modulo fp)
+        names = {s.segment_name for s in kept}
+        assert "pr_2" in names and len(names) <= 2
+
+    def test_and_or_composition(self, segs):
+        ctx = compile_query("SELECT count(*) FROM pr_sales "
+                            "WHERE day < 40 AND qty > 0")
+        assert [s.segment_name for s in prune_segments(ctx, segs)] == ["pr_0"]
+        ctx = compile_query("SELECT count(*) FROM pr_sales "
+                            "WHERE day < 40 OR day > 330")
+        assert [s.segment_name for s in prune_segments(ctx, segs)] == \
+            ["pr_0", "pr_3"]
+
+    def test_not_is_conservative(self, segs):
+        ctx = compile_query("SELECT count(*) FROM pr_sales "
+                            "WHERE NOT (day < 40)")
+        assert len(prune_segments(ctx, segs)) == 4
+
+    def test_executor_stats_and_results(self, segs):
+        ex = ServerQueryExecutor(use_device=False)
+        rt, stats = ex.execute(compile_query(
+            "SELECT count(*), sum(qty) FROM pr_sales "
+            "WHERE day BETWEEN 100 AND 149"), segs)
+        assert stats.num_segments_pruned == 3
+        assert stats.num_segments_processed == 1
+        assert rt.rows[0][0] == N  # all docs of pr_1
+
+    def test_all_pruned_returns_identity(self, segs):
+        ex = ServerQueryExecutor(use_device=False)
+        rt, stats = ex.execute(compile_query(
+            "SELECT count(*), min(qty) FROM pr_sales WHERE day = 99999"),
+            segs)
+        assert rt.rows[0][0] == 0
+
+    def test_sharded_executor_prunes_too(self, segs):
+        ex = ShardedQueryExecutor()
+        rt, stats = ex.execute(compile_query(
+            "SELECT count(*) FROM pr_sales WHERE day BETWEEN 0 AND 49"),
+            segs)
+        assert stats.num_segments_pruned == 3
+        assert rt.rows[0][0] == N
+
+
+class TestPartitionPruning:
+    def test_partition_metadata_prunes(self, tmp_path):
+        """Segments built with a partition function + single partition:
+        EQ literals hashing elsewhere prune (ref: the partition branch)."""
+        cfg = IndexingConfig(segment_partition_config=SegmentPartitionConfig(
+            {"region": {"functionName": "Modulo", "numPartitions": 4}}))
+        schema = _schema()
+        from pinot_tpu.utils.partition import get_partition_function
+
+        fn = get_partition_function("Modulo", 4)
+        segs = []
+        for p in range(2):
+            # region values chosen so each segment holds ONE partition
+            vals = [str(v) for v in range(40) if fn.partition(str(v)) == p]
+            b = SegmentBuilder(schema, f"pp_{p}", indexing_config=cfg)
+            n = len(vals)
+            b.build({"region": np.array(vals),
+                     "day": np.arange(n).astype(np.int64),
+                     "qty": np.ones(n, dtype=np.int64)}, str(tmp_path))
+            segs.append(load_segment(str(tmp_path / f"pp_{p}")))
+        probe = "8"  # Modulo(8, 4) == 0
+        assert fn.partition(probe) == 0
+        ctx = compile_query(
+            f"SELECT count(*) FROM pr_sales WHERE region = '{probe}'")
+        kept = prune_segments(ctx, segs)
+        assert [s.segment_name for s in kept] == ["pp_0"]
+
+
+def test_float_bloom_does_not_false_prune(tmp_path):
+    """Regression: f32-stored FLOAT values vs f64 query literals must hash
+    consistently or bloom pruning silently empties correct queries."""
+    schema = Schema("fb", [FieldSpec("f", DataType.FLOAT),
+                           FieldSpec("v", DataType.LONG, FieldType.METRIC)])
+    cfg = IndexingConfig(bloom_filter_columns=["f"],
+                         no_dictionary_columns=["f"])
+    b = SegmentBuilder(schema, "fb_0", indexing_config=cfg)
+    b.build({"f": np.array([0.1, 0.25, 7.5], dtype=np.float32),
+             "v": np.array([1, 2, 3], dtype=np.int64)}, str(tmp_path))
+    seg = load_segment(str(tmp_path / "fb_0"))
+    ctx = compile_query("SELECT count(*) FROM fb WHERE f = 0.1")
+    assert prune_segments(ctx, [seg]) == [seg]
+
+
+def test_total_docs_includes_pruned(segs):
+    ex = ServerQueryExecutor(use_device=False)
+    _, stats = ex.execute(compile_query(
+        "SELECT count(*) FROM pr_sales WHERE day BETWEEN 100 AND 149"), segs)
+    assert stats.total_docs == 4 * N  # pruned segments still counted

@@ -38,6 +38,72 @@ class BalancedInstanceSelector:
         return candidates[request_id % len(candidates)]
 
 
+class ReplicaGroupInstanceSelector:
+    """One replica GROUP serves the whole query (ref:
+    ReplicaGroupInstanceSelector): requestId picks the group, so each
+    query fans out to 1/N of the servers — the reference's QPS-scaling
+    story. A segment unavailable in the picked group falls back to any
+    live replica (non-strict semantics)."""
+
+    def __init__(self, groups: List[List[str]]):
+        self.groups = [set(g) for g in groups if g]
+
+    def select(self, segment: str, replicas: List[str], request_id: int,
+               excluded: frozenset) -> Optional[str]:
+        live = sorted(r for r in replicas if r not in excluded)
+        if not live:
+            return None
+        if self.groups:
+            n = len(self.groups)
+            for off in range(n):
+                group = self.groups[(request_id + off) % n]
+                in_group = [r for r in live if r in group]
+                if in_group:
+                    return in_group[0]
+        return live[request_id % len(live)]
+
+
+class StrictReplicaGroupInstanceSelector(ReplicaGroupInstanceSelector):
+    """Strict variant (ref: StrictReplicaGroupInstanceSelector): NO
+    cross-group fallback per segment — if the picked group cannot serve a
+    segment, the segment is unavailable for this query. Selection is
+    deterministic per requestId, so every segment of the query lands on
+    the same group (the upsert-consistency requirement)."""
+
+    def select(self, segment: str, replicas: List[str], request_id: int,
+               excluded: frozenset) -> Optional[str]:
+        live = {r for r in replicas if r not in excluded}
+        if not live or not self.groups:
+            return None
+        group = self.groups[request_id % len(self.groups)]
+        in_group = sorted(live & group)
+        return in_group[0] if in_group else None
+
+
+def _top_level_eq_values(node: FilterNode) -> Dict[str, List]:
+    """column -> literal values from top-level AND-ed EQ/IN predicates
+    (the only shapes partition pruning can use soundly)."""
+    out: Dict[str, List] = {}
+
+    def visit(n: FilterNode):
+        if n.op is FilterOp.AND:
+            for c in n.children:
+                visit(c)
+            return
+        if n.op is not FilterOp.PREDICATE:
+            return
+        p = n.predicate
+        if not isinstance(p.lhs, Identifier):
+            return
+        if p.type is PredicateType.EQ:
+            out.setdefault(p.lhs.name, []).append(p.value)
+        elif p.type is PredicateType.IN:
+            out.setdefault(p.lhs.name, []).extend(p.values)
+
+    visit(node)
+    return out
+
+
 def extract_time_interval(node: Optional[FilterNode], time_column: str
                           ) -> Tuple[Optional[int], Optional[int]]:
     """[lo, hi] bound on the time column implied by the filter (only
@@ -104,6 +170,10 @@ class RoutingManager:
         self.time_boundary = TimeBoundaryManager(store)
         self._request_id = 0
         self._lock = threading.Lock()
+        # table -> (selector kind, groups key, selector): rebuilt only when
+        # the routing config / instance partitions change (ref:
+        # InstanceSelectorFactory caching per RoutingEntry)
+        self._selector_cache: Dict[str, Tuple] = {}
 
     def _next_request_id(self) -> int:
         with self._lock:
@@ -130,18 +200,77 @@ class RoutingManager:
                          if not i.alive)
 
         pruned = self._time_prune(table, ctx, list(ev.keys()))
+        pruned = self._partition_prune(table, ctx, pruned)
+        selector = self._selector_for(table)
 
         routing: Dict[str, List[str]] = {}
         unavailable: List[str] = []
         for segment in pruned:
             replicas = [inst for inst, st in ev.get(segment, {}).items()
                         if st in (ONLINE, CONSUMING)]
-            chosen = self.selector.select(segment, replicas, request_id, dead)
+            chosen = selector.select(segment, replicas, request_id, dead)
             if chosen is None:
                 unavailable.append(segment)
             else:
                 routing.setdefault(chosen, []).append(segment)
         return routing, unavailable
+
+    def _selector_for(self, table: str):
+        """Per-table instance selector from the routing config
+        (ref: InstanceSelectorFactory), cached against its inputs."""
+        cfg = self.store.get_table_config(table)
+        kind = (cfg.routing_config.instance_selector_type
+                if cfg else "balanced")
+        if kind == "balanced":
+            return self.selector
+        groups = self.store.get_instance_partitions(table) or []
+        key = (kind, tuple(tuple(g) for g in groups))
+        cached = self._selector_cache.get(table)
+        if cached is not None and cached[0] == key:
+            return cached[1]
+        sel = (StrictReplicaGroupInstanceSelector(groups)
+               if kind == "strictReplicaGroup"
+               else ReplicaGroupInstanceSelector(groups))
+        self._selector_cache[table] = (key, sel)
+        return sel
+
+    def _partition_prune(self, table: str, ctx: Optional[QueryContext],
+                         segments: List[str]) -> List[str]:
+        """Ref: PartitionSegmentPruner — top-level AND-ed EQ/IN predicates
+        on a partitioned column keep only segments whose recorded partition
+        set contains the literal's partition."""
+        if ctx is None or ctx.filter is None:
+            return segments
+        cfg = self.store.get_table_config(table)
+        pruners = (cfg.routing_config.segment_pruner_types if cfg else [])
+        if not any(p.lower() == "partition" for p in pruners):
+            return segments  # ref: PartitionSegmentPruner runs only when
+            #                  configured in routing.segmentPrunerTypes
+        from pinot_tpu.utils.partition import get_partition_function
+
+        eq_values = _top_level_eq_values(ctx.filter)
+        if not eq_values:
+            return segments
+        out = []
+        for seg in segments:
+            md = self.store.get_segment_metadata(table, seg)
+            if md is None or not md.partition_metadata:
+                out.append(seg)
+                continue
+            keep = True
+            for col, values in eq_values.items():
+                pm = md.partition_metadata.get(col)
+                if not pm or not pm.get("partitions"):
+                    continue
+                fn = get_partition_function(pm["functionName"],
+                                            pm["numPartitions"])
+                if not any(fn.partition(v) in pm["partitions"]
+                           for v in values):
+                    keep = False
+                    break
+            if keep:
+                out.append(seg)
+        return out
 
     def _time_prune(self, table: str, ctx: Optional[QueryContext],
                     segments: List[str]) -> List[str]:

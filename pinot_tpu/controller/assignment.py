@@ -44,23 +44,37 @@ class BalancedSegmentAssignment(SegmentAssignment):
 
 class ReplicaGroupSegmentAssignment(SegmentAssignment):
     """Instances pre-split into ``replication`` groups; each segment takes
-    one instance per group (ref: ReplicaGroupSegmentAssignmentStrategy)."""
+    one instance per group (ref: ReplicaGroupSegmentAssignmentStrategy).
+    ``groups`` may be the table's PERSISTED instance partitions (the broker's
+    replica-group selectors read the same layout — InstancePartitions)."""
 
-    def __init__(self, num_replica_groups: int):
+    def __init__(self, num_replica_groups: int,
+                 groups: Optional[List[List[str]]] = None):
         self.num_replica_groups = num_replica_groups
+        self._groups = groups
 
     def assign(self, segment, current, instances, replication):
         if not instances:
             raise ValueError("no server instances to assign to")
-        groups: List[List[str]] = [[] for _ in range(self.num_replica_groups)]
-        for i, inst in enumerate(sorted(instances)):
-            groups[i % self.num_replica_groups].append(inst)
+        groups = self._groups or compute_instance_partitions(
+            instances, self.num_replica_groups)
         seg_index = len(current)
         out = []
         for g in groups[: replication]:
             if g:
                 out.append(g[seg_index % len(g)])
         return out
+
+
+def compute_instance_partitions(instances: List[str],
+                                num_groups: int) -> List[List[str]]:
+    """Deterministic instance -> replica-group split (ref:
+    InstanceReplicaGroupPartitionSelector): sorted instances dealt
+    round-robin into ``num_groups`` groups."""
+    groups: List[List[str]] = [[] for _ in range(max(num_groups, 1))]
+    for i, inst in enumerate(sorted(instances)):
+        groups[i % max(num_groups, 1)].append(inst)
+    return groups
 
 
 class PartitionedReplicaGroupAssignment(SegmentAssignment):
@@ -74,9 +88,8 @@ class PartitionedReplicaGroupAssignment(SegmentAssignment):
                partition: Optional[int] = None):
         if partition is None:
             partition = _partition_from_llc_name(segment)
-        groups: List[List[str]] = [[] for _ in range(self.num_replica_groups)]
-        for i, inst in enumerate(sorted(instances)):
-            groups[i % self.num_replica_groups].append(inst)
+        groups = compute_instance_partitions(instances,
+                                             self.num_replica_groups)
         out = []
         for g in groups[: replication]:
             if g:
@@ -113,10 +126,15 @@ def assignment_for_table(store: ClusterStateStore, table: str,
 
 def compute_target_assignment(
         current: Dict[str, Dict[str, str]], instances: List[str],
-        replication: int) -> Dict[str, Dict[str, str]]:
-    """Balanced target for all segments (CONSUMING segments keep their
-    state label)."""
-    strategy = BalancedSegmentAssignment()
+        replication: int,
+        groups: Optional[List[List[str]]] = None
+        ) -> Dict[str, Dict[str, str]]:
+    """Target for all segments (CONSUMING segments keep their state label).
+    ``groups`` switches to replica-group placement so rebalance preserves
+    the persisted instance-partition layout strict routing depends on."""
+    strategy: SegmentAssignment = (
+        ReplicaGroupSegmentAssignment(len(groups), groups=groups)
+        if groups else BalancedSegmentAssignment())
     target: Dict[str, Dict[str, str]] = {}
     for segment in sorted(current):
         state = CONSUMING if CONSUMING in current[segment].values() else ONLINE

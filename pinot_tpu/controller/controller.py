@@ -18,7 +18,10 @@ from typing import Dict, List, Optional
 
 from pinot_tpu.controller.assignment import (
     BalancedSegmentAssignment,
+    ReplicaGroupSegmentAssignment,
+    SegmentAssignment,
     assignment_for_table,
+    compute_instance_partitions,
     compute_target_assignment,
     rebalance_steps,
 )
@@ -84,6 +87,20 @@ class Controller:
                              "add the schema first")
         self.store.add_table_config(config)
         self.store.set_ideal_state(name, {})
+        if config.routing_config.instance_selector_type != "balanced":
+            # replica-group routing: persist the instance partitions so the
+            # assignment AND the broker selectors share one layout
+            # (ref: InstancePartitionsUtils.persistInstancePartitions)
+            servers = [i.instance_id
+                       for i in self.store.instances("SERVER",
+                                                     only_alive=True)]
+            if not servers:
+                raise ValueError(
+                    f"replica-group table {name} needs live servers at "
+                    "creation time (instance partitions are computed here)")
+            self.store.set_instance_partitions(
+                name, compute_instance_partitions(servers,
+                                                  config.replication))
         if config.table_type is TableType.REALTIME:
             if config.stream_config is None:
                 raise ValueError("realtime table needs a stream config")
@@ -104,17 +121,26 @@ class Controller:
         cfg = self.store.get_table_config(table_with_type)
         if cfg is None:
             raise KeyError(f"no such table {table_with_type}")
+        partition_meta = {
+            cm.name: {"functionName": cm.partition_function,
+                      "numPartitions": cm.num_partitions,
+                      "partitions": list(cm.partitions)}
+            for cm in metadata.columns.values() if cm.partition_function}
         zk = SegmentZKMetadata(
             segment_name=metadata.segment_name, table_name=table_with_type,
             status=ONLINE, download_url=download_url, crc=metadata.crc,
             creation_time_ms=metadata.creation_time_ms,
             push_time_ms=int(time.time() * 1000),
             start_time=metadata.min_time, end_time=metadata.max_time,
-            total_docs=metadata.num_docs)
+            total_docs=metadata.num_docs,
+            partition_metadata=partition_meta)
         self.store.set_segment_metadata(zk)
 
         servers, replication = assignment_for_table(self.store, table_with_type)
-        strategy = BalancedSegmentAssignment()
+        groups = self.store.get_instance_partitions(table_with_type)
+        strategy: SegmentAssignment = (
+            ReplicaGroupSegmentAssignment(len(groups), groups=groups)
+            if groups else BalancedSegmentAssignment())
 
         def apply(ideal):
             ideal = ideal or {}
@@ -186,7 +212,16 @@ class Controller:
         the rebalance raises and leaves the added replicas in place."""
         servers, replication = assignment_for_table(self.store, table)
         current = self.store.get_ideal_state(table)
-        target = compute_target_assignment(current, servers, replication)
+        # replica-group tables recompute (and re-persist) their instance
+        # partitions so the target layout and the broker selectors stay in
+        # lockstep (ref: TableRebalancer reassignInstances)
+        groups = None
+        if self.store.get_instance_partitions(table) is not None and servers:
+            groups = compute_instance_partitions(servers, replication)
+            if not dry_run:
+                self.store.set_instance_partitions(table, groups)
+        target = compute_target_assignment(current, servers, replication,
+                                           groups=groups)
         steps = rebalance_steps(current, target)
         if dry_run:
             return steps

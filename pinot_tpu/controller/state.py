@@ -54,6 +54,9 @@ class SegmentZKMetadata:
     end_offset: Optional[str] = None
     partition: Optional[int] = None
     sequence: Optional[int] = None
+    # column -> {functionName, numPartitions, partitions} for broker-side
+    # partition pruning (ref: SegmentZKMetadata partitionMetadata)
+    partition_metadata: Dict[str, Any] = field(default_factory=dict)
     custom: Dict[str, Any] = field(default_factory=dict)
 
     def to_dict(self) -> Dict[str, Any]:
@@ -72,6 +75,7 @@ class SegmentZKMetadata:
             "endOffset": self.end_offset,
             "partition": self.partition,
             "sequence": self.sequence,
+            "partitionMetadata": self.partition_metadata,
             "custom": self.custom,
         }
 
@@ -87,6 +91,7 @@ class SegmentZKMetadata:
             total_docs=d.get("totalDocs", 0),
             start_offset=d.get("startOffset"), end_offset=d.get("endOffset"),
             partition=d.get("partition"), sequence=d.get("sequence"),
+            partition_metadata=d.get("partitionMetadata", {}),
             custom=d.get("custom", {}),
         )
 
@@ -318,6 +323,16 @@ class ClusterStateStore:
             return ev
 
         self.update(f"externalview/{table}", apply, default={})
+
+    # instance partitions (ref: InstancePartitions.java — persisted
+    # replica-group layout the broker's replica-group selectors read)
+    def set_instance_partitions(self, table: str,
+                                groups: List[List[str]]) -> None:
+        self.set(f"instancepartitions/{table}", [list(g) for g in groups])
+
+    def get_instance_partitions(self, table: str
+                                ) -> Optional[List[List[str]]]:
+        return self.get(f"instancepartitions/{table}")
 
     # instances
     def register_instance(self, info: InstanceInfo) -> None:

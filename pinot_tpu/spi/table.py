@@ -320,6 +320,26 @@ class IngestionConfig:
 
 
 @dataclass
+class RoutingConfig:
+    """Ref: pinot-spi/.../config/table/RoutingConfig.java — the broker's
+    instance-selector + pruner choices."""
+
+    instance_selector_type: str = "balanced"  # balanced | replicaGroup |
+    #                                           strictReplicaGroup
+    segment_pruner_types: List[str] = field(default_factory=list)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"instanceSelectorType": self.instance_selector_type,
+                "segmentPrunerTypes": self.segment_pruner_types}
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "RoutingConfig":
+        return cls(
+            instance_selector_type=d.get("instanceSelectorType", "balanced"),
+            segment_pruner_types=d.get("segmentPrunerTypes") or [])
+
+
+@dataclass
 class TableConfig:
     """Ref: pinot-spi/.../config/table/TableConfig.java."""
 
@@ -328,6 +348,7 @@ class TableConfig:
     validation_config: SegmentsValidationConfig = field(default_factory=SegmentsValidationConfig)
     indexing_config: IndexingConfig = field(default_factory=IndexingConfig)
     tenant_config: TenantConfig = field(default_factory=TenantConfig)
+    routing_config: RoutingConfig = field(default_factory=RoutingConfig)
     upsert_config: Optional[UpsertConfig] = None
     stream_config: Optional[StreamIngestionConfig] = None
     ingestion_config: Optional[IngestionConfig] = None
@@ -358,6 +379,9 @@ class TableConfig:
             "tenants": self.tenant_config.to_dict(),
             "metadata": {"customConfigs": self.custom_config},
         }
+        if (self.routing_config.instance_selector_type != "balanced"
+                or self.routing_config.segment_pruner_types):
+            d["routing"] = self.routing_config.to_dict()
         if self.upsert_config:
             d["upsertConfig"] = self.upsert_config.to_dict()
         if self.stream_config:
@@ -391,6 +415,7 @@ class TableConfig:
             validation_config=SegmentsValidationConfig.from_dict(d.get("segmentsConfig", {})),
             indexing_config=IndexingConfig.from_dict(d.get("tableIndexConfig", {})),
             tenant_config=TenantConfig.from_dict(d.get("tenants", {})),
+            routing_config=RoutingConfig.from_dict(d.get("routing", {})),
             upsert_config=UpsertConfig.from_dict(uc) if uc else None,
             stream_config=stream_config,
             ingestion_config=(IngestionConfig.from_dict(d["ingestionConfig"])

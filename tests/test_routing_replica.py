@@ -1,0 +1,145 @@
+"""Replica-group instance selection + broker partition pruning.
+
+Ref: routing/instanceselector/ReplicaGroupInstanceSelector.java,
+StrictReplicaGroupInstanceSelector.java,
+routing/segmentpruner/PartitionSegmentPruner.java.
+"""
+
+import numpy as np
+import pytest
+
+from pinot_tpu.broker.routing import (
+    ReplicaGroupInstanceSelector,
+    StrictReplicaGroupInstanceSelector,
+)
+from pinot_tpu.query import compile_query
+from pinot_tpu.spi import DataType, FieldSpec, FieldType, Schema
+from pinot_tpu.spi.table import (
+    IndexingConfig,
+    RoutingConfig,
+    SegmentPartitionConfig,
+    TableConfig,
+)
+from pinot_tpu.tools.cluster import EmbeddedCluster
+
+GROUPS = [["s0", "s1"], ["s2", "s3"]]
+
+
+class TestSelectors:
+    def test_replica_group_picks_one_group(self):
+        sel = ReplicaGroupInstanceSelector(GROUPS)
+        replicas = ["s0", "s2"]  # one replica in each group
+        a = sel.select("seg", replicas, request_id=0, excluded=frozenset())
+        b = sel.select("seg", replicas, request_id=1, excluded=frozenset())
+        assert {a, b} == {"s0", "s2"}  # rotates groups by requestId
+
+    def test_replica_group_falls_back_across_groups(self):
+        sel = ReplicaGroupInstanceSelector(GROUPS)
+        # picked group 0 has no live replica -> falls to group 1
+        got = sel.select("seg", ["s0", "s2"], request_id=0,
+                         excluded=frozenset({"s0"}))
+        assert got == "s2"
+
+    def test_strict_no_cross_group_fallback(self):
+        sel = StrictReplicaGroupInstanceSelector(GROUPS)
+        got = sel.select("seg", ["s0", "s2"], request_id=0,
+                         excluded=frozenset({"s0"}))
+        assert got is None  # strict: group 0 picked, cannot serve
+        got = sel.select("seg", ["s0", "s2"], request_id=1,
+                         excluded=frozenset({"s0"}))
+        assert got == "s2"  # group 1 picked, serves fine
+
+
+def _schema():
+    return Schema("rg", [
+        FieldSpec("city", DataType.STRING),
+        FieldSpec("v", DataType.LONG, FieldType.METRIC)])
+
+
+@pytest.fixture()
+def rg_cluster(tmp_path):
+    c = EmbeddedCluster(num_servers=4, data_dir=str(tmp_path / "c"))
+    cfg = TableConfig("rg", routing_config=RoutingConfig(
+        instance_selector_type="replicaGroup"))
+    cfg.validation_config.replication = 2
+    c.create_table(cfg, _schema())
+    rng = np.random.default_rng(1)
+    for i in range(4):
+        c.ingest_rows("rg_OFFLINE", _schema(), {
+            "city": np.array(["sf", "nyc"])[rng.integers(0, 2, 500)],
+            "v": rng.integers(0, 9, 500).astype(np.int64),
+        }, segment_name=f"rg_{i}")
+    assert c.wait_for_ev_converged("rg_OFFLINE")
+    yield c
+    c.shutdown()
+
+
+class TestReplicaGroupRouting:
+    def test_instance_partitions_persisted(self, rg_cluster):
+        groups = rg_cluster.store.get_instance_partitions("rg_OFFLINE")
+        assert groups is not None and len(groups) == 2
+        assert sorted(sum(groups, [])) == sorted(rg_cluster.servers)
+
+    def test_one_group_serves_each_query(self, rg_cluster):
+        groups = [set(g) for g in
+                  rg_cluster.store.get_instance_partitions("rg_OFFLINE")]
+        rm = rg_cluster.broker.routing
+        ctx = compile_query("SELECT count(*) FROM rg")
+        for rid in range(6):
+            routing, unavailable = rm.get_routing_table(
+                "rg_OFFLINE", ctx, request_id=rid)
+            assert not unavailable
+            used = set(routing.keys())
+            # all chosen servers live in ONE replica group
+            assert any(used <= g for g in groups), (used, groups)
+            # and the group covers all 4 segments
+            assert sorted(sum(routing.values(), [])) == \
+                [f"rg_{i}" for i in range(4)]
+
+    def test_queries_answer_correctly(self, rg_cluster):
+        rows = rg_cluster.query_rows("SELECT count(*) FROM rg")
+        assert rows[0][0] == 2000
+
+
+class TestBrokerPartitionPruning:
+    def test_partitioned_segments_prune_at_broker(self, tmp_path):
+        c = EmbeddedCluster(num_servers=1, data_dir=str(tmp_path / "c"))
+        part_cfg = IndexingConfig(
+            segment_partition_config=SegmentPartitionConfig(
+                {"city": {"functionName": "Murmur", "numPartitions": 4}}))
+        cfg = TableConfig("pp", indexing_config=part_cfg,
+                          routing_config=RoutingConfig(
+                              segment_pruner_types=["partition"]))
+        schema = Schema("pp", [
+            FieldSpec("city", DataType.STRING),
+            FieldSpec("v", DataType.LONG, FieldType.METRIC)])
+        c.create_table(cfg, schema)
+        try:
+            from pinot_tpu.utils.partition import get_partition_function
+
+            fn = get_partition_function("Murmur", 4)
+            by_part = {}
+            for v in (f"city{i}" for i in range(60)):
+                by_part.setdefault(fn.partition(v), []).append(v)
+            parts = sorted(by_part)[:2]
+            for p in parts:
+                vals = by_part[p]
+                c.ingest_rows("pp_OFFLINE", schema, {
+                    "city": np.array(vals),
+                    "v": np.ones(len(vals), dtype=np.int64),
+                }, segment_name=f"pp_{p}")
+            assert c.wait_for_ev_converged("pp_OFFLINE")
+
+            probe = by_part[parts[0]][0]
+            rm = c.broker.routing
+            ctx = compile_query(
+                f"SELECT count(*) FROM pp WHERE city = '{probe}'")
+            routing, _ = rm.get_routing_table("pp_OFFLINE", ctx)
+            routed = sum(routing.values(), [])
+            assert routed == [f"pp_{parts[0]}"]  # other partition pruned
+
+            rows = c.query_rows(
+                f"SELECT count(*) FROM pp WHERE city = '{probe}'")
+            assert rows[0][0] == 1
+        finally:
+            c.shutdown()

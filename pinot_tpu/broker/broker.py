@@ -55,6 +55,8 @@ class BrokerRequestHandler:
                  routing: Optional[RoutingManager] = None,
                  scatter_workers: int = 16,
                  query_timeout_s: float = 30.0):
+        from pinot_tpu.spi.metrics import MetricsRegistry
+
         self.store = store
         self.routing = routing or RoutingManager(store)
         self.reduce_service = BrokerReduceService()
@@ -63,6 +65,7 @@ class BrokerRequestHandler:
 
         self._pool = _DaemonPool(scatter_workers, "scatter")
         self.query_timeout_s = query_timeout_s
+        self.metrics = MetricsRegistry(role="broker")
 
     # -- transport registry --------------------------------------------------
     def register_server(self, instance_id: str, server) -> None:
@@ -72,27 +75,53 @@ class BrokerRequestHandler:
 
     # -- entry (ref: handleSQLRequest:203) -----------------------------------
     def handle_sql(self, sql: str) -> BrokerResponse:
+        from pinot_tpu.spi.metrics import BrokerMeter, BrokerQueryPhase
+
         start = time.perf_counter()
+        self.metrics.meter(BrokerMeter.QUERIES).mark()
         response = BrokerResponse()
+
+        def phase(name: str, t0: float) -> float:
+            """Record a broker phase (ref: BrokerQueryPhase timers at
+            SingleConnectionBrokerRequestHandler.java:90-123)."""
+            now = time.perf_counter()
+            ms = (now - t0) * 1e3
+            response.phase_times_ms[name] = \
+                response.phase_times_ms.get(name, 0.0) + ms
+            self.metrics.timer(name).update_ms(ms)
+            return now
+
+        def finish(resp: BrokerResponse) -> BrokerResponse:
+            # exactly one exceptions_total tick per failed query, whatever
+            # the failure mode (parse / no table / unavailable / reduce)
+            if resp.has_exceptions:
+                self.metrics.meter(BrokerMeter.EXCEPTIONS).mark()
+            return resp
+
         try:
             ctx = compile_query(sql)
         except SqlParseError as e:
             response.add_exception(SQL_PARSING_ERROR, str(e))
-            return response
+            return finish(response)
+        t = phase(BrokerQueryPhase.COMPILATION, start)
 
         try:
             physical = self._resolve_tables(ctx.table_name)
         except QueryError as e:
             response.add_exception(TABLE_DOES_NOT_EXIST_ERROR, str(e))
-            return response
+            return finish(response)
 
         tables: List[DataTable] = []
         servers_queried = set()
         servers_responded = set()
         for table, sub_ctx in self._split_hybrid(ctx, physical):
+            t = time.perf_counter()
             routing, unavailable = self.routing.get_routing_table(
                 table, sub_ctx)
+            t = phase(BrokerQueryPhase.ROUTING, t)
             if unavailable:
+                self.metrics.meter(BrokerMeter.NO_SERVING_HOST).mark(
+                    len(unavailable))
                 response.add_exception(
                     SERVER_NOT_RESPONDING_ERROR,
                     f"{len(unavailable)} segments of {table} unavailable: "
@@ -101,6 +130,7 @@ class BrokerRequestHandler:
                 continue
             gathered, queried, responded = self._scatter_gather(
                 table, sub_ctx, routing)
+            phase(BrokerQueryPhase.SCATTER_GATHER, t)
             tables.extend(gathered)
             servers_queried |= queried
             servers_responded |= responded
@@ -111,8 +141,9 @@ class BrokerRequestHandler:
             # an existing-but-empty table answers with an empty result
             response.stats = QueryStats()
             response.time_used_ms = (time.perf_counter() - start) * 1e3
-            return response
+            return finish(response)
 
+        t = time.perf_counter()
         try:
             table, stats, server_errors = self.reduce_service.reduce(
                 ctx, tables)
@@ -123,8 +154,9 @@ class BrokerRequestHandler:
                 response.add_exception(SERVER_NOT_RESPONDING_ERROR, msg)
         except QueryError as e:
             response.add_exception(QUERY_EXECUTION_ERROR, str(e))
+        phase(BrokerQueryPhase.REDUCE, t)
         response.time_used_ms = (time.perf_counter() - start) * 1e3
-        return response
+        return finish(response)
 
     # -- table resolution + hybrid split -------------------------------------
     def _resolve_tables(self, raw_name: str) -> List[str]:

@@ -112,6 +112,7 @@ class DataTable:
             num_docs_scanned=st.get("numDocsScanned", 0),
             total_docs=st.get("totalDocs", 0),
             num_groups_limit_reached=st.get("numGroupsLimitReached", False),
+            phase_ms=st.get("phaseTimesMs", {}),
         )
         return cls(ResponseType(d["type"]), d["payload"], stats,
                    d.get("exceptions", []))

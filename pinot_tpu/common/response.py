@@ -21,6 +21,9 @@ class BrokerResponse:
     num_servers_queried: int = 0
     num_servers_responded: int = 0
     time_used_ms: float = 0.0
+    # broker-side phase timings (COMPILATION/ROUTING/SCATTER_GATHER/REDUCE);
+    # server phases arrive merged inside stats.phase_ms
+    phase_times_ms: Dict[str, float] = field(default_factory=dict)
     trace_info: Dict[str, Any] = field(default_factory=dict)
 
     def to_dict(self) -> Dict[str, Any]:
@@ -36,6 +39,11 @@ class BrokerResponse:
             "totalDocs": self.stats.total_docs,
             "numGroupsLimitReached": self.stats.num_groups_limit_reached,
             "timeUsedMs": round(self.time_used_ms, 3),
+            # broker + (summed) server phase timings in one map
+            "phaseTimesMs": {
+                **{k: round(v, 3) for k, v in self.phase_times_ms.items()},
+                **{k: round(v, 3) for k, v in self.stats.phase_ms.items()},
+            },
         }
         if self.result_table is not None:
             d["resultTable"] = self.result_table.to_dict()

@@ -59,8 +59,16 @@ class Controller:
                  controller_id: str = "controller_0",
                  llc_seed: Optional[str] = None):
         from pinot_tpu.controller.tasks import PinotTaskManager
+        from pinot_tpu.spi.metrics import MetricsRegistry
 
         self.store = store or ClusterStateStore()
+        self.metrics = MetricsRegistry(role="controller")
+        self.metrics.gauge("tables", lambda: len(self.store.table_names()))
+        self.metrics.gauge("segments", lambda: sum(
+            len(self.store.segment_names(t))
+            for t in self.store.table_names()))
+        self.metrics.gauge("live_servers", lambda: len(
+            self.store.instances("SERVER", only_alive=True)))
         self.controller_id = controller_id
         self.task_manager = PinotTaskManager(self.store)
         self.llc = LLCRealtimeSegmentManager(self.store, seed=llc_seed)

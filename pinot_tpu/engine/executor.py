@@ -165,9 +165,15 @@ class ServerQueryExecutor:
         one segment is kept so result-shape machinery (schema derivation,
         identity aggregation states) runs unchanged — a provably-empty
         scan of one segment is cheap and exact."""
-        from pinot_tpu.engine.pruner import prune_segments
+        import time as _time
 
+        from pinot_tpu.engine.pruner import prune_segments
+        from pinot_tpu.spi.metrics import ServerQueryPhase
+
+        t0 = _time.perf_counter()
         kept = prune_segments(ctx, segments, stats)
+        stats.add_phase_ms(ServerQueryPhase.SEGMENT_PRUNING,
+                           (_time.perf_counter() - t0) * 1e3)
         if not kept:
             kept = segments[:1]
             stats.num_segments_pruned -= 1

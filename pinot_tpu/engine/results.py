@@ -69,6 +69,13 @@ class QueryStats:
     num_docs_scanned: int = 0
     total_docs: int = 0
     num_groups_limit_reached: bool = False
+    # phase -> ms (ref: TimerContext/ServerQueryPhase —
+    # ServerQueryExecutorV1Impl.java:122,276,297,303); summed across
+    # servers at reduce
+    phase_ms: Dict[str, float] = field(default_factory=dict)
+
+    def add_phase_ms(self, phase: str, ms: float) -> None:
+        self.phase_ms[phase] = self.phase_ms.get(phase, 0.0) + ms
 
     def merge(self, other: "QueryStats") -> None:
         self.num_segments_queried += other.num_segments_queried
@@ -78,6 +85,8 @@ class QueryStats:
         self.num_docs_scanned += other.num_docs_scanned
         self.total_docs += other.total_docs
         self.num_groups_limit_reached |= other.num_groups_limit_reached
+        for phase, ms in other.phase_ms.items():
+            self.add_phase_ms(phase, ms)
 
     def to_dict(self) -> Dict[str, Any]:
         return {
@@ -88,6 +97,8 @@ class QueryStats:
             "numDocsScanned": self.num_docs_scanned,
             "totalDocs": self.total_docs,
             "numGroupsLimitReached": self.num_groups_limit_reached,
+            "phaseTimesMs": {k: round(v, 3)
+                             for k, v in self.phase_ms.items()},
         }
 
 

@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import logging
 import threading
+import time
 
 from typing import Dict, List, Optional
 
@@ -56,11 +57,14 @@ class ServerInstance:
                  scheduler: Optional[QueryScheduler] = None,
                  segment_dir: str = "/tmp/pinot_tpu_server",
                  consumer_tick_s: float = 0.02):
+        from pinot_tpu.spi.metrics import MetricsRegistry
+
         self.instance_id = instance_id
         self.store = store
         self.completion_protocol = completion_protocol
         self.executor = executor or ServerQueryExecutor()
         self.scheduler = scheduler or make_scheduler("fcfs")
+        self.metrics = MetricsRegistry(role="server")
         self.data_manager = InstanceDataManager()
         self.segment_dir = segment_dir
         self.consumer_tick_s = consumer_tick_s
@@ -253,25 +257,49 @@ class ServerInstance:
         if not self._queries_enabled:
             return DataTable.for_exception(
                 f"server {self.instance_id} is shut down")
+        submit_t = time.perf_counter()
         future = self.scheduler.submit(
-            lambda: self._execute(ctx, table, segment_names), table=table)
+            lambda: self._execute(ctx, table, segment_names, submit_t),
+            table=table)
         return future.result()
 
     def _execute(self, ctx: QueryContext, table: str,
-                 segment_names: Optional[List[str]]) -> DataTable:
+                 segment_names: Optional[List[str]],
+                 submit_t: float) -> DataTable:
+        from pinot_tpu.spi.metrics import ServerMeter, ServerQueryPhase
+
+        wait_ms = (time.perf_counter() - submit_t) * 1e3
+        self.metrics.timer(ServerQueryPhase.SCHEDULER_WAIT).update_ms(wait_ms)
+        self.metrics.meter(ServerMeter.QUERIES).mark()
         tdm = self.data_manager.get(table)
         if tdm is None:
+            self.metrics.meter(ServerMeter.QUERY_EXCEPTIONS).mark()
             return DataTable.for_exception(
                 f"table {table} not hosted on {self.instance_id}")
         acquired = tdm.acquire_segments(segment_names)
+        t0 = time.perf_counter()
         try:
             segments = [s.segment for s in acquired]
             if not segments:
+                self.metrics.meter(ServerMeter.QUERY_EXCEPTIONS).mark()
                 return DataTable.for_exception(
                     f"no segments of {table} on {self.instance_id}")
-            return self.executor.execute_instance(ctx, segments)
+            dt = self.executor.execute_instance(ctx, segments)
+            exec_ms = (time.perf_counter() - t0) * 1e3
+            # phase timings travel in the DataTable stats (ref: the
+            # TimerContext values at ServerQueryExecutorV1Impl:122-303)
+            dt.stats.add_phase_ms(ServerQueryPhase.SCHEDULER_WAIT, wait_ms)
+            dt.stats.add_phase_ms(ServerQueryPhase.QUERY_EXECUTION, exec_ms)
+            self.metrics.timer(
+                ServerQueryPhase.QUERY_EXECUTION).update_ms(exec_ms)
+            self.metrics.meter(ServerMeter.DOCS_SCANNED).mark(
+                dt.stats.num_docs_scanned)
+            self.metrics.meter(ServerMeter.SEGMENTS_PRUNED).mark(
+                dt.stats.num_segments_pruned)
+            return dt
         except Exception as e:  # query errors travel in the DataTable
             log.debug("[%s] query failed", self.instance_id, exc_info=True)
+            self.metrics.meter(ServerMeter.QUERY_EXCEPTIONS).mark()
             return DataTable.for_exception(str(e))
         finally:
             tdm.release_segments(acquired)

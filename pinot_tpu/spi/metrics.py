@@ -1,0 +1,166 @@
+"""Metrics SPI: meters / gauges / timers + prometheus-text export.
+
+Re-design of the reference's metrics layer
+(``pinot-common/.../metrics/AbstractMetrics.java:46`` + per-role
+``ServerMeter``/``BrokerMeter``/``ServerTimer``/``ServerQueryPhase`` enums,
+exported through a pluggable registry — yammer by JMX there, a
+prometheus-text endpoint here): each role process owns a
+:class:`MetricsRegistry`; meters and timers take a tiny uncontended lock
+per update (python '+=' is not atomic across threads).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from typing import Any, Callable, Dict, Optional, Union
+
+
+class Meter:
+    """Monotonic counter (ref: PinotMeter). Locked: '+=' is not atomic
+    under the GIL (LOAD/ADD/STORE can interleave across threads)."""
+
+    __slots__ = ("count", "_lock")
+
+    def __init__(self):
+        self.count = 0
+        self._lock = threading.Lock()
+
+    def mark(self, n: int = 1) -> None:
+        with self._lock:
+            self.count += n
+
+
+class Timer:
+    """Duration accumulator: count / total / max ms (ref: PinotTimer)."""
+
+    __slots__ = ("count", "total_ms", "max_ms", "_lock")
+
+    def __init__(self):
+        self.count = 0
+        self.total_ms = 0.0
+        self.max_ms = 0.0
+        self._lock = threading.Lock()
+
+    def update_ms(self, ms: float) -> None:
+        with self._lock:
+            self.count += 1
+            self.total_ms += ms
+            if ms > self.max_ms:
+                self.max_ms = ms
+
+    @property
+    def mean_ms(self) -> float:
+        return self.total_ms / self.count if self.count else 0.0
+
+    def time(self) -> "_TimerContext":
+        return _TimerContext(self)
+
+
+class _TimerContext:
+    __slots__ = ("_timer", "_t0")
+
+    def __init__(self, timer: Timer):
+        self._timer = timer
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        self._timer.update_ms((time.perf_counter() - self._t0) * 1e3)
+
+
+GaugeFn = Union[Callable[[], float], float, int]
+
+
+class MetricsRegistry:
+    """One per role process (ref: PinotMetricsRegistry)."""
+
+    def __init__(self, role: str = ""):
+        self.role = role
+        self._meters: Dict[str, Meter] = {}
+        self._timers: Dict[str, Timer] = {}
+        self._gauges: Dict[str, GaugeFn] = {}
+        self._lock = threading.Lock()
+
+    def meter(self, name: str) -> Meter:
+        m = self._meters.get(name)
+        if m is None:
+            with self._lock:
+                m = self._meters.setdefault(name, Meter())
+        return m
+
+    def timer(self, name: str) -> Timer:
+        t = self._timers.get(name)
+        if t is None:
+            with self._lock:
+                t = self._timers.setdefault(name, Timer())
+        return t
+
+    def gauge(self, name: str, fn: GaugeFn) -> None:
+        self._gauges[name] = fn
+
+    # -- export --------------------------------------------------------------
+    def _prefix(self, name: str) -> str:
+        p = f"pinot_{self.role}_" if self.role else "pinot_"
+        return p + name
+
+    def export_prometheus(self) -> str:
+        """Prometheus text exposition (the /metrics endpoint body)."""
+        lines = []
+        for name, m in sorted(self._meters.items()):
+            full = self._prefix(name)
+            lines.append(f"# TYPE {full} counter")
+            lines.append(f"{full} {m.count}")
+        for name, g in sorted(self._gauges.items()):
+            full = self._prefix(name)
+            v = g() if callable(g) else g
+            lines.append(f"# TYPE {full} gauge")
+            lines.append(f"{full} {float(v)}")
+        for name, t in sorted(self._timers.items()):
+            full = self._prefix(name)
+            lines.append(f"# TYPE {full}_ms summary")
+            lines.append(f"{full}_ms_count {t.count}")
+            lines.append(f"{full}_ms_sum {round(t.total_ms, 3)}")
+            lines.append(f"{full}_ms_max {round(t.max_ms, 3)}")
+        return "\n".join(lines) + "\n"
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "meters": {n: m.count for n, m in self._meters.items()},
+            "gauges": {n: (g() if callable(g) else g)
+                       for n, g in self._gauges.items()},
+            "timers": {n: {"count": t.count,
+                           "totalMs": round(t.total_ms, 3),
+                           "maxMs": round(t.max_ms, 3)}
+                       for n, t in self._timers.items()},
+        }
+
+
+# canonical metric names (subset of the reference's per-role enums)
+class BrokerMeter:
+    QUERIES = "queries_total"
+    EXCEPTIONS = "query_exceptions_total"
+    NO_SERVING_HOST = "no_serving_host_total"
+
+
+class BrokerQueryPhase:
+    COMPILATION = "COMPILATION"
+    ROUTING = "ROUTING"
+    SCATTER_GATHER = "SCATTER_GATHER"
+    REDUCE = "REDUCE"
+
+
+class ServerMeter:
+    QUERIES = "queries_total"
+    DOCS_SCANNED = "docs_scanned_total"
+    SEGMENTS_PRUNED = "segments_pruned_total"
+    QUERY_EXCEPTIONS = "query_exceptions_total"
+
+
+class ServerQueryPhase:
+    SCHEDULER_WAIT = "SCHEDULER_WAIT"
+    SEGMENT_PRUNING = "SEGMENT_PRUNING"
+    QUERY_EXECUTION = "QUERY_EXECUTION"

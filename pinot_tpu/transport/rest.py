@@ -51,10 +51,15 @@ class _Api:
                         match = pat.fullmatch(self.path.split("?", 1)[0])
                         if match:
                             code, payload = fn(match, body)
-                            raw = json.dumps(payload).encode("utf-8")
+                            if isinstance(payload, str):
+                                # text endpoints (/metrics prometheus body)
+                                raw = payload.encode("utf-8")
+                                ctype = "text/plain; version=0.0.4"
+                            else:
+                                raw = json.dumps(payload).encode("utf-8")
+                                ctype = "application/json"
                             self.send_response(code)
-                            self.send_header("Content-Type",
-                                             "application/json")
+                            self.send_header("Content-Type", ctype)
                             self.send_header("Content-Length", str(len(raw)))
                             self.end_headers()
                             self.wfile.write(raw)
@@ -104,6 +109,8 @@ class ControllerApi(_Api):
 
         self.route("GET", r"/health",
                    lambda m, b: (200, {"status": "OK"}))
+        self.route("GET", r"/metrics",
+                   lambda m, b: (200, c.metrics.export_prometheus()))
         # schemas (ref: PinotSchemaRestletResource)
         self.route("POST", r"/schemas",
                    lambda m, b: (200, self._add_schema(c, b)))
@@ -184,6 +191,8 @@ class BrokerApi(_Api):
 
         self.route("POST", r"/query/sql", query)
         self.route("GET", r"/health", lambda m, b: (200, {"status": "OK"}))
+        self.route("GET", r"/metrics",
+                   lambda m, b: (200, broker.metrics.export_prometheus()))
         self.route("GET", r"/debug/routing/([^/]+)",
                    lambda m, b: (200, dict(
                        broker.routing.get_routing_table(m.group(1))[0])))
@@ -207,6 +216,8 @@ class ServerAdminApi(_Api):
         super().__init__(port)
         s = server_instance
         self.route("GET", r"/health", lambda m, b: (200, {"status": "OK"}))
+        self.route("GET", r"/metrics",
+                   lambda m, b: (200, s.metrics.export_prometheus()))
         self.route("GET", r"/tables",
                    lambda m, b: (200, {"tables": s.hosted_tables()}))
         self.route("GET", r"/tables/([^/]+)/segments",

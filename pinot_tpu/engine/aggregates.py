@@ -257,8 +257,9 @@ _RESULT_TYPE = {
 
 # families with device kernels (kernels.py); others run on the host path
 _DEVICE_SCALAR = {"count", "sum", "min", "max", "avg", "minmaxrange",
-                  "distinctcount"}
-_DEVICE_GROUPED = {"count", "sum", "min", "max", "avg", "minmaxrange"}
+                  "distinctcount", "distinctcounthll"}
+_DEVICE_GROUPED = {"count", "sum", "min", "max", "avg", "minmaxrange",
+                   "distinctcounthll"}
 
 
 def resolve_agg(fn: Function) -> AggDef:

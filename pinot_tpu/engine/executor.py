@@ -390,6 +390,11 @@ def _decode_scalar_state(aspec: Tuple, raw: Any, provider: Any) -> Any:
         ids = np.nonzero(presence)[0]
         d = provider.data_source(aspec[1]).dictionary
         return frozenset(d.get_values(ids))
+    if base == "distinctcounthll":
+        from pinot_tpu.utils.hll import HyperLogLog
+
+        regs = np.asarray(raw).astype(np.uint8)
+        return HyperLogLog(aspec[2], regs).serialize()
     if base == "count":
         return int(raw)
     if base in ("sum", "min", "max"):
@@ -443,6 +448,14 @@ def decode_grouped_result(plan: SegmentPlan, provider: Any,
             lo = np.asarray(raw[0])[gidx]
             hi = np.asarray(raw[1])[gidx]
             states_per_agg.append([(float(a), float(b)) for a, b in zip(lo, hi)])
+        elif base == "distinctcounthll":
+            from pinot_tpu.utils.hll import HyperLogLog
+
+            log2m = aspec[2]
+            regs = np.asarray(raw).reshape(-1, 1 << log2m)[gidx]
+            states_per_agg.append(
+                [HyperLogLog(log2m, r.astype(np.uint8)).serialize()
+                 for r in regs])
         else:
             raise AssertionError(base)
 

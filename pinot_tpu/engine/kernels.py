@@ -268,6 +268,10 @@ def output_layout(spec: Tuple, num_seg: int = 0) -> List[Tuple[str, int]]:
         if aspec[0] == "distinctcount":
             entries.append((f"agg{i}", aspec[2]))  # [cardinality] presence
             continue
+        if aspec[0] == "distinctcounthll":
+            m = 1 << aspec[2]
+            entries.append((f"agg{i}", (num_groups or 1) * m))
+            continue
         nleaves = len(reducers[f"agg{i}"])
         size = num_groups if group_specs else 1
         if nleaves == 1:
@@ -301,7 +305,8 @@ def unpack_outputs(packed, spec: Tuple, num_seg: int = 0) -> Dict[str, Any]:
 
     packed = np.asarray(packed)
     grouped = bool(spec[2])
-    dc = {f"agg{i}" for i, a in enumerate(spec[1]) if a[0] == "distinctcount"}
+    dc = {f"agg{i}" for i, a in enumerate(spec[1])
+          if a[0] in ("distinctcount", "distinctcounthll")}
     out: Dict[str, Any] = {}
     multi: Dict[str, Dict[int, Any]] = {}
     off = 0
@@ -343,6 +348,7 @@ def partial_reduce_ops(spec: Tuple) -> Dict[str, Tuple[str, ...]]:
             "avg": ("sum", "sum"),
             "minmaxrange": ("min", "max"),
             "distinctcount": ("max",),
+            "distinctcounthll": ("max",),  # register merge = pmax
         }[base]
     return ops
 
@@ -370,6 +376,17 @@ def _emit_scalar_agg(aspec, cols, pc, mask):
         presence = jnp.zeros(card, dtype=jnp.int32).at[fwd].max(
             mask.astype(jnp.int32), mode="drop")
         return presence  # [card] 0/1; host maps present dictIds -> values
+    if aspec[0] == "distinctcounthll":
+        # HLL register update as masked scatter-max over precomputed
+        # per-dictId (bucket, rank) LUTs (utils/hll.register_updates)
+        _, colname, log2m = aspec
+        m = 1 << log2m
+        fwd = cols[colname]["fwd"]
+        bucket = pc.take()[fwd]
+        rank = pc.take()[fwd]
+        regs = jax.ops.segment_max(jnp.where(mask, rank, 0), bucket,
+                                   num_segments=m)
+        return jnp.maximum(regs, 0)  # untouched buckets -> 0, not int-min
     base, mv, vals, dt, wide, min_n, max_n = _masked_values(
         aspec, cols, pc, mask)
     zero = jnp.zeros((), dtype=dt)
@@ -420,6 +437,17 @@ def _emit_scalar_agg(aspec, cols, pc, mask):
 
 
 def _emit_grouped_agg(aspec, cols, pc, mask, seg_ids, num_groups):
+    if aspec[0] == "distinctcounthll":
+        # per-group registers: composed (group, bucket) scatter-max ids
+        _, colname, log2m = aspec
+        m = 1 << log2m
+        fwd = cols[colname]["fwd"]
+        bucket = pc.take()[fwd]
+        rank = pc.take()[fwd]
+        ids = seg_ids * m + bucket        # overflow group included
+        regs = jax.ops.segment_max(jnp.where(mask, rank, 0), ids,
+                                   num_segments=(num_groups + 1) * m)
+        return jnp.maximum(regs[:num_groups * m], 0)  # [G*m]
     base, mv, vals, dt, wide, min_n, max_n = _masked_values(
         aspec, cols, pc, mask)
     n = num_groups + 1

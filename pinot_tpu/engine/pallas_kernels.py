@@ -144,8 +144,9 @@ def extract_plan(plan, provider) -> Optional[PallasPlan]:
     filter_spec, agg_specs, group_specs, num_groups, _ = plan.spec
     if group_specs and num_groups > MAX_PALLAS_GROUPS:
         return None
-    if any(a[0] == "distinctcount" for a in agg_specs):
-        return None
+    if any(a[0] in ("distinctcount", "distinctcounthll")
+           for a in agg_specs):
+        return None  # 3-tuple specs (col, card/log2m) — jnp path serves
 
     try:
         packed_names: List[str] = []

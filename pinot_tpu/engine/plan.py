@@ -126,6 +126,28 @@ def plan_segment(ctx: QueryContext, segment: ImmutableSegment) -> SegmentPlan:
             raise PlanError(f"aggregation {agg.name} not device-supported "
                             f"{'grouped' if grouped else 'scalar'}")
         vexpr = agg_value_expr(fn)
+        if agg.base == "distinctcounthll" and not agg.mv:
+            # device HLL: per-dictId (bucket, rank) LUTs precomputed from
+            # the dictionary's hashes; register update = masked scatter-max
+            # (ref: DistinctCountHLLAggregationFunction; utils/hll.py)
+            from pinot_tpu.utils.hll import DEFAULT_LOG2M
+
+            if not isinstance(vexpr, Identifier):
+                raise PlanError("DISTINCTCOUNTHLL argument must be a column")
+            cm = segment.metadata.column(vexpr.name)
+            if not (cm.has_dictionary and cm.single_value):
+                raise PlanError("DISTINCTCOUNTHLL needs an SV dict column")
+            m = 1 << DEFAULT_LOG2M
+            if num_groups and (num_groups + 1) * m > (1 << 23):
+                raise PlanError("grouped HLL register space too large")
+            d = segment.data_source(vexpr.name).dictionary
+            bucket, rank = d.hll_register_luts(DEFAULT_LOG2M)
+            params.append(bucket)
+            params.append(rank)
+            agg_specs.append(("distinctcounthll", vexpr.name, DEFAULT_LOG2M))
+            if vexpr.name not in columns:
+                columns.append(vexpr.name)
+            continue
         if agg.base == "distinctcount" and not agg.mv:
             # checked before value compilation: the presence-bitmap kernel
             # reads dictIds directly, so non-numeric (string) columns are

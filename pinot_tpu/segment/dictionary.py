@@ -32,6 +32,24 @@ class Dictionary:
     def cardinality(self) -> int:
         return len(self)
 
+    def hll_register_luts(self, log2m: int):
+        """Memoized (bucket, rank) register LUTs over this dictionary's
+        values — the device HLL's plan-time parameters (string hashing is
+        python-loop FNV, so recomputing per query would dominate plan
+        time; the LUT depends only on (dictionary, log2m))."""
+        cache = getattr(self, "_hll_luts", None)
+        if cache is None:
+            cache = {}
+            self._hll_luts = cache
+        luts = cache.get(log2m)
+        if luts is None:
+            from pinot_tpu.utils.hll import dictionary_register_luts
+
+            luts = dictionary_register_luts(
+                self.get_values(range(len(self))), log2m)
+            cache[log2m] = luts
+        return luts
+
     def index_of(self, value: Any) -> int:
         """value -> dictId, or -1 if absent (ref: Dictionary.NULL_VALUE_INDEX)."""
         raise NotImplementedError

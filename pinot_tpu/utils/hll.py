@@ -49,6 +49,36 @@ def hash_values(values: Sequence[Any]) -> np.ndarray:
     return _hash64(out.view(np.int64))
 
 
+def register_updates(hashes: np.ndarray, log2m: int):
+    """(register index, rank) per hash — the HLL update decomposed so the
+    TPU path can PRECOMPUTE per-dictId (bucket, rank) lookup tables and
+    turn register updates into a masked scatter-max on device (the same
+    max-merge shape as dictId presence; see engine/kernels.py)."""
+    idx = (hashes >> np.uint64(64 - log2m)).astype(np.int64)
+    rest = hashes << np.uint64(log2m)
+    # rank = leading zeros of the remaining bits + 1 (capped)
+    width = 64 - log2m
+    rank = np.full(hashes.shape, width + 1, dtype=np.int32)
+    bits = rest
+    found = np.zeros(hashes.shape, dtype=bool)
+    for r in range(1, width + 1):
+        top = (bits >> np.uint64(63)).astype(bool)
+        newly = top & ~found
+        rank[newly] = r
+        found |= top
+        bits = bits << np.uint64(1)
+        if found.all():
+            break
+    return idx, rank
+
+
+def dictionary_register_luts(values, log2m: int = DEFAULT_LOG2M):
+    """(bucket [card] i32, rank [card] i32) for a dictionary's values —
+    the device HLL's plan-time parameters."""
+    idx, rank = register_updates(hash_values(list(values)), log2m)
+    return idx.astype(np.int32), rank.astype(np.int32)
+
+
 class HyperLogLog:
     def __init__(self, log2m: int = DEFAULT_LOG2M,
                  registers: Optional[np.ndarray] = None):
@@ -61,22 +91,8 @@ class HyperLogLog:
     def add_hashes(self, hashes: np.ndarray) -> None:
         if hashes.size == 0:
             return
-        idx = (hashes >> np.uint64(64 - self.log2m)).astype(np.int64)
-        rest = hashes << np.uint64(self.log2m)
-        # rank = leading zeros of the remaining bits + 1 (capped)
-        width = 64 - self.log2m
-        rank = np.full(hashes.shape, width + 1, dtype=np.uint8)
-        bits = rest
-        found = np.zeros(hashes.shape, dtype=bool)
-        for r in range(1, width + 1):
-            top = (bits >> np.uint64(63)).astype(bool)
-            newly = top & ~found
-            rank[newly] = r
-            found |= top
-            bits = bits << np.uint64(1)
-            if found.all():
-                break
-        np.maximum.at(self.registers, idx, rank)
+        idx, rank = register_updates(hashes, self.log2m)
+        np.maximum.at(self.registers, idx, rank.astype(np.uint8))
 
     def add_values(self, values: Sequence[Any]) -> None:
         self.add_hashes(hash_values(values))

@@ -155,3 +155,70 @@ class TestSketchQueries:
         from pinot_tpu.utils.hll import HyperLogLog
         h = HyperLogLog.deserialize(bytes.fromhex(t.rows[0][0]))
         assert h.cardinality() > 0
+
+
+class TestDeviceHLL:
+    """Round-4: DISTINCTCOUNTHLL runs the TPU path (BASELINE config #4).
+    Device and host hash identical values, so parity is EXACT."""
+
+    @pytest.fixture(scope="class")
+    def hll_segs(self, tmp_path_factory):
+        out = str(tmp_path_factory.mktemp("dhll"))
+        rng = np.random.default_rng(23)
+        n = 30_000
+        df = pd.DataFrame({
+            "user": np.array([f"u{i}" for i in range(8000)])[
+                rng.integers(0, 8000, n)],
+            "grp": np.array(["a", "b", "c"])[rng.integers(0, 3, n)],
+            "lat": np.round(rng.gamma(3, 25, n), 3),
+        })
+        schema = Schema("events", [
+            FieldSpec("user", DataType.STRING),
+            FieldSpec("grp", DataType.STRING),
+            FieldSpec("lat", DataType.DOUBLE, FieldType.METRIC),
+        ])
+        segs = []
+        for i, sl in enumerate([slice(0, n // 2), slice(n // 2, None)]):
+            part = df.iloc[sl]
+            SegmentBuilder(schema, f"dh_{i}").build(
+                {c: part[c].to_numpy() for c in df.columns}, out)
+            segs.append(load_segment(f"{out}/dh_{i}"))
+        return segs
+
+    def test_hll_plans_on_device(self, hll_segs):
+        from pinot_tpu.engine.plan import plan_segment
+
+        plan = plan_segment(compile_query(
+            "SELECT distinctcounthll(user) FROM events"), hll_segs[0])
+        assert plan.spec[1][0][0] == "distinctcounthll"
+        plan = plan_segment(compile_query(
+            "SELECT grp, distinctcounthll(user) FROM events GROUP BY grp"),
+            hll_segs[0])
+        assert plan.spec[1][0][0] == "distinctcounthll"
+
+    def test_device_matches_host_exactly(self, hll_segs):
+        dev = ServerQueryExecutor(use_device=True)
+        host = ServerQueryExecutor(use_device=False)
+        for sql in (
+            "SELECT distinctcounthll(user) FROM events",
+            "SELECT distinctcounthll(user) FROM events WHERE lat > 20",
+            "SELECT grp, distinctcounthll(user) FROM events "
+            "GROUP BY grp ORDER BY grp",
+        ):
+            got, _ = dev.execute(compile_query(sql), hll_segs[:1])
+            want, _ = host.execute(compile_query(sql), hll_segs[:1])
+            assert got.rows == want.rows, sql  # same hashes -> exact
+
+    def test_sharded_hll_matches_host(self, hll_segs):
+        from pinot_tpu.parallel import ShardedQueryExecutor
+
+        dev = ShardedQueryExecutor()
+        host = ServerQueryExecutor(use_device=False)
+        for sql in (
+            "SELECT distinctcounthll(user) FROM events",
+            "SELECT grp, distinctcounthll(user), count(*) FROM events "
+            "GROUP BY grp ORDER BY grp",
+        ):
+            got, _ = dev.execute(compile_query(sql), hll_segs)
+            want, _ = host.execute(compile_query(sql), hll_segs)
+            assert got.rows == want.rows, sql

@@ -141,6 +141,16 @@ def eval_predicate(segment: ImmutableSegment, pred: Predicate) -> np.ndarray:
     ds = segment.data_source(pred.lhs.name)
     cm = ds.metadata
 
+    if pred.type is PredicateType.JSON_MATCH:
+        return _eval_json_match(ds, pred, n)
+
+    # RANGE over a range-indexed RAW column: binary search + slice instead
+    # of a full compare scan (ref: RangeIndexBasedFilterOperator)
+    if (pred.type is PredicateType.RANGE and not cm.has_dictionary
+            and cm.single_value
+            and getattr(ds, "range_order", None) is not None):
+        return _range_index_mask(ds, pred, n)
+
     # Exclusive predicates on MV columns: ALL values must satisfy
     # (ref: BaseDictionaryBasedPredicateEvaluator.applyMV isExclusive) —
     # evaluate the inclusive form and negate.
@@ -179,6 +189,55 @@ def eval_predicate(segment: ImmutableSegment, pred: Predicate) -> np.ndarray:
     # RAW column: compare values directly
     vals = np.asarray(ds.forward_index[:n])
     return _compare_values(vals, pred, cm.data_type)
+
+
+def _eval_json_match(ds: DataSource, pred: Predicate, n: int) -> np.ndarray:
+    """JSON_MATCH: posting lists when the column carries a JSON index,
+    else parse-per-distinct-value over the dictionary (or per doc on raw)
+    (ref: JsonMatchFilterOperator vs the index-less decay)."""
+    from pinot_tpu.segment.jsonindex import match_json_value, parse_match_filter
+
+    cm = ds.metadata
+    if not cm.single_value:
+        raise UnsupportedQueryError(
+            f"JSON_MATCH on multi-value column {ds.name!r}")
+    try:
+        reader = getattr(ds, "json_index", None)
+        if reader is not None:
+            return np.asarray(reader.match(str(pred.value))[:n])
+        ast = parse_match_filter(str(pred.value))
+    except ValueError as e:
+        raise QueryError(f"bad JSON_MATCH filter: {e}")
+    if cm.has_dictionary:
+        d = ds.dictionary
+        lut = np.fromiter(
+            (match_json_value(d.get_value(i), ast)
+             for i in range(cm.cardinality)), dtype=bool,
+            count=cm.cardinality)
+        return lut[np.asarray(ds.forward_index[:n])]
+    vals = ds.forward_index[:n]
+    return np.fromiter((match_json_value(v, ast) for v in vals),
+                       dtype=bool, count=n)
+
+
+def _range_index_mask(ds: DataSource, pred: Predicate, n: int) -> np.ndarray:
+    order = np.asarray(ds.range_order)
+    sorted_vals = ds.range_sorted_values  # gathered once, cached
+    dt = ds.metadata.data_type
+    lo_i = 0
+    hi_i = n
+    if pred.lower is not None:
+        v = dt.convert(pred.lower)
+        side = "left" if pred.lower_inclusive else "right"
+        lo_i = int(np.searchsorted(sorted_vals, v, side=side))
+    if pred.upper is not None:
+        v = dt.convert(pred.upper)
+        side = "right" if pred.upper_inclusive else "left"
+        hi_i = int(np.searchsorted(sorted_vals, v, side=side))
+    mask = np.zeros(n, dtype=bool)
+    if hi_i > lo_i:
+        mask[order[lo_i:hi_i]] = True
+    return mask
 
 
 def _any_per_row(flat_hits: np.ndarray, offsets: np.ndarray, n: int) -> np.ndarray:

@@ -349,7 +349,10 @@ def _compile_predicate(pred: Predicate, segment: ImmutableSegment,
             params.append(np.array([a, b], dtype=np.int32))
             return (mvp + "range", col)
         if t in (PredicateType.IN, PredicateType.NOT_IN,
-                 PredicateType.REGEXP_LIKE, PredicateType.TEXT_MATCH):
+                 PredicateType.REGEXP_LIKE, PredicateType.TEXT_MATCH,
+                 PredicateType.JSON_MATCH):
+            if t is PredicateType.JSON_MATCH and not cm.single_value:
+                raise PlanError("JSON_MATCH on MV column is unsupported")
             lut = _build_lut(ds, pred)
             params.append(lut)
             return (mvp + "lut", col, card)
@@ -458,6 +461,22 @@ def _build_lut(ds: DataSource, pred: Predicate) -> np.ndarray:
             raise QueryError(f"bad regex {pred.value!r}: {e}")
         for i in range(card):
             if rx.search(str(d.get_value(i))):
+                lut[i] = True
+        return lut
+    if t is PredicateType.JSON_MATCH:
+        # parse each DISTINCT value once; the doc mask is then a dictId
+        # gather on device (JSON_MATCH rides the TPU scan like IN/REGEXP)
+        from pinot_tpu.segment.jsonindex import (
+            match_json_value,
+            parse_match_filter,
+        )
+
+        try:
+            ast = parse_match_filter(str(pred.value))
+        except ValueError as e:
+            raise QueryError(f"bad JSON_MATCH filter: {e}")
+        for i in range(card):
+            if match_json_value(d.get_value(i), ast):
                 lut[i] = True
         return lut
     # TEXT_MATCH fallback: term containment over the dictionary

@@ -313,6 +313,15 @@ class SegmentBuilder:
             data = arr[:num_docs]
             uniq = np.unique(data)
             is_sorted = bool(np.all(data[:-1] <= data[1:])) if num_docs > 1 else True
+            has_range = False
+            if fs.name in self.indexing.range_index_columns and num_docs:
+                # sorted-order permutation: RANGE resolves by binary search
+                # + slice instead of a full compare scan (the host-path
+                # equivalent of BitSlicedRangeIndexReader; the device path
+                # keeps its dense compare — that IS the TPU-shaped plan)
+                save("rangeord", np.argsort(data, kind="stable")
+                     .astype(np.int32))
+                has_range = True
             return meta.ColumnMetadata(
                 name=fs.name, data_type=fs.data_type, field_type=fs.field_type,
                 single_value=True, encoding=meta.Encoding.RAW,
@@ -322,6 +331,7 @@ class SegmentBuilder:
                 max_value=data.max() if num_docs else None,
                 is_sorted=is_sorted, has_dictionary=False, has_nulls=has_nulls,
                 has_bloom_filter=self._maybe_build_bloom(fs.name, uniq, save),
+                has_range_index=has_range,
                 **self._partition_meta(fs.name, values),
             )
 
@@ -392,6 +402,8 @@ class SegmentBuilder:
 
         has_bloom = self._maybe_build_bloom(
             fs.name, lambda: dictionary.get_values(range(card)), save)
+        has_json = self._maybe_build_json_index(fs, values, num_docs, save,
+                                                col_dir)
 
         return meta.ColumnMetadata(
             name=fs.name, data_type=fs.data_type, field_type=fs.field_type,
@@ -401,10 +413,22 @@ class SegmentBuilder:
             max_value=dictionary.max_value if card else None,
             is_sorted=is_sorted, has_dictionary=True,
             has_inverted_index=want_inverted, has_nulls=has_nulls,
-            has_bloom_filter=has_bloom,
+            has_bloom_filter=has_bloom, has_json_index=has_json,
             max_num_multi_values=max_mv, total_number_of_entries=total_entries,
             **self._partition_meta(fs.name, values),
         )
+
+    def _maybe_build_json_index(self, fs: FieldSpec, values, num_docs: int,
+                                save, col_dir: str) -> bool:
+        """JSON flattening index when configured (ref: jsonIndexColumns ->
+        segment/creator/impl/inv/json/)."""
+        if (fs.name not in self.indexing.json_index_columns
+                or not fs.single_value or fs.data_type.is_numeric):
+            return False
+        from pinot_tpu.segment.jsonindex import build_json_index
+
+        build_json_index(list(values), num_docs, save, col_dir, fs.name)
+        return True
 
     def _maybe_build_bloom(self, name: str, distinct_values, save) -> bool:
         """Bloom filter over a column's distinct values when configured

@@ -81,6 +81,41 @@ class DataSource:
             self._segment._load_array(self.name, "bloom"))
 
     @cached_property
+    def json_index(self):
+        """JsonIndexReader, or None (ref: ImmutableJsonIndexReader)."""
+        if not self.metadata.has_json_index:
+            return None
+        from pinot_tpu.segment.jsonindex import JsonIndexReader
+
+        with open(self._segment._path(self.name, "jinv", ext="bin"),
+                  "rb") as f:
+            blob = f.read()
+        return JsonIndexReader(
+            self._segment._load_array(self.name, "jkeysoff"),
+            self._segment._load_array(self.name, "jkeysblob"),
+            self._segment._load_array(self.name, "jinvoff"),
+            self._segment._load_array(self.name, "jinvbo"),
+            blob, self._segment.num_docs)
+
+    @cached_property
+    def range_order(self):
+        """Sorted-order permutation for RANGE binary search, or None
+        (host-path equivalent of BitSlicedRangeIndexReader)."""
+        if not self.metadata.has_range_index:
+            return None
+        return self._segment._load_array(self.name, "rangeord")
+
+    @cached_property
+    def range_sorted_values(self):
+        """Values in sorted order, gathered ONCE per staged segment so a
+        RANGE lookup is O(log n) search + O(k) scatter per query."""
+        order = self.range_order
+        if order is None:
+            return None
+        n = self._segment.num_docs
+        return np.asarray(self.forward_index[:n])[np.asarray(order)]
+
+    @cached_property
     def inverted_index(self) -> Optional[Tuple[np.ndarray, np.ndarray]]:
         """(doc-count offsets[card+1], byte offsets[card+1]) of the varint
         posting lists, or None (ref: BitmapInvertedIndexReader.java:34)."""

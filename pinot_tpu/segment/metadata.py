@@ -72,6 +72,8 @@ class ColumnMetadata:
     has_inverted_index: bool = False
     has_nulls: bool = False
     has_bloom_filter: bool = False
+    has_json_index: bool = False
+    has_range_index: bool = False
     max_num_multi_values: int = 0   # MV only: max values per row
     total_number_of_entries: int = 0  # MV only: total flattened values
     partition_function: Optional[str] = None
@@ -94,6 +96,8 @@ class ColumnMetadata:
             "hasInvertedIndex": self.has_inverted_index,
             "hasNulls": self.has_nulls,
             "hasBloomFilter": self.has_bloom_filter,
+            "hasJsonIndex": self.has_json_index,
+            "hasRangeIndex": self.has_range_index,
             "maxNumMultiValues": self.max_num_multi_values,
             "totalNumberOfEntries": self.total_number_of_entries,
         }
@@ -121,6 +125,8 @@ class ColumnMetadata:
             has_inverted_index=d.get("hasInvertedIndex", False),
             has_nulls=d.get("hasNulls", False),
             has_bloom_filter=d.get("hasBloomFilter", False),
+            has_json_index=d.get("hasJsonIndex", False),
+            has_range_index=d.get("hasRangeIndex", False),
             max_num_multi_values=d.get("maxNumMultiValues", 0),
             total_number_of_entries=d.get("totalNumberOfEntries", 0),
             partition_function=d.get("partitionFunction"),

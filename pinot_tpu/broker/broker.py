@@ -46,6 +46,7 @@ TABLE_DOES_NOT_EXIST_ERROR = 190
 BROKER_REQUEST_SEND_ERROR = 425
 SERVER_NOT_RESPONDING_ERROR = 427
 QUERY_EXECUTION_ERROR = 200
+TOO_MANY_REQUESTS_ERROR = 429
 
 
 class BrokerRequestHandler:
@@ -63,9 +64,15 @@ class BrokerRequestHandler:
         self._servers: Dict[str, object] = {}
         from pinot_tpu.server.scheduler import _DaemonPool
 
+        from pinot_tpu.broker.quota import QueryQuotaManager
+
         self._pool = _DaemonPool(scatter_workers, "scatter")
         self.query_timeout_s = query_timeout_s
         self.metrics = MetricsRegistry(role="broker")
+        self.quota = QueryQuotaManager(
+            store,
+            num_brokers_fn=lambda: max(
+                len(store.instances("BROKER", only_alive=True)), 1))
 
     # -- transport registry --------------------------------------------------
     def register_server(self, instance_id: str, server) -> None:
@@ -110,6 +117,14 @@ class BrokerRequestHandler:
         except QueryError as e:
             response.add_exception(TABLE_DOES_NOT_EXIST_ERROR, str(e))
             return finish(response)
+
+        # per-table QPS quota (ref: queryquota acquire before routing)
+        for table in physical:
+            if not self.quota.acquire(table):
+                response.add_exception(
+                    TOO_MANY_REQUESTS_ERROR,
+                    f"query quota exceeded for table {table}")
+                return finish(response)
 
         tables: List[DataTable] = []
         servers_queried = set()

@@ -17,6 +17,7 @@ from pinot_tpu.ingestion.stream import (
     register_decoder,
     register_stream_type,
 )
+from pinot_tpu.ingestion import socketstream  # registers stream.type=socket
 from pinot_tpu.ingestion.transformers import (
     CompositeTransformer,
     ComplexTypeTransformer,

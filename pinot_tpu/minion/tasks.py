@@ -63,17 +63,20 @@ class BaseTaskExecutor:
     def _download(self, task: PinotTaskConfig,
                   ctx: MinionContext) -> List[ImmutableSegment]:
         """Resolve input segments via their deep-store download URLs
-        (file:// in this runtime; ref: downloadSegmentFromDeepStore)."""
+        through the PinotFS registry (ref: downloadSegmentFromDeepStore)."""
+        import os
+
+        from pinot_tpu.spi.filesystem import fetch_segment
+
         segs = []
         for name in task.input_segments:
             md = ctx.store.get_segment_metadata(task.table, name)
             if md is None or not md.download_url:
                 raise FileNotFoundError(
                     f"segment {name} of {task.table} has no download url")
-            path = md.download_url
-            if path.startswith("file://"):
-                path = path[len("file://"):]
-            segs.append(load_segment(path))
+            local = fetch_segment(md.download_url,
+                                  os.path.join(ctx.work_dir, "downloads"))
+            segs.append(load_segment(local))
         return segs
 
     def _schema_and_config(self, ctx: MinionContext, table: str):

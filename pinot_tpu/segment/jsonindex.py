@@ -124,6 +124,7 @@ _TOKEN = re.compile(r"""
 
 
 def _tokenize(s: str) -> List[Tuple[str, str]]:
+    s = s.strip()
     out, i = [], 0
     while i < len(s):
         m = _TOKEN.match(s, i)
@@ -197,19 +198,22 @@ def parse_match_filter(s: str):
             return ("missing", path)
         raise ValueError(f"expected an operator after {path!r}")
 
-    def expr():
+    def and_expr():
+        # AND binds tighter than OR (SQL precedence)
         node = term()
-        while True:
-            kind, _ = peek()
-            if kind in ("and", "or"):
-                take()
-                rhs = term()
-                if node[0] == kind:
-                    node = (kind, node[1] + [rhs])
-                else:
-                    node = (kind, [node, rhs])
-            else:
-                return node
+        children = [node]
+        while peek()[0] == "and":
+            take()
+            children.append(term())
+        return children[0] if len(children) == 1 else ("and", children)
+
+    def expr():
+        node = and_expr()
+        children = [node]
+        while peek()[0] == "or":
+            take()
+            children.append(and_expr())
+        return children[0] if len(children) == 1 else ("or", children)
 
     node = expr()
     if pos != len(toks):

@@ -15,6 +15,7 @@ ServerQueryExecutorV1Impl.processQuery:119).
 from __future__ import annotations
 
 import logging
+import os
 import threading
 import time
 
@@ -176,9 +177,19 @@ class ServerInstance:
             log.warning("[%s] no download url for %s/%s",
                         self.instance_id, table, seg)
             return
-        local = md.download_url
-        if local.startswith("file://"):
-            local = local[len("file://"):]
+        # deep-store resolution through the PinotFS registry (ref:
+        # downloadSegmentFromDeepStore, BaseTableDataManager.java:388) —
+        # local URIs serve in place, remote schemes materialize under the
+        # server's segment dir
+        from pinot_tpu.spi.filesystem import fetch_segment
+
+        try:
+            local = fetch_segment(md.download_url,
+                                  os.path.join(self.segment_dir, table))
+        except Exception:
+            log.exception("[%s] deep-store fetch failed for %s/%s (%s)",
+                          self.instance_id, table, seg, md.download_url)
+            return
         if isinstance(tdm, RealtimeTableDataManager):
             # upsert tables must register downloaded keys (on_sealed handles
             # both the upsert and plain realtime cases)
@@ -229,9 +240,13 @@ class ServerInstance:
             elif mgr.state is ConsumerState.DISCARDED:
                 zk = self.store.get_segment_metadata(table, seg)
                 if zk and zk.download_url:
-                    local = zk.download_url
-                    if local.startswith("file://"):
-                        local = local[len("file://"):]
+                    # same PinotFS resolution as _ensure_online (http(s)
+                    # deep stores must materialize locally here too)
+                    from pinot_tpu.spi.filesystem import fetch_segment
+
+                    local = fetch_segment(
+                        zk.download_url,
+                        os.path.join(self.segment_dir, table))
                     tdm.on_sealed(seg, local)
                 else:
                     # winner's metadata not visible yet: drop the consumer

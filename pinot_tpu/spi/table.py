@@ -320,6 +320,29 @@ class IngestionConfig:
 
 
 @dataclass
+class QuotaConfig:
+    """Ref: pinot-spi/.../config/table/QuotaConfig.java."""
+
+    max_queries_per_second: Optional[float] = None
+    storage: Optional[str] = None  # e.g. "100G" (recorded, not enforced)
+
+    def to_dict(self) -> Dict[str, Any]:
+        d: Dict[str, Any] = {}
+        if self.max_queries_per_second is not None:
+            d["maxQueriesPerSecond"] = str(self.max_queries_per_second)
+        if self.storage:
+            d["storage"] = self.storage
+        return d
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "QuotaConfig":
+        qps = d.get("maxQueriesPerSecond")
+        return cls(
+            max_queries_per_second=float(qps) if qps is not None else None,
+            storage=d.get("storage"))
+
+
+@dataclass
 class RoutingConfig:
     """Ref: pinot-spi/.../config/table/RoutingConfig.java — the broker's
     instance-selector + pruner choices."""
@@ -349,6 +372,7 @@ class TableConfig:
     indexing_config: IndexingConfig = field(default_factory=IndexingConfig)
     tenant_config: TenantConfig = field(default_factory=TenantConfig)
     routing_config: RoutingConfig = field(default_factory=RoutingConfig)
+    quota_config: QuotaConfig = field(default_factory=QuotaConfig)
     upsert_config: Optional[UpsertConfig] = None
     stream_config: Optional[StreamIngestionConfig] = None
     ingestion_config: Optional[IngestionConfig] = None
@@ -382,6 +406,8 @@ class TableConfig:
         if (self.routing_config.instance_selector_type != "balanced"
                 or self.routing_config.segment_pruner_types):
             d["routing"] = self.routing_config.to_dict()
+        if self.quota_config.to_dict():
+            d["quota"] = self.quota_config.to_dict()
         if self.upsert_config:
             d["upsertConfig"] = self.upsert_config.to_dict()
         if self.stream_config:
@@ -416,6 +442,7 @@ class TableConfig:
             indexing_config=IndexingConfig.from_dict(d.get("tableIndexConfig", {})),
             tenant_config=TenantConfig.from_dict(d.get("tenants", {})),
             routing_config=RoutingConfig.from_dict(d.get("routing", {})),
+            quota_config=QuotaConfig.from_dict(d.get("quota", {})),
             upsert_config=UpsertConfig.from_dict(uc) if uc else None,
             stream_config=stream_config,
             ingestion_config=(IngestionConfig.from_dict(d["ingestionConfig"])

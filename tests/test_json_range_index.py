@@ -202,3 +202,15 @@ class TestReviewRegressions:
             host.execute(compile_query(
                 "SELECT count(*) FROM js WHERE "
                 "json_match(payload, '\"$.a\" >')"), [segment])
+
+
+def test_and_binds_tighter_than_or():
+    """SQL precedence in JSON_MATCH filters (regression: flat left-assoc)."""
+    ast = parse_match_filter("\"$.a\"=1 OR \"$.b\"=2 AND \"$.c\"=3")
+    assert ast == ("or", [("eq", "a", "1"),
+                          ("and", [("eq", "b", "2"), ("eq", "c", "3")])])
+    assert match_json_value('{"a": 1}', ast)          # a=1 alone satisfies
+    assert not match_json_value('{"b": 2}', ast)      # b=2 needs c=3
+    assert match_json_value('{"b": 2, "c": 3}', ast)
+    # trailing whitespace tolerated
+    assert parse_match_filter("\"$.a\"=1 ") == ("eq", "a", "1")

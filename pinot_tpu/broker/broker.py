@@ -143,8 +143,12 @@ class BrokerRequestHandler:
                     f"{unavailable[:5]}")
             if not routing:
                 continue
-            gathered, queried, responded = self._scatter_gather(
-                table, sub_ctx, routing)
+            if self._use_streaming(sub_ctx, routing):
+                gathered, queried, responded = \
+                    self._scatter_gather_streaming(table, sub_ctx, routing)
+            else:
+                gathered, queried, responded = self._scatter_gather(
+                    table, sub_ctx, routing)
             phase(BrokerQueryPhase.SCATTER_GATHER, t)
             tables.extend(gathered)
             servers_queried |= queried
@@ -213,6 +217,73 @@ class BrokerRequestHandler:
             (offline, replace(ctx, filter=_and(ctx.filter, off_pred))),
             (realtime, replace(ctx, filter=_and(ctx.filter, rt_pred))),
         ]
+
+    # -- streaming scatter/gather (ref: GrpcBrokerRequestHandler +
+    # StreamingReduceService): selection-only queries pull per-segment
+    # blocks from ALL servers concurrently and stop the moment
+    # offset+limit rows arrived — the wire analogue of
+    # SelectionOnlyCombineOperator's early exit.
+    def _scatter_gather_streaming(self, table: str, ctx: QueryContext,
+                                  routing: Dict[str, List[str]]):
+        import threading
+
+        need = ctx.offset + ctx.limit
+        queried, responded = set(), set()
+        enough = threading.Event()
+        lock = threading.Lock()
+        have = [0]
+
+        def pull(server, segments) -> List[DataTable]:
+            out: List[DataTable] = []
+            for block in server.execute_query_streaming(ctx, table,
+                                                        segments):
+                out.append(block)
+                if not block.exceptions:
+                    with lock:
+                        have[0] += len(block.payload.get("rows", []))
+                        if have[0] >= need:
+                            enough.set()
+                if enough.is_set():
+                    break
+            return out
+
+        futures = {}
+        for instance_id, segments in routing.items():
+            queried.add(instance_id)
+            server = self._servers.get(instance_id)
+            if server is None:
+                futures[instance_id] = None
+                continue
+            futures[instance_id] = self._pool.submit(
+                lambda srv=server, segs=segments: pull(srv, segs))
+
+        gathered: List[DataTable] = []
+        deadline = time.monotonic() + self.query_timeout_s
+        for instance_id, fut in futures.items():
+            if fut is None:
+                gathered.append(DataTable.for_exception(
+                    f"server {instance_id} is not connected"))
+                continue
+            try:
+                remaining = max(deadline - time.monotonic(), 0.001)
+                gathered.extend(fut.result(timeout=remaining))
+                responded.add(instance_id)
+            except FutureTimeout:
+                enough.set()  # stop the straggler's pull loop
+                gathered.append(DataTable.for_exception(
+                    f"server {instance_id} timed out after "
+                    f"{self.query_timeout_s}s"))
+            except Exception as e:  # noqa: BLE001
+                gathered.append(DataTable.for_exception(
+                    f"server {instance_id} failed: {e!r}"))
+        return gathered, queried, responded
+
+    def _use_streaming(self, ctx: QueryContext,
+                       routing: Dict[str, List[str]]) -> bool:
+        return (ctx.is_selection and not ctx.order_by
+                and not ctx.distinct
+                and all(hasattr(self._servers.get(i), "execute_query_streaming")
+                        for i in routing))
 
     # -- scatter/gather (ref: QueryRouter.submitQuery:85) --------------------
     def _scatter_gather(self, table: str, ctx: QueryContext,

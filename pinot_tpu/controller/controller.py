@@ -320,10 +320,35 @@ class Controller:
         (ref: PinotTaskManager cron-able generation)."""
         return self.task_manager.generate_tasks()
 
+    def run_liveness_check(self, timeout_ms: int = 10_000,
+                           now_ms: Optional[int] = None) -> List[str]:
+        """Automatic failure detection (the Helix ephemeral-znode liveness
+        analogue): instances whose heartbeat went stale are marked dead so
+        routing excludes them; a fresh heartbeat revives them
+        (store.touch_instance). Instances that never heartbeat (embedded
+        tests drive liveness manually) are left alone. Returns the newly
+        dead instance ids."""
+        import time as _time
+
+        now_ms = now_ms if now_ms is not None else int(_time.time() * 1000)
+        newly_dead = []
+        for info in self.store.instances():
+            if not info.heartbeat_ms:
+                continue  # never heartbeated: liveness managed manually
+            stale = now_ms - info.heartbeat_ms > timeout_ms
+            if stale and info.alive:
+                log.warning("instance %s heartbeat stale (%dms) — marking "
+                            "dead", info.instance_id,
+                            now_ms - info.heartbeat_ms)
+                self.store.set_instance_alive(info.instance_id, False)
+                newly_dead.append(info.instance_id)
+        return newly_dead
+
     def start_periodic_tasks(self, interval_s: float = 5.0) -> None:
         def loop():
             while not self._periodic_stop.wait(interval_s):
                 try:
+                    self.run_liveness_check()
                     self.run_retention_manager()
                     self.run_realtime_validation()
                     self.run_task_generation()

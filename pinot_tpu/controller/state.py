@@ -106,17 +106,20 @@ class InstanceInfo:
     port: int = 0
     tags: List[str] = field(default_factory=lambda: ["DefaultTenant"])
     alive: bool = True
+    # last heartbeat (ms since epoch); the ephemeral-znode liveness analogue
+    heartbeat_ms: int = 0
 
     def to_dict(self) -> Dict[str, Any]:
         return {"instanceId": self.instance_id,
                 "type": self.instance_type, "host": self.host,
-                "port": self.port, "tags": self.tags, "alive": self.alive}
+                "port": self.port, "tags": self.tags, "alive": self.alive,
+                "heartbeatMs": self.heartbeat_ms}
 
     @classmethod
     def from_dict(cls, d: Dict[str, Any]) -> "InstanceInfo":
         return cls(d["instanceId"], d["type"], d.get("host", "localhost"),
                    d.get("port", 0), d.get("tags", ["DefaultTenant"]),
-                   d.get("alive", True))
+                   d.get("alive", True), d.get("heartbeatMs", 0))
 
 
 Watcher = Callable[[str, Any], None]
@@ -358,6 +361,22 @@ class ClusterStateStore:
         def apply(d):
             if d:
                 d["alive"] = alive
+            return d
+
+        self.update(f"instances/{instance_id}", apply)
+
+    def touch_instance(self, instance_id: str,
+                       now_ms: Optional[int] = None) -> None:
+        """Heartbeat (the ephemeral-znode keepalive analogue): refreshes
+        heartbeatMs and revives a dead-marked instance."""
+        import time as _time
+
+        now_ms = now_ms if now_ms is not None else int(_time.time() * 1000)
+
+        def apply(d):
+            if d:
+                d["heartbeatMs"] = now_ms
+                d["alive"] = True
             return d
 
         self.update(f"instances/{instance_id}", apply)

@@ -356,15 +356,21 @@ class ServerQueryExecutor:
 
     def _validate_columns(self, ctx: QueryContext,
                           seg: ImmutableSegment) -> None:
-        known = set(seg.metadata.columns.keys())
+        from pinot_tpu.engine.host_eval import VIRTUAL_COLUMNS
+
+        known = set(seg.metadata.columns.keys()) | set(VIRTUAL_COLUMNS)
         for c in ctx.referenced_columns():
             if c not in known:
                 raise QueryError(f"unknown column {c!r} in table "
                                  f"{ctx.table_name!r}")
 
     def _schema_types(self, seg: ImmutableSegment) -> Dict[str, str]:
-        return {name: cm.data_type.label
-                for name, cm in seg.metadata.columns.items()}
+        from pinot_tpu.engine.host_eval import VIRTUAL_COLUMNS
+
+        out = {name: cm.data_type.label
+               for name, cm in seg.metadata.columns.items()}
+        out.update(VIRTUAL_COLUMNS)
+        return out
 
 
 # --------------------------------------------------------------------------

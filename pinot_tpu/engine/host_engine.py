@@ -44,6 +44,10 @@ def _expand_select(ctx: QueryContext, schema: Schema) -> List[Expr]:
 
 
 def _column_type(segment: ImmutableSegment, e: Expr) -> str:
+    if isinstance(e, Identifier) and e.name.startswith("$"):
+        from pinot_tpu.engine.host_eval import VIRTUAL_COLUMNS
+
+        return VIRTUAL_COLUMNS.get(e.name, "STRING")
     if isinstance(e, Identifier) and e.name in segment.metadata.columns:
         cm = segment.metadata.column(e.name)
         label = cm.data_type.label

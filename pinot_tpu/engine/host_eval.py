@@ -138,6 +138,11 @@ def eval_predicate(segment: ImmutableSegment, pred: Predicate) -> np.ndarray:
         # expression predicate: evaluate values then compare
         return _eval_expr_predicate(segment, pred)
 
+    if pred.lhs.name.startswith("$"):
+        vals = _virtual_column_values(segment, pred.lhs.name, n)
+        dt = (DataType.LONG if vals.dtype.kind == "i" else DataType.STRING)
+        return _compare_values(vals, pred, dt)
+
     ds = segment.data_source(pred.lhs.name)
     cm = ds.metadata
 
@@ -277,6 +282,25 @@ def _compare_values(vals: np.ndarray, pred: Predicate, dt: DataType) -> np.ndarr
     raise UnsupportedQueryError(f"predicate {t} not supported on raw column")
 
 
+def _virtual_column_values(segment: ImmutableSegment, name: str,
+                           n: int) -> np.ndarray:
+    """Auto-columns every segment serves (ref: segment/virtualcolumn/* —
+    DocIdVirtualColumnProvider etc.)."""
+    if name == "$docId":
+        return np.arange(n, dtype=np.int64)
+    if name == "$segmentName":
+        return np.full(n, segment.segment_name, dtype=object)
+    if name == "$hostName":
+        import socket
+
+        return np.full(n, socket.gethostname(), dtype=object)
+    raise UnsupportedQueryError(f"unknown virtual column {name!r}")
+
+
+VIRTUAL_COLUMNS = {"$docId": "LONG", "$segmentName": "STRING",
+                   "$hostName": "STRING"}
+
+
 def _eval_expr_predicate(segment: ImmutableSegment, pred: Predicate) -> np.ndarray:
     vals = eval_expr_values(segment, pred.lhs)
     dt = (DataType.DOUBLE if np.issubdtype(np.asarray(vals).dtype, np.floating)
@@ -328,6 +352,9 @@ def eval_expr_values(segment: ImmutableSegment, expr: Expr,
         return np.full(n if doc_ids is None else len(doc_ids), expr.value)
 
     if isinstance(expr, Identifier):
+        if expr.name.startswith("$"):
+            vals = _virtual_column_values(segment, expr.name, n)
+            return vals if doc_ids is None else vals[doc_ids]
         ds = segment.data_source(expr.name)
         cm = ds.metadata
         if not cm.single_value:
@@ -365,6 +392,10 @@ def _to_float(a: np.ndarray) -> np.ndarray:
 def read_values(segment: ImmutableSegment, column: str,
                 doc_ids: np.ndarray) -> List[Any]:
     """Gather output values for selection results (host path)."""
+    if column.startswith("$"):
+        vals = _virtual_column_values(segment, column, segment.num_docs)
+        return [v.item() if hasattr(v, "item") else v
+                for v in vals[doc_ids]]
     ds = segment.data_source(column)
     cm = ds.metadata
     if cm.single_value:

@@ -132,7 +132,7 @@ def plan_segment(ctx: QueryContext, segment: ImmutableSegment) -> SegmentPlan:
             # (ref: DistinctCountHLLAggregationFunction; utils/hll.py)
             from pinot_tpu.utils.hll import DEFAULT_LOG2M
 
-            if not isinstance(vexpr, Identifier):
+            if not isinstance(vexpr, Identifier) or vexpr.name.startswith("$"):
                 raise PlanError("DISTINCTCOUNTHLL argument must be a column")
             cm = segment.metadata.column(vexpr.name)
             if not (cm.has_dictionary and cm.single_value):
@@ -152,7 +152,7 @@ def plan_segment(ctx: QueryContext, segment: ImmutableSegment) -> SegmentPlan:
             # checked before value compilation: the presence-bitmap kernel
             # reads dictIds directly, so non-numeric (string) columns are
             # fine here even though they have no device value expression
-            if not isinstance(vexpr, Identifier):
+            if not isinstance(vexpr, Identifier) or vexpr.name.startswith("$"):
                 raise PlanError("DISTINCTCOUNT argument must be a column")
             cm = segment.metadata.column(vexpr.name)
             if not cm.has_dictionary:
@@ -167,7 +167,7 @@ def plan_segment(ctx: QueryContext, segment: ImmutableSegment) -> SegmentPlan:
         if vexpr is None:
             vspec = None
         elif agg.mv:
-            if not isinstance(vexpr, Identifier):
+            if not isinstance(vexpr, Identifier) or vexpr.name.startswith("$"):
                 raise PlanError("MV aggregation argument must be a column")
             cm = segment.metadata.column(vexpr.name)
             if cm.single_value or not cm.data_type.is_numeric:
@@ -243,6 +243,8 @@ def _acc_dtype(base: str, vexpr: Optional[Expr], segment: ImmutableSegment,
 def _group_strategy(e: Expr, segment: ImmutableSegment) -> Tuple[str, str, int]:
     if not isinstance(e, Identifier):
         raise PlanError(f"group-by expression {e} -> host path")
+    if e.name.startswith("$"):
+        raise PlanError("group-by on virtual column -> host path")
     cm = segment.metadata.column(e.name)
     if not cm.single_value:
         raise PlanError("group-by on MV column -> host path")
@@ -317,6 +319,8 @@ def _compile_predicate(pred: Predicate, segment: ImmutableSegment,
         raise PlanError(f"expression predicate {pred.lhs} -> host path")
 
     col = pred.lhs.name
+    if col.startswith("$"):
+        raise PlanError("virtual column predicate -> host path")
     ds = segment.data_source(col)
     cm = ds.metadata
     if col not in columns:
@@ -499,6 +503,8 @@ def _compile_value(e: Expr, segment: ImmutableSegment,
         params.append(np.float64(e.value))
         return ("lit",)
     if isinstance(e, Identifier):
+        if e.name.startswith("$"):
+            raise PlanError("virtual column in value expression -> host")
         cm = segment.metadata.column(e.name)
         if not cm.single_value:
             raise PlanError(f"MV column {e.name} in value expression")

@@ -75,7 +75,7 @@ class ServerInstance:
         self._upsert_managers: Dict[str, object] = {}
 
     # -- lifecycle (ref: BaseServerStarter.start) ---------------------------
-    def start(self) -> None:
+    def start(self, heartbeat_interval_s: float = 0.0) -> None:
         self.store.register_instance(
             InstanceInfo(self.instance_id, "SERVER", port=0))
         # replay current assignments, then watch for changes (the Helix
@@ -86,10 +86,34 @@ class ServerInstance:
             self._reconcile_table(table)
         self._started = True
         self._queries_enabled = True
+        if heartbeat_interval_s > 0:
+            # the ephemeral-znode keepalive: the controller's liveness
+            # check marks us dead when these stop
+            self._hb_stop = threading.Event()
+
+            def beat():
+                while not self._hb_stop.wait(heartbeat_interval_s):
+                    try:
+                        self.store.touch_instance(self.instance_id)
+                    except Exception:
+                        log.exception("[%s] heartbeat failed",
+                                      self.instance_id)
+
+            self.store.touch_instance(self.instance_id)
+            self._hb_thread = threading.Thread(
+                target=beat, daemon=True,
+                name=f"heartbeat-{self.instance_id}")
+            self._hb_thread.start()
 
     def shutdown(self) -> None:
         """Ref: shutdown = disable queries, drain, unregister."""
         self._queries_enabled = False
+        hb = getattr(self, "_hb_stop", None)
+        if hb is not None:
+            hb.set()
+            # join BEFORE marking dead: an in-flight touch_instance would
+            # resurrect the instance (touch sets alive=True)
+            self._hb_thread.join(timeout=5)
         self.scheduler.shutdown()
         self.data_manager.shutdown()
         self.store.set_instance_alive(self.instance_id, False)
@@ -316,6 +340,47 @@ class ServerInstance:
             log.debug("[%s] query failed", self.instance_id, exc_info=True)
             self.metrics.meter(ServerMeter.QUERY_EXCEPTIONS).mark()
             return DataTable.for_exception(str(e))
+        finally:
+            tdm.release_segments(acquired)
+
+    def execute_query_streaming(self, ctx: QueryContext, table: str,
+                                segment_names: Optional[List[str]] = None):
+        """Selection queries stream one DataTable block PER SEGMENT (ref:
+        StreamingSelectionOnlyOperator feeding GrpcQueryServer.submit) so
+        the broker can stop pulling once LIMIT rows arrived. Generator of
+        DataTables; non-selection shapes yield the single combined block."""
+        if not self._queries_enabled:
+            yield DataTable.for_exception(
+                f"server {self.instance_id} is shut down")
+            return
+        if not ctx.is_selection:
+            yield self.execute_query(ctx, table, segment_names)
+            return
+        tdm = self.data_manager.get(table)
+        if tdm is None:
+            yield DataTable.for_exception(
+                f"table {table} not hosted on {self.instance_id}")
+            return
+        acquired = tdm.acquire_segments(segment_names)
+        try:
+            if not acquired:
+                yield DataTable.for_exception(
+                    f"no segments of {table} on {self.instance_id}")
+                return
+            # prune ONCE across the acquired set: the per-segment
+            # execute_instance would otherwise keep-one-fallback every
+            # prunable segment into a scan
+            from pinot_tpu.engine.pruner import prune_segments
+
+            kept = prune_segments(
+                ctx, [h.segment for h in acquired]) or \
+                [acquired[0].segment]
+            for segment in kept:
+                yield self.executor.execute_instance(ctx, [segment])
+        except Exception as e:  # noqa: BLE001 — errors travel in-band
+            log.debug("[%s] streaming query failed", self.instance_id,
+                      exc_info=True)
+            yield DataTable.for_exception(str(e))
         finally:
             tdm.release_segments(acquired)
 

@@ -33,6 +33,7 @@ log = logging.getLogger(__name__)
 
 _SERVICE = "pinot_tpu.QueryServer"
 _METHOD_EXECUTE = f"/{_SERVICE}/Execute"
+_METHOD_EXECUTE_STREAMING = f"/{_SERVICE}/ExecuteStreaming"
 
 
 def _encode_request(ctx: QueryContext, table: str,
@@ -52,7 +53,11 @@ def _decode_request(raw: bytes):
 
 class GrpcQueryServer:
     """Network front of one ServerInstance
-    (ref: GrpcQueryServer.java:45 submit:84)."""
+    (ref: GrpcQueryServer.java:45 submit:84). ``Execute`` is the unary
+    whole-result method; ``ExecuteStreaming`` streams per-segment blocks
+    for selection queries (ref: the streaming operators under
+    ``operator/streaming/*`` feeding GrpcQueryServer) so the broker can
+    short-circuit LIMIT without waiting for every segment."""
 
     def __init__(self, server_instance, port: int = 0, max_workers: int = 8):
         self._instance = server_instance
@@ -61,6 +66,10 @@ class GrpcQueryServer:
         handler = grpc.method_handlers_generic_handler(_SERVICE, {
             "Execute": grpc.unary_unary_rpc_method_handler(
                 self._execute,
+                request_deserializer=None,
+                response_serializer=None),
+            "ExecuteStreaming": grpc.unary_stream_rpc_method_handler(
+                self._execute_streaming,
                 request_deserializer=None,
                 response_serializer=None),
         })
@@ -75,6 +84,25 @@ class GrpcQueryServer:
             log.debug("grpc execute failed", exc_info=True)
             table_result = DataTable.for_exception(repr(e))
         return table_result.to_bytes()
+
+    def _execute_streaming(self, request: bytes, context):
+        """Yield one DataTable per block: selection queries stream a block
+        PER SEGMENT (each block carries its own stats — unlike the
+        reference's trailing-metadata framing, StreamingResponseUtils);
+        other query shapes degrade to a single block (their combine is a
+        reduction — there is nothing incremental to ship)."""
+        try:
+            ctx, table, segments = _decode_request(request)
+            if not ctx.is_selection:
+                yield self._instance.execute_query(
+                    ctx, table, segments).to_bytes()
+                return
+            for block in self._instance.execute_query_streaming(
+                    ctx, table, segments):
+                yield block.to_bytes()
+        except Exception as e:  # noqa: BLE001 — errors travel in-band
+            log.debug("grpc streaming execute failed", exc_info=True)
+            yield DataTable.for_exception(repr(e)).to_bytes()
 
     def start(self) -> None:
         self._grpc.start()
@@ -95,6 +123,9 @@ class GrpcServerStub:
         self._call = self._channel.unary_unary(
             _METHOD_EXECUTE, request_serializer=None,
             response_deserializer=None)
+        self._call_streaming = self._channel.unary_stream(
+            _METHOD_EXECUTE_STREAMING, request_serializer=None,
+            response_deserializer=None)
         self.timeout_s = timeout_s
 
     def execute_query(self, ctx: QueryContext, table: str,
@@ -105,6 +136,19 @@ class GrpcServerStub:
             return DataTable.from_bytes(raw)
         except grpc.RpcError as e:
             return DataTable.for_exception(
+                f"rpc to {self.address} failed: {e.code().name}")
+
+    def execute_query_streaming(self, ctx: QueryContext, table: str,
+                                segments: Optional[List[str]] = None):
+        """Yield DataTable blocks as the server produces them
+        (ref: GrpcQueryClient.submit returning a response iterator)."""
+        try:
+            for raw in self._call_streaming(
+                    _encode_request(ctx, table, segments),
+                    timeout=self.timeout_s):
+                yield DataTable.from_bytes(raw)
+        except grpc.RpcError as e:
+            yield DataTable.for_exception(
                 f"rpc to {self.address} failed: {e.code().name}")
 
     def close(self) -> None:

@@ -33,6 +33,8 @@ ERROR = "ERROR"
 MERGE_ROLLUP_TASK = "MergeRollupTask"
 REALTIME_TO_OFFLINE_TASK = "RealtimeToOfflineSegmentsTask"
 PURGE_TASK = "PurgeTask"
+CONVERT_TO_RAW_TASK = "ConvertToRawIndexTask"
+SEGMENT_GENERATION_AND_PUSH_TASK = "SegmentGenerationAndPushTask"
 
 # stop regenerating a unit of work after this many ERROR attempts; pruning
 # terminal records after the TTL both bounds state-store growth and acts as
@@ -356,8 +358,76 @@ def _generate_purge(mgr: PinotTaskManager, table: str, cfg,
         return
 
 
+def _generate_convert_to_raw(mgr: PinotTaskManager, table: str, cfg,
+                             tconf: Dict[str, str], now_ms: int):
+    """One conversion per not-yet-converted ONLINE segment (ref:
+    ConvertToRawIndexTaskGenerator — skips segments whose custom map
+    records the conversion). Poisoned segments (MAX_TASK_ATTEMPTS errors)
+    are skipped so one bad segment cannot block the rest forever."""
+    for md in mgr.store.segment_metadata_list(table):
+        if md.status != ONLINE:
+            continue
+        if md.custom.get("convertToRawDone"):
+            continue
+        if mgr.error_attempts(table, CONVERT_TO_RAW_TASK,
+                              input_segments=[md.segment_name]) \
+                >= MAX_TASK_ATTEMPTS:
+            continue
+        yield PinotTaskConfig(
+            task_id=_new_id(CONVERT_TO_RAW_TASK),
+            task_type=CONVERT_TO_RAW_TASK, table=table,
+            configs=dict(tconf), input_segments=[md.segment_name])
+        return  # one at a time, like the purge generator
+
+
+def ingested_files_path(table: str) -> str:
+    return f"minionTaskMetadata/{table}/{SEGMENT_GENERATION_AND_PUSH_TASK}.files"
+
+
+def _generate_segment_generation_and_push(mgr: PinotTaskManager, table: str,
+                                          cfg, tconf: Dict[str, str],
+                                          now_ms: int):
+    """Batch-ingest landing files not yet successfully processed (ref:
+    SegmentGenerationAndPushTaskGenerator scanning inputDirURI). The
+    processed set {filename: mtime} is recorded by the EXECUTOR on
+    success — never at generation time, so task ERRORs retry (up to
+    MAX_TASK_ATTEMPTS per file set) instead of silently dropping files;
+    the (name, mtime) key also survives same-millisecond arrivals.
+    Landing files are treated as immutable once written (the reference's
+    batch-input convention); a rewritten file re-ingests whole."""
+    import json as _json
+    import os
+
+    input_dir = tconf.get("inputDirURI", "")
+    if not input_dir or not os.path.isdir(input_dir):
+        return
+    processed = mgr.store.get(ingested_files_path(table)) or {}
+    fresh = []
+    for entry in sorted(os.listdir(input_dir)):
+        path = os.path.join(input_dir, entry)
+        if not os.path.isfile(path):
+            continue
+        mtime = int(os.path.getmtime(path) * 1000)
+        if processed.get(entry) != mtime:
+            fresh.append(path)
+    if not fresh:
+        return
+    key = ",".join(sorted(os.path.basename(f) for f in fresh))
+    if mgr.error_attempts(table, SEGMENT_GENERATION_AND_PUSH_TASK,
+                          configs_match={"fileSetKey": key}) \
+            >= MAX_TASK_ATTEMPTS:
+        return  # poisoned file set: stop regenerating every cycle
+    yield PinotTaskConfig(
+        task_id=_new_id(SEGMENT_GENERATION_AND_PUSH_TASK),
+        task_type=SEGMENT_GENERATION_AND_PUSH_TASK, table=table,
+        configs=dict(tconf, inputFiles=_json.dumps(fresh),
+                     fileSetKey=key))
+
+
 _GENERATORS = {
     MERGE_ROLLUP_TASK: _generate_merge_rollup,
     REALTIME_TO_OFFLINE_TASK: _generate_realtime_to_offline,
     PURGE_TASK: _generate_purge,
+    CONVERT_TO_RAW_TASK: _generate_convert_to_raw,
+    SEGMENT_GENERATION_AND_PUSH_TASK: _generate_segment_generation_and_push,
 }

@@ -18,9 +18,11 @@ from dataclasses import dataclass
 from typing import Callable, Dict, List, Optional
 
 from pinot_tpu.controller.tasks import (
+    CONVERT_TO_RAW_TASK,
     MERGE_ROLLUP_TASK,
     PURGE_TASK,
     REALTIME_TO_OFFLINE_TASK,
+    SEGMENT_GENERATION_AND_PUSH_TASK,
     PinotTaskConfig,
 )
 from pinot_tpu.segment.immutable import ImmutableSegment, load_segment
@@ -205,8 +207,101 @@ class PurgeTaskExecutor(BaseTaskExecutor):
         return names
 
 
+class ConvertToRawIndexTaskExecutor(BaseTaskExecutor):
+    """Rebuild a segment with the configured columns stored RAW
+    (no-dictionary) and refresh-push it under the SAME name
+    (ref: ConvertToRawIndexTaskExecutor.java — a segment conversion, not
+    a merge; the custom map records completion so the generator stops)."""
+
+    task_type = CONVERT_TO_RAW_TASK
+
+    def execute(self, task: PinotTaskConfig, ctx: MinionContext) -> List[str]:
+        from dataclasses import replace as dc_replace
+
+        from pinot_tpu.segment.creator import SegmentBuilder
+        from pinot_tpu.segment.processing import read_columnar
+
+        schema, cfg = self._schema_and_config(ctx, task.table)
+        cols_to_convert = [c.strip() for c in
+                           task.configs.get("columnsToConvert", "").split(",")
+                           if c.strip()]
+        (in_name,) = task.input_segments
+        (segment,) = self._download(task, ctx)
+
+        columns = read_columnar(segment)
+        indexing = dc_replace(
+            cfg.indexing_config,
+            no_dictionary_columns=sorted(
+                set(cfg.indexing_config.no_dictionary_columns)
+                | set(cols_to_convert)))
+        out_dir = os.path.join(ctx.work_dir, task.task_id)
+        builder = SegmentBuilder(schema, in_name, table_name=cfg.table_name,
+                                 indexing_config=indexing)
+        builder.build(columns, out_dir)
+        names = self._upload(ctx, task.table, [os.path.join(out_dir,
+                                                            in_name)])
+        # record completion in the segment's custom map (generator gate)
+        md = ctx.store.get_segment_metadata(task.table, in_name)
+        if md is not None:
+            md.custom["convertToRawDone"] = ",".join(cols_to_convert) or "*"
+            ctx.store.set_segment_metadata(md)
+        return names
+
+
+class SegmentGenerationAndPushTaskExecutor(BaseTaskExecutor):
+    """Run a batch segment-generation job inside the minion and push the
+    results (ref: SegmentGenerationAndPushTaskExecutor.java driving the
+    standalone job runner)."""
+
+    task_type = SEGMENT_GENERATION_AND_PUSH_TASK
+
+    def execute(self, task: PinotTaskConfig, ctx: MinionContext) -> List[str]:
+        import json as _json
+
+        from pinot_tpu.controller.tasks import ingested_files_path
+        from pinot_tpu.ingestion.batchjob import (
+            SegmentGenerationJobRunner,
+            SegmentGenerationJobSpec,
+        )
+
+        schema, cfg = self._schema_and_config(ctx, task.table)
+        files = _json.loads(task.configs.get("inputFiles", "[]"))
+        if not files:
+            raise ValueError("SegmentGenerationAndPushTask without "
+                             "inputFiles")
+        out_dir = os.path.join(ctx.work_dir, task.task_id)
+        os.makedirs(out_dir, exist_ok=True)
+        names: List[str] = []
+        for seq, path in enumerate(files):
+            spec = SegmentGenerationJobSpec(
+                output_dir_uri=out_dir,
+                table_name=cfg.table_name,
+                data_format=task.configs.get("inputFormat") or None,
+                segment_name_prefix=f"{cfg.table_name}_{task.task_id}_{seq}")
+            runner = SegmentGenerationJobRunner(spec, schema=schema,
+                                                table_config=cfg)
+            # explicit file (no glob round-trip: names with metacharacters
+            # must not silently match nothing)
+            runner._build_one(path, f"{spec.segment_name_prefix}_0")
+            seg_dirs = [os.path.join(out_dir,
+                                     f"{spec.segment_name_prefix}_0")]
+            names.extend(self._upload(ctx, task.table, seg_dirs))
+        # record success AFTER upload: the generator only skips files the
+        # cluster actually serves
+        def apply(d):
+            d = dict(d or {})
+            for p in files:
+                d[os.path.basename(p)] = int(os.path.getmtime(p) * 1000)
+            return d
+
+        ctx.store.update(ingested_files_path(task.table), apply)
+        return names
+
+
 TASK_EXECUTORS: Dict[str, BaseTaskExecutor] = {
     e.task_type: e for e in (MergeRollupTaskExecutor(),
                              RealtimeToOfflineSegmentsTaskExecutor(),
-                             PurgeTaskExecutor())
+                             PurgeTaskExecutor(),
+                             ConvertToRawIndexTaskExecutor(),
+                             SegmentGenerationAndPushTaskExecutor())
 }

@@ -35,17 +35,22 @@ class _DaemonPool:
             item = self._q.get()
             if item is None:
                 return
-            fut, fn = item
+            fut, fn, on_skip = item
             if not fut.set_running_or_notify_cancel():
+                # cancelled while queued: bookkeeping (inflight counters)
+                # must still run or shutdown blocks on a phantom query
+                if on_skip is not None:
+                    on_skip()
                 continue
             try:
                 fut.set_result(fn())
             except BaseException as e:  # noqa: BLE001 — future carries it
                 fut.set_exception(e)
 
-    def submit(self, fn: Callable[[], Any]) -> Future:
+    def submit(self, fn: Callable[[], Any],
+               on_skip: Optional[Callable[[], None]] = None) -> Future:
         fut: Future = Future()
-        self._q.put((fut, fn))
+        self._q.put((fut, fn, on_skip))
         return fut
 
     def stop(self) -> None:
@@ -69,15 +74,18 @@ class QueryScheduler:
                 raise RuntimeError("scheduler is shut down")
             self._inflight += 1
 
+        def done():
+            with self._lock:
+                self._inflight -= 1
+                self._drained.notify_all()
+
         def run():
             try:
                 return fn()
             finally:
-                with self._lock:
-                    self._inflight -= 1
-                    self._drained.notify_all()
+                done()
 
-        return self._pool.submit(run)
+        return self._pool.submit(run, on_skip=done)
 
     def shutdown(self, timeout_s: float = 30.0) -> None:
         """Disable new queries, drain in-flight ones
@@ -135,6 +143,111 @@ class TokenBucketScheduler(QueryScheduler):
         return super().submit(delayed, table)
 
 
+class PriorityScheduler(QueryScheduler):
+    """Multi-level priority queue with per-table fairness (ref:
+    ``priority/MultiLevelPriorityQueue.java`` + ``PriorityScheduler``):
+    a fixed worker pool pops from per-table queues; the next queue is the
+    one with the LOWEST in-progress+pending cost share, scaled by the
+    table's priority weight, so a flood from one table cannot starve
+    others and high-priority tables drain first under contention."""
+
+    def __init__(self, num_workers: int = 8,
+                 table_priorities: Optional[Dict[str, float]] = None):
+        # intentionally does NOT call super().__init__: this scheduler owns
+        # its queues instead of a shared _DaemonPool queue
+        self._accepting = True
+        self._inflight = 0
+        self._lock = threading.Lock()
+        self._drained = threading.Condition(self._lock)
+        self._priorities = dict(table_priorities or {})
+        self._queues: Dict[str, "queue.Queue"] = {}
+        self._costs: Dict[str, float] = {}
+        self._available = threading.Semaphore(0)
+        self._stop = False
+        self._threads = [
+            threading.Thread(target=self._work, daemon=True,
+                             name=f"prio-query-{i}")
+            for i in range(num_workers)]
+        for t in self._threads:
+            t.start()
+
+    def _pick_table(self) -> Optional[str]:
+        """Lowest weighted cost wins (the multi-level 'wakeup' choice)."""
+        best, best_score = None, None
+        for table, q in self._queues.items():
+            if q.empty():
+                continue
+            weight = max(self._priorities.get(table, 1.0), 1e-6)
+            score = self._costs.get(table, 0.0) / weight
+            if best_score is None or score < best_score:
+                best, best_score = table, score
+        return best
+
+    def _work(self) -> None:
+        while True:
+            self._available.acquire()
+            with self._lock:
+                if self._stop and all(q.empty()
+                                      for q in self._queues.values()):
+                    return
+                table = self._pick_table()
+                if table is None:
+                    continue
+                fut, fn = self._queues[table].get_nowait()
+            done = self._finish(table)
+            if not fut.set_running_or_notify_cancel():
+                done()  # cancelled while queued: release its cost+inflight
+                continue
+            try:
+                fut.set_result(fn())
+            except BaseException as e:  # noqa: BLE001 — future carries it
+                fut.set_exception(e)
+            finally:
+                done()
+
+    def _finish(self, table: str) -> Callable[[], None]:
+        """One-shot completion: releases the table's cost share (cost =
+        pending + in-progress, so it DECAYS — a long-lived table must not
+        be starved by newly-seen tables) and the drain counter."""
+        fired = [False]
+
+        def done():
+            if fired[0]:
+                return
+            fired[0] = True
+            with self._lock:
+                self._costs[table] = max(
+                    self._costs.get(table, 1.0) - 1.0, 0.0)
+                self._inflight -= 1
+                self._drained.notify_all()
+
+        return done
+
+    def submit(self, fn: Callable[[], Any], table: str = "") -> Future:
+        fut: Future = Future()
+        with self._lock:
+            if not self._accepting:
+                raise RuntimeError("scheduler is shut down")
+            self._inflight += 1
+            self._costs[table] = self._costs.get(table, 0.0) + 1.0
+            self._queues.setdefault(table, queue.Queue()).put((fut, fn))
+        self._available.release()
+        return fut
+
+    def shutdown(self, timeout_s: float = 30.0) -> None:
+        with self._lock:
+            self._accepting = False
+            deadline = time.monotonic() + timeout_s
+            while self._inflight > 0:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    break
+                self._drained.wait(remaining)
+            self._stop = True
+        for _ in self._threads:
+            self._available.release()
+
+
 def make_scheduler(policy: str = "fcfs", **kw) -> QueryScheduler:
     """Ref: QuerySchedulerFactory."""
     policy = policy.lower()
@@ -142,4 +255,6 @@ def make_scheduler(policy: str = "fcfs", **kw) -> QueryScheduler:
         return FcfsScheduler(**kw)
     if policy in ("tokenbucket", "token_bucket"):
         return TokenBucketScheduler(**kw)
+    if policy == "priority":
+        return PriorityScheduler(**kw)
     raise ValueError(f"unknown scheduler policy {policy!r}")

@@ -1,0 +1,78 @@
+"""Plugin loader: runtime discovery of third-party SPI implementations.
+
+Re-design of ``pinot-spi/.../plugin/PluginManager.java:40`` +
+``PluginClassLoader``: the reference scans a plugins directory and loads
+each plugin in an isolated classloader; here each plugin is a python
+module (a ``.py`` file or a package directory) imported from the plugins
+dir — importing it is the registration step (plugins call the SPI
+registries: ``ingestion.stream.register_stream_type``,
+``spi.filesystem.register_fs``, ``ingestion.readers`` format map, scalar
+function registries, ...). Isolation is per-module-namespace rather than
+per-classloader (python has no classloader hierarchy to mirror).
+
+Directory convention (``plugins.dir`` config key or PINOT_PLUGINS_DIR):
+    plugins/
+      my_stream.py          <- registers on import
+      my_fs/__init__.py     <- package plugin
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import logging
+import os
+import sys
+
+from typing import List, Optional
+
+log = logging.getLogger(__name__)
+
+PLUGINS_DIR_ENV = "PINOT_PLUGINS_DIR"
+
+
+class PluginManager:
+    """Ref: PluginManager.java:40 (init/load/get)."""
+
+    def __init__(self, plugins_dir: Optional[str] = None):
+        self.plugins_dir = plugins_dir or os.environ.get(PLUGINS_DIR_ENV)
+        self.loaded: List[str] = []
+
+    def load_all(self) -> List[str]:
+        """Import every plugin module under the plugins dir; returns the
+        loaded plugin names (skips, with a log, plugins that fail —
+        matching the reference's tolerant startup scan)."""
+        d = self.plugins_dir
+        if not d or not os.path.isdir(d):
+            return []
+        for entry in sorted(os.listdir(d)):
+            path = os.path.join(d, entry)
+            name = None
+            if entry.endswith(".py") and not entry.startswith("_"):
+                name = entry[:-3]
+            elif (os.path.isdir(path)
+                  and os.path.isfile(os.path.join(path, "__init__.py"))):
+                name = entry
+                path = os.path.join(path, "__init__.py")
+            if name is None:
+                continue
+            try:
+                self._load_module(f"pinot_plugin_{name}", path)
+                self.loaded.append(name)
+            except Exception:  # noqa: BLE001 — one bad plugin isn't fatal
+                log.exception("failed to load plugin %s", entry)
+        return list(self.loaded)
+
+    @staticmethod
+    def _load_module(mod_name: str, path: str):
+        spec = importlib.util.spec_from_file_location(mod_name, path)
+        assert spec is not None and spec.loader is not None, path
+        module = importlib.util.module_from_spec(spec)
+        sys.modules[mod_name] = module
+        try:
+            spec.loader.exec_module(module)
+        except BaseException:
+            # a half-initialized plugin must not stay importable (python's
+            # own import machinery removes failed modules the same way)
+            sys.modules.pop(mod_name, None)
+            raise
+        return module

@@ -364,10 +364,16 @@ def _generate_convert_to_raw(mgr: PinotTaskManager, table: str, cfg,
     ConvertToRawIndexTaskGenerator — skips segments whose custom map
     records the conversion). Poisoned segments (MAX_TASK_ATTEMPTS errors)
     are skipped so one bad segment cannot block the rest forever."""
+    want = ",".join(sorted(c.strip() for c in
+                           tconf.get("columnsToConvert", "").split(",")
+                           if c.strip()))
     for md in mgr.store.segment_metadata_list(table):
         if md.status != ONLINE:
             continue
-        if md.custom.get("convertToRawDone"):
+        done = md.custom.get("convertToRawDone")
+        # reconvert when the requested column set CHANGED (the recorded
+        # value is the converted set, compared — not just truthiness)
+        if done is not None and done == (want or "*"):
             continue
         if mgr.error_attempts(table, CONVERT_TO_RAW_TASK,
                               input_segments=[md.segment_name]) \
@@ -403,13 +409,18 @@ def _generate_segment_generation_and_push(mgr: PinotTaskManager, table: str,
         return
     processed = mgr.store.get(ingested_files_path(table)) or {}
     fresh = []
+    mtimes: Dict[str, int] = {}
     for entry in sorted(os.listdir(input_dir)):
         path = os.path.join(input_dir, entry)
-        if not os.path.isfile(path):
-            continue
-        mtime = int(os.path.getmtime(path) * 1000)
+        try:
+            if not os.path.isfile(path):
+                continue
+            mtime = int(os.path.getmtime(path) * 1000)
+        except FileNotFoundError:
+            continue  # deleted mid-scan: a producer race, not an error
         if processed.get(entry) != mtime:
             fresh.append(path)
+            mtimes[entry] = mtime
     if not fresh:
         return
     key = ",".join(sorted(os.path.basename(f) for f in fresh))
@@ -421,6 +432,9 @@ def _generate_segment_generation_and_push(mgr: PinotTaskManager, table: str,
         task_id=_new_id(SEGMENT_GENERATION_AND_PUSH_TASK),
         task_type=SEGMENT_GENERATION_AND_PUSH_TASK, table=table,
         configs=dict(tconf, inputFiles=_json.dumps(fresh),
+                     # generation-time mtimes: success recording must match
+                     # the content that was READ, not a later re-stat
+                     inputFileMtimes=_json.dumps(mtimes),
                      fileSetKey=key))
 
 

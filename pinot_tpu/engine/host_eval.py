@@ -114,11 +114,25 @@ def _matching_dict_ids(ds: DataSource, pred: Predicate) -> np.ndarray:
         return np.array([i for i in range(card)
                          if rx.search(str(d.get_value(i)))], dtype=np.int64)
     if t is PredicateType.TEXT_MATCH:
-        # without a Lucene-style text index, TEXT_MATCH falls back to a
-        # term-containment check over the dictionary
-        term = str(pred.value).lower()
+        from pinot_tpu.segment.textindex import (
+            match_text_value,
+            parse_text_query,
+        )
+
+        try:
+            reader = getattr(ds, "text_index", None)
+            if reader is not None:
+                # tokenized inverted index -> dictId postings
+                # (ref: TextMatchFilterOperator over TextIndexReader)
+                return reader.matching_ids(str(pred.value))
+            # index-less decay: SAME query dialect, evaluated per distinct
+            # value (results must not depend on whether the index exists)
+            ast = parse_text_query(str(pred.value))
+        except ValueError as e:
+            raise QueryError(f"bad TEXT_MATCH query: {e}")
         return np.array([i for i in range(card)
-                         if term in str(d.get_value(i)).lower()], dtype=np.int64)
+                         if match_text_value(d.get_value(i), ast)],
+                        dtype=np.int64)
     raise UnsupportedQueryError(f"predicate {t} not supported on "
                                 f"dictionary column {ds.name!r}")
 

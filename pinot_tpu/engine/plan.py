@@ -483,10 +483,21 @@ def _build_lut(ds: DataSource, pred: Predicate) -> np.ndarray:
             if match_json_value(d.get_value(i), ast):
                 lut[i] = True
         return lut
-    # TEXT_MATCH fallback: term containment over the dictionary
-    term = str(pred.value).lower()
+    # TEXT_MATCH: tokenized index when present (dictId postings -> LUT,
+    # so the query rides the device scan); the index-less decay evaluates
+    # the SAME dialect per distinct value
+    from pinot_tpu.segment.textindex import match_text_value, parse_text_query
+
+    try:
+        reader = getattr(ds, "text_index", None)
+        if reader is not None:
+            lut[reader.matching_ids(str(pred.value))] = True
+            return lut
+        ast = parse_text_query(str(pred.value))
+    except ValueError as e:
+        raise QueryError(f"bad TEXT_MATCH query: {e}")
     for i in range(card):
-        if term in str(d.get_value(i)).lower():
+        if match_text_value(d.get_value(i), ast):
             lut[i] = True
     return lut
 

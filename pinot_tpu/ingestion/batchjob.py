@@ -201,7 +201,7 @@ class SegmentGenerationJobRunner:
                 _load_json_uri(spec.table_config_uri))
 
     def run(self) -> List[str]:
-        """Build all segments; returns the segment directories."""
+        """Build all glob-matched segments; returns the segment dirs."""
         spec = self.spec
         files = _match_glob(spec.input_dir_uri,
                             spec.include_file_name_pattern,
@@ -210,6 +210,13 @@ class SegmentGenerationJobRunner:
             raise FileNotFoundError(
                 f"no input files match {spec.include_file_name_pattern!r} "
                 f"under {spec.input_dir_uri!r}")
+        return self.run_files(files)
+
+    def run_files(self, files: List[str]) -> List[str]:
+        """Build segments from an EXPLICIT file list (no glob round-trip —
+        callers with exact paths, like the minion task, must not lose
+        files to glob metacharacters in their names)."""
+        spec = self.spec
         os.makedirs(spec.output_dir_uri, exist_ok=True)
         table = (spec.table_name
                  or (self.table_config.table_name if self.table_config

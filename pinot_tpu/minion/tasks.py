@@ -240,10 +240,12 @@ class ConvertToRawIndexTaskExecutor(BaseTaskExecutor):
         builder.build(columns, out_dir)
         names = self._upload(ctx, task.table, [os.path.join(out_dir,
                                                             in_name)])
-        # record completion in the segment's custom map (generator gate)
+        # record completion in the segment's custom map — SORTED so the
+        # generator's changed-config comparison is order-insensitive
         md = ctx.store.get_segment_metadata(task.table, in_name)
         if md is not None:
-            md.custom["convertToRawDone"] = ",".join(cols_to_convert) or "*"
+            md.custom["convertToRawDone"] = \
+                ",".join(sorted(cols_to_convert)) or "*"
             ctx.store.set_segment_metadata(md)
         return names
 
@@ -270,28 +272,23 @@ class SegmentGenerationAndPushTaskExecutor(BaseTaskExecutor):
             raise ValueError("SegmentGenerationAndPushTask without "
                              "inputFiles")
         out_dir = os.path.join(ctx.work_dir, task.task_id)
-        os.makedirs(out_dir, exist_ok=True)
-        names: List[str] = []
-        for seq, path in enumerate(files):
-            spec = SegmentGenerationJobSpec(
-                output_dir_uri=out_dir,
-                table_name=cfg.table_name,
-                data_format=task.configs.get("inputFormat") or None,
-                segment_name_prefix=f"{cfg.table_name}_{task.task_id}_{seq}")
-            runner = SegmentGenerationJobRunner(spec, schema=schema,
-                                                table_config=cfg)
-            # explicit file (no glob round-trip: names with metacharacters
-            # must not silently match nothing)
-            runner._build_one(path, f"{spec.segment_name_prefix}_0")
-            seg_dirs = [os.path.join(out_dir,
-                                     f"{spec.segment_name_prefix}_0")]
-            names.extend(self._upload(ctx, task.table, seg_dirs))
-        # record success AFTER upload: the generator only skips files the
-        # cluster actually serves
+        spec = SegmentGenerationJobSpec(
+            output_dir_uri=out_dir,
+            table_name=cfg.table_name,
+            data_format=task.configs.get("inputFormat") or None,
+            segment_name_prefix=f"{cfg.table_name}_{task.task_id}")
+        runner = SegmentGenerationJobRunner(spec, schema=schema,
+                                            table_config=cfg)
+        seg_dirs = runner.run_files(files)
+        names = self._upload(ctx, task.table, seg_dirs)
+        # record success AFTER upload, with the GENERATION-TIME mtimes (a
+        # re-stat here would bind a later rewrite's mtime to the content
+        # that was actually read, or crash on a deleted landing file)
+        recorded = _json.loads(task.configs.get("inputFileMtimes", "{}"))
+
         def apply(d):
             d = dict(d or {})
-            for p in files:
-                d[os.path.basename(p)] = int(os.path.getmtime(p) * 1000)
+            d.update(recorded)
             return d
 
         ctx.store.update(ingested_files_path(task.table), apply)

@@ -404,6 +404,17 @@ class SegmentBuilder:
             fs.name, lambda: dictionary.get_values(range(card)), save)
         has_json = self._maybe_build_json_index(fs, values, num_docs, save,
                                                 col_dir)
+        has_text = False
+        if (fs.name in self.indexing.text_index_columns
+                and fs.single_value and not fs.data_type.is_numeric):
+            # text index over the DICTIONARY values: postings hold dictIds,
+            # so TEXT_MATCH resolves to the same dictId-LUT shape the
+            # device scan consumes (ref: LuceneTextIndexCreator)
+            from pinot_tpu.segment.textindex import build_text_index
+
+            build_text_index(dictionary.get_values(range(card)), save,
+                             col_dir, fs.name)
+            has_text = True
 
         return meta.ColumnMetadata(
             name=fs.name, data_type=fs.data_type, field_type=fs.field_type,
@@ -414,6 +425,7 @@ class SegmentBuilder:
             is_sorted=is_sorted, has_dictionary=True,
             has_inverted_index=want_inverted, has_nulls=has_nulls,
             has_bloom_filter=has_bloom, has_json_index=has_json,
+            has_text_index=has_text,
             max_num_multi_values=max_mv, total_number_of_entries=total_entries,
             **self._partition_meta(fs.name, values),
         )

@@ -98,6 +98,25 @@ class DataSource:
             blob, self._segment.num_docs)
 
     @cached_property
+    def text_index(self):
+        """TextIndexReader over dictIds, or None (ref: TextIndexReader)."""
+        if not self.metadata.has_text_index:
+            return None
+        from pinot_tpu.segment.textindex import TextIndexReader
+
+        with open(self._segment._path(self.name, "txtinv", ext="bin"),
+                  "rb") as f:
+            blob = f.read()
+        d = self.dictionary
+        return TextIndexReader(
+            self._segment._load_array(self.name, "txtoff"),
+            self._segment._load_array(self.name, "txtblob"),
+            self._segment._load_array(self.name, "txtinvoff"),
+            self._segment._load_array(self.name, "txtinvbo"),
+            blob, self.metadata.cardinality,
+            value_of=lambda i: d.get_value(int(i)))
+
+    @cached_property
     def range_order(self):
         """Sorted-order permutation for RANGE binary search, or None
         (host-path equivalent of BitSlicedRangeIndexReader)."""

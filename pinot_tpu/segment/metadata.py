@@ -73,6 +73,7 @@ class ColumnMetadata:
     has_nulls: bool = False
     has_bloom_filter: bool = False
     has_json_index: bool = False
+    has_text_index: bool = False
     has_range_index: bool = False
     max_num_multi_values: int = 0   # MV only: max values per row
     total_number_of_entries: int = 0  # MV only: total flattened values
@@ -97,6 +98,7 @@ class ColumnMetadata:
             "hasNulls": self.has_nulls,
             "hasBloomFilter": self.has_bloom_filter,
             "hasJsonIndex": self.has_json_index,
+            "hasTextIndex": self.has_text_index,
             "hasRangeIndex": self.has_range_index,
             "maxNumMultiValues": self.max_num_multi_values,
             "totalNumberOfEntries": self.total_number_of_entries,
@@ -126,6 +128,7 @@ class ColumnMetadata:
             has_nulls=d.get("hasNulls", False),
             has_bloom_filter=d.get("hasBloomFilter", False),
             has_json_index=d.get("hasJsonIndex", False),
+            has_text_index=d.get("hasTextIndex", False),
             has_range_index=d.get("hasRangeIndex", False),
             max_num_multi_values=d.get("maxNumMultiValues", 0),
             total_number_of_entries=d.get("totalNumberOfEntries", 0),

@@ -1,0 +1,268 @@
+"""Text index: tokenized inverted index behind TEXT_MATCH.
+
+Re-design of the reference's Lucene-backed text index
+(``segment/index/readers/text/TextIndexReader`` family +
+``creator/impl/text/LuceneTextIndexCreator``): instead of a Lucene
+directory, terms map to posting lists over the column's DICTIONARY ids
+(raw columns fall back to doc ids) — a TEXT_MATCH then resolves to a
+dictId set, which is exactly the boolean-LUT shape the device scan and
+the host evaluator already consume for IN/REGEXP. Storage reuses the
+inverted-index scheme (sorted term strings as offsets+blob, delta+varint
+postings).
+
+Analyzer: lowercase + split on non-alphanumerics (the StandardAnalyzer
+subset; no stemming/stop-words). Query dialect (the operative subset of
+Lucene's QueryParser, which the reference feeds TEXT_MATCH strings to):
+bare terms, ``"quoted phrases"`` (adjacency verified against the source
+values), ``prefix*`` wildcards, AND / OR (OR is the default operator,
+as in Lucene) and parentheses.
+"""
+
+from __future__ import annotations
+
+import re
+from bisect import bisect_left
+from typing import Any, Callable, List, Sequence, Set, Tuple
+
+import numpy as np
+
+_TOKEN_RE = re.compile(r"[a-z0-9]+")
+
+
+def tokenize(text: str) -> List[str]:
+    return _TOKEN_RE.findall(str(text).lower())
+
+
+# --------------------------------------------------------------------------
+# creator
+# --------------------------------------------------------------------------
+
+def build_text_index(values: Sequence[Any], save, col_dir: str,
+                     name: str) -> None:
+    """``values`` are the UNIT of indexing: dictionary values for dict
+    columns (postings hold dictIds), per-doc values for raw columns
+    (postings hold docIds)."""
+    import os
+
+    from pinot_tpu import native
+
+    postings: dict = {}
+    for vid, value in enumerate(values):
+        if value is None:
+            continue
+        for term in set(tokenize(value)):
+            postings.setdefault(term, []).append(vid)
+
+    terms = sorted(postings)
+    blob = "".join(terms).encode("utf-8")
+    offsets = np.zeros(len(terms) + 1, dtype=np.int64)
+    for i, t in enumerate(terms):
+        offsets[i + 1] = offsets[i] + len(t.encode("utf-8"))
+    save("txtoff", offsets)
+    save("txtblob", np.frombuffer(blob, dtype=np.uint8))
+
+    counts = np.zeros(len(terms) + 1, dtype=np.int64)
+    flat: List[int] = []
+    for i, t in enumerate(terms):
+        counts[i + 1] = counts[i] + len(postings[t])
+        flat.extend(postings[t])
+    save("txtinvoff", counts)
+    posting_blob, byte_offsets = native.varint_encode_lists(
+        np.asarray(flat, dtype=np.int32), counts)
+    save("txtinvbo", byte_offsets)
+    with open(os.path.join(col_dir, f"{name}.txtinv.bin"), "wb") as f:
+        f.write(posting_blob)
+
+
+# --------------------------------------------------------------------------
+# query parsing (Lucene QueryParser subset; OR is the default operator)
+# --------------------------------------------------------------------------
+
+_QTOKEN = re.compile(r"""
+    \s*(?:
+      (?P<lp>\() | (?P<rp>\)) |
+      (?P<and>AND\b) | (?P<or>OR\b) |
+      "(?P<phrase>[^"]*)" |
+      (?P<word>[^\s()"]+)
+    )""", re.VERBOSE)
+
+
+def parse_text_query(q: str):
+    """-> AST: ("term", t) | ("prefix", p) | ("phrase", [terms], raw)
+    | ("and"|"or", [children])."""
+    toks: List[Tuple[str, str]] = []
+    i = 0
+    q = q.strip()
+    while i < len(q):
+        m = _QTOKEN.match(q, i)
+        if m is None or m.end() == i:
+            raise ValueError(f"bad TEXT_MATCH query at {q[i:i+20]!r}")
+        i = m.end()
+        kind = m.lastgroup
+        if kind:
+            toks.append((kind, m.group(kind)))
+    pos = 0
+
+    def peek():
+        return toks[pos][0] if pos < len(toks) else None
+
+    def take():
+        nonlocal pos
+        if pos >= len(toks):
+            raise ValueError(f"unexpected end of TEXT_MATCH query {q!r}")
+        t = toks[pos]
+        pos += 1
+        return t
+
+    def unit():
+        kind, text = take()
+        if kind == "lp":
+            node = expr()
+            if peek() != "rp":
+                raise ValueError("unbalanced parentheses")
+            take()
+            return node
+        if kind == "phrase":
+            terms = tokenize(text)
+            if not terms:
+                raise ValueError("empty phrase")
+            return ("phrase", terms, text)
+        if kind == "word":
+            if text.endswith("*") and len(text) > 1:
+                p = tokenize(text[:-1])
+                if len(p) != 1:
+                    raise ValueError(f"bad wildcard {text!r}")
+                return ("prefix", p[0])
+            terms = tokenize(text)
+            if len(terms) != 1:
+                # 'foo-bar' tokenizes to two terms: treat as a phrase
+                return ("phrase", terms, text)
+            return ("term", terms[0])
+        raise ValueError(f"expected a term, got {text!r}")
+
+    def and_expr():
+        node = unit()
+        children = [node]
+        while peek() == "and":
+            take()
+            children.append(unit())
+        return children[0] if len(children) == 1 else ("and", children)
+
+    def expr():
+        node = and_expr()
+        children = [node]
+        while peek() in ("or", "lp", "phrase", "word"):
+            if peek() == "or":
+                take()
+            children.append(and_expr())  # juxtaposition = OR (Lucene)
+        return children[0] if len(children) == 1 else ("or", children)
+
+    node = expr()
+    if pos != len(toks):
+        raise ValueError(f"trailing tokens in TEXT_MATCH query: {toks[pos:]}")
+    return node
+
+
+def match_text_value(value: Any, ast) -> bool:
+    """Index-less evaluation of one value (the fallback oracle)."""
+    terms = tokenize(value)
+    have = set(terms)
+
+    def ev(node) -> bool:
+        op = node[0]
+        if op == "term":
+            return node[1] in have
+        if op == "prefix":
+            return any(t.startswith(node[1]) for t in have)
+        if op == "phrase":
+            want = node[1]
+            return any(terms[i:i + len(want)] == want
+                       for i in range(len(terms) - len(want) + 1))
+        if op == "and":
+            return all(ev(c) for c in node[1])
+        return any(ev(c) for c in node[1])
+
+    return ev(ast)
+
+
+# --------------------------------------------------------------------------
+# reader
+# --------------------------------------------------------------------------
+
+class TextIndexReader:
+    """Posting resolution of a TEXT_MATCH query to a value-id set (dictIds
+    for dict columns, docIds for raw)."""
+
+    def __init__(self, term_off: np.ndarray, term_blob: np.ndarray,
+                 inv_off: np.ndarray, inv_byte_off: np.ndarray,
+                 inv_blob: bytes, num_ids: int,
+                 value_of: Callable[[int], Any]):
+        blob = bytes(term_blob.tobytes())
+        self._terms = [
+            blob[int(term_off[i]):int(term_off[i + 1])].decode("utf-8")
+            for i in range(len(term_off) - 1)]
+        self._inv_off = inv_off
+        self._inv_byte_off = inv_byte_off
+        self._inv_blob = inv_blob
+        self.num_ids = num_ids
+        self._value_of = value_of  # id -> source text (phrase verification)
+
+    def _postings(self, idx: int) -> np.ndarray:
+        from pinot_tpu import native
+
+        n = int(self._inv_off[idx + 1] - self._inv_off[idx])
+        if n == 0:
+            return np.empty(0, dtype=np.int32)
+        lo = int(self._inv_byte_off[idx])
+        hi = int(self._inv_byte_off[idx + 1])
+        return native.varint_decode(self._inv_blob[lo:hi], n)
+
+    def _ids_for_term(self, term: str) -> Set[int]:
+        i = bisect_left(self._terms, term)
+        if i < len(self._terms) and self._terms[i] == term:
+            return set(int(x) for x in self._postings(i))
+        return set()
+
+    def _ids_for_prefix(self, prefix: str) -> Set[int]:
+        lo = bisect_left(self._terms, prefix)
+        hi = bisect_left(self._terms, prefix + "\U0010ffff")
+        out: Set[int] = set()
+        for i in range(lo, hi):
+            out |= set(int(x) for x in self._postings(i))
+        return out
+
+    def matching_ids(self, query: str) -> np.ndarray:
+        """Sorted value ids matching the TEXT_MATCH query."""
+        ast = parse_text_query(query)
+
+        def ev(node) -> Set[int]:
+            op = node[0]
+            if op == "term":
+                return self._ids_for_term(node[1])
+            if op == "prefix":
+                return self._ids_for_prefix(node[1])
+            if op == "phrase":
+                # AND the terms, then verify adjacency against the source
+                # values (positions are not stored; candidates are few)
+                cand: Set[int] = None  # type: ignore[assignment]
+                for t in node[1]:
+                    ids = self._ids_for_term(t)
+                    cand = ids if cand is None else (cand & ids)
+                    if not cand:
+                        return set()
+                return {i for i in cand
+                        if match_text_value(self._value_of(i), node)}
+            if op == "and":
+                out: Set[int] = None  # type: ignore[assignment]
+                for c in node[1]:
+                    ids = ev(c)
+                    out = ids if out is None else (out & ids)
+                    if not out:
+                        return set()
+                return out
+            out = set()
+            for c in node[1]:
+                out |= ev(c)
+            return out
+
+        return np.asarray(sorted(ev(ast)), dtype=np.int64)

@@ -55,8 +55,11 @@ class PluginManager:
                 path = os.path.join(path, "__init__.py")
             if name is None:
                 continue
+            mod_name = f"pinot_plugin_{name}"
+            if mod_name in sys.modules:
+                continue  # idempotent: registrations must not re-run
             try:
-                self._load_module(f"pinot_plugin_{name}", path)
+                self._load_module(mod_name, path)
                 self.loaded.append(name)
             except Exception:  # noqa: BLE001 — one bad plugin isn't fatal
                 log.exception("failed to load plugin %s", entry)

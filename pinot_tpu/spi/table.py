@@ -95,6 +95,7 @@ class IndexingConfig:
     range_index_columns: List[str] = field(default_factory=list)
     sorted_column: List[str] = field(default_factory=list)
     bloom_filter_columns: List[str] = field(default_factory=list)
+    text_index_columns: List[str] = field(default_factory=list)
     no_dictionary_columns: List[str] = field(default_factory=list)
     json_index_columns: List[str] = field(default_factory=list)
     var_length_dictionary_columns: List[str] = field(default_factory=list)
@@ -110,6 +111,7 @@ class IndexingConfig:
             "rangeIndexColumns": self.range_index_columns,
             "sortedColumn": self.sorted_column,
             "bloomFilterColumns": self.bloom_filter_columns,
+            "textIndexColumns": self.text_index_columns,
             "noDictionaryColumns": self.no_dictionary_columns,
             "jsonIndexColumns": self.json_index_columns,
             "varLengthDictionaryColumns": self.var_length_dictionary_columns,
@@ -131,6 +133,7 @@ class IndexingConfig:
             range_index_columns=d.get("rangeIndexColumns") or [],
             sorted_column=d.get("sortedColumn") or [],
             bloom_filter_columns=d.get("bloomFilterColumns") or [],
+            text_index_columns=d.get("textIndexColumns") or [],
             no_dictionary_columns=d.get("noDictionaryColumns") or [],
             json_index_columns=d.get("jsonIndexColumns") or [],
             var_length_dictionary_columns=d.get("varLengthDictionaryColumns") or [],

@@ -1,0 +1,112 @@
+"""Text index: tokenized TEXT_MATCH over dictId postings.
+
+Ref: LuceneTextIndexCreator / TextIndexReader / TextMatchFilterOperator
+(Lucene QueryParser dialect subset: terms, phrases, prefix*, AND/OR).
+"""
+
+import numpy as np
+import pytest
+
+from pinot_tpu.engine import ServerQueryExecutor
+from pinot_tpu.query import compile_query
+from pinot_tpu.segment import SegmentBuilder, load_segment
+from pinot_tpu.segment.textindex import (
+    match_text_value,
+    parse_text_query,
+    tokenize,
+)
+from pinot_tpu.spi import DataType, FieldSpec, FieldType, Schema
+from pinot_tpu.spi.table import IndexingConfig
+
+DOCS = [
+    "Java database for realtime analytics",
+    "TPU accelerated query engine",
+    "distributed realtime OLAP datastore",
+    "the quick brown fox",
+    "quick analytics on TPU hardware",
+    "batch ingestion pipeline",
+    "streaming ingestion for analytics",
+    "query planning and execution",
+]
+
+
+class TestAnalyzer:
+    def test_tokenize(self):
+        assert tokenize("The Quick-Brown FOX!") == \
+            ["the", "quick", "brown", "fox"]
+
+    def test_query_parse(self):
+        assert parse_text_query("quick") == ("term", "quick")
+        assert parse_text_query("quick fox") == \
+            ("or", [("term", "quick"), ("term", "fox")])  # Lucene default OR
+        assert parse_text_query("quick AND fox") == \
+            ("and", [("term", "quick"), ("term", "fox")])
+        assert parse_text_query('"realtime analytics"')[0] == "phrase"
+        assert parse_text_query("ana*") == ("prefix", "ana")
+
+    def test_match_oracle(self):
+        assert match_text_value("quick brown fox", parse_text_query(
+            '"quick brown"'))
+        assert not match_text_value("brown quick fox", parse_text_query(
+            '"quick brown"'))  # adjacency matters
+
+
+@pytest.fixture(scope="module", params=["indexed", "unindexed"])
+def seg(request, tmp_path_factory):
+    out = str(tmp_path_factory.mktemp(f"tx_{request.param}"))
+    n = len(DOCS) * 50
+    docs = (DOCS * 50)[:n]
+    cfg = IndexingConfig(
+        text_index_columns=["body"] if request.param == "indexed" else [])
+    schema = Schema("txt", [
+        FieldSpec("body", DataType.STRING),
+        FieldSpec("v", DataType.LONG, FieldType.METRIC)])
+    b = SegmentBuilder(schema, "txt_0", indexing_config=cfg)
+    b.build({"body": np.array(docs), "v": np.arange(n).astype(np.int64)},
+            out)
+    return load_segment(f"{out}/txt_0"), docs
+
+
+QUERIES = [
+    "analytics",
+    "quick AND analytics",
+    "realtime analytics",          # OR
+    '"realtime analytics"',        # phrase (adjacent)
+    "ingest*",
+    '(quick OR streaming) AND analytics',
+    "tpu AND quer*",
+]
+
+
+class TestTextMatchQueries:
+    @pytest.mark.parametrize("q", QUERIES)
+    def test_counts_match_oracle(self, seg, q):
+        segment, docs = seg
+        ast = parse_text_query(q)
+        expected = sum(1 for d in docs if match_text_value(d, ast))
+        sql_q = q.replace("'", "''")
+        for use_device in (True, False):
+            ex = ServerQueryExecutor(use_device=use_device)
+            rt, _ = ex.execute(compile_query(
+                f"SELECT count(*) FROM txt "
+                f"WHERE text_match(body, '{sql_q}')"), [segment])
+            assert rt.rows[0][0] == expected, (q, use_device)
+        assert expected > 0, q  # every query exercises real matches
+
+    def test_index_flag_and_reader(self, seg):
+        segment, _ = seg
+        cm = segment.metadata.column("body")
+        ds = segment.data_source("body")
+        if cm.has_text_index:
+            ids = ds.text_index.matching_ids("analytics")
+            assert len(ids) > 0
+
+    def test_bad_query_is_query_error(self, seg):
+        from pinot_tpu.engine.errors import QueryError
+
+        segment, _ = seg
+        ex = ServerQueryExecutor(use_device=False)
+        with pytest.raises(QueryError):
+            ex.execute(compile_query(
+                "SELECT count(*) FROM txt WHERE text_match(body, '((')"),
+                [segment])

@@ -116,6 +116,24 @@ class Controller:
             for seg in consuming:
                 self._segment_tables[seg] = name
 
+    def update_table(self, config: TableConfig) -> None:
+        """Replace an existing table's config (ref: updateTableConfig —
+        PUT /tables/{name}); pair with reload_table to apply new indexes."""
+        name = config.table_name_with_type
+        if self.store.get_table_config(name) is None:
+            raise KeyError(f"no such table {name}")
+        self.store.add_table_config(config)
+
+    def reload_table(self, name_with_type: str) -> None:
+        """Ask every server hosting the table to reload its segments —
+        rebuilding any newly-configured indexes in place (ref: the reload
+        message path, PinotSegmentRestletResource.reloadAllSegments ->
+        SegmentMessageHandlerFactory)."""
+        if self.store.get_table_config(name_with_type) is None:
+            raise KeyError(f"no such table {name_with_type}")
+        self.store.update(f"reloadrequests/{name_with_type}",
+                          lambda v: (v or 0) + 1)
+
     def delete_table(self, name_with_type: str) -> None:
         self.store.delete_table(name_with_type)
 

@@ -60,6 +60,33 @@ def compute_dir_crc(col_dir: str) -> int:
 RowsInput = Union[Iterable[Mapping[str, Any]], Mapping[str, Sequence[Any]]]
 
 
+def build_inverted_index(name: str, dict_ids_flat: np.ndarray,
+                         mv_counts: Optional[np.ndarray], num_docs: int,
+                         cardinality: int, save, col_dir: str) -> None:
+    """Inverted index: per dictId, the sorted docIds containing it, stored
+    as delta+varint posting lists (the RoaringBitmap-equivalent compressed
+    form; ref: creators under segment/creator/impl/inv/). ``invoff`` =
+    cumulative doc counts, ``invbo`` = byte offsets into the varint blob.
+    Shared by the creator and the reload preprocessor."""
+    if mv_counts is None:
+        doc_ids = np.arange(num_docs, dtype=np.int64)
+        ids = dict_ids_flat[:num_docs]
+    else:
+        doc_ids = np.repeat(np.arange(num_docs, dtype=np.int64), mv_counts)
+        ids = dict_ids_flat
+    order = np.lexsort((doc_ids, ids))
+    sorted_ids = ids[order]
+    sorted_docs = doc_ids[order].astype(np.int32)
+    offsets = np.zeros(cardinality + 1, dtype=np.int64)
+    np.add.at(offsets, sorted_ids + 1, 1)
+    offsets = np.cumsum(offsets)
+    save("invoff", offsets)
+    blob, byte_offsets = native.varint_encode_lists(sorted_docs, offsets)
+    save("invbo", byte_offsets)
+    with open(os.path.join(col_dir, f"{name}.inv.bin"), "wb") as f:
+        f.write(blob)
+
+
 class SegmentBuilder:
     """Driver for building one immutable segment directory.
 
@@ -460,30 +487,11 @@ class SegmentBuilder:
     def _build_inverted(self, name: str, dict_ids_flat: np.ndarray,
                         mv_rows: Optional[List[List[Any]]], num_docs: int,
                         cardinality: int, save, col_dir: str) -> None:
-        """Inverted index: per dictId, the sorted docIds containing it,
-        stored as delta+varint posting lists (the RoaringBitmap-equivalent
-        compressed form; ref: creators under segment/creator/impl/inv/).
-        ``invoff`` = cumulative doc counts, ``invbo`` = byte offsets into the
-        varint blob."""
-        if mv_rows is None:
-            doc_ids = np.arange(num_docs, dtype=np.int64)
-            ids = dict_ids_flat[:num_docs]
-        else:
-            counts = np.fromiter((len(r) for r in mv_rows), dtype=np.int64,
-                                 count=num_docs)
-            doc_ids = np.repeat(np.arange(num_docs, dtype=np.int64), counts)
-            ids = dict_ids_flat
-        order = np.lexsort((doc_ids, ids))
-        sorted_ids = ids[order]
-        sorted_docs = doc_ids[order].astype(np.int32)
-        offsets = np.zeros(cardinality + 1, dtype=np.int64)
-        np.add.at(offsets, sorted_ids + 1, 1)
-        offsets = np.cumsum(offsets)
-        save("invoff", offsets)
-        blob, byte_offsets = native.varint_encode_lists(sorted_docs, offsets)
-        save("invbo", byte_offsets)
-        with open(os.path.join(col_dir, f"{name}.inv.bin"), "wb") as f:
-            f.write(blob)
+        counts = (None if mv_rows is None else
+                  np.fromiter((len(r) for r in mv_rows), dtype=np.int64,
+                              count=num_docs))
+        build_inverted_index(name, dict_ids_flat, counts, num_docs,
+                             cardinality, save, col_dir)
 
     def _partition_meta(self, col: str, values: List[Any]) -> Dict[str, Any]:
         spc = self.indexing.segment_partition_config

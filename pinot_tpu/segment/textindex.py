@@ -134,6 +134,11 @@ def parse_text_query(q: str):
                     raise ValueError(f"bad wildcard {text!r}")
                 return ("prefix", p[0])
             terms = tokenize(text)
+            if not terms:
+                # '*', '%%', ... — no analyzable content; rejecting beats
+                # an index/decay divergence (empty phrase matched ALL rows
+                # on the decay path and crashed the indexed path)
+                raise ValueError(f"no searchable terms in {text!r}")
             if len(terms) != 1:
                 # 'foo-bar' tokenizes to two terms: treat as a phrase
                 return ("phrase", terms, text)

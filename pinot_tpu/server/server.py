@@ -81,6 +81,7 @@ class ServerInstance:
         # replay current assignments, then watch for changes (the Helix
         # participant registration + state-transition replay)
         self.store.watch("idealstate/", self._on_ideal_state_change)
+        self.store.watch("reloadrequests/", self._on_reload_request)
         for path in self.store.children("idealstate"):
             table = path.split("/", 1)[1]
             self._reconcile_table(table)
@@ -289,6 +290,35 @@ class ServerInstance:
         except Exception:
             log.exception("[%s] seal handling failed for %s",
                           self.instance_id, seg)
+
+    # -- reload (ref: SegmentMessageHandlerFactory refresh/reload) ----------
+    def _on_reload_request(self, path: str, _value) -> None:
+        table = path.split("/", 1)[-1]
+        tdm = self.data_manager.get(table)
+        if tdm is None:
+            return
+        cfg = self.store.get_table_config(table)
+        if cfg is None:
+            return
+        from pinot_tpu.segment.preprocessor import reload_segment
+
+        acquired = tdm.acquire_segments(None)
+        try:
+            for holder in acquired:
+                seg = holder.segment
+                if getattr(seg, "is_mutable", False):
+                    continue  # consuming segments rebuild indexes at seal
+                try:
+                    added = reload_segment(tdm, seg, cfg.indexing_config)
+                    if added:
+                        log.info("[%s] reloaded %s/%s: %s",
+                                 self.instance_id, table,
+                                 seg.segment_name, added)
+                except Exception:
+                    log.exception("[%s] reload failed for %s/%s",
+                                  self.instance_id, table, seg.segment_name)
+        finally:
+            tdm.release_segments(acquired)
 
     # -- query path (ref: InstanceRequestHandler.channelRead0:90) -----------
     def execute_query(self, ctx: QueryContext, table: str,

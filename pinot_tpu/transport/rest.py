@@ -78,6 +78,9 @@ class _Api:
             def do_POST(self):
                 self._dispatch("POST")
 
+            def do_PUT(self):
+                self._dispatch("PUT")
+
             def do_DELETE(self):
                 self._dispatch("DELETE")
 
@@ -121,6 +124,8 @@ class ControllerApi(_Api):
         # tables (ref: PinotTableRestletResource)
         self.route("POST", r"/tables",
                    lambda m, b: (200, self._add_table(c, b)))
+        self.route("PUT", r"/tables/([^/]+)",
+                   lambda m, b: self._update_table(c, m.group(1), b))
         self.route("GET", r"/tables",
                    lambda m, b: (200, {"tables": store.table_names()}))
         self.route("DELETE", r"/tables/([^/]+)",
@@ -136,6 +141,9 @@ class ControllerApi(_Api):
         # local-path upload; multi-host file upload arrives with deep store)
         self.route("POST", r"/segments",
                    lambda m, b: (200, self._add_segment(c, b)))
+        # ref: PinotSegmentRestletResource POST /segments/{table}/reload
+        self.route("POST", r"/segments/([^/]+)/reload",
+                   lambda m, b: (200, self._reload(c, m.group(1))))
         self.route("GET", r"/segments/([^/]+)",
                    lambda m, b: (200, store.segment_names(m.group(1))))
         self.route("GET", r"/instances",
@@ -165,6 +173,24 @@ class ControllerApi(_Api):
     def _delete_table(c, name) -> Dict[str, Any]:
         c.delete_table(name)
         return {"status": f"Table deleted {name}"}
+
+    @staticmethod
+    def _update_table(c, url_name: str, body):
+        cfg = TableConfig.from_dict(body)
+        # URL and body must agree (ref: PinotTableRestletResource rejects
+        # the mismatch) — a stale body must not overwrite another table
+        if url_name not in (cfg.table_name, cfg.table_name_with_type):
+            return (400, {"error": f"table name {url_name!r} in the URL "
+                                   f"does not match the body "
+                                   f"({cfg.table_name_with_type})"})
+        c.update_table(cfg)
+        return (200, {"status": f"Table config updated for "
+                                f"{cfg.table_name_with_type}"})
+
+    @staticmethod
+    def _reload(c, table) -> Dict[str, Any]:
+        c.reload_table(table)
+        return {"status": f"Submitted reload for table: {table}"}
 
     @staticmethod
     def _add_segment(c, body) -> Dict[str, Any]:

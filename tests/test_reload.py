@@ -1,0 +1,193 @@
+"""Segment reload: add newly-configured indexes in place.
+
+Ref: SegmentPreProcessor.java + loader/* IndexHandlers + the reload
+message path (PinotSegmentRestletResource.reloadAllSegments).
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from pinot_tpu.segment import SegmentBuilder, load_segment
+from pinot_tpu.segment.preprocessor import preprocess_segment
+from pinot_tpu.spi import DataType, FieldSpec, FieldType, Schema
+from pinot_tpu.spi.table import IndexingConfig, TableConfig
+from pinot_tpu.tools.cluster import EmbeddedCluster
+
+
+def _schema():
+    return Schema("rl", [
+        FieldSpec("city", DataType.STRING),
+        FieldSpec("doc", DataType.JSON),
+        FieldSpec("amt", DataType.LONG, FieldType.METRIC),
+        FieldSpec("v", DataType.LONG, FieldType.METRIC),
+    ])
+
+
+def _build(tmp_path, indexing=None, name="rl_0"):
+    rng = np.random.default_rng(2)
+    n = 3000
+    b = SegmentBuilder(_schema(), name,
+                       indexing_config=indexing or IndexingConfig(
+                           no_dictionary_columns=["amt"]))
+    b.build({
+        "city": np.array(["sf", "nyc", "sea"])[rng.integers(0, 3, n)],
+        "doc": np.array([json.dumps({"t": f"tag{i % 7}"})
+                         for i in range(n)]),
+        "amt": rng.integers(0, 10_000, n).astype(np.int64),
+        "v": np.ones(n, dtype=np.int64),
+    }, str(tmp_path))
+    return f"{tmp_path}/{name}"
+
+
+class TestPreprocessor:
+    def test_adds_all_missing_index_kinds(self, tmp_path):
+        seg_dir = _build(tmp_path)
+        seg = load_segment(seg_dir)
+        assert not seg.metadata.column("city").has_inverted_index
+        old_crc = seg.metadata.crc
+
+        added = preprocess_segment(seg_dir, IndexingConfig(
+            inverted_index_columns=["city"],
+            bloom_filter_columns=["city"],
+            text_index_columns=["city"],
+            json_index_columns=["doc"],
+            range_index_columns=["amt"],
+            no_dictionary_columns=["amt"]))
+        assert sorted(added) == ["amt:range", "city:bloom", "city:inverted",
+                                 "city:text", "doc:json"]
+        seg2 = load_segment(seg_dir)
+        cm = seg2.metadata.column("city")
+        assert cm.has_inverted_index and cm.has_bloom_filter \
+            and cm.has_text_index
+        assert seg2.metadata.column("doc").has_json_index
+        assert seg2.metadata.column("amt").has_range_index
+        assert seg2.metadata.crc != old_crc
+        # the added indexes actually serve reads
+        assert len(seg2.data_source("city").doc_ids_for_dict_id(0)) > 0
+        assert seg2.data_source("city").bloom_filter.might_contain("sf")
+        assert len(seg2.data_source("city").text_index
+                   .matching_ids("sf")) == 1
+        assert seg2.data_source("doc").json_index.match(
+            '"$.t"=\'tag3\'').sum() > 0
+
+    def test_idempotent(self, tmp_path):
+        seg_dir = _build(tmp_path)
+        cfg = IndexingConfig(inverted_index_columns=["city"])
+        assert preprocess_segment(seg_dir, cfg) == ["city:inverted"]
+        assert preprocess_segment(seg_dir, cfg) == []  # already built
+
+
+class TestClusterReload:
+    def test_update_config_then_reload(self, tmp_path):
+        """Add a json index to a LIVE table: update config -> reload ->
+        json_match plans via the index and answers correctly."""
+        cluster = EmbeddedCluster(num_servers=1,
+                                  data_dir=str(tmp_path / "c"))
+        try:
+            cfg = TableConfig("rl", indexing_config=IndexingConfig(
+                no_dictionary_columns=["amt"]))
+            cluster.create_table(cfg, _schema())
+            rng = np.random.default_rng(4)
+            n = 2000
+            cluster.ingest_rows("rl_OFFLINE", _schema(), {
+                "city": np.array(["sf", "nyc"])[rng.integers(0, 2, n)],
+                "doc": np.array([json.dumps({"t": f"tag{i % 5}"})
+                                 for i in range(n)]),
+                "amt": rng.integers(0, 100, n).astype(np.int64),
+                "v": np.ones(n, dtype=np.int64)}, segment_name="rl_0")
+            assert cluster.wait_for_ev_converged("rl_OFFLINE")
+
+            expected = n // 5
+            sql = ("SELECT count(*) FROM rl "
+                   "WHERE json_match(doc, '\"$.t\"=''tag2''')")
+            assert cluster.query_rows(sql)[0][0] == expected  # index-less
+
+            new_cfg = TableConfig("rl", indexing_config=IndexingConfig(
+                no_dictionary_columns=["amt"],
+                json_index_columns=["doc"],
+                inverted_index_columns=["city"]))
+            cluster.controller.update_table(new_cfg)
+            cluster.controller.reload_table("rl_OFFLINE")
+
+            # reload is synchronous over the in-process watch
+            server = cluster.servers["server_0"]
+            held = server.data_manager.get("rl_OFFLINE")
+            acq = held.acquire_segments(None)
+            try:
+                seg = acq[0].segment
+                assert seg.metadata.column("doc").has_json_index
+                assert seg.metadata.column("city").has_inverted_index
+            finally:
+                held.release_segments(acq)
+            assert cluster.query_rows(sql)[0][0] == expected  # via index
+        finally:
+            cluster.shutdown()
+
+    def test_reload_over_rest(self, tmp_path):
+        import urllib.request
+
+        from pinot_tpu.transport.rest import ControllerApi
+
+        cluster = EmbeddedCluster(num_servers=1,
+                                  data_dir=str(tmp_path / "c"))
+        api = ControllerApi(cluster.controller, port=0)
+        api.start()
+        try:
+            cluster.create_table(TableConfig("rl"), _schema())
+            cluster.ingest_rows("rl_OFFLINE", _schema(), {
+                "city": np.array(["sf"] * 10),
+                "doc": np.array(["{}"] * 10),
+                "amt": np.arange(10).astype(np.int64),
+                "v": np.ones(10, dtype=np.int64)}, segment_name="rl_0")
+            assert cluster.wait_for_ev_converged("rl_OFFLINE")
+
+            def http(method, path, body=None):
+                req = urllib.request.Request(
+                    f"http://localhost:{api.port}{path}",
+                    data=json.dumps(body).encode() if body else None,
+                    method=method,
+                    headers={"Content-Type": "application/json"})
+                with urllib.request.urlopen(req, timeout=20) as r:
+                    return json.loads(r.read().decode())
+
+            new_cfg = TableConfig("rl", indexing_config=IndexingConfig(
+                bloom_filter_columns=["city"]))
+            http("PUT", "/tables/rl_OFFLINE", new_cfg.to_dict())
+            resp = http("POST", "/segments/rl_OFFLINE/reload")
+            assert "reload" in resp["status"].lower()
+            server = cluster.servers["server_0"]
+            acq = server.data_manager.get("rl_OFFLINE").acquire_segments(None)
+            try:
+                assert acq[0].segment.metadata.column(
+                    "city").has_bloom_filter
+            finally:
+                server.data_manager.get("rl_OFFLINE").release_segments(acq)
+        finally:
+            api.stop()
+            cluster.shutdown()
+
+
+def test_put_table_rejects_name_mismatch(tmp_path):
+    import urllib.error
+    import urllib.request
+
+    from pinot_tpu.transport.rest import ControllerApi
+
+    cluster = EmbeddedCluster(num_servers=1, data_dir=str(tmp_path / "c"))
+    api = ControllerApi(cluster.controller, port=0)
+    api.start()
+    try:
+        cluster.create_table(TableConfig("rl"), _schema())
+        body = json.dumps(TableConfig("other").to_dict()).encode()
+        req = urllib.request.Request(
+            f"http://localhost:{api.port}/tables/rl_OFFLINE",
+            data=body, method="PUT",
+            headers={"Content-Type": "application/json"})
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(req, timeout=20)
+        assert exc.value.code == 400
+    finally:
+        api.stop()
+        cluster.shutdown()

@@ -110,3 +110,16 @@ class TestTextMatchQueries:
             ex.execute(compile_query(
                 "SELECT count(*) FROM txt WHERE text_match(body, '((')"),
                 [segment])
+
+
+def test_unanalyzable_query_rejected_consistently(seg):
+    """'*' has no searchable terms: QueryError on BOTH paths (regression:
+    the decay path matched every row, the indexed path crashed)."""
+    from pinot_tpu.engine.errors import QueryError
+
+    segment, _ = seg
+    ex = ServerQueryExecutor(use_device=False)
+    with pytest.raises(QueryError):
+        ex.execute(compile_query(
+            "SELECT count(*) FROM txt WHERE text_match(body, '*')"),
+            [segment])

@@ -168,6 +168,10 @@ class BrokerRequestHandler:
                 ctx, tables)
             response.result_table = table
             response.stats = stats
+            if stats.trace:
+                # ref: trace JSON attached to response metadata
+                # (ServerQueryExecutorV1Impl.java:221-226)
+                response.trace_info = {"entries": stats.trace}
             for msg in server_errors:
                 # partial result: the table stands, but the caller sees it
                 response.add_exception(SERVER_NOT_RESPONDING_ERROR, msg)
@@ -266,7 +270,9 @@ class BrokerRequestHandler:
                 continue
             try:
                 remaining = max(deadline - time.monotonic(), 0.001)
-                gathered.extend(fut.result(timeout=remaining))
+                for dt in fut.result(timeout=remaining):
+                    _tag_trace(dt, instance_id)
+                    gathered.append(dt)
                 responded.add(instance_id)
             except FutureTimeout:
                 enough.set()  # stop the straggler's pull loop
@@ -308,7 +314,9 @@ class BrokerRequestHandler:
                 continue
             try:
                 remaining = max(deadline - time.monotonic(), 0.001)
-                gathered.append(fut.result(timeout=remaining))
+                dt = fut.result(timeout=remaining)
+                _tag_trace(dt, instance_id)
+                gathered.append(dt)
                 responded.add(instance_id)
             except FutureTimeout:
                 gathered.append(DataTable.for_exception(
@@ -327,3 +335,10 @@ def _and(a: Optional[FilterNode], b: FilterNode) -> FilterNode:
     if a is None:
         return b
     return FilterNode(FilterOp.AND, children=(a, b))
+
+
+def _tag_trace(dt: DataTable, instance_id: str) -> None:
+    """Attribute trace entries to their server BEFORE the reduce flattens
+    them (the reference keys traceInfo per server)."""
+    for e in dt.stats.trace:
+        e.setdefault("instance", instance_id)

@@ -1,0 +1,131 @@
+"""Python client: broker connection + result-set model.
+
+Re-design of the reference's java client
+(``pinot-clients/pinot-java-client/.../Connection.java`` +
+``JsonAsyncHttpPinotClientTransport.java`` + ``ResultSetGroup``): a
+connection holds one or more broker URLs (round-robin, the static
+broker-selector mode; ZK-dynamic selection maps to watching the cluster
+state store), posts SQL to ``POST /query/sql``, and wraps the JSON
+response in the same ResultSetGroup/ResultSet accessors the java client
+exposes — so reference client code translates line for line::
+
+    conn = connect(["localhost:8099"])
+    results = conn.execute("SELECT count(*) FROM baseballStats")
+    results.result_set.get_long(0, 0)
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import urllib.request
+
+from typing import Any, Dict, List, Optional, Sequence
+
+
+class PinotClientError(Exception):
+    """Transport failures and server-side query exceptions
+    (ref: PinotClientException)."""
+
+
+class ResultSet:
+    """One result table (ref: ResultTableResultSet)."""
+
+    def __init__(self, result_table: Dict[str, Any]):
+        schema = result_table.get("dataSchema", {})
+        self.column_names: List[str] = schema.get("columnNames", [])
+        self.column_types: List[str] = schema.get("columnDataTypes", [])
+        self.rows: List[List[Any]] = result_table.get("rows", [])
+
+    @property
+    def row_count(self) -> int:
+        return len(self.rows)
+
+    @property
+    def column_count(self) -> int:
+        return len(self.column_names)
+
+    def get_value(self, row: int, col: int) -> Any:
+        return self.rows[row][col]
+
+    def get_int(self, row: int, col: int) -> int:
+        return int(self.rows[row][col])
+
+    get_long = get_int
+
+    def get_double(self, row: int, col: int) -> float:
+        return float(self.rows[row][col])
+
+    def get_string(self, row: int, col: int) -> str:
+        return str(self.rows[row][col])
+
+    def __iter__(self):
+        return iter(self.rows)
+
+
+class ResultSetGroup:
+    """The parsed broker response (ref: ResultSetGroup.java)."""
+
+    def __init__(self, response: Dict[str, Any]):
+        self.raw = response
+        rt = response.get("resultTable")
+        self.result_set: Optional[ResultSet] = (
+            ResultSet(rt) if rt is not None else None)
+        self.exceptions: List[Dict[str, Any]] = \
+            response.get("exceptions", [])
+
+    @property
+    def result_set_count(self) -> int:
+        return 1 if self.result_set is not None else 0
+
+    def get_result_set(self, index: int = 0) -> ResultSet:
+        if index != 0 or self.result_set is None:
+            raise IndexError(f"no result set {index}")
+        return self.result_set
+
+    # query execution stats (ref: ExecutionStats)
+    @property
+    def stats(self) -> Dict[str, Any]:
+        return {k: v for k, v in self.raw.items()
+                if k not in ("resultTable", "exceptions")}
+
+
+class Connection:
+    """Ref: Connection.java — execute() round-robins the broker list."""
+
+    def __init__(self, broker_urls: Sequence[str], timeout_s: float = 60.0,
+                 fail_on_exceptions: bool = True):
+        if not broker_urls:
+            raise ValueError("at least one broker url is required")
+        self._brokers = [self._normalize(u) for u in broker_urls]
+        self._rr = itertools.cycle(range(len(self._brokers)))
+        self.timeout_s = timeout_s
+        self.fail_on_exceptions = fail_on_exceptions
+
+    @staticmethod
+    def _normalize(url: str) -> str:
+        if not url.startswith(("http://", "https://")):
+            url = "http://" + url
+        return url.rstrip("/")
+
+    def execute(self, sql: str) -> ResultSetGroup:
+        broker = self._brokers[next(self._rr)]
+        body = json.dumps({"sql": sql}).encode("utf-8")
+        req = urllib.request.Request(
+            f"{broker}/query/sql", data=body,
+            headers={"Content-Type": "application/json"})
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout_s) as r:
+                payload = json.loads(r.read().decode("utf-8"))
+        except OSError as e:
+            raise PinotClientError(f"broker {broker} unreachable: {e}")
+        group = ResultSetGroup(payload)
+        if self.fail_on_exceptions and group.exceptions:
+            raise PinotClientError(
+                f"query failed: {group.exceptions[:3]}")
+        return group
+
+
+def connect(broker_urls: Sequence[str], **kw) -> Connection:
+    """Ref: ConnectionFactory.fromHostList."""
+    return Connection(broker_urls, **kw)

@@ -113,6 +113,7 @@ class DataTable:
             total_docs=st.get("totalDocs", 0),
             num_groups_limit_reached=st.get("numGroupsLimitReached", False),
             phase_ms=st.get("phaseTimesMs", {}),
+            trace=st.get("trace", []),
         )
         return cls(ResponseType(d["type"]), d["payload"], stats,
                    d.get("exceptions", []))

@@ -201,19 +201,33 @@ class ServerQueryExecutor:
     def _segment_aggregation(self, ctx: QueryContext, aggs: List[AggDef],
                              seg: ImmutableSegment,
                              stats: QueryStats) -> AggResult:
+        import time as _time
+
+        trace_on = ctx.trace_enabled
+        t0 = _time.perf_counter() if trace_on else 0.0
+
+        def done(result, path):
+            if trace_on:
+                stats.add_trace("SegmentAggregate",
+                                (_time.perf_counter() - t0) * 1e3,
+                                segment=seg.segment_name, path=path)
+            return result
+
         fast = self._metadata_fast_path(ctx, aggs, seg, stats)
         if fast is not None:
-            return fast
+            return done(fast, "metadata")
         st = self._try_star_tree(ctx, aggs, seg, stats)
         if st is not None:
-            return st
+            return done(st, "startree")
         if self.use_device:
             try:
                 plan = plan_segment(ctx, seg)
-                return self._run_device_scalar(plan, seg, stats)
+                return done(self._run_device_scalar(plan, seg, stats),
+                            "device")
             except PlanError:
                 pass
-        return host_engine.host_aggregate_segment(ctx, aggs, seg, stats)
+        return done(host_engine.host_aggregate_segment(ctx, aggs, seg,
+                                                       stats), "host")
 
     def _star_tree_pick(self, ctx: QueryContext, aggs: List[AggDef],
                         seg: ImmutableSegment):
@@ -286,16 +300,30 @@ class ServerQueryExecutor:
     def _segment_group_by(self, ctx: QueryContext, aggs: List[AggDef],
                           seg: ImmutableSegment,
                           stats: QueryStats) -> GroupByResult:
+        import time as _time
+
+        trace_on = ctx.trace_enabled
+        t0 = _time.perf_counter() if trace_on else 0.0
+
+        def done(result, path):
+            if trace_on:
+                stats.add_trace("SegmentGroupBy",
+                                (_time.perf_counter() - t0) * 1e3,
+                                segment=seg.segment_name, path=path)
+            return result
+
         st = self._try_star_tree(ctx, aggs, seg, stats)
         if st is not None:
-            return st
+            return done(st, "startree")
         if self.use_device:
             try:
                 plan = plan_segment(ctx, seg)
-                return self._run_device_grouped(plan, seg, stats)
+                return done(self._run_device_grouped(plan, seg, stats),
+                            "device")
             except PlanError:
                 pass
-        return host_engine.host_group_by_segment(ctx, aggs, seg, stats)
+        return done(host_engine.host_group_by_segment(ctx, aggs, seg,
+                                                      stats), "host")
 
     def _run_device_grouped(self, plan: SegmentPlan, seg: ImmutableSegment,
                             stats: QueryStats) -> GroupByResult:

@@ -73,9 +73,17 @@ class QueryStats:
     # ServerQueryExecutorV1Impl.java:122,276,297,303); summed across
     # servers at reduce
     phase_ms: Dict[str, float] = field(default_factory=dict)
+    # request-scoped trace entries, populated only when the query sets
+    # trace=true (ref: TraceContext.java:46 — operator-level timings
+    # attached to the response metadata)
+    trace: List[Dict[str, Any]] = field(default_factory=list)
 
     def add_phase_ms(self, phase: str, ms: float) -> None:
         self.phase_ms[phase] = self.phase_ms.get(phase, 0.0) + ms
+
+    def add_trace(self, operator: str, ms: float, **detail: Any) -> None:
+        self.trace.append({"operator": operator, "ms": round(ms, 3),
+                           **detail})
 
     def merge(self, other: "QueryStats") -> None:
         self.num_segments_queried += other.num_segments_queried
@@ -87,6 +95,7 @@ class QueryStats:
         self.num_groups_limit_reached |= other.num_groups_limit_reached
         for phase, ms in other.phase_ms.items():
             self.add_phase_ms(phase, ms)
+        self.trace.extend(other.trace)
 
     def to_dict(self) -> Dict[str, Any]:
         return {
@@ -99,6 +108,7 @@ class QueryStats:
             "numGroupsLimitReached": self.num_groups_limit_reached,
             "phaseTimesMs": {k: round(v, 3)
                              for k, v in self.phase_ms.items()},
+            **({"trace": self.trace} if self.trace else {}),
         }
 
 

@@ -10,6 +10,8 @@ reference's plan-node selection (ref: InstancePlanMakerImplV2.java:227).
 
 from __future__ import annotations
 
+import time
+
 from typing import Dict, List, Optional, Tuple
 
 import jax.numpy as jnp
@@ -146,6 +148,8 @@ class ShardedQueryExecutor(ServerQueryExecutor):
         plan, call_fn, is_pallas = cached
         num_docs = self._device_num_docs(batch, S)
 
+        trace_on = ctx.trace_enabled
+        t0 = time.perf_counter() if trace_on else 0.0
         try:
             packed = call_fn(num_docs)
         except (PlanError, ValueError):
@@ -167,9 +171,17 @@ class ShardedQueryExecutor(ServerQueryExecutor):
             self._query_cache.pop(qkey, None)
             call_fn = self._build_jnp_call(plan, batch, S)
             self._query_cache[qkey] = (plan, call_fn, False)
+            is_pallas = False  # the trace must name the kernel that RAN
             packed = call_fn(num_docs)
         # ONE D2H fetch decodes the entire query result
         out = unpack_outputs(packed, plan.spec, num_seg=S)
+        if trace_on:
+            stats.add_trace(
+                "ShardedCombine", (time.perf_counter() - t0) * 1e3,
+                kernel="pallas" if is_pallas else "jnp",
+                segments=batch.num_segments,
+                mesh=f"{self.mesh.shape[SEG_AXIS]}x"
+                     f"{self.mesh.shape[DOC_AXIS]}")
 
         stats.num_segments_processed += batch.num_segments
         stats.total_docs += batch.num_docs

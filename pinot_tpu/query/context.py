@@ -137,6 +137,12 @@ class QueryContext:
     def timeout_ms(self, default: int) -> int:
         return int(self.options.get("timeoutMs", default))
 
+    @property
+    def trace_enabled(self) -> bool:
+        """OPTION(trace=true) — request-scoped tracing
+        (ref: trace flag at BaseBrokerRequestHandler)."""
+        return self.options.get("trace", "").lower() == "true"
+
     def __str__(self) -> str:
         return (f"QueryContext(table={self.table_name}, "
                 f"select={[str(e) for e in self.select_expressions]}, "

@@ -1,0 +1,103 @@
+"""Python client library + request-scoped tracing.
+
+Ref: pinot-java-client Connection/ResultSetGroup (client),
+TraceContext.java:46 + response traceInfo (tracing).
+"""
+
+import numpy as np
+import pytest
+
+from pinot_tpu.client import PinotClientError, connect
+from pinot_tpu.spi import DataType, FieldSpec, FieldType, Schema
+from pinot_tpu.spi.table import TableConfig
+from pinot_tpu.tools.cluster import EmbeddedCluster
+from pinot_tpu.transport.rest import BrokerApi
+
+N = 3000
+
+
+@pytest.fixture(scope="module")
+def cluster(tmp_path_factory):
+    c = EmbeddedCluster(num_servers=2, data_dir=str(
+        tmp_path_factory.mktemp("cl")))
+    schema = Schema("ct", [
+        FieldSpec("city", DataType.STRING),
+        FieldSpec("v", DataType.LONG, FieldType.METRIC)])
+    c.create_table(TableConfig("ct"), schema)
+    rng = np.random.default_rng(5)
+    for i in range(2):
+        c.ingest_rows("ct_OFFLINE", schema, {
+            "city": np.array(["sf", "nyc"])[rng.integers(0, 2, N)],
+            "v": rng.integers(0, 50, N).astype(np.int64)},
+            segment_name=f"ct_{i}")
+    assert c.wait_for_ev_converged("ct_OFFLINE")
+    api = BrokerApi(c.broker, port=0)
+    api.start()
+    yield c, f"localhost:{api.port}"
+    api.stop()
+    c.shutdown()
+
+
+class TestClient:
+    def test_connect_and_query(self, cluster):
+        _, broker = cluster
+        conn = connect([broker])
+        results = conn.execute("SELECT count(*), sum(v) FROM ct")
+        rs = results.get_result_set()
+        assert rs.get_long(0, 0) == 2 * N
+        assert rs.column_names == ["count(*)", "sum(v)"]
+        assert results.stats["numServersQueried"] >= 1
+
+    def test_group_by_iteration(self, cluster):
+        _, broker = cluster
+        conn = connect([broker])
+        rs = conn.execute(
+            "SELECT city, count(*) FROM ct GROUP BY city ORDER BY city"
+        ).result_set
+        cities = [row[0] for row in rs]
+        assert cities == ["nyc", "sf"]
+        assert rs.get_string(1, 0) == "sf"
+
+    def test_exceptions_raise(self, cluster):
+        _, broker = cluster
+        conn = connect([broker])
+        with pytest.raises(PinotClientError):
+            conn.execute("SELECT count(*) FROM nope")
+        lax = connect([broker], fail_on_exceptions=False)
+        group = lax.execute("SELECT count(*) FROM nope")
+        assert group.exceptions
+
+    def test_unreachable_broker(self):
+        conn = connect(["localhost:1"], timeout_s=2.0)
+        with pytest.raises(PinotClientError, match="unreachable"):
+            conn.execute("SELECT 1 FROM t")
+
+
+class TestTracing:
+    def test_trace_option_attaches_entries(self, cluster):
+        c, broker = cluster
+        conn = connect([broker])
+        results = conn.execute(
+            "SELECT city, sum(v) FROM ct GROUP BY city "
+            "OPTION(trace=true)")
+        trace = results.raw.get("traceInfo", {}).get("entries", [])
+        assert trace, results.raw
+        assert all("operator" in e and "ms" in e for e in trace)
+        ops = {e["operator"] for e in trace}
+        assert ops & {"ShardedCombine", "SegmentGroupBy"}
+
+    def test_no_trace_by_default(self, cluster):
+        _, broker = cluster
+        conn = connect([broker])
+        results = conn.execute("SELECT count(*) FROM ct")
+        assert "traceInfo" not in results.raw
+
+
+def test_trace_entries_carry_instance(cluster):
+    c, broker = cluster
+    conn = connect([broker])
+    results = conn.execute(
+        "SELECT count(*) FROM ct OPTION(trace=true)")
+    entries = results.raw["traceInfo"]["entries"]
+    assert all("instance" in e for e in entries), entries
+    assert {e["instance"] for e in entries} <= {"server_0", "server_1"}

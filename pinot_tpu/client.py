@@ -117,8 +117,20 @@ class Connection:
         try:
             with urllib.request.urlopen(req, timeout=self.timeout_s) as r:
                 payload = json.loads(r.read().decode("utf-8"))
+        except urllib.error.HTTPError as e:
+            # reached the broker, got an error status: surface the body
+            detail = ""
+            try:
+                detail = e.read().decode("utf-8", "replace")[:500]
+            except OSError:
+                pass
+            raise PinotClientError(
+                f"broker {broker} returned {e.code}: {detail}") from e
         except OSError as e:
-            raise PinotClientError(f"broker {broker} unreachable: {e}")
+            raise PinotClientError(f"broker {broker} unreachable: {e}") from e
+        except ValueError as e:  # JSONDecodeError: 200 with a non-JSON body
+            raise PinotClientError(
+                f"broker {broker} returned a non-JSON response: {e}") from e
         group = ResultSetGroup(payload)
         if self.fail_on_exceptions and group.exceptions:
             raise PinotClientError(

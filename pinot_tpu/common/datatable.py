@@ -8,19 +8,30 @@ schema, per-server execution stats, and exceptions. Values round-trip
 through a tagged encoding covering the intermediate-state types (tuples for
 AVG/MINMAXRANGE, frozensets for DISTINCTCOUNT, bytes, non-finite floats).
 
-JSON framing keeps the format debuggable and language-neutral; bulk
-selection payloads can later swap to Arrow IPC without changing consumers.
+Framing is binary columnar (magic ``PDT3``): header + stats/exceptions
+sections + a per-type payload where selection/distinct/group-by data ships
+as typed columns — numeric columns as raw little-endian buffers, string
+columns as offset+heap pairs, heterogeneous state columns through the
+tagged object serde (common/serde.py, the ObjectSerDeUtils analogue).
+``from_bytes`` sniffs the magic and still accepts the legacy JSON framing,
+so mixed-version servers interoperate.
 """
 
 from __future__ import annotations
 
 import enum
 import json
+import struct
 
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional
 
+import numpy as np
+
+from pinot_tpu.common import serde
 from pinot_tpu.engine.results import DataSchema, QueryStats
+
+MAGIC = b"PDT3"
 
 
 class ResponseType(enum.Enum):
@@ -28,6 +39,17 @@ class ResponseType(enum.Enum):
     GROUP_BY = "GROUP_BY"
     SELECTION = "SELECTION"
     DISTINCT = "DISTINCT"
+
+
+# stable wire ordinals: never renumber, append only (declaration order must
+# not leak into the binary framing or mixed-version decode breaks)
+_WIRE_ORDINAL = {
+    ResponseType.AGGREGATION: 0,
+    ResponseType.GROUP_BY: 1,
+    ResponseType.SELECTION: 2,
+    ResponseType.DISTINCT: 3,
+}
+_WIRE_TYPE = {v: k for k, v in _WIRE_ORDINAL.items()}
 
 
 # --------------------------------------------------------------------------
@@ -73,6 +95,88 @@ def decode_value(v: Any) -> Any:
 
 
 # --------------------------------------------------------------------------
+# columnar sections (binary framing)
+# --------------------------------------------------------------------------
+
+_COL_I64 = 0
+_COL_F64 = 1
+_COL_STR = 2
+_COL_OBJ = 3
+
+
+def _encode_column(out: bytearray, values: List[Any]) -> None:
+    """One typed column: numeric homogeneity -> raw buffers, strings ->
+    offsets+heap, anything else (tuples/sets/bytes/None/mixed) -> tagged
+    objects. The type sniff treats numpy scalars as their python values."""
+    vals = [v.item() if hasattr(v, "item") else v for v in values]
+    if vals and all(type(v) is int for v in vals) \
+            and all(-(1 << 63) <= v < (1 << 63) for v in vals):
+        out.append(_COL_I64)
+        out.extend(np.asarray(vals, dtype="<i8").tobytes())
+        return
+    if vals and all(isinstance(v, float) for v in vals):
+        out.append(_COL_F64)
+        out.extend(np.asarray(vals, dtype="<f8").tobytes())
+        return
+    if vals and all(type(v) is str for v in vals):
+        parts = [v.encode("utf-8") for v in vals]
+        heap = b"".join(parts)
+        offsets = np.cumsum([0] + [len(p) for p in parts]).astype("<u4")
+        out.append(_COL_STR)
+        out.extend(struct.pack("<I", len(heap)))
+        out.extend(heap)
+        out.extend(offsets.tobytes())
+        return
+    out.append(_COL_OBJ)
+    for v in vals:
+        serde.pack_obj(v, out)
+
+
+def _decode_column(buf: bytes, off: int, n: int) -> tuple:
+    """-> (values, new offset, json_safe). ``json_safe`` means every value
+    already satisfies the payload's JSON-shape invariant, so the caller can
+    skip the per-cell ``encode_value`` pass (i64/str always; f64 unless a
+    non-finite slipped in; obj never — tuples/sets/bytes need wrapping)."""
+    kind = buf[off]
+    off += 1
+    if kind == _COL_I64:
+        a = np.frombuffer(buf, dtype="<i8", count=n, offset=off)
+        return [int(v) for v in a], off + 8 * n, True
+    if kind == _COL_F64:
+        a = np.frombuffer(buf, dtype="<f8", count=n, offset=off)
+        return ([float(v) for v in a], off + 8 * n,
+                bool(np.isfinite(a).all()))
+    if kind == _COL_STR:
+        (heap_len,) = struct.unpack_from("<I", buf, off)
+        off += 4
+        raw = buf[off:off + heap_len]
+        off += heap_len
+        offsets = np.frombuffer(buf, dtype="<u4", count=n + 1, offset=off)
+        off += 4 * (n + 1)
+        vals = [raw[offsets[i]:offsets[i + 1]].decode("utf-8")
+                for i in range(n)]
+        return vals, off, True
+    if kind == _COL_OBJ:
+        vals = []
+        for _ in range(n):
+            v, off = serde.unpack_obj(buf, off)
+            vals.append(v)
+        return vals, off, False
+    raise ValueError(f"unknown column kind {kind}")
+
+
+def _put_section(out: bytearray, raw: bytes) -> None:
+    out.extend(struct.pack("<I", len(raw)))
+    out.extend(raw)
+
+
+def _get_section(buf: bytes, off: int) -> tuple:
+    (n,) = struct.unpack_from("<I", buf, off)
+    off += 4
+    return buf[off:off + n], off + n
+
+
+# --------------------------------------------------------------------------
 # the DataTable
 # --------------------------------------------------------------------------
 
@@ -93,18 +197,110 @@ class DataTable:
 
     # -- framing -------------------------------------------------------------
     def to_bytes(self) -> bytes:
-        return json.dumps({
-            "type": self.response_type.value,
-            "payload": self.payload,
-            "stats": self.stats.to_dict(),
-            "exceptions": self.exceptions,
-        }, separators=(",", ":")).encode("utf-8")
+        """Binary columnar framing (see module doc). Layout:
+        magic | u8 type-ordinal | stats json section | exceptions json
+        section | per-type payload."""
+        out = bytearray(MAGIC)
+        out.append(_WIRE_ORDINAL[self.response_type])
+        _put_section(out, json.dumps(
+            self.stats.to_dict(), separators=(",", ":")).encode("utf-8"))
+        _put_section(out, json.dumps(
+            self.exceptions, separators=(",", ":")).encode("utf-8"))
+        t = self.response_type
+        if t is ResponseType.AGGREGATION:
+            states = [decode_value(s) for s in self.payload["states"]] \
+                if self.payload else []
+            serde.pack_obj(len(states), out)
+            for s in states:
+                serde.pack_obj(s, out)
+        elif t is ResponseType.GROUP_BY:
+            groups = self.group_by_groups() if self.payload else {}
+            _put_section(out, json.dumps(
+                self.payload.get("schema_types", {}),
+                separators=(",", ":")).encode("utf-8"))
+            keys = list(groups.keys())
+            vals = list(groups.values())
+            n = len(keys)
+            arity = len(keys[0]) if keys else 0
+            n_aggs = len(vals[0]) if vals else 0
+            out.extend(struct.pack("<IHH", n, arity, n_aggs))
+            for k in range(arity):
+                _encode_column(out, [key[k] for key in keys])
+            for a in range(n_aggs):
+                _encode_column(out, [v[a] for v in vals])
+        else:  # SELECTION / DISTINCT
+            rows = self.rows() if self.payload else []
+            schema = self.payload.get("schema", {"columnNames": [],
+                                                 "columnDataTypes": []}) \
+                if self.payload else {"columnNames": [], "columnDataTypes": []}
+            _put_section(out, json.dumps(
+                schema, separators=(",", ":")).encode("utf-8"))
+            n_cols = len(schema["columnNames"])
+            out.extend(struct.pack("<IHH", len(rows), n_cols,
+                                   self.num_hidden))
+            for c in range(n_cols):
+                _encode_column(out, [r[c] for r in rows])
+        return bytes(out)
 
     @classmethod
     def from_bytes(cls, raw: bytes) -> "DataTable":
-        d = json.loads(raw.decode("utf-8"))
-        st = d.get("stats", {})
-        stats = QueryStats(
+        if not raw.startswith(MAGIC):
+            return cls._from_json_bytes(raw)
+        off = len(MAGIC)
+        rtype = _WIRE_TYPE[raw[off]]
+        off += 1
+        stats_raw, off = _get_section(raw, off)
+        exc_raw, off = _get_section(raw, off)
+        stats = cls._stats_from_dict(json.loads(stats_raw.decode("utf-8")))
+        exceptions = json.loads(exc_raw.decode("utf-8"))
+        if rtype is ResponseType.AGGREGATION:
+            n, off = serde.unpack_obj(raw, off)
+            states = []
+            for _ in range(n):
+                s, off = serde.unpack_obj(raw, off)
+                states.append(s)
+            payload = {"states": [encode_value(s) for s in states]}
+        elif rtype is ResponseType.GROUP_BY:
+            st_raw, off = _get_section(raw, off)
+            schema_types = json.loads(st_raw.decode("utf-8"))
+            n, arity, n_aggs = struct.unpack_from("<IHH", raw, off)
+            off += 8
+            key_cols = []
+            for _ in range(arity):
+                col, off, _safe = _decode_column(raw, off, n)
+                key_cols.append(col)
+            agg_cols = []
+            for _ in range(n_aggs):
+                col, off, safe = _decode_column(raw, off, n)
+                agg_cols.append(col if safe
+                                else [encode_value(v) for v in col])
+            payload = {
+                "groups": [
+                    [encode_value(tuple(kc[i] for kc in key_cols)),
+                     [ac[i] for ac in agg_cols]]
+                    for i in range(n)],
+                "schema_types": schema_types,
+            }
+        else:
+            schema_raw, off = _get_section(raw, off)
+            schema = json.loads(schema_raw.decode("utf-8"))
+            n_rows, n_cols, num_hidden = struct.unpack_from("<IHH", raw, off)
+            off += 8
+            cols = []
+            for _ in range(n_cols):
+                col, off, safe = _decode_column(raw, off, n_rows)
+                cols.append(col if safe
+                            else [encode_value(v) for v in col])
+            rows = [[cols[c][i] for c in range(n_cols)]
+                    for i in range(n_rows)]
+            payload = {"schema": schema, "rows": rows}
+            if rtype is ResponseType.SELECTION:
+                payload["num_hidden"] = num_hidden
+        return cls(rtype, payload, stats, exceptions)
+
+    @staticmethod
+    def _stats_from_dict(st: Dict[str, Any]) -> QueryStats:
+        return QueryStats(
             num_segments_queried=st.get("numSegmentsQueried", 0),
             num_segments_processed=st.get("numSegmentsProcessed", 0),
             num_segments_matched=st.get("numSegmentsMatched", 0),
@@ -115,8 +311,23 @@ class DataTable:
             phase_ms=st.get("phaseTimesMs", {}),
             trace=st.get("trace", []),
         )
-        return cls(ResponseType(d["type"]), d["payload"], stats,
+
+    @classmethod
+    def _from_json_bytes(cls, raw: bytes) -> "DataTable":
+        """Legacy JSON framing (kept for mixed-version interop + debug)."""
+        d = json.loads(raw.decode("utf-8"))
+        return cls(ResponseType(d["type"]), d["payload"],
+                   cls._stats_from_dict(d.get("stats", {})),
                    d.get("exceptions", []))
+
+    def to_json_bytes(self) -> bytes:
+        """The debuggable JSON framing (not the serving default)."""
+        return json.dumps({
+            "type": self.response_type.value,
+            "payload": self.payload,
+            "stats": self.stats.to_dict(),
+            "exceptions": self.exceptions,
+        }, separators=(",", ":")).encode("utf-8")
 
     # -- typed constructors --------------------------------------------------
     @classmethod

@@ -10,6 +10,8 @@ merges per-segment partials and reduces to a ResultTable.
 
 from __future__ import annotations
 
+import time
+
 from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
@@ -32,6 +34,22 @@ from pinot_tpu.query.context import QueryContext
 from pinot_tpu.query.expressions import Identifier
 from pinot_tpu.segment.immutable import ImmutableSegment
 from pinot_tpu.spi.config import CommonConstants
+
+
+def _segment_tracer(ctx: QueryContext, stats: QueryStats, op: str, seg):
+    """``done(result, path)`` pass-through that records a per-segment trace
+    entry when the request carries trace=true (ref: TraceContext.java:46 —
+    operator timings attach to the request's trace tree)."""
+    if not ctx.trace_enabled:
+        return lambda result, path: result
+    t0 = time.perf_counter()
+
+    def done(result, path):
+        stats.add_trace(op, (time.perf_counter() - t0) * 1e3,
+                        segment=seg.segment_name, path=path)
+        return result
+
+    return done
 
 
 class ServerQueryExecutor:
@@ -201,17 +219,7 @@ class ServerQueryExecutor:
     def _segment_aggregation(self, ctx: QueryContext, aggs: List[AggDef],
                              seg: ImmutableSegment,
                              stats: QueryStats) -> AggResult:
-        import time as _time
-
-        trace_on = ctx.trace_enabled
-        t0 = _time.perf_counter() if trace_on else 0.0
-
-        def done(result, path):
-            if trace_on:
-                stats.add_trace("SegmentAggregate",
-                                (_time.perf_counter() - t0) * 1e3,
-                                segment=seg.segment_name, path=path)
-            return result
+        done = _segment_tracer(ctx, stats, "SegmentAggregate", seg)
 
         fast = self._metadata_fast_path(ctx, aggs, seg, stats)
         if fast is not None:
@@ -300,17 +308,7 @@ class ServerQueryExecutor:
     def _segment_group_by(self, ctx: QueryContext, aggs: List[AggDef],
                           seg: ImmutableSegment,
                           stats: QueryStats) -> GroupByResult:
-        import time as _time
-
-        trace_on = ctx.trace_enabled
-        t0 = _time.perf_counter() if trace_on else 0.0
-
-        def done(result, path):
-            if trace_on:
-                stats.add_trace("SegmentGroupBy",
-                                (_time.perf_counter() - t0) * 1e3,
-                                segment=seg.segment_name, path=path)
-            return result
+        done = _segment_tracer(ctx, stats, "SegmentGroupBy", seg)
 
         st = self._try_star_tree(ctx, aggs, seg, stats)
         if st is not None:

@@ -23,6 +23,7 @@ from pinot_tpu.engine.errors import QueryError, UnsupportedQueryError
 from pinot_tpu.query.expressions import Expr, Function, Identifier, Literal
 from pinot_tpu.utils.hll import HyperLogLog
 from pinot_tpu.utils.tdigest import TDigest
+from pinot_tpu.utils.theta import ThetaSketch
 
 POS_INF = float("inf")
 NEG_INF = float("-inf")
@@ -74,6 +75,11 @@ _EMPTY: Dict[str, Any] = {
     "mode": dict,
     "percentile": tuple,
     "percentiletdigest": lambda: TDigest().serialize(),
+    "distinctcountthetasketch": lambda: ThetaSketch().serialize(),
+    "idset": frozenset(),
+    # (time, value) of the chosen row, or None when no row matched yet
+    "lastwithtime": None,
+    "firstwithtime": None,
 }
 
 _MERGE: Dict[str, Callable[[Any, Any], Any]] = {
@@ -90,6 +96,16 @@ _MERGE: Dict[str, Callable[[Any, Any], Any]] = {
     "percentile": lambda a, b: tuple(a) + tuple(b),
     "percentiletdigest": lambda a, b: TDigest.deserialize(a).merge(
         TDigest.deserialize(b)).serialize(),
+    "distinctcountthetasketch": lambda a, b: ThetaSketch.deserialize(a).merge(
+        ThetaSketch.deserialize(b)).serialize(),
+    "idset": lambda a, b: frozenset(a) | frozenset(b),
+    # deterministic across merge orders: lexicographic (time, value) extreme
+    # (the reference keeps the row with the largest/smallest time; ties are
+    # merge-order-dependent there — here the value breaks the tie)
+    "lastwithtime": lambda a, b: b if a is None else a if b is None
+    else max(a, b),
+    "firstwithtime": lambda a, b: b if a is None else a if b is None
+    else min(a, b),
 }
 
 
@@ -114,6 +130,30 @@ def _final_percentile(d: AggDef, s) -> float:
     return float(vals[min(idx, vals.size - 1)])
 
 
+def _final_idset(d: AggDef, s) -> str:
+    """Serialized id set, base64 (ref: IdSetAggregationFunction -> the
+    IN_ID_SET / IN_PARTITIONED_SUBQUERY filter consumes this string)."""
+    import base64
+
+    from pinot_tpu.common import serde
+
+    return base64.b64encode(serde.dumps(
+        sorted(s, key=lambda v: (str(type(v)), v)))).decode("ascii")
+
+
+def _final_withtime(d: AggDef, s):
+    if s is None:  # no matching rows
+        return None if d.result_type == "STRING" else NEG_INF
+    v = s[1]
+    if d.result_type in ("INT", "LONG"):
+        return int(v)
+    if d.result_type in ("FLOAT", "DOUBLE"):
+        return float(v)
+    if d.result_type == "BOOLEAN":
+        return bool(v)
+    return v if isinstance(v, str) else str(v)
+
+
 _FINAL: Dict[str, Callable[[AggDef, Any], Any]] = {
     "count": lambda d, s: int(s),
     "sum": lambda d, s: float(s),
@@ -129,6 +169,12 @@ _FINAL: Dict[str, Callable[[AggDef, Any], Any]] = {
     "percentile": _final_percentile,
     "percentiletdigest": lambda d, s: TDigest.deserialize(s).quantile(
         d.percentile / 100.0),
+    "distinctcountthetasketch": lambda d, s: (
+        s.hex() if d.name.startswith("distinctcountrawthetasketch")
+        else int(round(ThetaSketch.deserialize(s).estimate()))),
+    "idset": _final_idset,
+    "lastwithtime": lambda d, s: _final_withtime(d, s),
+    "firstwithtime": lambda d, s: _final_withtime(d, s),
 }
 
 
@@ -222,6 +268,49 @@ def _host_tdigest(d: AggDef, values, mask):
     return TDigest.of(_flat_filtered(d, values, mask)).serialize()
 
 
+def _raw_filtered(d: AggDef, values, mask) -> list:
+    """Filtered values kept raw (strings included), MV flattened."""
+    if d.mv:
+        out = []
+        for v, m in zip(values, mask):
+            if m:
+                out.extend(v.tolist() if hasattr(v, "tolist") else list(v))
+        return out
+    if isinstance(values, list):
+        return [v for v, m in zip(values, mask) if m]
+    vals = np.asarray(values)[mask]
+    return vals.tolist()
+
+
+def _host_theta(d: AggDef, values, mask):
+    return ThetaSketch.of(_raw_filtered(d, values, mask)).serialize()
+
+
+def _host_idset(d: AggDef, values, mask):
+    vals = _raw_filtered(d, values, mask)
+    return frozenset(v.item() if hasattr(v, "item") else v for v in vals)
+
+
+def _host_withtime(d: AggDef, values, mask):
+    """``values`` is (value array/list, time array): pick the row with the
+    extreme time (ref: LastWithTimeAggregationFunction /
+    FirstWithTimeAggregationFunction)."""
+    vals, times = values
+    idx = np.nonzero(np.asarray(mask))[0]
+    if idx.size == 0:
+        return None
+    t = np.asarray(times)[idx]  # native dtype: float times must not truncate
+    pos = int(np.argmax(t) if d.base == "lastwithtime" else np.argmin(t))
+    chosen_time = t[pos].item() if hasattr(t[pos], "item") else t[pos]
+    # deterministic tie-break on value (matches the merge algebra)
+    tied = idx[t == t[pos]]
+    pick = lambda i: vals[i] if isinstance(vals, list) else vals[int(i)]
+    cand = [pick(i) for i in tied]
+    cand = [c.item() if hasattr(c, "item") else c for c in cand]
+    v = max(cand) if d.base == "lastwithtime" else min(cand)
+    return (chosen_time, v)
+
+
 _HOST: Dict[str, Callable] = {
     "count": _host_count,
     "sum": _host_sum,
@@ -234,6 +323,10 @@ _HOST: Dict[str, Callable] = {
     "mode": _host_mode,
     "percentile": _host_percentile,
     "percentiletdigest": _host_tdigest,
+    "distinctcountthetasketch": _host_theta,
+    "idset": _host_idset,
+    "lastwithtime": _host_withtime,
+    "firstwithtime": _host_withtime,
 }
 
 
@@ -253,6 +346,10 @@ _RESULT_TYPE = {
     "mode": "DOUBLE",
     "percentile": "DOUBLE",
     "percentiletdigest": "DOUBLE",
+    "distinctcountthetasketch": "LONG",
+    "idset": "STRING",
+    "lastwithtime": "DOUBLE",  # overridden by the dataType argument
+    "firstwithtime": "DOUBLE",
 }
 
 # families with device kernels (kernels.py); others run on the host path
@@ -298,13 +395,31 @@ def resolve_agg(fn: Function) -> AggDef:
         # family here; percentiletdigest is the approximate sketch
         "percentile": "percentile", "percentileest": "percentile",
         "percentiletdigest": "percentiletdigest",
+        "distinctcountthetasketch": "distinctcountthetasketch",
+        "distinctcountrawthetasketch": "distinctcountthetasketch",
+        "idset": "idset",
+        "lastwithtime": "lastwithtime",
+        "firstwithtime": "firstwithtime",
     }.get(base_name)
     if family is None:
         raise UnsupportedQueryError(f"aggregation function {name!r} not supported")
 
     result_type = _RESULT_TYPE[family]
-    if base_name == "distinctcountrawhll":
+    if base_name in ("distinctcountrawhll", "distinctcountrawthetasketch"):
         result_type = "STRING"
+    if family in ("lastwithtime", "firstwithtime"):
+        # 3rd argument is the value's data type label
+        # (ref: LastWithTimeAggregationFunction 3-arg form)
+        if len(fn.args) != 3:
+            raise QueryError(
+                f"{name} requires (valueColumn, timeColumn, 'dataType')")
+        dt = fn.args[2]
+        if not isinstance(dt, Literal) or not isinstance(dt.value, str):
+            raise QueryError(f"{name}: dataType argument must be a string")
+        result_type = dt.value.upper()
+        if result_type not in ("INT", "LONG", "FLOAT", "DOUBLE", "STRING",
+                               "BOOLEAN"):
+            raise QueryError(f"{name}: unsupported dataType {dt.value!r}")
 
     return AggDef(
         name=name,

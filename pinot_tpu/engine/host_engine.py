@@ -227,6 +227,11 @@ def _agg_input_values(segment: ImmutableSegment, agg: AggDef, fn: Function,
     vexpr = agg_value_expr(fn)
     if vexpr is None:
         return np.zeros(segment.num_docs)  # COUNT(*): values unused
+    if agg.base in ("lastwithtime", "firstwithtime"):
+        # (valueColumn, timeColumn, 'dataType'): evaluate both columns
+        vals = eval_expr_values(segment, vexpr)
+        times = eval_expr_values(segment, fn.args[1])
+        return (vals, times)
     if agg.mv:
         if not isinstance(vexpr, Identifier):
             raise UnsupportedQueryError("MV aggregation argument must be a column")
@@ -300,6 +305,11 @@ def host_group_by_segment(ctx: QueryContext, aggs: List[AggDef],
             sub_mask = np.ones(len(idx), dtype=bool)
             if agg.mv:
                 sub_vals = [vals[i] for i in idx]
+            elif agg.base in ("lastwithtime", "firstwithtime"):
+                v, t = vals  # (value array/list, time array) pair
+                sub_vals = (np.asarray(v, dtype=object)[idx]
+                            if isinstance(v, list) else np.asarray(v)[idx],
+                            np.asarray(t)[idx])
             else:
                 sub_vals = np.asarray(vals)[idx]
             st = agg.compute_host(sub_vals, sub_mask)

@@ -111,6 +111,9 @@ def _matching_dict_ids(ds: DataSource, pred: Predicate) -> np.ndarray:
             rx = re.compile(str(pred.value))
         except re.error as e:
             raise QueryError(f"bad regex {pred.value!r}: {e}")
+        reader = getattr(ds, "fst_index", None)
+        if reader is not None:
+            return reader.matching_ids(str(pred.value))
         return np.array([i for i in range(card)
                          if rx.search(str(d.get_value(i)))], dtype=np.int64)
     if t is PredicateType.TEXT_MATCH:
@@ -316,12 +319,59 @@ VIRTUAL_COLUMNS = {"$docId": "LONG", "$segmentName": "STRING",
 
 
 def _eval_expr_predicate(segment: ImmutableSegment, pred: Predicate) -> np.ndarray:
+    geo_mask = _try_geo_index(segment, pred)
+    if geo_mask is not None:
+        return geo_mask
     vals = eval_expr_values(segment, pred.lhs)
     dt = (DataType.DOUBLE if np.issubdtype(np.asarray(vals).dtype, np.floating)
           else DataType.LONG)
     if np.asarray(vals).dtype == object:
         dt = DataType.STRING
     return _compare_values(np.asarray(vals), pred, dt)
+
+
+def _try_geo_index(segment: ImmutableSegment,
+                   pred: Predicate) -> Optional[np.ndarray]:
+    """``stdistance(geoCol, 'POINT...') < r`` with a geo-indexed column:
+    cell-disk prefilter + exact haversine on candidates only
+    (ref: H3IndexFilterOperator). Returns None when the shape doesn't fit."""
+    lhs = pred.lhs
+    if not (isinstance(lhs, Function) and lhs.name in ("stdistance", "st_distance")
+            and pred.type is PredicateType.RANGE
+            and pred.upper is not None and pred.lower is None
+            and len(lhs.args) == 2):
+        return None
+    col_arg, lit_arg = lhs.args
+    if isinstance(col_arg, Literal) and isinstance(lit_arg, Identifier):
+        col_arg, lit_arg = lit_arg, col_arg
+    if not (isinstance(col_arg, Identifier) and isinstance(lit_arg, Literal)):
+        return None
+    if col_arg.name.startswith("$") \
+            or col_arg.name not in segment.metadata.columns:
+        return None
+    ds = segment.data_source(col_arg.name)
+    reader = getattr(ds, "geo_index", None)
+    if reader is None:
+        return None
+    from pinot_tpu.utils import geo
+
+    try:
+        center = geo.parse_ewkt(lit_arg.value)
+    except ValueError:
+        return None
+    if not center.geography:
+        # planar (euclidean) distance: the index's haversine candidates
+        # would disagree with the scalar semantics — decline
+        return None
+    if center.kind != "POINT":
+        return None
+    n = segment.num_docs
+    ids = reader.ids_within(center.x, center.y, float(pred.upper),
+                            inclusive=pred.upper_inclusive)
+    if ids.size == 0:
+        return np.zeros(n, dtype=bool)
+    fwd = np.asarray(ds.forward_index[:n])
+    return np.isin(fwd, ids)
 
 
 def _predicate_column(pred: Predicate) -> str:
@@ -392,6 +442,22 @@ def eval_expr_values(segment: ImmutableSegment, expr: Expr,
         if name in _UNARY:
             a = _to_float(eval_expr_values(segment, expr.args[0], doc_ids))
             return _UNARY[name](a)
+        # scalar-registry fallback: any registered function evaluates
+        # row-wise over the argument arrays (ref: the TransformFunction ->
+        # ScalarFunction reflection bridge, FunctionInvoker)
+        from pinot_tpu.query import functions as fnreg
+
+        fn = fnreg.lookup(name)
+        if fn is not None:
+            arg_arrays = [eval_expr_values(segment, a, doc_ids)
+                          for a in expr.args]
+            n_rows = (len(arg_arrays[0]) if arg_arrays
+                      else (n if doc_ids is None else len(doc_ids)))
+            out = [fn(*(arr[i] for arr in arg_arrays))
+                   for i in range(n_rows)]
+            arr = np.asarray(out)
+            return arr if arr.dtype != object or not out \
+                else np.asarray(out, dtype=object)
         raise UnsupportedQueryError(f"transform function {name!r} not supported")
 
     raise UnsupportedQueryError(f"cannot evaluate expression {expr}")

@@ -463,6 +463,12 @@ def _build_lut(ds: DataSource, pred: Predicate) -> np.ndarray:
             rx = re.compile(str(pred.value))
         except re.error as e:
             raise QueryError(f"bad regex {pred.value!r}: {e}")
+        reader = getattr(ds, "fst_index", None)
+        if reader is not None:
+            # FST prefix narrowing: verify the regexp only inside the
+            # trie-resolved dictId interval (ref: FSTBasedRegexpPredicateEvaluator)
+            lut[reader.matching_ids(str(pred.value))] = True
+            return lut
         for i in range(card):
             if rx.search(str(d.get_value(i))):
                 lut[i] = True

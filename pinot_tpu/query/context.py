@@ -44,6 +44,11 @@ class AggregationFunctionType(Enum):
     PERCENTILE = "percentile"
     PERCENTILEEST = "percentileest"
     PERCENTILETDIGEST = "percentiletdigest"
+    DISTINCTCOUNTTHETASKETCH = "distinctcountthetasketch"
+    DISTINCTCOUNTRAWTHETASKETCH = "distinctcountrawthetasketch"
+    IDSET = "idset"
+    LASTWITHTIME = "lastwithtime"
+    FIRSTWITHTIME = "firstwithtime"
     # MV variants
     COUNTMV = "countmv"
     SUMMV = "summv"

@@ -11,6 +11,7 @@ with a decorator.
 from __future__ import annotations
 
 import datetime as _dt
+import functools
 import json as _json
 import math
 import re
@@ -577,3 +578,98 @@ def _array_distinct(a):
 def _value_in(a, *allowed):
     allow = set(allowed)
     return [x for x in a if x in allow]
+
+
+@functools.lru_cache(maxsize=64)
+def _decode_idset(serialized_idset: str) -> frozenset:
+    import base64
+
+    from pinot_tpu.common import serde
+
+    return frozenset(serde.loads(base64.b64decode(serialized_idset)))
+
+
+@scalar_function(name="inidset", aliases=["inIdSet", "in_id_set"])
+def _in_id_set(value, serialized_idset):
+    """Membership test against an IDSET() aggregation result (ref:
+    InIdSetTransformFunction consuming IdSetAggregationFunction's base64
+    payload) -> 1/0 like the reference's boolean-as-int transforms. The
+    decoded set is cached: row-level eval calls this once per row."""
+    v = value.item() if hasattr(value, "item") else value
+    return 1 if v in _decode_idset(serialized_idset) else 0
+
+
+# --------------------------------------------------------------------------
+# geospatial (ref: pinot-core geospatial/transform/function/*; geography is
+# carried through strings with the EWKT "SRID=4326;" prefix rather than the
+# reference's serialized-bytes + SRID flag)
+# --------------------------------------------------------------------------
+
+def _parse_geo(v):
+    from pinot_tpu.utils import geo
+
+    return geo.parse_ewkt(v)
+
+
+@scalar_function(name="stpoint", aliases=["ST_Point", "st_point"])
+def _st_point(x, y, is_geography=0):
+    from pinot_tpu.utils import geo
+
+    g = geo.point(float(x), float(y), bool(is_geography))
+    return (geo.GEOG_PREFIX + g.wkt()) if g.geography else g.wkt()
+
+
+@scalar_function(name="stgeomfromtext", aliases=["ST_GeomFromText"])
+def _st_geom_from_text(wkt):
+    return _parse_geo(wkt).wkt()
+
+
+@scalar_function(name="stgeogfromtext", aliases=["ST_GeogFromText"])
+def _st_geog_from_text(wkt):
+    from pinot_tpu.utils import geo
+
+    g = geo.from_wkt(str(wkt), geography=True)
+    return geo.GEOG_PREFIX + g.wkt()
+
+
+@scalar_function(name="stastext", aliases=["ST_AsText"])
+def _st_as_text(v):
+    return _parse_geo(v).wkt()
+
+
+@scalar_function(name="stdistance", aliases=["ST_Distance"])
+def _st_distance(a, b):
+    from pinot_tpu.utils import geo
+
+    return geo.distance(_parse_geo(a), _parse_geo(b))
+
+
+@scalar_function(name="stcontains", aliases=["ST_Contains"])
+def _st_contains(outer, inner):
+    from pinot_tpu.utils import geo
+
+    return 1 if geo.contains(_parse_geo(outer), _parse_geo(inner)) else 0
+
+
+@scalar_function(name="stwithin", aliases=["ST_Within"])
+def _st_within(inner, outer):
+    from pinot_tpu.utils import geo
+
+    return 1 if geo.contains(_parse_geo(outer), _parse_geo(inner)) else 0
+
+
+@scalar_function(name="starea", aliases=["ST_Area"])
+def _st_area(g):
+    from pinot_tpu.utils import geo
+
+    return geo.area(_parse_geo(g))
+
+
+@scalar_function(name="stx", aliases=["ST_X"])
+def _st_x(g):
+    return _parse_geo(g).x
+
+
+@scalar_function(name="sty", aliases=["ST_Y"])
+def _st_y(g):
+    return _parse_geo(g).y

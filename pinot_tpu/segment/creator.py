@@ -104,9 +104,12 @@ class SegmentBuilder:
         if table_config is not None:
             self.table_name = table_config.table_name
             self.indexing = table_config.indexing_config
+            self.field_configs = {c.name: c
+                                  for c in table_config.field_config_list}
         else:
             self.table_name = table_name or schema.schema_name
             self.indexing = indexing_config or IndexingConfig()
+            self.field_configs = {}
 
     # -- public API --------------------------------------------------------
     def build(self, rows: RowsInput, out_dir: str) -> meta.SegmentMetadata:
@@ -180,6 +183,11 @@ class SegmentBuilder:
                           "rb") as f:
                     return native.bitunpack(f.read(), sm.padded_capacity,
                                             bits)
+            if cm.compression_codec:
+                from pinot_tpu.segment.compression import read_compressed
+
+                return read_compressed(
+                    os.path.join(col_dir, f"{col}.fwdcc.bin"))
             return np.load(os.path.join(col_dir, f"{col}.fwd.npy"))
 
         count = 0
@@ -320,7 +328,9 @@ class SegmentBuilder:
                       col_dir: str) -> meta.ColumnMetadata:
         values, null_mask = self._normalize(fs, raw_values, num_docs)
         has_nulls = bool(null_mask.any())
-        no_dict = (fs.name in self.indexing.no_dictionary_columns
+        fc = self.field_configs.get(fs.name)
+        no_dict = ((fs.name in self.indexing.no_dictionary_columns
+                    or (fc is not None and fc.encoding_type.upper() == "RAW"))
                    and fs.data_type.is_numeric and fs.single_value)
         want_inverted = fs.name in self.indexing.inverted_index_columns
 
@@ -336,7 +346,17 @@ class SegmentBuilder:
             # RAW numeric column: fwd index holds values directly
             arr = np.zeros(capacity, dtype=fs.data_type.stored_np)
             arr[:num_docs] = np.asarray(values, dtype=fs.data_type.stored_np)
-            save("fwd", arr)
+            codec_used = None
+            if fc is not None and fc.compression_codec:
+                # chunk-compressed raw index (ref: ChunkCompressorFactory +
+                # VarByteChunkSVForwardIndexWriterV4)
+                from pinot_tpu.segment.compression import write_compressed
+
+                codec_used = write_compressed(
+                    os.path.join(col_dir, f"{fs.name}.fwdcc.bin"),
+                    arr, fc.compression_codec)
+            else:
+                save("fwd", arr)
             data = arr[:num_docs]
             uniq = np.unique(data)
             is_sorted = bool(np.all(data[:-1] <= data[1:])) if num_docs > 1 else True
@@ -359,6 +379,7 @@ class SegmentBuilder:
                 is_sorted=is_sorted, has_dictionary=False, has_nulls=has_nulls,
                 has_bloom_filter=self._maybe_build_bloom(fs.name, uniq, save),
                 has_range_index=has_range,
+                compression_codec=codec_used,
                 **self._partition_meta(fs.name, values),
             )
 
@@ -443,6 +464,37 @@ class SegmentBuilder:
                              col_dir, fs.name)
             has_text = True
 
+        has_geo = False
+        if (fc is not None and (fc.index_type or "").upper() == "H3"
+                and fs.single_value and not fs.data_type.is_numeric):
+            # grid-cell geo index over the dictionary's WKT points
+            # (ref: H3IndexCreator; design note in geoindex.py)
+            from pinot_tpu.segment.geoindex import (
+                DEFAULT_RESOLUTION,
+                build_geo_index,
+            )
+
+            res = int(str(fc.properties.get(
+                "resolutions", DEFAULT_RESOLUTION)).split(",")[0])
+            has_geo = build_geo_index(
+                dictionary.get_values(range(card)), res, save)
+
+        has_fst = False
+        if ((fs.name in self.indexing.fst_index_columns
+             or (fc is not None and (fc.index_type or "").upper() == "FST"))
+                and fs.single_value and not fs.data_type.is_numeric):
+            # FST index: CSR byte-trie over the sorted dictionary terms
+            # (ref: LuceneFSTIndexCreator; design note in fstindex.py)
+            from pinot_tpu.segment.fstindex import FstIndexBuilder
+
+            eo, el, et, nr = FstIndexBuilder(
+                [str(v) for v in dictionary.get_values(range(card))]).build()
+            save("fstoff", eo)
+            save("fstlab", el)
+            save("fsttgt", et)
+            save("fstrng", nr)
+            has_fst = True
+
         return meta.ColumnMetadata(
             name=fs.name, data_type=fs.data_type, field_type=fs.field_type,
             single_value=fs.single_value, encoding=meta.Encoding.DICT,
@@ -452,7 +504,8 @@ class SegmentBuilder:
             is_sorted=is_sorted, has_dictionary=True,
             has_inverted_index=want_inverted, has_nulls=has_nulls,
             has_bloom_filter=has_bloom, has_json_index=has_json,
-            has_text_index=has_text,
+            has_text_index=has_text, has_fst_index=has_fst,
+            has_geo_index=has_geo,
             max_num_multi_values=max_mv, total_number_of_entries=total_entries,
             **self._partition_meta(fs.name, values),
         )

@@ -1,0 +1,82 @@
+"""Geo index: grid-cell prefiltering for distance predicates.
+
+Re-design of the reference's H3 index
+(``segment/index/readers/geospatial/ImmutableH3IndexReader.java`` +
+``H3IndexFilterOperator`` — points bucketed into hex cells so
+``ST_Distance(col, point) < r`` prefilters by a kRing of cells before the
+exact test): here the cells are a square lat/lng grid (utils/geo.cell_of —
+design note there), the index maps each DICTIONARY id to its cell (the
+dictionary holds WKT points, so the per-dictId cell array is the whole
+index), and the filter path does
+
+    cell disk -> candidate dictIds -> exact haversine on candidates -> LUT
+
+which keeps the final doc mask in the same dictId-LUT shape every other
+index produces (device scan compatible).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+DEFAULT_RESOLUTION = 9
+
+
+def build_geo_index(values, resolution: int, save) -> bool:
+    """Per-dictId cell ids at ``resolution``; non-point values poison the
+    build (returns False) rather than producing a lying index."""
+    from pinot_tpu.utils import geo
+
+    lngs, lats = [], []
+    for v in values:
+        try:
+            g = geo.parse_ewkt(v)
+        except ValueError:
+            return False
+        if g.kind != "POINT":
+            return False
+        lngs.append(g.x)
+        lats.append(g.y)
+    cells = geo.cells_of(np.asarray(lngs, dtype=np.float64),
+                         np.asarray(lats, dtype=np.float64), resolution)
+    save("geocells", cells.astype(np.int64))
+    save("geometa", np.asarray([resolution], dtype=np.int64))
+    return True
+
+
+class GeoIndexReader:
+    """Query-side candidate narrowing."""
+
+    def __init__(self, cells: np.ndarray, resolution: int, dictionary):
+        self.cells = np.asarray(cells)
+        self.resolution = int(resolution)
+        self.dictionary = dictionary
+
+    def candidate_dict_ids(self, lng: float, lat: float,
+                           radius_m: float) -> np.ndarray:
+        from pinot_tpu.utils import geo
+
+        disk = np.asarray(
+            geo.cell_disk(lng, lat, radius_m, self.resolution),
+            dtype=np.int64)
+        return np.nonzero(np.isin(self.cells, disk))[0]
+
+    def ids_within(self, lng: float, lat: float, radius_m: float,
+                   inclusive: bool = True) -> np.ndarray:
+        """dictIds whose point is within ``radius_m`` meters (haversine —
+        matching ST_DISTANCE geography semantics)."""
+        from pinot_tpu.utils import geo
+
+        cand = self.candidate_dict_ids(lng, lat, radius_m)
+        if cand.size == 0:
+            return cand
+        xs = np.empty(cand.size)
+        ys = np.empty(cand.size)
+        for j, i in enumerate(cand):
+            g = geo.parse_ewkt(self.dictionary.get_value(int(i)))
+            xs[j], ys[j] = g.x, g.y
+        d = geo.haversine_m(xs, ys, lng, lat)
+        keep = d <= radius_m if inclusive else d < radius_m
+        return cand[keep]

@@ -55,6 +55,13 @@ class DataSource:
                     buf.read(), self._segment.metadata.padded_capacity, bits)
             finally:
                 buf.release()
+        if cm.compression_codec:
+            # chunk-compressed raw column: decompress once (HBM staging
+            # consumes the dense array; ref: BaseChunkSVForwardIndexReader)
+            from pinot_tpu.segment.compression import read_compressed
+
+            return read_compressed(
+                self._segment._path(self.name, "fwdcc", ext="bin"))
         return self._segment._load_array(self.name, "fwd")
 
     @cached_property
@@ -115,6 +122,34 @@ class DataSource:
             self._segment._load_array(self.name, "txtinvbo"),
             blob, self.metadata.cardinality,
             value_of=lambda i: d.get_value(int(i)))
+
+    @cached_property
+    def fst_index(self):
+        """FstIndexReader for REGEXP prefix narrowing, or None
+        (ref: LuceneFSTIndexReader)."""
+        if not self.metadata.has_fst_index:
+            return None
+        from pinot_tpu.segment.fstindex import FstIndexReader
+
+        return FstIndexReader(
+            self._segment._load_array(self.name, "fstoff"),
+            self._segment._load_array(self.name, "fstlab"),
+            self._segment._load_array(self.name, "fsttgt"),
+            self._segment._load_array(self.name, "fstrng"),
+            self.dictionary)
+
+    @cached_property
+    def geo_index(self):
+        """GeoIndexReader for distance prefilters, or None
+        (ref: ImmutableH3IndexReader)."""
+        if not self.metadata.has_geo_index:
+            return None
+        from pinot_tpu.segment.geoindex import GeoIndexReader
+
+        meta_arr = self._segment._load_array(self.name, "geometa")
+        return GeoIndexReader(
+            self._segment._load_array(self.name, "geocells"),
+            int(meta_arr[0]), self.dictionary)
 
     @cached_property
     def range_order(self):

@@ -74,12 +74,16 @@ class ColumnMetadata:
     has_bloom_filter: bool = False
     has_json_index: bool = False
     has_text_index: bool = False
+    has_fst_index: bool = False
+    has_geo_index: bool = False
     has_range_index: bool = False
     max_num_multi_values: int = 0   # MV only: max values per row
     total_number_of_entries: int = 0  # MV only: total flattened values
     partition_function: Optional[str] = None
     num_partitions: int = 0
     partitions: List[int] = field(default_factory=list)  # partitions present
+    # raw columns only: chunk codec of the fwd index file (None = .npy)
+    compression_codec: Optional[str] = None
 
     def to_dict(self) -> Dict[str, Any]:
         d = {
@@ -99,6 +103,8 @@ class ColumnMetadata:
             "hasBloomFilter": self.has_bloom_filter,
             "hasJsonIndex": self.has_json_index,
             "hasTextIndex": self.has_text_index,
+            "hasFstIndex": self.has_fst_index,
+            "hasGeoIndex": self.has_geo_index,
             "hasRangeIndex": self.has_range_index,
             "maxNumMultiValues": self.max_num_multi_values,
             "totalNumberOfEntries": self.total_number_of_entries,
@@ -107,6 +113,8 @@ class ColumnMetadata:
             d["partitionFunction"] = self.partition_function
             d["numPartitions"] = self.num_partitions
             d["partitions"] = self.partitions
+        if self.compression_codec:
+            d["compressionCodec"] = self.compression_codec
         return d
 
     @classmethod
@@ -129,12 +137,15 @@ class ColumnMetadata:
             has_bloom_filter=d.get("hasBloomFilter", False),
             has_json_index=d.get("hasJsonIndex", False),
             has_text_index=d.get("hasTextIndex", False),
+            has_fst_index=d.get("hasFstIndex", False),
+            has_geo_index=d.get("hasGeoIndex", False),
             has_range_index=d.get("hasRangeIndex", False),
             max_num_multi_values=d.get("maxNumMultiValues", 0),
             total_number_of_entries=d.get("totalNumberOfEntries", 0),
             partition_function=d.get("partitionFunction"),
             num_partitions=d.get("numPartitions", 0),
             partitions=d.get("partitions", []),
+            compression_codec=d.get("compressionCodec"),
         )
 
 

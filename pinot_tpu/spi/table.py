@@ -96,6 +96,7 @@ class IndexingConfig:
     sorted_column: List[str] = field(default_factory=list)
     bloom_filter_columns: List[str] = field(default_factory=list)
     text_index_columns: List[str] = field(default_factory=list)
+    fst_index_columns: List[str] = field(default_factory=list)
     no_dictionary_columns: List[str] = field(default_factory=list)
     json_index_columns: List[str] = field(default_factory=list)
     var_length_dictionary_columns: List[str] = field(default_factory=list)
@@ -112,6 +113,7 @@ class IndexingConfig:
             "sortedColumn": self.sorted_column,
             "bloomFilterColumns": self.bloom_filter_columns,
             "textIndexColumns": self.text_index_columns,
+            "fstIndexColumns": self.fst_index_columns,
             "noDictionaryColumns": self.no_dictionary_columns,
             "jsonIndexColumns": self.json_index_columns,
             "varLengthDictionaryColumns": self.var_length_dictionary_columns,
@@ -134,6 +136,7 @@ class IndexingConfig:
             sorted_column=d.get("sortedColumn") or [],
             bloom_filter_columns=d.get("bloomFilterColumns") or [],
             text_index_columns=d.get("textIndexColumns") or [],
+            fst_index_columns=d.get("fstIndexColumns") or [],
             no_dictionary_columns=d.get("noDictionaryColumns") or [],
             json_index_columns=d.get("jsonIndexColumns") or [],
             var_length_dictionary_columns=d.get("varLengthDictionaryColumns") or [],
@@ -143,6 +146,39 @@ class IndexingConfig:
             segment_partition_config=SegmentPartitionConfig.from_dict(spc) if spc else None,
             aggregate_metrics=d.get("aggregateMetrics", False),
             null_handling_enabled=d.get("nullHandlingEnabled", False),
+        )
+
+
+@dataclass
+class FieldConfig:
+    """Per-column encoding/index directives
+    (ref: pinot-spi/.../config/table/FieldConfig.java — fieldConfigList)."""
+
+    name: str
+    encoding_type: str = "DICTIONARY"   # DICTIONARY | RAW
+    index_type: Optional[str] = None    # TEXT | FST | H3 | ...
+    compression_codec: Optional[str] = None  # SNAPPY | LZ4 | ZSTANDARD | ...
+    properties: Dict[str, str] = field(default_factory=dict)
+
+    def to_dict(self) -> Dict[str, Any]:
+        d: Dict[str, Any] = {"name": self.name,
+                             "encodingType": self.encoding_type}
+        if self.index_type:
+            d["indexType"] = self.index_type
+        if self.compression_codec:
+            d["compressionCodec"] = self.compression_codec
+        if self.properties:
+            d["properties"] = self.properties
+        return d
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "FieldConfig":
+        return cls(
+            name=d["name"],
+            encoding_type=d.get("encodingType", "DICTIONARY"),
+            index_type=d.get("indexType"),
+            compression_codec=d.get("compressionCodec"),
+            properties=d.get("properties") or {},
         )
 
 
@@ -383,6 +419,7 @@ class TableConfig:
     custom_config: Dict[str, Any] = field(default_factory=dict)
     # taskType -> config map (ref: TableTaskConfig.java taskTypeConfigsMap)
     task_config: Dict[str, Dict[str, str]] = field(default_factory=dict)
+    field_config_list: List[FieldConfig] = field(default_factory=list)
 
     def __post_init__(self):
         if isinstance(self.table_type, str):
@@ -421,6 +458,9 @@ class TableConfig:
             d["query"] = self.query_config
         if self.task_config:
             d["task"] = {"taskTypeConfigsMap": self.task_config}
+        if self.field_config_list:
+            d["fieldConfigList"] = [c.to_dict()
+                                    for c in self.field_config_list]
         return d
 
     def to_json(self) -> str:
@@ -453,6 +493,8 @@ class TableConfig:
             query_config=d.get("query", {}),
             custom_config=(d.get("metadata") or {}).get("customConfigs", {}),
             task_config=(d.get("task") or {}).get("taskTypeConfigsMap", {}),
+            field_config_list=[FieldConfig.from_dict(c)
+                               for c in d.get("fieldConfigList") or []],
         )
 
     @classmethod

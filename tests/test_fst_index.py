@@ -1,0 +1,132 @@
+"""FST index: trie build, prefix narrowing, REGEXP parity
+(ref: LuceneFSTIndexReader, FSTBasedRegexpPredicateEvaluator)."""
+
+import re
+
+import numpy as np
+import pytest
+
+from pinot_tpu.engine import ServerQueryExecutor
+from pinot_tpu.query import compile_query
+from pinot_tpu.segment import SegmentBuilder, load_segment
+from pinot_tpu.segment.fstindex import (
+    FstIndexBuilder,
+    FstIndexReader,
+    literal_prefix,
+)
+from pinot_tpu.spi import DataType, FieldSpec, FieldType, Schema
+from pinot_tpu.spi.table import IndexingConfig
+
+
+class _Dict:
+    def __init__(self, terms):
+        self.terms = terms
+
+    def get_value(self, i):
+        return self.terms[i]
+
+
+def _reader(terms):
+    terms = sorted(terms)
+    return FstIndexReader(*FstIndexBuilder(terms).build(), _Dict(terms)), terms
+
+
+class TestLiteralPrefix:
+    @pytest.mark.parametrize("pattern,expect", [
+        ("^abc.*", "abc"),
+        ("^abc", "abc"),
+        ("abc", ""),            # unanchored: search semantics
+        ("^a[bc]d", "a"),
+        ("^ab?c", "a"),         # quantified literal excluded
+        ("^", ""),
+        (r"^a\.b", "a.b"),      # escaped metachar is literal
+        (r"^a\d+", "a"),
+        ("^(ab|cd)", ""),
+    ])
+    def test_extraction(self, pattern, expect):
+        assert literal_prefix(pattern) == expect
+
+
+class TestTrie:
+    def test_prefix_range_exact(self):
+        r, terms = _reader(["apple", "apricot", "banana", "band", "bandit",
+                            "cherry"])
+        lo, hi = r.prefix_range("ban")
+        assert terms[lo:hi] == ["banana", "band", "bandit"]
+        lo, hi = r.prefix_range("band")
+        assert terms[lo:hi] == ["band", "bandit"]
+        assert r.prefix_range("zz") == (0, 0)
+        lo, hi = r.prefix_range("")
+        assert (lo, hi) == (0, len(terms))
+
+    def test_prefix_beyond_max_depth(self):
+        base = "x" * 20
+        r, terms = _reader([base + "a", base + "b", "other"])
+        lo, hi = r.prefix_range(base + "b")
+        assert terms[lo:hi] == [base + "b"]
+
+    def test_matching_ids_parity_random(self):
+        rng = np.random.default_rng(5)
+        terms = sorted({f"{p}{i}" for p in ("foo", "bar", "bazz", "qux")
+                        for i in rng.integers(0, 500, 80)})
+        r, terms = _reader(terms)
+        for pattern in ("^foo", "^bar1.*", "^bazz4[0-9]$", "qux", "9$"):
+            rx = re.compile(pattern)
+            expect = [i for i, t in enumerate(terms) if rx.search(t)]
+            got = r.matching_ids(pattern).tolist()
+            assert got == expect, pattern
+
+    def test_single_term(self):
+        r, terms = _reader(["only"])
+        assert r.matching_ids("^on").tolist() == [0]
+        assert r.matching_ids("^x").tolist() == []
+
+
+class TestEndToEnd:
+    @pytest.fixture(scope="class")
+    def seg(self, tmp_path_factory):
+        out = str(tmp_path_factory.mktemp("fst"))
+        rng = np.random.default_rng(9)
+        n = 4000
+        urls = [f"/api/v{rng.integers(1, 4)}/users/{i % 100}" if i % 3
+                else f"/static/img/{i % 50}.png" for i in range(n)]
+        schema = Schema("logs", [
+            FieldSpec("url", DataType.STRING),
+            FieldSpec("n", DataType.LONG, FieldType.METRIC),
+        ])
+        cfg = IndexingConfig(fst_index_columns=["url"])
+        SegmentBuilder(schema, "l0", indexing_config=cfg).build(
+            {"url": urls, "n": list(range(n))}, out)
+        return load_segment(f"{out}/l0"), urls
+
+    def test_has_index(self, seg):
+        segment, _ = seg
+        assert segment.metadata.column("url").has_fst_index
+        assert segment.data_source("url").fst_index is not None
+
+    def test_regexp_query_parity(self, seg):
+        segment, urls = seg
+        ex = ServerQueryExecutor()
+        t, _ = ex.execute(compile_query(
+            "SELECT count(*) FROM logs WHERE regexp_like(url, '^/static/')"),
+            [segment])
+        expect = sum(1 for u in urls if u.startswith("/static/"))
+        assert t.rows[0][0] == expect
+
+    def test_regexp_unanchored_parity(self, seg):
+        segment, urls = seg
+        ex = ServerQueryExecutor()
+        t, _ = ex.execute(compile_query(
+            "SELECT count(*) FROM logs WHERE regexp_like(url, 'users/7$')"),
+            [segment])
+        expect = sum(1 for u in urls if re.search("users/7$", u))
+        assert t.rows[0][0] == expect
+
+
+def test_alternation_voids_prefix():
+    """'^abc|xyz': the anchor binds only to the first alternative, so
+    prefix narrowing must be disabled."""
+    assert literal_prefix("^abc|xyz") == ""
+    assert literal_prefix("^a(b|c)d") == "a"  # grouped alternation is fine
+    r, terms = _reader(["abcx", "hello xyz", "zzz"])
+    assert r.matching_ids("^abc|xyz").tolist() == [0, 1]

@@ -1,0 +1,175 @@
+"""Theta sketch, IDSET, LAST/FIRSTWITHTIME aggregations
+(ref: DistinctCountThetaSketchAggregationFunction,
+IdSetAggregationFunction + InIdSetTransformFunction,
+LastWithTimeAggregationFunction / FirstWithTimeAggregationFunction)."""
+
+import base64
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from pinot_tpu.common import serde
+from pinot_tpu.engine import ServerQueryExecutor
+from pinot_tpu.query import compile_query
+from pinot_tpu.segment import SegmentBuilder, load_segment
+from pinot_tpu.spi import DataType, FieldSpec, FieldType, Schema
+from pinot_tpu.utils.theta import ThetaSketch
+
+
+class TestThetaSketch:
+    def test_exact_below_k(self):
+        s = ThetaSketch.of(list(range(1000)))
+        assert s.estimate() == 1000.0
+
+    @pytest.mark.parametrize("true_n", [10_000, 100_000])
+    def test_estimate_within_error(self, true_n):
+        vals = np.arange(true_n) * 7919
+        est = ThetaSketch.of(vals).estimate()
+        # RSE ~ 1/sqrt(k) = 1.6% at k=4096; allow 5 sigma
+        assert abs(est - true_n) <= 0.08 * true_n, est
+
+    def test_merge_equals_union(self):
+        a_vals = np.arange(0, 60_000)
+        b_vals = np.arange(30_000, 90_000)
+        est = ThetaSketch.of(a_vals).merge(ThetaSketch.of(b_vals)).estimate()
+        assert abs(est - 90_000) <= 0.08 * 90_000
+
+    def test_intersect_and_anotb(self):
+        a = ThetaSketch.of(np.arange(0, 50_000))
+        b = ThetaSketch.of(np.arange(25_000, 75_000))
+        inter = a.intersect(b).estimate()
+        diff = a.a_not_b(b).estimate()
+        assert abs(inter - 25_000) <= 0.15 * 25_000
+        assert abs(diff - 25_000) <= 0.15 * 25_000
+
+    def test_serde_round_trip(self):
+        s = ThetaSketch.of(["x", "y", 3, 4.5, b"bytes"])
+        s2 = ThetaSketch.deserialize(s.serialize())
+        assert np.array_equal(s.hashes, s2.hashes)
+        assert s2.theta == s.theta and s2.k == s.k
+
+
+@pytest.fixture(scope="module")
+def events(tmp_path_factory):
+    out = str(tmp_path_factory.mktemp("theta"))
+    rng = np.random.default_rng(23)
+    n = 8000
+    df = pd.DataFrame({
+        "user": [f"u{i}" for i in rng.integers(0, 3000, n)],
+        "grp": [f"g{i}" for i in rng.integers(0, 3, n)],
+        "val": rng.integers(0, 1000, n).astype(np.int64),
+        "ts": rng.permutation(n).astype(np.int64),  # unique times
+    })
+    schema = Schema("events", [
+        FieldSpec("user", DataType.STRING),
+        FieldSpec("grp", DataType.STRING),
+        FieldSpec("val", DataType.LONG, FieldType.METRIC),
+        FieldSpec("ts", DataType.LONG),
+    ])
+    SegmentBuilder(schema, "ev_0").build(
+        {c: df[c].tolist() for c in df.columns}, out)
+    SegmentBuilder(schema, "ev_1").build(
+        {c: df[c].tolist()[:n // 2] for c in df.columns}, out)
+    return [load_segment(f"{out}/ev_0"), load_segment(f"{out}/ev_1")], df
+
+
+class TestThetaQueries:
+    def test_scalar(self, events):
+        segs, df = events
+        ex = ServerQueryExecutor()
+        t, _ = ex.execute(compile_query(
+            "SELECT distinctcountthetasketch(user) FROM events"), segs)
+        true_n = df.user.nunique()
+        assert abs(t.rows[0][0] - true_n) <= max(0.05 * true_n, 2)
+
+    def test_raw_returns_hex(self, events):
+        segs, _ = events
+        ex = ServerQueryExecutor()
+        t, _ = ex.execute(compile_query(
+            "SELECT distinctcountrawthetasketch(user) FROM events"), segs)
+        raw = bytes.fromhex(t.rows[0][0])
+        assert ThetaSketch.deserialize(raw).estimate() > 0
+
+    def test_group_by(self, events):
+        segs, df = events
+        ex = ServerQueryExecutor()
+        t, _ = ex.execute(compile_query(
+            "SELECT grp, distinctcountthetasketch(user) FROM events "
+            "GROUP BY grp ORDER BY grp"), segs)
+        expect = df.groupby("grp").user.nunique()
+        for grp, est in t.rows:
+            true_n = int(expect[grp])
+            assert abs(est - true_n) <= max(0.05 * true_n, 2), (grp, est)
+
+
+class TestIdSet:
+    def test_idset_roundtrips_through_inidset(self, events):
+        segs, df = events
+        ex = ServerQueryExecutor()
+        t, _ = ex.execute(compile_query(
+            "SELECT idset(val) FROM events WHERE grp = 'g1'"), segs)
+        encoded = t.rows[0][0]
+        ids = set(serde.loads(base64.b64decode(encoded)))
+        assert ids == set(df[df.grp == "g1"].val.tolist())
+        # the membership transform consumes the aggregation's output
+        from pinot_tpu.query.functions import lookup
+        in_id_set = lookup("inIdSet")
+        member = next(iter(ids))
+        assert in_id_set(member, encoded) == 1
+        assert in_id_set(-999, encoded) == 0
+
+
+class TestWithTime:
+    def test_lastwithtime(self, events):
+        segs, df = events
+        ex = ServerQueryExecutor()
+        t, _ = ex.execute(compile_query(
+            "SELECT lastwithtime(val, ts, 'LONG') FROM events"), segs)
+        expect = int(df.loc[df.ts.idxmax()].val)
+        assert t.rows[0][0] == expect
+
+    def test_firstwithtime_grouped(self, events):
+        segs, df = events
+        ex = ServerQueryExecutor()
+        t, _ = ex.execute(compile_query(
+            "SELECT grp, firstwithtime(user, ts, 'STRING') FROM events "
+            "GROUP BY grp ORDER BY grp"), segs)
+        expect = df.loc[df.groupby("grp").ts.idxmin()].set_index("grp").user
+        for grp, got in t.rows:
+            assert got == expect[grp], (grp, got)
+
+    def test_withtime_empty_filter(self, events):
+        segs, _ = events
+        ex = ServerQueryExecutor()
+        t, _ = ex.execute(compile_query(
+            "SELECT lastwithtime(val, ts, 'LONG') FROM events "
+            "WHERE grp = 'nope'"), segs)
+        assert t.rows[0][0] == float("-inf")
+
+    def test_bad_datatype_rejected(self, events):
+        segs, _ = events
+        from pinot_tpu.engine.errors import QueryError
+        ex = ServerQueryExecutor()
+        with pytest.raises(QueryError):
+            ex.execute(compile_query(
+                "SELECT lastwithtime(val, ts, 'BLOB') FROM events"), segs)
+
+
+def test_lastwithtime_float_times(events, tmp_path):
+    """DOUBLE time columns must not truncate (10.9 beats 10.2)."""
+    import pandas as pd
+    df = pd.DataFrame({"v": [1.0, 2.0], "t": [10.9, 10.2],
+                       "g": ["a", "a"]})
+    schema = Schema("ft", [
+        FieldSpec("g", DataType.STRING),
+        FieldSpec("v", DataType.DOUBLE, FieldType.METRIC),
+        FieldSpec("t", DataType.DOUBLE),
+    ])
+    SegmentBuilder(schema, "ft0").build(
+        {c: df[c].tolist() for c in df.columns}, str(tmp_path))
+    seg = load_segment(str(tmp_path / "ft0"))
+    ex = ServerQueryExecutor()
+    t, _ = ex.execute(compile_query(
+        "SELECT lastwithtime(v, t, 'DOUBLE') FROM ft"), [seg])
+    assert t.rows[0][0] == 1.0

@@ -174,6 +174,11 @@ class RoutingManager:
         # the routing config / instance partitions change (ref:
         # InstanceSelectorFactory caching per RoutingEntry)
         self._selector_cache: Dict[str, Tuple] = {}
+        # table -> hidden segment set; store-watch invalidated so the per-
+        # query hot path skips lineage parsing for lineage-less tables
+        self._lineage_cache: Dict[str, frozenset] = {}
+        store.watch("lineage/",
+                    lambda path, value: self._lineage_cache.clear())
 
     def _next_request_id(self) -> int:
         with self._lock:
@@ -199,7 +204,14 @@ class RoutingManager:
         dead = frozenset(i.instance_id for i in self.store.instances("SERVER")
                          if not i.alive)
 
-        pruned = self._time_prune(table, ctx, list(ev.keys()))
+        segments = list(ev.keys())
+        # lineage visibility: replaced inputs / in-flight outputs are hidden
+        # (ref: SegmentLineageUtils.filterSegmentsBasedOnLineageInPlace)
+        hidden = self._lineage_hidden(table)
+        if hidden:
+            segments = [s for s in segments if s not in hidden]
+
+        pruned = self._time_prune(table, ctx, segments)
         pruned = self._partition_prune(table, ctx, pruned)
         selector = self._selector_for(table)
 
@@ -214,6 +226,17 @@ class RoutingManager:
             else:
                 routing.setdefault(chosen, []).append(segment)
         return routing, unavailable
+
+    def _lineage_hidden(self, table: str) -> frozenset:
+        cached = self._lineage_cache.get(table)
+        if cached is not None:
+            return cached
+        from pinot_tpu.controller.lineage import SegmentLineageManager
+
+        hidden = frozenset(
+            SegmentLineageManager(self.store).hidden_segments(table))
+        self._lineage_cache[table] = hidden
+        return hidden
 
     def _selector_for(self, table: str):
         """Per-table instance selector from the routing config

@@ -177,6 +177,24 @@ class Controller:
 
         self.store.update_ideal_state(table_with_type, apply)
 
+    # -- segment lineage (ref: start/end/revertReplaceSegments REST) --------
+    def start_replace_segments(self, table: str, segments_from: List[str],
+                               segments_to: List[str]) -> str:
+        from pinot_tpu.controller.lineage import SegmentLineageManager
+
+        return SegmentLineageManager(self.store).start_replace(
+            table, segments_from, segments_to)
+
+    def end_replace_segments(self, table: str, entry_id: str) -> None:
+        from pinot_tpu.controller.lineage import SegmentLineageManager
+
+        SegmentLineageManager(self.store).end_replace(table, entry_id)
+
+    def revert_replace_segments(self, table: str, entry_id: str) -> None:
+        from pinot_tpu.controller.lineage import SegmentLineageManager
+
+        SegmentLineageManager(self.store).revert_replace(table, entry_id)
+
     def delete_segment(self, table: str, segment: str) -> None:
         self.store.delete_segment(table, segment)
 
@@ -284,9 +302,15 @@ class Controller:
     def run_retention_manager(self, now_ms: Optional[int] = None) -> List[str]:
         """Delete segments past the table's retention
         (ref: RetentionManager + SegmentDeletionManager)."""
+        from pinot_tpu.controller.lineage import SegmentLineageManager
+
         now_ms = now_ms or int(time.time() * 1000)
         deleted = []
+        lineage = SegmentLineageManager(self.store)
         for table in self.store.table_names():
+            # lineage GC rides retention (ref: RetentionManager's
+            # manageSegmentLineageCleanupForTable)
+            lineage.cleanup(table, now_ms=now_ms)
             cfg = self.store.get_table_config(table)
             vc = cfg.validation_config
             if not vc.retention_time_unit or not vc.retention_time_value:
@@ -338,6 +362,18 @@ class Controller:
         (ref: PinotTaskManager cron-able generation)."""
         return self.task_manager.generate_tasks()
 
+    def run_segment_relocation(self,
+                               now_ms: Optional[int] = None) -> List[str]:
+        """Move aged segments to their tier's tagged servers
+        (ref: SegmentRelocator periodic task; controller/tiers.py)."""
+        from pinot_tpu.controller.tiers import SegmentRelocator
+
+        relocator = SegmentRelocator(self.store)
+        moved = []
+        for table in self.store.table_names():
+            moved.extend(relocator.relocate_table(table, now_ms=now_ms))
+        return moved
+
     def run_liveness_check(self, timeout_ms: int = 10_000,
                            now_ms: Optional[int] = None) -> List[str]:
         """Automatic failure detection (the Helix ephemeral-znode liveness
@@ -370,6 +406,7 @@ class Controller:
                     self.run_retention_manager()
                     self.run_realtime_validation()
                     self.run_task_generation()
+                    self.run_segment_relocation()
                 except Exception:
                     log.exception("periodic task failed")
 

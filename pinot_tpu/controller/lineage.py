@@ -1,0 +1,158 @@
+"""Segment lineage: atomic segment-replacement protocol.
+
+Re-design of ``pinot-common/.../lineage/SegmentLineage.java`` +
+``SegmentLineageUtils`` (the replace-segments protocol minion tasks use so
+queries never see both the inputs and outputs of a merge/rollup): a lineage
+entry records ``segments_from -> segments_to`` with a state machine
+
+    IN_PROGRESS  (startReplaceSegments: outputs uploading, hide them)
+    COMPLETED    (endReplaceSegments:   outputs live, hide the inputs)
+    REVERTED     (revertReplaceSegments: forget the outputs)
+
+Routing applies the same visibility rule as the reference's
+``SegmentLineageUtils.filterSegmentsBasedOnLineageInPlace``: hide
+``segments_to`` of IN_PROGRESS/REVERTED entries and ``segments_from`` of
+COMPLETED entries.
+"""
+
+from __future__ import annotations
+
+import itertools
+import time
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set
+
+IN_PROGRESS = "IN_PROGRESS"
+COMPLETED = "COMPLETED"
+REVERTED = "REVERTED"
+
+_counter = itertools.count()
+
+
+@dataclass
+class LineageEntry:
+    entry_id: str
+    segments_from: List[str]
+    segments_to: List[str]
+    state: str = IN_PROGRESS
+    timestamp_ms: int = 0
+
+    def to_dict(self) -> Dict:
+        return {"id": self.entry_id, "segmentsFrom": self.segments_from,
+                "segmentsTo": self.segments_to, "state": self.state,
+                "timestampMs": self.timestamp_ms}
+
+    @classmethod
+    def from_dict(cls, d: Dict) -> "LineageEntry":
+        return cls(d["id"], list(d["segmentsFrom"]), list(d["segmentsTo"]),
+                   d.get("state", IN_PROGRESS), d.get("timestampMs", 0))
+
+
+class SegmentLineageManager:
+    """Controller-side lineage book-keeping over the state store."""
+
+    def __init__(self, store):
+        self.store = store
+
+    def _path(self, table: str) -> str:
+        return f"lineage/{table}"
+
+    def _load(self, table: str) -> List[LineageEntry]:
+        raw = self.store.get(self._path(table)) or []
+        return [LineageEntry.from_dict(d) for d in raw]
+
+    def _save(self, table: str, entries: List[LineageEntry]) -> None:
+        self.store.set(self._path(table), [e.to_dict() for e in entries])
+
+    # -- protocol (ref: PinotSegmentRestletResource start/end/revert) -------
+    def start_replace(self, table: str, segments_from: List[str],
+                      segments_to: List[str]) -> str:
+        entries = self._load(table)
+        active: Set[str] = set()
+        for e in entries:
+            if e.state == IN_PROGRESS:
+                active.update(e.segments_from)
+        overlap = active & set(segments_from)
+        if overlap:
+            raise ValueError(
+                f"segments already in an in-progress replacement: "
+                f"{sorted(overlap)}")
+        entry = LineageEntry(
+            entry_id=f"lin_{int(time.time() * 1000)}_{next(_counter)}",
+            segments_from=list(segments_from),
+            segments_to=list(segments_to),
+            state=IN_PROGRESS,
+            timestamp_ms=int(time.time() * 1000))
+        self._save(table, entries + [entry])
+        return entry.entry_id
+
+    def end_replace(self, table: str, entry_id: str) -> None:
+        self._set_state(table, entry_id, from_state=IN_PROGRESS,
+                        to_state=COMPLETED)
+
+    def revert_replace(self, table: str, entry_id: str) -> None:
+        self._set_state(table, entry_id, from_state=IN_PROGRESS,
+                        to_state=REVERTED)
+
+    def _set_state(self, table: str, entry_id: str, from_state: str,
+                   to_state: str) -> None:
+        entries = self._load(table)
+        for e in entries:
+            if e.entry_id == entry_id:
+                if e.state != from_state:
+                    raise ValueError(
+                        f"lineage entry {entry_id} is {e.state}, "
+                        f"not {from_state}")
+                e.state = to_state
+                self._save(table, entries)
+                return
+        raise KeyError(f"no lineage entry {entry_id} for {table}")
+
+    def entries(self, table: str) -> List[LineageEntry]:
+        return self._load(table)
+
+    # -- stale-entry cleanup (ref: RetentionManager's lineage GC) -----------
+    def cleanup(self, table: str, max_age_ms: int = 24 * 3_600_000,
+                now_ms: Optional[int] = None) -> List[str]:
+        """Auto-revert IN_PROGRESS entries older than ``max_age_ms`` (the
+        minion died mid-replacement: free its inputs for a retry, keep its
+        half-uploaded outputs hidden) and drop terminal entries of that age
+        whose visibility effect has been realized (COMPLETED inputs /
+        REVERTED outputs no longer in the segment list). Returns the ids of
+        entries touched."""
+        import time as _time
+
+        now = int(_time.time() * 1000) if now_ms is None else now_ms
+        entries = self._load(table)
+        live = set(self.store.segment_names(table))
+        touched: List[str] = []
+        kept: List[LineageEntry] = []
+        for e in entries:
+            age = now - e.timestamp_ms
+            if age <= max_age_ms:
+                kept.append(e)
+                continue
+            if e.state == IN_PROGRESS:
+                e.state = REVERTED
+                touched.append(e.entry_id)
+                kept.append(e)
+            elif e.state == COMPLETED and not (set(e.segments_from) & live):
+                touched.append(e.entry_id)  # effect realized: drop
+            elif e.state == REVERTED and not (set(e.segments_to) & live):
+                touched.append(e.entry_id)
+            else:
+                kept.append(e)
+        if touched:
+            self._save(table, kept)
+        return touched
+
+    # -- visibility (ref: filterSegmentsBasedOnLineageInPlace) --------------
+    def hidden_segments(self, table: str) -> Set[str]:
+        hidden: Set[str] = set()
+        for e in self._load(table):
+            if e.state == COMPLETED:
+                hidden.update(e.segments_from)
+            else:  # IN_PROGRESS outputs are not yet queryable; REVERTED ever
+                hidden.update(e.segments_to)
+        return hidden

@@ -1,0 +1,136 @@
+"""Tiered storage: age-based segment relocation to tagged servers.
+
+Re-design of the reference's tier model (``pinot-spi/.../config/table/
+TierConfig.java``, ``pinot-common/.../tier/TierFactory`` +
+``TimeBasedTierSegmentSelector``, applied by the controller's
+``SegmentRelocator``): a table declares ordered tiers, each selecting
+segments older than a threshold and naming the server tag that should hold
+them; the relocator periodic task recomputes each segment's target tier and
+rewrites IdealState entries whose instances don't match the tier's tag.
+
+Age here is measured from the segment's push/creation wall-clock time (the
+reference converts the time-column end time to millis; raw time-column
+units are not globally convertible in this build, and push age is the
+operational quantity tiering actually manages).
+"""
+
+from __future__ import annotations
+
+import re
+import time
+
+from dataclasses import dataclass
+from typing import Dict, List, Optional
+
+_AGE_RE = re.compile(r"^(\d+)\s*(ms|s|m|h|d)$", re.I)
+_UNIT_MS = {"ms": 1, "s": 1000, "m": 60_000, "h": 3_600_000,
+            "d": 86_400_000}
+
+
+def parse_age_ms(text: str) -> int:
+    m = _AGE_RE.match(str(text).strip())
+    if not m:
+        raise ValueError(f"bad segmentAge {text!r} (want e.g. '7d', '24h')")
+    return int(m.group(1)) * _UNIT_MS[m.group(2).lower()]
+
+
+@dataclass
+class TierConfig:
+    """One tier (ref: TierConfig.java JSON layout)."""
+
+    name: str
+    segment_age: str = "0d"            # segments OLDER than this belong here
+    server_tag: str = "DefaultTenant"
+    segment_selector_type: str = "time"
+    storage_type: str = "pinot_server"
+
+    def to_dict(self) -> Dict:
+        return {"name": self.name, "segmentSelectorType":
+                self.segment_selector_type, "segmentAge": self.segment_age,
+                "storageType": self.storage_type,
+                "serverTag": self.server_tag}
+
+    @classmethod
+    def from_dict(cls, d: Dict) -> "TierConfig":
+        return cls(name=d["name"],
+                   segment_age=d.get("segmentAge", "0d"),
+                   server_tag=d.get("serverTag", "DefaultTenant"),
+                   segment_selector_type=d.get("segmentSelectorType", "time"),
+                   storage_type=d.get("storageType", "pinot_server"))
+
+
+def target_tier(tiers: List[TierConfig], age_ms: int) -> Optional[TierConfig]:
+    """The matching tier with the LARGEST age threshold the segment exceeds
+    (ref: TierConfigUtils.getSortedTiers — most specific tier wins)."""
+    best: Optional[TierConfig] = None
+    best_age = -1
+    for t in tiers:
+        if t.segment_selector_type.lower() != "time":
+            continue
+        thresh = parse_age_ms(t.segment_age)
+        if age_ms >= thresh and thresh > best_age:
+            best = t
+            best_age = thresh
+    return best
+
+
+class SegmentRelocator:
+    """Controller periodic task (ref: helix/core/relocation/SegmentRelocator)."""
+
+    def __init__(self, store):
+        self.store = store
+
+    def relocate_table(self, table: str,
+                       now_ms: Optional[int] = None) -> List[str]:
+        """-> names of segments whose IdealState moved to a new tier's
+        servers. The server reconcile loop then downloads/drops per the
+        updated map, and the external view follows."""
+        cfg = self.store.get_table_config(table)
+        tiers = [TierConfig.from_dict(d)
+                 for d in (cfg.tier_configs or [])] if cfg else []
+        if not tiers:
+            return []
+        now = int(time.time() * 1000) if now_ms is None else now_ms
+        servers_by_tag: Dict[str, List[str]] = {}
+        for inst in self.store.instances("SERVER"):
+            if not inst.alive:
+                continue
+            for tag in inst.tags:
+                servers_by_tag.setdefault(tag, []).append(inst.instance_id)
+
+        import zlib
+
+        replication = cfg.replication if cfg else 1
+        moved: List[str] = []
+
+        def apply(ideal):
+            # atomic read-modify-write under the store lock: a segment
+            # uploaded concurrently must not be clobbered out of the map
+            moved.clear()
+            ideal = dict(ideal or {})
+            for segment, inst_map in list(ideal.items()):
+                md = self.store.get_segment_metadata(table, segment)
+                if md is None or md.status != "ONLINE":
+                    continue
+                ts = md.push_time_ms or md.creation_time_ms
+                if not ts:
+                    continue
+                tier = target_tier(tiers, now - ts)
+                if tier is None:
+                    continue
+                pool = sorted(servers_by_tag.get(tier.server_tag, []))
+                if not pool:
+                    continue  # no server carries the tag: leave alone
+                if set(inst_map.keys()) <= set(pool):
+                    continue  # already on the tier
+                n = min(replication, len(pool))
+                # stable choice: crc-offset round robin keeps segments
+                # spread (process-salted hash() reshuffles every restart)
+                start = zlib.crc32(segment.encode("utf-8")) % len(pool)
+                chosen = [pool[(start + i) % len(pool)] for i in range(n)]
+                ideal[segment] = {inst: "ONLINE" for inst in chosen}
+                moved.append(segment)
+            return ideal
+
+        self.store.update_ideal_state(table, apply)
+        return list(moved)

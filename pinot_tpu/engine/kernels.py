@@ -250,17 +250,48 @@ def build_kernel(spec: Tuple):
 # in sequential small D2H fetches). f64 keeps counts and i32-ranged sums
 # exact to 2^53; SUM finalizes as double anyway (ref: the reference
 # aggregates SUM in double, AggregationFunctionType SUM -> DOUBLE).
+#
+# SPARSE COMPACTION: dense group-by outputs scale with the PADDED key space
+# (SSB Q4.3: 2^20 slots for ~800 real groups -> megabytes over the tunnel
+# per query). At >= COMPACT_MIN_GROUPS the pack switches to a compact
+# layout — device-side ``nonzero(presence, size=K)`` + gathers — so D2H
+# scales with actual groups (the fixed-shape analogue of the reference's
+# DictionaryBasedGroupKeyGenerator cardinality ladder switching from dense
+# arrays to maps). More than K live groups raises PlanError at decode and
+# the executor falls back to the host path (full results, never truncation).
 # --------------------------------------------------------------------------
+
+COMPACT_MIN_GROUPS = 8192
+COMPACT_K = 8192
+
+
+def compact_mode(spec: Tuple) -> int:
+    """0 = dense; else the compact K for this spec. distinctcount/HLL
+    leaves carry their own [cardinality]/[G*m] shapes and stay dense."""
+    _, agg_specs, group_specs, num_groups, _ = spec
+    if not group_specs or num_groups < COMPACT_MIN_GROUPS:
+        return 0
+    if any(a[0] in ("distinctcount", "distinctcounthll") for a in agg_specs):
+        return 0
+    return min(COMPACT_K, num_groups)
 
 def output_layout(spec: Tuple, num_seg: int = 0) -> List[Tuple[str, int]]:
     """[(key, size)] slices of the packed vector, in pack order. Key
     ``aggI.J`` is leaf J of a multi-leaf aggregation state (avg, minmaxrange).
     ``num_seg > 0`` appends the sharded combine's per-segment matched-doc
-    counts."""
+    counts. In compact mode, grouped leaves shrink to K gathered entries
+    prefixed by the live-group count and their group indices."""
     _, agg_specs, group_specs, num_groups, _ = spec
+    K = compact_mode(spec)
+    if K:
+        num_groups = K
     reducers = partial_reduce_ops(spec)
     entries: List[Tuple[str, int]] = []
-    if group_specs:
+    if K:
+        entries.append(("compact_n", 1))
+        entries.append(("compact_idx", K))
+        entries.append(("presence", K))
+    elif group_specs:
         entries.append(("presence", num_groups))
     else:
         entries.append(("num_matched", 1))
@@ -286,13 +317,28 @@ def output_layout(spec: Tuple, num_seg: int = 0) -> List[Tuple[str, int]]:
 def pack_outputs(out: Dict[str, Any], spec: Tuple) -> jnp.ndarray:
     """Flatten the kernel output tree into one f64 vector (device side)."""
     num_seg = out["seg_matched"].shape[0] if "seg_matched" in out else 0
+    K = compact_mode(spec)
+    idx = None
+    if K:
+        presence = out["presence"]
+        # fill 0 is safe: positions >= n are ignored by the decode
+        idx = jnp.nonzero(presence > 0, size=K, fill_value=0)[0]
+        n = (presence > 0).sum(dtype=jnp.int32)
     parts = []
     for key, _ in output_layout(spec, num_seg):
-        if "." in key:
+        if key == "compact_n":
+            leaf = n
+        elif key == "compact_idx":
+            leaf = idx
+        elif "." in key:
             k, j = key.split(".")
             leaf = out[k][int(j)]
+            if idx is not None:
+                leaf = jnp.asarray(leaf)[idx]
         else:
             leaf = out[key]
+            if idx is not None and key != "seg_matched":
+                leaf = jnp.asarray(leaf)[idx]
         parts.append(jnp.asarray(leaf, dtype=jnp.float64).reshape(-1))
     return jnp.concatenate(parts) if len(parts) > 1 else parts[0]
 
@@ -300,27 +346,56 @@ def pack_outputs(out: Dict[str, Any], spec: Tuple) -> jnp.ndarray:
 def unpack_outputs(packed, spec: Tuple, num_seg: int = 0) -> Dict[str, Any]:
     """Packed f64 vector (host numpy) -> the kernel output tree the decode
     helpers consume. Scalar leaves come back as python-indexable scalars,
-    vector leaves (grouped/presence/seg_matched) as arrays."""
+    vector leaves (grouped/presence/seg_matched) as arrays. Compact-mode
+    leaves are scattered back into dense [num_groups] arrays host-side
+    (cheap zeros; the expensive part was shipping them over the tunnel)."""
     import numpy as np
 
     packed = np.asarray(packed)
     grouped = bool(spec[2])
+    num_groups = spec[3]
+    K = compact_mode(spec)
     dc = {f"agg{i}" for i, a in enumerate(spec[1])
           if a[0] in ("distinctcount", "distinctcounthll")}
     out: Dict[str, Any] = {}
     multi: Dict[str, Dict[int, Any]] = {}
     off = 0
+    n = 0
+    idx = None
+
+    def expand(leaf):
+        if idx is None:
+            return leaf
+        dense = np.zeros(num_groups, dtype=leaf.dtype)
+        dense[idx] = leaf[:n]
+        return dense
+
     for key, size in output_layout(spec, num_seg):
         leaf = packed[off:off + size]
         off += size
+        if key == "compact_n":
+            n = int(leaf[0])
+            if n > K:
+                from pinot_tpu.engine.plan import PlanError
+
+                raise PlanError(
+                    f"{n} live groups exceed the compact cap {K} "
+                    f"-> host path serves the full result")
+            continue
+        if key == "compact_idx":
+            idx = leaf[:n].astype(np.int64)
+            continue
         if "." in key:
             k, j = key.split(".")
-            multi.setdefault(k, {})[int(j)] = leaf if grouped else leaf[0]
+            multi.setdefault(k, {})[int(j)] = \
+                expand(leaf) if grouped else leaf[0]
             continue
         if key == "num_matched":
             out[key] = leaf[0]
-        elif key == "seg_matched" or grouped or key in dc:
+        elif key == "seg_matched":
             out[key] = leaf
+        elif grouped or key in dc:
+            out[key] = expand(leaf)
         else:
             out[key] = leaf[0]
     for k, leaves in multi.items():

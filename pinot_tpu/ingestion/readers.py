@@ -164,19 +164,30 @@ class ParquetRecordReader(RecordReader):
 
 
 class AvroRecordReader(RecordReader):
-    """Gated: no avro library in this environment (ref: pinot-avro)."""
+    """Avro container files via the from-scratch binary decoder
+    (ingestion/avro.py; ref: pinot-avro AvroRecordReader over
+    org.apache.avro DataFileStream)."""
 
     def init(self, data_file: str,
              fields_to_read: Optional[Sequence[str]] = None,
              config: Optional[RecordReaderConfig] = None) -> None:
-        raise NotImplementedError(
-            "avro input requires an avro library (not bundled); convert to "
-            "parquet/csv/json or install fastavro")
+        self._path = data_file
+        self._fields = set(fields_to_read) if fields_to_read else None
 
     def __iter__(self) -> Iterator[GenericRow]:
-        raise NotImplementedError
+        from pinot_tpu.ingestion.avro import read_container
 
-    def rewind(self) -> None:
+        _, values = read_container(self._path)
+        for rec in values:
+            if not isinstance(rec, dict):
+                rec = {"value": rec}
+            row = GenericRow()
+            for k, v in rec.items():
+                if self._fields is None or k in self._fields:
+                    row[k] = v
+            yield row
+
+    def rewind(self) -> None:  # iteration re-reads the file
         pass
 
 

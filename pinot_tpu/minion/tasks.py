@@ -134,10 +134,20 @@ class MergeRollupTaskExecutor(BaseTaskExecutor):
                 task.configs.get("maxNumRecordsPerSegment", "5000000")),
         ))
         out_dirs = proc.process(os.path.join(ctx.work_dir, task.task_id))
-        names = self._upload(ctx, task.table, out_dirs)
-        # segment replacement: every input row was re-emitted into some
-        # bucketed output above, so delete-after-add loses nothing (ref:
-        # segment lineage replacement via SegmentReplacementProtocol)
+        # lineage replace protocol: outputs hidden while uploading, then the
+        # COMPLETED flip atomically swaps visibility — queries never see
+        # inputs and outputs together (ref: SegmentReplacementProtocol via
+        # start/endReplaceSegments; controller/lineage.py)
+        out_names = [os.path.basename(d) for d in out_dirs]
+        entry_id = ctx.controller.start_replace_segments(
+            task.table, list(task.input_segments), out_names)
+        try:
+            names = self._upload(ctx, task.table, out_dirs)
+            ctx.controller.end_replace_segments(task.table, entry_id)
+        except Exception:
+            ctx.controller.revert_replace_segments(task.table, entry_id)
+            raise
+        # inputs are lineage-hidden now; physical deletion reclaims space
         for name in task.input_segments:
             ctx.controller.delete_segment(task.table, name)
         return names

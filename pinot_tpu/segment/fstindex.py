@@ -28,11 +28,13 @@ MAX_DEPTH = 16  # trie depth cap: deeper prefixes narrow via dictId binsearch
 
 
 class FstIndexBuilder:
-    """Builds the CSR trie over sorted utf-8 terms."""
+    """Builds the CSR trie over sorted utf-8 terms. Depth is fixed at
+    MAX_DEPTH: the reader's walk predicate must agree with the builder's
+    expansion rule, so the cap is a module contract, not a parameter."""
 
-    def __init__(self, terms: List[str], max_depth: int = MAX_DEPTH):
+    def __init__(self, terms: List[str]):
         self.terms = [t.encode("utf-8") for t in terms]
-        self.max_depth = max_depth
+        self.max_depth = MAX_DEPTH
 
     def build(self) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
         """-> (edge_offsets [n_nodes+1], edge_labels [n_edges] u8,

@@ -39,9 +39,12 @@ def build_geo_index(values, resolution: int, save) -> bool:
             return False
         lngs.append(g.x)
         lats.append(g.y)
-    cells = geo.cells_of(np.asarray(lngs, dtype=np.float64),
-                         np.asarray(lats, dtype=np.float64), resolution)
+    lng_arr = np.asarray(lngs, dtype=np.float64)
+    lat_arr = np.asarray(lats, dtype=np.float64)
+    cells = geo.cells_of(lng_arr, lat_arr, resolution)
     save("geocells", cells.astype(np.int64))
+    save("geolng", lng_arr)
+    save("geolat", lat_arr)
     save("geometa", np.asarray([resolution], dtype=np.int64))
     return True
 
@@ -49,10 +52,14 @@ def build_geo_index(values, resolution: int, save) -> bool:
 class GeoIndexReader:
     """Query-side candidate narrowing."""
 
-    def __init__(self, cells: np.ndarray, resolution: int, dictionary):
+    def __init__(self, cells: np.ndarray, resolution: int, dictionary,
+                 lngs: Optional[np.ndarray] = None,
+                 lats: Optional[np.ndarray] = None):
         self.cells = np.asarray(cells)
         self.resolution = int(resolution)
         self.dictionary = dictionary
+        self.lngs = None if lngs is None else np.asarray(lngs)
+        self.lats = None if lats is None else np.asarray(lats)
 
     def candidate_dict_ids(self, lng: float, lat: float,
                            radius_m: float) -> np.ndarray:
@@ -72,11 +79,15 @@ class GeoIndexReader:
         cand = self.candidate_dict_ids(lng, lat, radius_m)
         if cand.size == 0:
             return cand
-        xs = np.empty(cand.size)
-        ys = np.empty(cand.size)
-        for j, i in enumerate(cand):
-            g = geo.parse_ewkt(self.dictionary.get_value(int(i)))
-            xs[j], ys[j] = g.x, g.y
+        if self.lngs is not None:
+            # stored coordinate arrays: pure vectorized exact pass
+            xs, ys = self.lngs[cand], self.lats[cand]
+        else:  # legacy index without coordinate arrays: parse candidates
+            xs = np.empty(cand.size)
+            ys = np.empty(cand.size)
+            for j, i in enumerate(cand):
+                g = geo.parse_ewkt(self.dictionary.get_value(int(i)))
+                xs[j], ys[j] = g.x, g.y
         d = geo.haversine_m(xs, ys, lng, lat)
         keep = d <= radius_m if inclusive else d < radius_m
         return cand[keep]

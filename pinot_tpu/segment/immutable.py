@@ -149,7 +149,9 @@ class DataSource:
         meta_arr = self._segment._load_array(self.name, "geometa")
         return GeoIndexReader(
             self._segment._load_array(self.name, "geocells"),
-            int(meta_arr[0]), self.dictionary)
+            int(meta_arr[0]), self.dictionary,
+            lngs=self._segment._load_array(self.name, "geolng"),
+            lats=self._segment._load_array(self.name, "geolat"))
 
     @cached_property
     def range_order(self):

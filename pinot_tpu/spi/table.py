@@ -420,6 +420,8 @@ class TableConfig:
     # taskType -> config map (ref: TableTaskConfig.java taskTypeConfigsMap)
     task_config: Dict[str, Dict[str, str]] = field(default_factory=dict)
     field_config_list: List[FieldConfig] = field(default_factory=list)
+    # tier configs ride as raw dicts (controller/tiers.TierConfig parses)
+    tier_configs: List[Dict[str, Any]] = field(default_factory=list)
 
     def __post_init__(self):
         if isinstance(self.table_type, str):
@@ -461,6 +463,8 @@ class TableConfig:
         if self.field_config_list:
             d["fieldConfigList"] = [c.to_dict()
                                     for c in self.field_config_list]
+        if self.tier_configs:
+            d["tierConfigs"] = self.tier_configs
         return d
 
     def to_json(self) -> str:
@@ -495,6 +499,7 @@ class TableConfig:
             task_config=(d.get("task") or {}).get("taskTypeConfigsMap", {}),
             field_config_list=[FieldConfig.from_dict(c)
                                for c in d.get("fieldConfigList") or []],
+            tier_configs=d.get("tierConfigs") or [],
         )
 
     @classmethod

@@ -28,10 +28,21 @@ Route = Tuple[str, re.Pattern, Callable]
 
 
 class _Api:
-    """Tiny method+path router on ThreadingHTTPServer."""
+    """Tiny method+path router on ThreadingHTTPServer.
 
-    def __init__(self, port: int = 0):
+    ``access_control`` guards every route (ref: the AccessControlFactory
+    hook in BaseBrokerStarter / controller admin app): unauthenticated
+    requests get 401, authenticated-but-unauthorized get 403. Health
+    endpoints stay open (liveness probes don't carry credentials)."""
+
+    OPEN_PATHS = ("/health",)
+
+    def __init__(self, port: int = 0, access_control=None):
+        from pinot_tpu.spi.auth import AllowAllAccessControl
+
         self._routes: List[Route] = []
+        self.access_control = access_control or AllowAllAccessControl()
+        self._principal_local = threading.local()
         api = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -41,6 +52,29 @@ class _Api:
 
             def _dispatch(self, method: str):
                 try:
+                    path_only = self.path.split("?", 1)[0]
+                    principal = api.access_control.authenticate(self.headers)
+                    if principal is None \
+                            and path_only not in api.OPEN_PATHS:
+                        self.send_response(401)
+                        self.send_header("WWW-Authenticate",
+                                         'Basic realm="pinot"')
+                        self.send_header("Content-Length", "0")
+                        self.end_headers()
+                        return
+                    if path_only not in api.OPEN_PATHS:
+                        # method-level authorization: mutations need WRITE
+                        # (per-table scoping is enforced at the query route)
+                        from pinot_tpu.spi.auth import READ, WRITE
+
+                        # POST /query/sql is a read despite the verb
+                        access = READ if (method == "GET" or path_only
+                                          == "/query/sql") else WRITE
+                        if not api.access_control.has_access(
+                                principal, None, access):
+                            self.send_error(403, "permission denied")
+                            return
+                    api._principal_local.value = principal
                     body = None
                     n = int(self.headers.get("Content-Length") or 0)
                     if n:
@@ -91,6 +125,10 @@ class _Api:
     def route(self, method: str, pattern: str, fn: Callable) -> None:
         self._routes.append((method, re.compile(pattern), fn))
 
+    def current_principal(self):
+        """The principal of the request being dispatched on THIS thread."""
+        return getattr(self._principal_local, "value", None)
+
     def start(self) -> None:
         self._thread = threading.Thread(target=self._httpd.serve_forever,
                                         daemon=True, name="rest-api")
@@ -99,6 +137,18 @@ class _Api:
     def stop(self) -> None:
         self._httpd.shutdown()
         self._httpd.server_close()
+
+
+_FROM_RE = re.compile(r'\bFROM\s+(?:"([^"]+)"|([A-Za-z_][\w.]*))', re.I)
+
+
+def _table_of_sql(sql: str) -> Optional[str]:
+    """Table name for authorization scoping (quoted or bare). A miss makes
+    table-scoped principals FAIL CLOSED at the query route — never open."""
+    m = _FROM_RE.search(sql or "")
+    if not m:
+        return None
+    return m.group(1) if m.group(1) is not None else m.group(2)
 
 
 class ControllerApi(_Api):
@@ -207,11 +257,21 @@ class ControllerApi(_Api):
 class BrokerApi(_Api):
     """Ref: broker api/resources PinotClientRequest — POST /query/sql."""
 
-    def __init__(self, broker, port: int = 0):
-        super().__init__(port)
+    def __init__(self, broker, port: int = 0, access_control=None):
+        super().__init__(port, access_control=access_control)
 
         def query(m, body):
             sql = (body or {}).get("sql", "")
+            table = _table_of_sql(sql)
+            from pinot_tpu.spi.auth import READ
+
+            principal = self.current_principal()
+            scoped = bool(getattr(principal, "tables", None))
+            if (table is None and scoped) or not self.access_control \
+                    .has_access(principal, table, READ):
+                # unresolvable table + table-scoped principal fails CLOSED
+                return 403, {"exceptions": [
+                    f"Permission denied for table {table!r}"]}
             resp = broker.handle_sql(sql)
             return 200, resp.to_dict()
 

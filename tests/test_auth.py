@@ -1,0 +1,121 @@
+"""Access control: basic-auth REST guard + table scoping
+(ref: AccessControlFactory, BasicAuthAccessControlFactory)."""
+
+import base64
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+from pinot_tpu.spi.auth import (
+    AllowAllAccessControl,
+    BasicAuthAccessControl,
+    Principal,
+    access_control_from_config,
+)
+
+
+def _basic(user, pw):
+    return "Basic " + base64.b64encode(
+        f"{user}:{pw}".encode()).decode("ascii")
+
+
+class TestSpi:
+    def test_allow_all(self):
+        ac = AllowAllAccessControl()
+        assert ac.authenticate({}) is not None
+        assert ac.has_access(None, "t", "WRITE")
+
+    def test_basic_auth_rejects_bad_credentials(self):
+        ac = BasicAuthAccessControl([Principal("admin", "secret")])
+        assert ac.authenticate({}) is None
+        assert ac.authenticate(
+            {"Authorization": _basic("admin", "wrong")}) is None
+        p = ac.authenticate({"Authorization": _basic("admin", "secret")})
+        assert p.name == "admin"
+        assert ac.has_access(p, "anything", "WRITE")
+
+    def test_table_and_permission_scoping(self):
+        p = Principal("ro", "x", tables=["sales"], permissions=["READ"])
+        assert p.allows("sales_OFFLINE", "READ")
+        assert p.allows("sales", "read")
+        assert not p.allows("sales", "WRITE")
+        assert not p.allows("other", "READ")
+        # unscoped principal allows everything
+        assert Principal("admin").allows("any", "WRITE")
+
+    def test_factory(self):
+        assert isinstance(access_control_from_config(None),
+                          AllowAllAccessControl)
+        ac = access_control_from_config({"type": "basic", "principals": [
+            {"username": "u", "password": "p", "tables": ["t"]}]})
+        assert isinstance(ac, BasicAuthAccessControl)
+        with pytest.raises(ValueError):
+            access_control_from_config({"type": "kerberos"})
+
+
+class TestRestGuard:
+    @pytest.fixture(scope="class")
+    def cluster(self, tmp_path_factory):
+        import numpy as np
+
+        from pinot_tpu.segment import SegmentBuilder
+        from pinot_tpu.spi import DataType, FieldSpec, FieldType, Schema
+        from pinot_tpu.tools.cluster import EmbeddedCluster
+        from pinot_tpu.transport.rest import BrokerApi
+
+        out = str(tmp_path_factory.mktemp("auth"))
+        schema = Schema("sales", [
+            FieldSpec("region", DataType.STRING),
+            FieldSpec("qty", DataType.LONG, FieldType.METRIC),
+        ])
+        rng = np.random.default_rng(3)
+        frame = {"region": ["east", "west"] * 500,
+                 "qty": rng.integers(0, 10, 1000).tolist()}
+        from pinot_tpu.spi.table import TableConfig
+
+        cluster = EmbeddedCluster(data_dir=out)
+        cluster.create_table(TableConfig(table_name="sales"), schema)
+        seg_dir = str(tmp_path_factory.mktemp("authseg"))
+        SegmentBuilder(schema, "sales_0").build(frame, seg_dir)
+        cluster.upload_segment_dir("sales_OFFLINE", f"{seg_dir}/sales_0")
+        cluster.wait_for_ev_converged("sales_OFFLINE")
+        ac = access_control_from_config({"type": "basic", "principals": [
+            {"username": "admin", "password": "s3cret"},
+            {"username": "scoped", "password": "pw", "tables": ["other"]},
+        ]})
+        api = BrokerApi(cluster.broker, access_control=ac)
+        api.start()
+        yield api
+        api.stop()
+        cluster.shutdown()
+
+    def _query(self, api, auth=None):
+        req = urllib.request.Request(
+            f"http://localhost:{api.port}/query/sql",
+            data=json.dumps({"sql": "SELECT count(*) FROM sales"}).encode(),
+            headers={"Content-Type": "application/json",
+                     **({"Authorization": auth} if auth else {})})
+        with urllib.request.urlopen(req, timeout=10) as r:
+            return r.status, json.loads(r.read().decode())
+
+    def test_unauthenticated_401(self, cluster):
+        with pytest.raises(urllib.error.HTTPError) as e:
+            self._query(cluster)
+        assert e.value.code == 401
+
+    def test_health_stays_open(self, cluster):
+        with urllib.request.urlopen(
+                f"http://localhost:{cluster.port}/health", timeout=10) as r:
+            assert r.status == 200
+
+    def test_authenticated_query(self, cluster):
+        status, payload = self._query(cluster, _basic("admin", "s3cret"))
+        assert status == 200
+        assert payload["resultTable"]["rows"][0][0] == 1000
+
+    def test_scoped_principal_403(self, cluster):
+        with pytest.raises(urllib.error.HTTPError) as e:
+            self._query(cluster, _basic("scoped", "pw"))
+        assert e.value.code == 403

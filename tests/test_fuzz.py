@@ -1,0 +1,184 @@
+"""Random query fuzzer: device executor vs host engine vs pandas.
+
+Re-design of the reference's random query generator
+(``pinot-integration-tests/.../QueryGenerator.java:65`` — fuzzes
+selection/aggregation/group-by queries against Pinot and the H2 oracle):
+seeded random SQL over a synthetic table, executed through the sharded
+device executor AND the host (numpy) engine, with pandas as the
+independent oracle for the aggregation algebra.
+"""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from pinot_tpu.engine import ServerQueryExecutor
+from pinot_tpu.parallel import ShardedQueryExecutor
+from pinot_tpu.query import compile_query
+from pinot_tpu.segment import SegmentBuilder, load_segment
+from pinot_tpu.spi import DataType, FieldSpec, FieldType, Schema
+
+N_QUERIES = 40
+N_SEGMENTS = 3
+DOCS = 4096
+
+DIMS = {"color": ["red", "green", "blue", "gold"],
+        "shape": ["circle", "square", "tri"]}
+INT_COLS = ["year", "qty"]
+FLOAT_COLS = ["price"]
+AGGS = ["count(*)", "sum(qty)", "min(price)", "max(price)", "avg(qty)",
+        "minmaxrange(year)", "distinctcount(color)", "sum(qty * price)"]
+
+
+def _frame(n, seed):
+    rng = np.random.default_rng(seed)
+    return pd.DataFrame({
+        "color": np.asarray(DIMS["color"])[rng.integers(0, 4, n)],
+        "shape": np.asarray(DIMS["shape"])[rng.integers(0, 3, n)],
+        "year": rng.integers(2000, 2020, n),
+        "qty": rng.integers(0, 100, n),
+        "price": np.round(rng.uniform(1, 500, n), 2),
+    })
+
+
+@pytest.fixture(scope="module")
+def table(tmp_path_factory):
+    out = str(tmp_path_factory.mktemp("fuzz"))
+    schema = Schema("fz", [
+        FieldSpec("color", DataType.STRING),
+        FieldSpec("shape", DataType.STRING),
+        FieldSpec("year", DataType.INT),
+        FieldSpec("qty", DataType.LONG, FieldType.METRIC),
+        FieldSpec("price", DataType.DOUBLE, FieldType.METRIC),
+    ])
+    frames, segs = [], []
+    for i in range(N_SEGMENTS):
+        df = _frame(DOCS, seed=50 + i)
+        frames.append(df)
+        SegmentBuilder(schema, f"fz_{i}").build(
+            {c: df[c].tolist() for c in df.columns}, out)
+        segs.append(load_segment(f"{out}/fz_{i}"))
+    return segs, pd.concat(frames, ignore_index=True)
+
+
+def _rand_predicate(rng):
+    kind = rng.integers(0, 6)
+    if kind == 0:
+        c = rng.choice(list(DIMS))
+        v = rng.choice(DIMS[c])
+        return f"{c} = '{v}'", lambda df: df[c] == v
+    if kind == 1:
+        c = rng.choice(list(DIMS))
+        v = rng.choice(DIMS[c])
+        return f"{c} != '{v}'", lambda df: df[c] != v
+    if kind == 2:
+        c = rng.choice(list(DIMS))
+        vs = list(rng.choice(DIMS[c], size=2, replace=False))
+        quoted = ", ".join(f"'{v}'" for v in vs)
+        return f"{c} IN ({quoted})", lambda df: df[c].isin(vs)
+    if kind == 3:
+        lo, hi = sorted(rng.integers(2000, 2020, 2).tolist())
+        return (f"year BETWEEN {lo} AND {hi}",
+                lambda df: (df.year >= lo) & (df.year <= hi))
+    if kind == 4:
+        v = int(rng.integers(0, 100))
+        return f"qty > {v}", lambda df: df.qty > v
+    v = float(np.round(rng.uniform(1, 500), 2))
+    return f"price <= {v}", lambda df: df.price <= v
+
+
+def _rand_filter(rng):
+    n = int(rng.integers(0, 3))
+    if n == 0:
+        return "", lambda df: pd.Series(True, index=df.index)
+    parts, fns = [], []
+    for _ in range(n):
+        sql, fn = _rand_predicate(rng)
+        parts.append(sql)
+        fns.append(fn)
+    op = " AND " if rng.integers(0, 2) else " OR "
+    sql = " WHERE " + op.join(parts)
+    if op == " AND ":
+        return sql, lambda df: np.logical_and.reduce([f(df) for f in fns])
+    return sql, lambda df: np.logical_or.reduce([f(df) for f in fns])
+
+
+def _pandas_agg(df, agg):
+    if not len(df):
+        return {"count(*)": 0}.get(agg)  # empty-group semantics vary; skip
+    if agg == "count(*)":
+        return len(df)
+    if agg == "sum(qty)":
+        return float(df.qty.sum())
+    if agg == "min(price)":
+        return float(df.price.min())
+    if agg == "max(price)":
+        return float(df.price.max())
+    if agg == "avg(qty)":
+        return float(df.qty.mean())
+    if agg == "minmaxrange(year)":
+        return float(df.year.max() - df.year.min())
+    if agg == "distinctcount(color)":
+        return df.color.nunique()
+    if agg == "sum(qty * price)":
+        return float((df.qty * df.price).sum())
+    raise AssertionError(agg)
+
+
+def _close(a, b):
+    if b is None:
+        return True  # empty-group: engine semantics checked by parity below
+    if isinstance(b, float):
+        return abs(a - b) <= 1e-6 * max(1.0, abs(b))
+    return a == b
+
+
+@pytest.mark.parametrize("qi", range(N_QUERIES))
+def test_fuzz_query(table, qi):
+    segs, df = table
+    rng = np.random.default_rng(1234 + qi)
+    n_aggs = int(rng.integers(1, 4))
+    aggs = list(rng.choice(AGGS, size=n_aggs, replace=False))
+    where, mask_fn = _rand_filter(rng)
+    group = []
+    if rng.integers(0, 2):
+        group = list(rng.choice(list(DIMS), size=int(rng.integers(1, 3)),
+                                replace=False))
+    cols = ", ".join(group + aggs)
+    sql = f"SELECT {cols} FROM fz{where}"
+    if group:
+        sql += f" GROUP BY {', '.join(group)}"
+        sql += f" ORDER BY {', '.join(group)} LIMIT 10000"
+
+    device = ShardedQueryExecutor()
+    host = ServerQueryExecutor(use_device=False)
+    dev_rt, _ = device.execute(compile_query(sql), segs)
+    host_rt, _ = host.execute(compile_query(sql), segs)
+
+    # 1) device/host parity (exact algebra match)
+    assert len(dev_rt.rows) == len(host_rt.rows), sql
+    for dr, hr in zip(dev_rt.rows, host_rt.rows):
+        for d, h in zip(dr, hr):
+            if isinstance(h, float):
+                assert abs(d - h) <= 1e-4 * max(1.0, abs(h)), (sql, d, h)
+            else:
+                assert d == h, (sql, d, h)
+
+    # 2) pandas oracle
+    fdf = df[mask_fn(df)]
+    if not group:
+        assert len(dev_rt.rows) == 1, sql
+        for val, agg in zip(dev_rt.rows[0], aggs):
+            expect = _pandas_agg(fdf, agg)
+            assert _close(val, expect), (sql, agg, val, expect)
+    else:
+        expect_groups = {k if isinstance(k, tuple) else (k,): g
+                         for k, g in fdf.groupby(group)}
+        got_keys = {tuple(r[:len(group)]) for r in dev_rt.rows}
+        assert got_keys == set(expect_groups.keys()), sql
+        for row in dev_rt.rows:
+            key = tuple(row[:len(group)])
+            g = expect_groups[key]
+            for val, agg in zip(row[len(group):], aggs):
+                expect = _pandas_agg(g, agg)
+                assert _close(val, expect), (sql, key, agg, val, expect)

@@ -1,0 +1,183 @@
+"""Segment lineage (replace protocol + routing visibility) and tiered
+storage relocation (ref: SegmentLineage.java, TierConfig.java,
+SegmentRelocator)."""
+
+import pytest
+
+from pinot_tpu.broker.routing import RoutingManager
+from pinot_tpu.controller.lineage import (
+    COMPLETED,
+    IN_PROGRESS,
+    SegmentLineageManager,
+)
+from pinot_tpu.controller.state import (
+    ClusterStateStore,
+    InstanceInfo,
+    SegmentZKMetadata,
+)
+from pinot_tpu.controller.tiers import (
+    SegmentRelocator,
+    TierConfig,
+    parse_age_ms,
+    target_tier,
+)
+from pinot_tpu.spi.table import TableConfig, TableType
+
+
+@pytest.fixture
+def store():
+    return ClusterStateStore()
+
+
+TABLE = "t_OFFLINE"
+
+
+def _seed(store, segments=("s0", "s1", "s2"), servers=("srv1", "srv2")):
+    store.add_table_config(TableConfig(table_name="t"))
+    for s in servers:
+        store.register_instance(InstanceInfo(s, "SERVER"))
+    ideal = {}
+    for seg in segments:
+        store.set_segment_metadata(SegmentZKMetadata(
+            seg, TABLE, status="ONLINE", push_time_ms=1_000_000))
+        ideal[seg] = {servers[0]: "ONLINE"}
+    store.set_ideal_state(TABLE, ideal)
+    for seg in segments:
+        for inst, st in ideal[seg].items():
+            store.report_instance_state(TABLE, seg, inst, st)
+    return ideal
+
+
+class TestLineage:
+    def test_protocol_states(self, store):
+        lm = SegmentLineageManager(store)
+        eid = lm.start_replace(TABLE, ["s0", "s1"], ["m0"])
+        assert lm.entries(TABLE)[0].state == IN_PROGRESS
+        # while in progress: outputs hidden, inputs visible
+        assert lm.hidden_segments(TABLE) == {"m0"}
+        lm.end_replace(TABLE, eid)
+        assert lm.entries(TABLE)[0].state == COMPLETED
+        assert lm.hidden_segments(TABLE) == {"s0", "s1"}
+
+    def test_revert_hides_outputs_forever(self, store):
+        lm = SegmentLineageManager(store)
+        eid = lm.start_replace(TABLE, ["s0"], ["m0"])
+        lm.revert_replace(TABLE, eid)
+        assert lm.hidden_segments(TABLE) == {"m0"}
+        # inputs are free for a new attempt
+        lm.start_replace(TABLE, ["s0"], ["m1"])
+
+    def test_conflicting_start_rejected(self, store):
+        lm = SegmentLineageManager(store)
+        lm.start_replace(TABLE, ["s0"], ["m0"])
+        with pytest.raises(ValueError):
+            lm.start_replace(TABLE, ["s0", "s2"], ["m1"])
+
+    def test_double_end_rejected(self, store):
+        lm = SegmentLineageManager(store)
+        eid = lm.start_replace(TABLE, ["s0"], ["m0"])
+        lm.end_replace(TABLE, eid)
+        with pytest.raises(ValueError):
+            lm.end_replace(TABLE, eid)
+
+    def test_routing_respects_lineage(self, store):
+        _seed(store)
+        rm = RoutingManager(store)
+        routing, _ = rm.get_routing_table(TABLE)
+        assert sorted(sum(routing.values(), [])) == ["s0", "s1", "s2"]
+        lm = SegmentLineageManager(store)
+        eid = lm.start_replace(TABLE, ["s0", "s1"], ["m0"])
+        # m0 uploads mid-protocol: visible in EV but must not be routed
+        store.set_segment_metadata(SegmentZKMetadata(
+            "m0", TABLE, status="ONLINE"))
+        store.update_ideal_state(
+            TABLE, lambda i: {**i, "m0": {"srv1": "ONLINE"}})
+        store.report_instance_state(TABLE, "m0", "srv1", "ONLINE")
+        routing, _ = rm.get_routing_table(TABLE)
+        assert sorted(sum(routing.values(), [])) == ["s0", "s1", "s2"]
+        lm.end_replace(TABLE, eid)
+        routing, _ = rm.get_routing_table(TABLE)
+        assert sorted(sum(routing.values(), [])) == ["m0", "s2"]
+
+
+class TestTiers:
+    def test_parse_age(self):
+        assert parse_age_ms("7d") == 7 * 86_400_000
+        assert parse_age_ms("90m") == 90 * 60_000
+        with pytest.raises(ValueError):
+            parse_age_ms("7 fortnights")
+
+    def test_target_tier_most_specific(self):
+        tiers = [TierConfig("warm", "1d", "warm_tag"),
+                 TierConfig("cold", "30d", "cold_tag")]
+        assert target_tier(tiers, parse_age_ms("2d")).name == "warm"
+        assert target_tier(tiers, parse_age_ms("45d")).name == "cold"
+        assert target_tier(tiers, 1000) is None
+
+    def test_relocation_moves_aged_segments(self, store):
+        store.add_table_config(TableConfig(
+            table_name="t",
+            tier_configs=[{"name": "cold", "segmentAge": "30d",
+                           "serverTag": "cold_tag",
+                           "segmentSelectorType": "time"}]))
+        store.register_instance(InstanceInfo("hot1", "SERVER",
+                                             tags=["DefaultTenant"]))
+        store.register_instance(InstanceInfo("cold1", "SERVER",
+                                             tags=["cold_tag"]))
+        now = 100 * 86_400_000
+        store.set_segment_metadata(SegmentZKMetadata(
+            "old", TABLE, status="ONLINE", push_time_ms=now - 40 * 86_400_000))
+        store.set_segment_metadata(SegmentZKMetadata(
+            "new", TABLE, status="ONLINE", push_time_ms=now - 86_400_000))
+        store.set_ideal_state(TABLE, {"old": {"hot1": "ONLINE"},
+                                      "new": {"hot1": "ONLINE"}})
+        moved = SegmentRelocator(store).relocate_table(TABLE, now_ms=now)
+        assert moved == ["old"]
+        ideal = store.get_ideal_state(TABLE)
+        assert list(ideal["old"].keys()) == ["cold1"]
+        assert list(ideal["new"].keys()) == ["hot1"]
+        # idempotent: second run moves nothing
+        assert SegmentRelocator(store).relocate_table(TABLE, now_ms=now) == []
+
+    def test_no_tagged_server_leaves_placement(self, store):
+        store.add_table_config(TableConfig(
+            table_name="t",
+            tier_configs=[{"name": "cold", "segmentAge": "1d",
+                           "serverTag": "nosuch_tag"}]))
+        store.register_instance(InstanceInfo("hot1", "SERVER"))
+        now = 10 * 86_400_000
+        store.set_segment_metadata(SegmentZKMetadata(
+            "s", TABLE, status="ONLINE", push_time_ms=now - 5 * 86_400_000))
+        store.set_ideal_state(TABLE, {"s": {"hot1": "ONLINE"}})
+        assert SegmentRelocator(store).relocate_table(TABLE, now_ms=now) == []
+
+    def test_tierconfig_json_roundtrip(self):
+        tc = TableConfig(table_name="x", tier_configs=[
+            {"name": "cold", "segmentAge": "30d", "serverTag": "cold_tag"}])
+        tc2 = TableConfig.from_dict(tc.to_dict())
+        assert tc2.tier_configs[0]["serverTag"] == "cold_tag"
+
+
+class TestLineageCleanup:
+    def test_stale_in_progress_auto_reverts(self, store):
+        lm = SegmentLineageManager(store)
+        eid = lm.start_replace(TABLE, ["s0"], ["m0"])
+        ts = lm.entries(TABLE)[0].timestamp_ms
+        # young: untouched
+        assert lm.cleanup(TABLE, now_ms=ts + 1000) == []
+        # stale: auto-revert frees the inputs, keeps outputs hidden
+        touched = lm.cleanup(TABLE, now_ms=ts + 25 * 3_600_000)
+        assert touched == [eid]
+        assert lm.entries(TABLE)[0].state == "REVERTED"
+        assert lm.hidden_segments(TABLE) == {"m0"}
+        lm.start_replace(TABLE, ["s0"], ["m1"])  # retry now possible
+
+    def test_terminal_entries_dropped_once_realized(self, store):
+        lm = SegmentLineageManager(store)
+        eid = lm.start_replace(TABLE, ["gone0"], ["m0"])
+        lm.end_replace(TABLE, eid)
+        ts = lm.entries(TABLE)[0].timestamp_ms
+        # inputs no longer exist in the segment list -> entry drops
+        touched = lm.cleanup(TABLE, now_ms=ts + 25 * 3_600_000)
+        assert touched == [eid]
+        assert lm.entries(TABLE) == []

@@ -206,3 +206,61 @@ def test_packed_output_roundtrip():
     assert int(back_s["num_matched"]) == 7
     assert int(back_s["agg0"]) == 7
     np.testing.assert_array_equal(back_s["agg1"], [1, 0, 1, 1, 0])
+
+
+class TestCompactGroupBy:
+    """Sparse output compaction for huge padded key spaces
+    (kernels.compact_mode; SSB Q3.2/Q4.3 shape)."""
+
+    @pytest.fixture(scope="class")
+    def wide_segs(self, tmp_path_factory):
+        out = str(tmp_path_factory.mktemp("wide"))
+        rng = np.random.default_rng(77)
+        n = 20_000
+        # two ~150-card dims + year: padded key space ~2^18 >> live groups
+        schema = Schema("wide", [
+            FieldSpec("a", DataType.STRING),
+            FieldSpec("b", DataType.STRING),
+            FieldSpec("year", DataType.INT),
+            FieldSpec("v", DataType.LONG, FieldType.METRIC),
+        ])
+        frame = {
+            "a": [f"a{i:03d}" for i in rng.integers(0, 150, n)],
+            "b": [f"b{i:03d}" for i in rng.integers(0, 150, n)],
+            "year": rng.integers(2000, 2004, n).tolist(),
+            "v": rng.integers(0, 100, n).tolist(),
+        }
+        segs = []
+        for i in range(2):
+            SegmentBuilder(schema, f"w{i}").build(frame, out)
+            segs.append(load_segment(f"{out}/w{i}"))
+        return segs
+
+    def test_compact_parity(self, wide_segs):
+        from pinot_tpu.engine.kernels import compact_mode
+        from pinot_tpu.engine.plan import plan_segment
+
+        sql = ("SELECT a, b, year, sum(v), count(*) FROM wide "
+               "WHERE a IN ('a001', 'a002', 'a003') "
+               "GROUP BY a, b, year ORDER BY a, b, year LIMIT 5000")
+        ctx = compile_query(sql)
+        assert compact_mode(plan_segment(ctx, wide_segs[0]).spec) > 0
+        dev = ShardedQueryExecutor()
+        host = ServerQueryExecutor(use_device=False)
+        drt, _ = dev.execute(ctx, wide_segs)
+        hrt, _ = host.execute(ctx, wide_segs)
+        assert drt.rows == hrt.rows
+        assert len(drt.rows) > 100
+
+    def test_overflow_falls_back_to_full_results(self, wide_segs):
+        """More live groups than the compact cap: the host path must serve
+        the complete result (never truncation)."""
+        sql = ("SELECT a, b, year, sum(v) FROM wide "
+               "GROUP BY a, b, year ORDER BY a, b, year LIMIT 100000")
+        ctx = compile_query(sql)
+        dev = ShardedQueryExecutor()
+        host = ServerQueryExecutor(use_device=False)
+        drt, _ = dev.execute(ctx, wide_segs)
+        hrt, _ = host.execute(ctx, wide_segs)
+        assert drt.rows == hrt.rows
+        assert len(drt.rows) > 8192

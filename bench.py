@@ -172,7 +172,9 @@ def _init_backend() -> str:
     output records which backend ran)."""
     import subprocess
 
-    for attempt in range(2):
+    # round-4 postmortem: tunnel health OSCILLATES — init sometimes hangs
+    # for minutes then recovers, so be patient before giving up on the chip
+    for attempt in range(4):
         try:
             probe = subprocess.run(
                 [sys.executable, "-c",
@@ -185,7 +187,7 @@ def _init_backend() -> str:
         except subprocess.TimeoutExpired:
             print(f"bench: backend probe {attempt + 1} timed out",
                   file=sys.stderr)
-        time.sleep(5.0)
+        time.sleep(10.0)
     else:
         print("bench: falling back to CPU host platform", file=sys.stderr)
         os.environ["JAX_PLATFORMS"] = "cpu"

@@ -174,9 +174,11 @@ class RoutingManager:
         # the routing config / instance partitions change (ref:
         # InstanceSelectorFactory caching per RoutingEntry)
         self._selector_cache: Dict[str, Tuple] = {}
-        # table -> hidden segment set; store-watch invalidated so the per-
-        # query hot path skips lineage parsing for lineage-less tables
-        self._lineage_cache: Dict[str, frozenset] = {}
+        # table -> (store version at compute time, hidden segment set); the
+        # version stamp closes the TOCTOU where a watch-driven clear lands
+        # between computing the set and caching it (the stale insert would
+        # otherwise persist until the next lineage mutation)
+        self._lineage_cache: Dict[str, Tuple[int, frozenset]] = {}
         store.watch("lineage/",
                     lambda path, value: self._lineage_cache.clear())
 
@@ -230,12 +232,18 @@ class RoutingManager:
     def _lineage_hidden(self, table: str) -> frozenset:
         cached = self._lineage_cache.get(table)
         if cached is not None:
-            return cached
+            return cached[1]
         from pinot_tpu.controller.lineage import SegmentLineageManager
 
+        ver = self.store.version
         hidden = frozenset(
             SegmentLineageManager(self.store).hidden_segments(table))
-        self._lineage_cache[table] = hidden
+        self._lineage_cache[table] = (ver, hidden)
+        # a mutation racing this compute may have fired the invalidating
+        # watch BEFORE the insert above; self-evict so the stale set can't
+        # outlive the race (any post-mutation clear removes it anyway)
+        if self.store.version != ver:
+            self._lineage_cache.pop(table, None)
         return hidden
 
     def _selector_for(self, table: str):

@@ -62,29 +62,40 @@ class SegmentLineageManager:
         raw = self.store.get(self._path(table)) or []
         return [LineageEntry.from_dict(d) for d in raw]
 
-    def _save(self, table: str, entries: List[LineageEntry]) -> None:
-        self.store.set(self._path(table), [e.to_dict() for e in entries])
+    def _mutate(self, table: str, fn) -> None:
+        """Atomic read-modify-write through the store's update() — a
+        concurrent end_replace and cleanup must never lose each other's
+        state flips (the protocol's whole point is swap atomicity)."""
+
+        def apply(raw):
+            entries = [LineageEntry.from_dict(d) for d in (raw or [])]
+            return [e.to_dict() for e in fn(entries)]
+
+        self.store.update(self._path(table), apply, default=[])
 
     # -- protocol (ref: PinotSegmentRestletResource start/end/revert) -------
     def start_replace(self, table: str, segments_from: List[str],
                       segments_to: List[str]) -> str:
-        entries = self._load(table)
-        active: Set[str] = set()
-        for e in entries:
-            if e.state == IN_PROGRESS:
-                active.update(e.segments_from)
-        overlap = active & set(segments_from)
-        if overlap:
-            raise ValueError(
-                f"segments already in an in-progress replacement: "
-                f"{sorted(overlap)}")
         entry = LineageEntry(
             entry_id=f"lin_{int(time.time() * 1000)}_{next(_counter)}",
             segments_from=list(segments_from),
             segments_to=list(segments_to),
             state=IN_PROGRESS,
             timestamp_ms=int(time.time() * 1000))
-        self._save(table, entries + [entry])
+
+        def apply(entries):
+            active: Set[str] = set()
+            for e in entries:
+                if e.state == IN_PROGRESS:
+                    active.update(e.segments_from)
+            overlap = active & set(segments_from)
+            if overlap:
+                raise ValueError(
+                    f"segments already in an in-progress replacement: "
+                    f"{sorted(overlap)}")
+            return entries + [entry]
+
+        self._mutate(table, apply)
         return entry.entry_id
 
     def end_replace(self, table: str, entry_id: str) -> None:
@@ -97,17 +108,18 @@ class SegmentLineageManager:
 
     def _set_state(self, table: str, entry_id: str, from_state: str,
                    to_state: str) -> None:
-        entries = self._load(table)
-        for e in entries:
-            if e.entry_id == entry_id:
-                if e.state != from_state:
-                    raise ValueError(
-                        f"lineage entry {entry_id} is {e.state}, "
-                        f"not {from_state}")
-                e.state = to_state
-                self._save(table, entries)
-                return
-        raise KeyError(f"no lineage entry {entry_id} for {table}")
+        def apply(entries):
+            for e in entries:
+                if e.entry_id == entry_id:
+                    if e.state != from_state:
+                        raise ValueError(
+                            f"lineage entry {entry_id} is {e.state}, "
+                            f"not {from_state}")
+                    e.state = to_state
+                    return entries
+            raise KeyError(f"no lineage entry {entry_id} for {table}")
+
+        self._mutate(table, apply)
 
     def entries(self, table: str) -> List[LineageEntry]:
         return self._load(table)
@@ -124,28 +136,38 @@ class SegmentLineageManager:
         import time as _time
 
         now = int(_time.time() * 1000) if now_ms is None else now_ms
-        entries = self._load(table)
+        # read-only pre-check: a no-op cleanup must not bump the store
+        # version (every write invalidates broker lineage caches)
+        if not any(now - e.timestamp_ms > max_age_ms
+                   for e in self._load(table)):
+            return []
         live = set(self.store.segment_names(table))
         touched: List[str] = []
-        kept: List[LineageEntry] = []
-        for e in entries:
-            age = now - e.timestamp_ms
-            if age <= max_age_ms:
-                kept.append(e)
-                continue
-            if e.state == IN_PROGRESS:
-                e.state = REVERTED
-                touched.append(e.entry_id)
-                kept.append(e)
-            elif e.state == COMPLETED and not (set(e.segments_from) & live):
-                touched.append(e.entry_id)  # effect realized: drop
-            elif e.state == REVERTED and not (set(e.segments_to) & live):
-                touched.append(e.entry_id)
-            else:
-                kept.append(e)
-        if touched:
-            self._save(table, kept)
-        return touched
+
+        def apply(entries):
+            touched.clear()
+            kept: List[LineageEntry] = []
+            for e in entries:
+                age = now - e.timestamp_ms
+                if age <= max_age_ms:
+                    kept.append(e)
+                    continue
+                if e.state == IN_PROGRESS:
+                    e.state = REVERTED
+                    touched.append(e.entry_id)
+                    kept.append(e)
+                elif e.state == COMPLETED \
+                        and not (set(e.segments_from) & live):
+                    touched.append(e.entry_id)  # effect realized: drop
+                elif e.state == REVERTED \
+                        and not (set(e.segments_to) & live):
+                    touched.append(e.entry_id)
+                else:
+                    kept.append(e)
+            return kept
+
+        self._mutate(table, apply)
+        return list(touched)
 
     # -- visibility (ref: filterSegmentsBasedOnLineageInPlace) --------------
     def hidden_segments(self, table: str) -> Set[str]:

@@ -49,6 +49,8 @@ class _Cursor:
         shift = 0
         acc = 0
         while True:
+            if self.pos >= len(self.buf):
+                raise AvroError("truncated avro data (mid-varint)")
             b = self.buf[self.pos]
             self.pos += 1
             acc |= (b & 0x7F) << shift

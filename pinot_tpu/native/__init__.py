@@ -333,3 +333,15 @@ def varint_decode(buf: bytes, n: int) -> np.ndarray:
         prev += d
         out_l.append(prev)
     return np.asarray(out_l, dtype=np.int32)
+
+
+def mmap_buffer_count() -> int:
+    """Currently-mapped native buffers (0 with the numpy fallback) —
+    the MmapDebugResource accounting hook."""
+    lib = load()
+    if lib is None:
+        return 0
+    try:
+        return int(lib.pn_mmap_open_count())
+    except Exception:
+        return 0

@@ -147,11 +147,17 @@ class DataSource:
         from pinot_tpu.segment.geoindex import GeoIndexReader
 
         meta_arr = self._segment._load_array(self.name, "geometa")
+        # segments built before coordinate arrays existed fall back to the
+        # reader's parse-candidates path
+        has_coords = os.path.exists(
+            self._segment._path(self.name, "geolng"))
         return GeoIndexReader(
             self._segment._load_array(self.name, "geocells"),
             int(meta_arr[0]), self.dictionary,
-            lngs=self._segment._load_array(self.name, "geolng"),
-            lats=self._segment._load_array(self.name, "geolat"))
+            lngs=(self._segment._load_array(self.name, "geolng")
+                  if has_coords else None),
+            lats=(self._segment._load_array(self.name, "geolat")
+                  if has_coords else None))
 
     @cached_property
     def range_order(self):

@@ -19,7 +19,7 @@ import os
 import threading
 import time
 
-from typing import Dict, List, Optional
+from typing import Any, Dict, List, Optional
 
 from pinot_tpu.common.datatable import DataTable
 from pinot_tpu.controller.state import (
@@ -421,3 +421,50 @@ class ServerInstance:
     def hosted_segments(self, table: str) -> List[str]:
         tdm = self.data_manager.get(table)
         return tdm.segment_names() if tdm else []
+
+    def table_size(self, table: str) -> Dict[str, Any]:
+        """On-disk bytes per hosted segment (ref: TableSizeResource)."""
+        import os
+
+        tdm = self.data_manager.get(table)
+        if tdm is None:
+            return {"tableName": table, "segments": {}, "totalBytes": 0}
+        sizes: Dict[str, int] = {}
+        for name in tdm.segment_names():
+            seg = None
+            acquired = tdm.acquire_segments([name])
+            try:
+                seg = acquired[0].segment if acquired else None
+                seg_dir = getattr(seg, "segment_dir", None)
+                total = 0
+                if seg_dir and os.path.isdir(seg_dir):
+                    for root, _dirs, files in os.walk(seg_dir):
+                        total += sum(
+                            os.path.getsize(os.path.join(root, f))
+                            for f in files)
+                sizes[name] = total
+            finally:
+                if acquired:
+                    tdm.release_segments(acquired)
+        return {"tableName": table, "segments": sizes,
+                "totalBytes": sum(sizes.values())}
+
+    def memory_debug(self) -> Dict[str, Any]:
+        """Staged-device + native mmap accounting
+        (ref: MmapDebugResource)."""
+        from pinot_tpu import native
+
+        staged = {}
+        ex = getattr(self, "executor", None)
+        staging = getattr(ex, "staging", None)
+        if staging is not None:
+            for name, st in staging._staged.items():
+                staged[name] = {
+                    "columns": len(st._columns),
+                    "packed": len(st._packed),
+                    "values": len(st._values),
+                }
+        return {
+            "stagedSegments": staged,
+            "nativeMmapBuffers": native.mmap_buffer_count(),
+        }

@@ -86,9 +86,11 @@ class _Api:
                         if match:
                             code, payload = fn(match, body)
                             if isinstance(payload, str):
-                                # text endpoints (/metrics prometheus body)
+                                # text endpoints (/metrics prometheus, /ui)
                                 raw = payload.encode("utf-8")
-                                ctype = "text/plain; version=0.0.4"
+                                ctype = ("text/html; charset=utf-8"
+                                         if payload.startswith("<!doctype")
+                                         else "text/plain; version=0.0.4")
                             else:
                                 raw = json.dumps(payload).encode("utf-8")
                                 ctype = "application/json"
@@ -155,8 +157,8 @@ class ControllerApi(_Api):
     """Ref: controller api/resources (45 Jersey resources, reduced to the
     operative set: schemas, tables, segments, state, rebalance, health)."""
 
-    def __init__(self, controller, port: int = 0):
-        super().__init__(port)
+    def __init__(self, controller, port: int = 0, access_control=None):
+        super().__init__(port, access_control=access_control)
         c = controller
         store = controller.store
 
@@ -199,6 +201,73 @@ class ControllerApi(_Api):
         self.route("GET", r"/instances",
                    lambda m, b: (200, {"instances": [
                        i.to_dict() for i in store.instances()]}))
+        # lineage (ref: startReplaceSegments/endReplaceSegments REST)
+        self.route("POST", r"/segments/([^/]+)/startReplaceSegments",
+                   lambda m, b: (200, {"segmentLineageEntryId":
+                                       c.start_replace_segments(
+                                           m.group(1),
+                                           (b or {}).get("segmentsFrom", []),
+                                           (b or {}).get("segmentsTo", []))}))
+        self.route("POST", r"/segments/([^/]+)/endReplaceSegments/([^/]+)",
+                   lambda m, b: (200, self._end_replace(c, m)))
+        self.route("POST", r"/segments/([^/]+)/revertReplaceSegments/([^/]+)",
+                   lambda m, b: (200, self._revert_replace(c, m)))
+        # recommender (ref: RecommenderDriver via PinotTableRestletResource)
+        self.route("POST", r"/tables/([^/]+)/recommender",
+                   lambda m, b: self._recommend(store, m.group(1), b))
+        # minimal cluster status UI (ref: the controller's bundled web app)
+        self.route("GET", r"/ui",
+                   lambda m, b: (200, self._render_ui(store)))
+
+    @staticmethod
+    def _end_replace(c, m) -> Dict[str, Any]:
+        c.end_replace_segments(m.group(1), m.group(2))
+        return {"status": "done"}
+
+    @staticmethod
+    def _revert_replace(c, m) -> Dict[str, Any]:
+        c.revert_replace_segments(m.group(1), m.group(2))
+        return {"status": "reverted"}
+
+    @staticmethod
+    def _recommend(store, table: str, body):
+        from pinot_tpu.controller.recommender import recommend
+        from pinot_tpu.spi.table import raw_table_name
+
+        schema = store.get_schema(raw_table_name(table))
+        if schema is None:
+            return 404, {"error": f"no schema for table {table}"}
+        return 200, recommend(schema, (body or {}).get("queries", []),
+                              qps=float((body or {}).get("qps", 0)))
+
+    @staticmethod
+    def _render_ui(store) -> str:
+        """One self-contained HTML status page (tables / segments /
+        instances) — the operational core of the reference's React app."""
+        from html import escape
+
+        rows = []
+        for t in store.table_names():
+            ideal = store.get_ideal_state(t)
+            ev = store.get_external_view(t)
+            rows.append(f"<tr><td>{escape(t)}</td><td>{len(ideal)}</td>"
+                        f"<td>{len(ev)}</td></tr>")
+        inst = [f"<tr><td>{escape(i.instance_id)}</td>"
+                f"<td>{escape(i.instance_type)}</td>"
+                f"<td>{'up' if i.alive else 'DOWN'}</td>"
+                f"<td>{escape(', '.join(i.tags))}</td></tr>"
+                for i in store.instances()]
+        return ("<!doctype html><title>pinot-tpu</title>"
+                "<style>body{font-family:sans-serif;margin:2em}"
+                "table{border-collapse:collapse;margin:1em 0}"
+                "td,th{border:1px solid #ccc;padding:4px 10px}</style>"
+                "<h1>pinot-tpu cluster</h1>"
+                "<h2>Tables</h2><table><tr><th>table</th><th>segments "
+                "(ideal)</th><th>segments (serving)</th></tr>"
+                + "".join(rows) + "</table>"
+                "<h2>Instances</h2><table><tr><th>id</th><th>type</th>"
+                "<th>state</th><th>tags</th></tr>"
+                + "".join(inst) + "</table>")
 
     @staticmethod
     def _add_schema(c, body) -> Dict[str, Any]:
@@ -284,12 +353,15 @@ class BrokerApi(_Api):
                        broker.routing.get_routing_table(m.group(1))[0])))
 
 
-def serve_cluster(cluster, controller_port: int = 0, broker_port: int = 0):
+def serve_cluster(cluster, controller_port: int = 0, broker_port: int = 0,
+                  access_control=None):
     """Expose an EmbeddedCluster over REST: controller admin + broker query
     endpoints (ref: QuickstartRunner wiring the role REST apps). Returns
     the started APIs; call ``.stop()`` on each to tear down."""
-    apis = [ControllerApi(cluster.controller, port=controller_port),
-            BrokerApi(cluster.broker, port=broker_port)]
+    apis = [ControllerApi(cluster.controller, port=controller_port,
+                          access_control=access_control),
+            BrokerApi(cluster.broker, port=broker_port,
+                      access_control=access_control)]
     for api in apis:
         api.start()
     return apis
@@ -298,8 +370,9 @@ def serve_cluster(cluster, controller_port: int = 0, broker_port: int = 0):
 class ServerAdminApi(_Api):
     """Ref: server api/resources TablesResource (health + hosted state)."""
 
-    def __init__(self, server_instance, port: int = 0):
-        super().__init__(port)
+    def __init__(self, server_instance, port: int = 0,
+                 access_control=None):
+        super().__init__(port, access_control=access_control)
         s = server_instance
         self.route("GET", r"/health", lambda m, b: (200, {"status": "OK"}))
         self.route("GET", r"/metrics",
@@ -309,3 +382,8 @@ class ServerAdminApi(_Api):
         self.route("GET", r"/tables/([^/]+)/segments",
                    lambda m, b: (200, {m.group(1):
                                        s.hosted_segments(m.group(1))}))
+        # ref: TableSizeResource / MmapDebugResource
+        self.route("GET", r"/tables/([^/]+)/size",
+                   lambda m, b: (200, s.table_size(m.group(1))))
+        self.route("GET", r"/debug/memory",
+                   lambda m, b: (200, s.memory_debug()))

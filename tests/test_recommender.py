@@ -1,0 +1,76 @@
+"""Config recommender rules (ref: controller recommender/RecommenderDriver)."""
+
+import pytest
+
+from pinot_tpu.controller.recommender import recommend
+from pinot_tpu.spi import DataType, FieldSpec, FieldType, Schema
+
+
+@pytest.fixture
+def schema():
+    return Schema("ev", [
+        FieldSpec("country", DataType.STRING),
+        FieldSpec("city", DataType.STRING),
+        FieldSpec("url", DataType.STRING),
+        FieldSpec("payload", DataType.STRING),
+        FieldSpec("ts", DataType.LONG),
+        FieldSpec("clicks", DataType.LONG, FieldType.METRIC),
+        FieldSpec("cost", DataType.DOUBLE, FieldType.METRIC),
+    ])
+
+
+def test_inverted_sorted_and_bloom(schema):
+    queries = (["SELECT count(*) FROM ev WHERE country = 'US'"] * 6
+               + ["SELECT count(*) FROM ev WHERE city = 'SF'"] * 3
+               + ["SELECT sum(clicks) FROM ev"])
+    out = recommend(schema, queries)
+    rec = out["recommendations"]
+    assert rec["sortedColumn"] == ["country"]          # most filtered
+    assert rec["invertedIndexColumns"] == ["city"]
+    assert "country" in rec["bloomFilterColumns"]
+    assert out["numQueriesParsed"] == 10
+
+
+def test_range_text_json_regex_rules(schema):
+    queries = [
+        "SELECT count(*) FROM ev WHERE ts BETWEEN 1 AND 9",
+        "SELECT count(*) FROM ev WHERE text_match(url, 'foo')",
+        "SELECT count(*) FROM ev WHERE json_match(payload, '\"a\"=1')",
+        "SELECT count(*) FROM ev WHERE regexp_like(url, '^/api')",
+    ]
+    rec = recommend(schema, queries)["recommendations"]
+    assert rec["rangeIndexColumns"] == ["ts"]
+    assert rec["textIndexColumns"] == ["url"]
+    assert rec["jsonIndexColumns"] == ["payload"]
+    assert rec["fstIndexColumns"] == ["url"]
+
+
+def test_nodict_metrics(schema):
+    rec = recommend(schema, ["SELECT sum(clicks), avg(cost) FROM ev "
+                             "WHERE country = 'US'"])["recommendations"]
+    assert rec["noDictionaryColumns"] == ["clicks", "cost"]
+
+
+def test_partitioning_needs_qps(schema):
+    q = ["SELECT count(*) FROM ev WHERE country = 'US'"] * 10
+    assert "segmentPartitionConfig" not in \
+        recommend(schema, q, qps=10)["recommendations"]
+    rec = recommend(schema, q, qps=500)["recommendations"]
+    assert rec["segmentPartitionConfig"]["columnPartitionMap"][
+        "country"]["functionName"] == "Murmur"
+
+
+def test_star_tree_rule(schema):
+    q = ["SELECT country, city, sum(clicks), count(*) FROM ev "
+         "GROUP BY country, city"] * 5 + ["SELECT count(*) FROM ev"]
+    rec = recommend(schema, q)["recommendations"]
+    st = rec["starTreeIndexConfigs"][0]
+    assert sorted(st["dimensionsSplitOrder"]) == ["city", "country"]
+    assert "SUM__clicks" in st["functionColumnPairs"]
+    assert "COUNT__*" in st["functionColumnPairs"]
+
+
+def test_unparseable_skipped(schema):
+    out = recommend(schema, ["NOT SQL AT ALL", "SELECT count(*) FROM ev"])
+    assert out["skipped"] == ["NOT SQL AT ALL"]
+    assert out["numQueriesParsed"] == 1

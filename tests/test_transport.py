@@ -253,3 +253,50 @@ class TestGrpc:
             assert dt.exceptions
         finally:
             stub.close()
+
+
+def test_recommender_and_ui_endpoints(rest, tmp_path):
+    cluster, ctrl_url, _ = rest
+    _create_and_load(cluster, tmp_path)
+    out = _http("POST", f"{ctrl_url}/tables/tx_sales/recommender",
+                {"queries": ["SELECT count(*) FROM tx_sales "
+                             "WHERE region = 'east'"] * 5})
+    assert out["recommendations"]["sortedColumn"] == ["region"]
+    # the status page renders tables + instances
+    req = urllib.request.Request(f"{ctrl_url}/ui")
+    with urllib.request.urlopen(req, timeout=30) as resp:
+        assert resp.headers["Content-Type"].startswith("text/html")
+        html = resp.read().decode()
+    assert "tx_sales_OFFLINE" in html
+
+
+def test_lineage_endpoints(rest, tmp_path):
+    cluster, ctrl_url, brk_url = rest
+    _create_and_load(cluster, tmp_path)
+    table = "tx_sales_OFFLINE"
+    segs = _http("GET", f"{ctrl_url}/segments/{table}")
+    out = _http("POST", f"{ctrl_url}/segments/{table}/startReplaceSegments",
+                {"segmentsFrom": [segs[0]], "segmentsTo": ["merged_0"]})
+    eid = out["segmentLineageEntryId"]
+    _http("POST", f"{ctrl_url}/segments/{table}/endReplaceSegments/{eid}")
+    # replaced input is now hidden from routing
+    routing = _http("GET", f"{brk_url}/debug/routing/{table}")
+    routed = sorted(sum(routing.values(), []))
+    assert segs[0] not in routed
+
+
+def test_server_admin_size_and_memory(cluster, tmp_path):
+    _create_and_load(cluster, tmp_path)
+    server = next(iter(cluster.servers.values()))
+    api = ServerAdminApi(server, port=0)
+    api.start()
+    try:
+        base = f"http://localhost:{api.port}"
+        size = _http("GET", f"{base}/tables/tx_sales_OFFLINE/size")
+        assert size["totalBytes"] > 0
+        # 2 segments are spread across the 2 servers; this one hosts >= 1
+        assert len(size["segments"]) >= 1
+        mem = _http("GET", f"{base}/debug/memory")
+        assert "stagedSegments" in mem and "nativeMmapBuffers" in mem
+    finally:
+        api.stop()

@@ -101,8 +101,15 @@ class WorkloadStats:
                 from pinot_tpu.engine.aggregates import agg_value_expr
 
                 v = agg_value_expr(fn)
-                col = v.name if isinstance(v, Identifier) else "*"
-                self.agg_pairs[(fn.name.upper(), col)] += 1
+                if v is None:
+                    col = "*"  # count(*)
+                elif isinstance(v, Identifier) and not v.name.startswith("$"):
+                    col = v.name
+                else:
+                    continue  # expression arg: not a star-tree metric pair
+                # scoped to the group set: pairs from OTHER group-bys must
+                # not leak into a tree recommended for this set
+                self.agg_pairs[(cols, fn.name.upper(), col)] += 1
 
 
 def recommend(schema: Schema, queries: List[str],
@@ -194,9 +201,10 @@ def recommend(schema: Schema, queries: List[str],
     if stats.group_by_sets:
         (top_set, hits) = stats.group_by_sets.most_common(1)[0]
         if hits / n >= STARTREE_MIN_SHARE:
-            pairs = sorted({f"{fn}__{col}" for (fn, col), k
+            pairs = sorted({f"{fn}__{col}" for (gset, fn, col), k
                             in stats.agg_pairs.items()
-                            if fn in ("SUM", "COUNT", "MIN", "MAX")})
+                            if gset == top_set
+                            and fn in ("SUM", "COUNT", "MIN", "MAX")})
             if pairs:
                 rec["starTreeIndexConfigs"] = [{
                     "dimensionsSplitOrder": list(top_set),

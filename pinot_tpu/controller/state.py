@@ -146,6 +146,8 @@ class ClusterStateStore:
         # watchers observe updates in version order even when mutators race
         # (the ClusterChangeMediator serialization property)
         self._pending: List[Tuple[str, Any]] = []
+        # bounded mutation log for remote-replica polling
+        self._mutation_log: List[Tuple[int, str, Any]] = []
         # RLock: a watcher may mutate the store, re-entering the drain
         self._notify_lock = threading.RLock()
         if snapshot_path and os.path.isfile(snapshot_path):
@@ -170,10 +172,27 @@ class ClusterStateStore:
             self._data[path] = value
             self._version += 1
             v = self._version
+            self._log_locked(path, value)
             self._persist_locked()
             self._pending.append((path, value))
         self._drain_notifications()
         return v
+
+    def compare_and_set(self, path: str, expected: Any, value: Any) -> bool:
+        """CAS on the current value — the remote-store client's atomic
+        update primitive (the ZK setData-with-version analogue)."""
+        value = self._copy(value)
+        with self._lock:
+            cur = self._data.get(path)
+            if cur != expected:
+                return False
+            self._data[path] = value
+            self._version += 1
+            self._log_locked(path, value)
+            self._persist_locked()
+            self._pending.append((path, value))
+        self._drain_notifications()
+        return True
 
     def update(self, path: str, fn: Callable[[Any], Any],
                default: Any = None) -> Any:
@@ -183,6 +202,7 @@ class ClusterStateStore:
             new = self._copy(fn(self._copy(cur)))
             self._data[path] = new
             self._version += 1
+            self._log_locked(path, new)
             self._persist_locked()
             self._pending.append((path, new))
         self._drain_notifications()
@@ -194,10 +214,40 @@ class ClusterStateStore:
             self._data.pop(path, None)
             if existed:
                 self._version += 1
+                self._log_locked(path, None)
                 self._persist_locked()
                 self._pending.append((path, None))
         if existed:
             self._drain_notifications()
+
+    # -- mutation log (remote-replica sync; ref: the ZK transaction log) ----
+    _LOG_CAP = 10_000
+
+    def _log_locked(self, path: str, value: Any) -> None:
+        self._mutation_log.append((self._version, path, value))
+        if len(self._mutation_log) > self._LOG_CAP:
+            del self._mutation_log[: len(self._mutation_log) - self._LOG_CAP]
+
+    def mutations_since(self, since_version: int):
+        """(current_version, [(version, path, value)...]) after
+        ``since_version``, or (current_version, None) when the log no
+        longer reaches back that far (caller must full-resync)."""
+        with self._lock:
+            if since_version >= self._version:
+                return self._version, []
+            if (not self._mutation_log
+                    or self._mutation_log[0][0] > since_version + 1):
+                return self._version, None
+            out = [(v, p, self._copy(val))
+                   for v, p, val in self._mutation_log
+                   if v > since_version]
+            return self._version, out
+
+    def snapshot_data(self):
+        """(version, full data dict) for remote full-resyncs."""
+        with self._lock:
+            return self._version, {k: self._copy(v)
+                                   for k, v in self._data.items()}
 
     def children(self, prefix: str) -> List[str]:
         prefix = prefix.rstrip("/") + "/"

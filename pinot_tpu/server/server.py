@@ -423,9 +423,8 @@ class ServerInstance:
         return tdm.segment_names() if tdm else []
 
     def table_size(self, table: str) -> Dict[str, Any]:
-        """On-disk bytes per hosted segment (ref: TableSizeResource)."""
-        import os
-
+        """On-disk bytes per hosted segment (ref: TableSizeResource);
+        segments that vanish mid-walk are omitted, not reported as 0."""
         tdm = self.data_manager.get(table)
         if tdm is None:
             return {"tableName": table, "segments": {}, "totalBytes": 0}
@@ -433,9 +432,10 @@ class ServerInstance:
         for name in tdm.segment_names():
             seg = None
             acquired = tdm.acquire_segments([name])
+            if not acquired:
+                continue  # deleted concurrently: omit (ref: missing segs)
             try:
-                seg = acquired[0].segment if acquired else None
-                seg_dir = getattr(seg, "segment_dir", None)
+                seg_dir = getattr(acquired[0].segment, "segment_dir", None)
                 total = 0
                 if seg_dir and os.path.isdir(seg_dir):
                     for root, _dirs, files in os.walk(seg_dir):
@@ -444,8 +444,7 @@ class ServerInstance:
                             for f in files)
                 sizes[name] = total
             finally:
-                if acquired:
-                    tdm.release_segments(acquired)
+                tdm.release_segments(acquired)
         return {"tableName": table, "segments": sizes,
                 "totalBytes": sum(sizes.values())}
 
@@ -458,7 +457,9 @@ class ServerInstance:
         ex = getattr(self, "executor", None)
         staging = getattr(ex, "staging", None)
         if staging is not None:
-            for name, st in staging._staged.items():
+            # .copy() is one atomic C call under the GIL: safe against
+            # queries staging/evicting concurrently on other threads
+            for name, st in staging._staged.copy().items():
                 staged[name] = {
                     "columns": len(st._columns),
                     "packed": len(st._packed),

@@ -36,6 +36,8 @@ class _Api:
     endpoints stay open (liveness probes don't carry credentials)."""
 
     OPEN_PATHS = ("/health",)
+    # POSTs that are semantically reads (authorized with READ, not WRITE)
+    READ_POSTS = ("/query/sql", "/state/get", "/state/poll")
 
     def __init__(self, port: int = 0, access_control=None):
         from pinot_tpu.spi.auth import AllowAllAccessControl
@@ -67,9 +69,8 @@ class _Api:
                         # (per-table scoping is enforced at the query route)
                         from pinot_tpu.spi.auth import READ, WRITE
 
-                        # POST /query/sql is a read despite the verb
                         access = READ if (method == "GET" or path_only
-                                          == "/query/sql") else WRITE
+                                          in api.READ_POSTS) else WRITE
                         if not api.access_control.has_access(
                                 principal, None, access):
                             self.send_error(403, "permission denied")
@@ -201,17 +202,17 @@ class ControllerApi(_Api):
         self.route("GET", r"/instances",
                    lambda m, b: (200, {"instances": [
                        i.to_dict() for i in store.instances()]}))
-        # lineage (ref: startReplaceSegments/endReplaceSegments REST)
+        # lineage (ref: startReplaceSegments/endReplaceSegments REST);
+        # protocol conflicts are 409, unknown entries 404 — a retrying
+        # client must distinguish them from server faults
         self.route("POST", r"/segments/([^/]+)/startReplaceSegments",
-                   lambda m, b: (200, {"segmentLineageEntryId":
-                                       c.start_replace_segments(
-                                           m.group(1),
-                                           (b or {}).get("segmentsFrom", []),
-                                           (b or {}).get("segmentsTo", []))}))
+                   lambda m, b: self._start_replace(c, m, b))
         self.route("POST", r"/segments/([^/]+)/endReplaceSegments/([^/]+)",
-                   lambda m, b: (200, self._end_replace(c, m)))
+                   lambda m, b: self._lineage_flip(
+                       c.end_replace_segments, m))
         self.route("POST", r"/segments/([^/]+)/revertReplaceSegments/([^/]+)",
-                   lambda m, b: (200, self._revert_replace(c, m)))
+                   lambda m, b: self._lineage_flip(
+                       c.revert_replace_segments, m))
         # recommender (ref: RecommenderDriver via PinotTableRestletResource)
         self.route("POST", r"/tables/([^/]+)/recommender",
                    lambda m, b: self._recommend(store, m.group(1), b))
@@ -220,14 +221,24 @@ class ControllerApi(_Api):
                    lambda m, b: (200, self._render_ui(store)))
 
     @staticmethod
-    def _end_replace(c, m) -> Dict[str, Any]:
-        c.end_replace_segments(m.group(1), m.group(2))
-        return {"status": "done"}
+    def _start_replace(c, m, b):
+        try:
+            eid = c.start_replace_segments(
+                m.group(1), (b or {}).get("segmentsFrom", []),
+                (b or {}).get("segmentsTo", []))
+        except ValueError as e:  # overlapping in-progress replacement
+            return 409, {"error": str(e)}
+        return 200, {"segmentLineageEntryId": eid}
 
     @staticmethod
-    def _revert_replace(c, m) -> Dict[str, Any]:
-        c.revert_replace_segments(m.group(1), m.group(2))
-        return {"status": "reverted"}
+    def _lineage_flip(fn, m):
+        try:
+            fn(m.group(1), m.group(2))
+        except KeyError as e:
+            return 404, {"error": str(e)}
+        except ValueError as e:  # wrong state for the transition
+            return 409, {"error": str(e)}
+        return 200, {"status": "done"}
 
     @staticmethod
     def _recommend(store, table: str, body):

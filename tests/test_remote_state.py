@@ -1,0 +1,163 @@
+"""Multi-host control plane: remote state-store replica over HTTP
+(SURVEY §5 distributed comm backend; the ZK-spectator analogue)."""
+
+import time
+
+import numpy as np
+import pytest
+
+from pinot_tpu.controller.controller import Controller
+from pinot_tpu.controller.state import ClusterStateStore
+from pinot_tpu.transport.state_service import (
+    RemoteClusterStateStore,
+    StateStoreApi,
+)
+
+
+@pytest.fixture
+def authority():
+    store = ClusterStateStore()
+    api = StateStoreApi(store, port=0)
+    api.start()
+    yield store, f"http://localhost:{api.port}"
+    api.stop()
+
+
+def _wait(cond, timeout=5.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(0.02)
+    return False
+
+
+class TestReplica:
+    def test_read_your_writes_and_replication(self, authority):
+        store, url = authority
+        remote = RemoteClusterStateStore(url)
+        try:
+            remote.set("a/b", {"x": 1})
+            assert remote.get("a/b") == {"x": 1}       # own write, local
+            assert store.get("a/b") == {"x": 1}        # authority has it
+            store.set("a/c", [1, 2])                   # other-writer path
+            assert _wait(lambda: remote.get("a/c") == [1, 2])
+        finally:
+            remote.close()
+
+    def test_watch_fires_on_remote_mutation(self, authority):
+        store, url = authority
+        remote = RemoteClusterStateStore(url)
+        seen = []
+        remote.watch("tables/", lambda p, v: seen.append((p, v)))
+        try:
+            store.set("tables/t1", {"n": 1})
+            assert _wait(lambda: ("tables/t1", {"n": 1}) in seen)
+        finally:
+            remote.close()
+
+    def test_update_is_atomic_across_clients(self, authority):
+        store, url = authority
+        a = RemoteClusterStateStore(url)
+        b = RemoteClusterStateStore(url)
+        try:
+            import threading
+
+            def bump(client, n):
+                for _ in range(n):
+                    client.update("counter", lambda v: (v or 0) + 1,
+                                  default=0)
+
+            ts = [threading.Thread(target=bump, args=(c, 25))
+                  for c in (a, b)]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join()
+            assert store.get("counter") == 50
+        finally:
+            a.close()
+            b.close()
+
+    def test_delete_replicates(self, authority):
+        store, url = authority
+        remote = RemoteClusterStateStore(url)
+        try:
+            store.set("gone/x", 1)
+            assert _wait(lambda: remote.get("gone/x") == 1)
+            remote.delete("gone/x")
+            assert store.get("gone/x") is None
+        finally:
+            remote.close()
+
+    def test_full_resync_after_log_overflow(self, authority):
+        store, url = authority
+        remote = RemoteClusterStateStore(url, poll_interval_s=10)  # stalled
+        try:
+            for i in range(ClusterStateStore._LOG_CAP + 50):
+                store.set("k", i)
+            # replica is far behind the log tail: next sync snapshots
+            remote._sync_once()
+            assert remote.get("k") == ClusterStateStore._LOG_CAP + 49
+        finally:
+            remote.close()
+
+
+class TestMultiHostCluster:
+    def test_remote_roles_end_to_end(self, authority, tmp_path):
+        """Controller local; server + broker on 'another host': control
+        plane over the HTTP replica, data plane over gRPC."""
+        from pinot_tpu.broker.broker import BrokerRequestHandler
+        from pinot_tpu.segment import SegmentBuilder
+        from pinot_tpu.server.server import ServerInstance
+        from pinot_tpu.spi import DataType, FieldSpec, FieldType, Schema
+        from pinot_tpu.spi.table import TableConfig
+        from pinot_tpu.transport.grpc_transport import (
+            GrpcQueryServer,
+            GrpcServerStub,
+        )
+
+        store, url = authority
+        controller = Controller(store)
+
+        schema = Schema("rs", [
+            FieldSpec("k", DataType.STRING),
+            FieldSpec("v", DataType.LONG, FieldType.METRIC)])
+        controller.add_schema(schema)
+        controller.add_table(TableConfig(table_name="rs"))
+
+        # --- the "remote host" ------------------------------------------
+        server_store = RemoteClusterStateStore(url)
+        broker_store = RemoteClusterStateStore(url)
+        server = ServerInstance("remote_server_0", server_store,
+                                segment_dir=str(tmp_path / "srv"))
+        server.start()
+        grpc_srv = GrpcQueryServer(server, port=0)
+        grpc_srv.start()
+        broker = BrokerRequestHandler(broker_store)
+        broker.register_server(
+            "remote_server_0", GrpcServerStub(f"localhost:{grpc_srv.port}"))
+        try:
+            rng = np.random.default_rng(5)
+            frame = {"k": ["a", "b"] * 600,
+                     "v": rng.integers(0, 50, 1200).tolist()}
+            sm = SegmentBuilder(schema, "rs_0").build(frame, str(tmp_path))
+            controller.add_segment("rs_OFFLINE", sm,
+                                   str(tmp_path / "rs_0"))
+            # the remote server sees the assignment via its replica watch,
+            # downloads, serves; EV flows back through its replica writes
+            assert _wait(lambda: "rs_0" in server.hosted_segments(
+                "rs_OFFLINE"), timeout=10)
+            # ...and the broker's own replica must observe the EV too
+            assert _wait(lambda: "rs_0" in broker_store.get_external_view(
+                "rs_OFFLINE"), timeout=10)
+            resp = broker.handle_sql(
+                "SELECT k, sum(v) FROM rs GROUP BY k ORDER BY k")
+            expect_a = sum(v for k, v in zip(frame["k"], frame["v"])
+                           if k == "a")
+            assert resp.result_table.rows[0] == ["a", expect_a]
+        finally:
+            server.shutdown()
+            grpc_srv.stop()
+            server_store.close()
+            broker_store.close()

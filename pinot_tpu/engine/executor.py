@@ -267,6 +267,10 @@ class ServerQueryExecutor:
         (ref: MetadataBasedAggregationOperator, DictionaryBasedAggregationOperator)."""
         if ctx.filter is not None or ctx.is_group_by:
             return None
+        if getattr(seg, "valid_doc_ids", None) is not None:
+            # upsert: metadata counts/extremes include invalidated docs
+            # (ref: the fast paths require allDocsMatch + no validDocIds)
+            return None
         states: List[Any] = []
         for agg, fn in zip(aggs, ctx.aggregations):
             vexpr = agg_value_expr(fn)
@@ -365,7 +369,15 @@ class ServerQueryExecutor:
         staged = self.staging.stage(seg)
         cols = {name: staged.column(name).tree() for name in plan.columns}
         kernel = self.kernels.get(plan.spec)
-        packed = kernel(cols, tuple(plan.params), np.int32(seg.num_docs))
+        params = tuple(plan.params)
+        if plan.spec[0][:1] == ("and",) \
+                and plan.spec[0][1][0] == ("validdocs",):
+            # swap the host snapshot for the version-cached device mask so
+            # repeat queries skip the per-call H2D upload
+            mask = staged.valid_mask()
+            if mask is not None:
+                params = (mask,) + params[1:]
+        packed = kernel(cols, params, np.int32(seg.num_docs))
         # one D2H fetch for the whole output tree (tunnel-latency fix)
         out = unpack_outputs(packed, plan.spec)
         self._track_kernel_stats(out, seg, stats)

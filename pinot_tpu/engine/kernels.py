@@ -69,6 +69,9 @@ def _emit_filter(spec: Tuple, cols: Dict[str, Dict[str, jnp.ndarray]],
         return jnp.ones(capacity, dtype=bool)
     if op == "false":
         return jnp.zeros(capacity, dtype=bool)
+    if op == "validdocs":
+        # upsert valid-doc snapshot [capacity] (plan.py injects the param)
+        return pc.take()
     if op == "and":
         m = _emit_filter(spec[1][0], cols, pc, capacity)
         for s in spec[1][1:]:

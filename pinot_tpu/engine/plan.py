@@ -75,14 +75,25 @@ def plan_segment(ctx: QueryContext, segment: ImmutableSegment) -> SegmentPlan:
         # consuming segments are host-resident (unsorted dictionaries, live
         # append) — served by the host engine until sealed (SURVEY.md §7)
         raise PlanError("mutable segment -> host path")
-    if getattr(segment, "valid_doc_ids", None) is not None:
-        # upsert bitmaps mutate as newer keys arrive; the host path reads
-        # them live (device staging of the mask is a later optimization)
-        raise PlanError("upsert-managed segment -> host path")
     params: List[np.ndarray] = []
     columns: List[str] = []
 
     filter_spec = _compile_filter(ctx.filter, segment, params, columns)
+
+    valid = getattr(segment, "valid_doc_ids", None)
+    if valid is not None:
+        # upsert-managed: AND a point-in-time snapshot of the live valid-doc
+        # bitmap into the filter (the validDocIds contract,
+        # ref: IndexSegment.getValidDocIds ANDed into every filter). The
+        # snapshot is taken per plan_segment call — plans are built per
+        # execution, so every query sees the bitmap as of its start (the
+        # reference's queryableDocIds snapshot semantics). Params are
+        # positional: the bitmap rides FIRST, before the filter's params.
+        n = segment.num_docs
+        snap = np.zeros(segment.padded_capacity, dtype=bool)
+        snap[:n] = np.asarray(valid[:n])
+        params.insert(0, snap)
+        filter_spec = ("and", (("validdocs",), filter_spec))
 
     agg_defs = [resolve_agg(f) for f in ctx.aggregations]
 

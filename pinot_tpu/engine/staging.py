@@ -203,11 +203,34 @@ class StagedSegment:
             self._values[name] = v
         return v
 
+    def valid_mask(self):
+        """Device-committed upsert valid-doc snapshot [capacity], cached by
+        the bitmap's mutation version so repeat queries skip the H2D upload
+        (the round-3 tunnel-latency lesson applied to the validdocs param).
+        None when the segment isn't upsert-managed or the bitmap carries no
+        version (raw-array attach: plan.py's host snapshot serves)."""
+        v = getattr(self.segment, "valid_doc_ids", None)
+        if v is None:
+            return None
+        ver = getattr(v, "version", None)
+        if ver is None:
+            return None
+        cached = getattr(self, "_valid_cache", None)
+        if cached is not None and cached[0] == ver:
+            return cached[1]
+        n = self.segment.num_docs
+        snap = np.zeros(self.capacity, dtype=bool)
+        snap[:n] = np.asarray(v[:n])
+        arr = jnp.asarray(snap)
+        self._valid_cache = (ver, arr)
+        return arr
+
     def release(self) -> None:
         """Drop device references (HBM freed when XLA GCs the buffers)."""
         self._columns.clear()
         self._packed.clear()
         self._values.clear()
+        self._valid_cache = None
 
 
 class StagingCache:

@@ -106,9 +106,14 @@ class ShardedQueryExecutor(ServerQueryExecutor):
         key = tuple(s.segment_name for s in segments)
         b = self._batches.get(key)
         if b is None or any(cached is not seg for cached, seg
-                            in zip(b.segments, segments)):
+                            in zip(b.segments, segments)) \
+                or any(getattr(s, "valid_doc_ids", None) is not None
+                       for s in segments):
             # identity check: a reloaded segment keeps its name but must not
-            # serve stale device arrays (same guard as StagingCache)
+            # serve stale device arrays (same guard as StagingCache). A
+            # bitmap attached AFTER the batch was built must also invalidate
+            # it: rebuilding raises ValueError (batch.py rejects upsert) and
+            # the per-segment path — which consults the bitmap — serves.
             if b is not None:
                 self._evict_batch(b)
             b = SegmentBatch(segments)

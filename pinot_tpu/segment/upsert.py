@@ -45,6 +45,9 @@ class PartitionUpsertMetadataManager:
         self.comparison_column = comparison_column
         self.mode = mode
         self._locations: Dict[Tuple, RecordLocation] = {}
+        # per-segment bitmap mutation counters: device-staged mask caches
+        # key on these (staging.StagedSegment.valid_mask)
+        self._versions: Dict[str, int] = {}
         self._valid: Dict[str, np.ndarray] = {}
         self._lock = threading.Lock()
 
@@ -53,6 +56,15 @@ class PartitionUpsertMetadataManager:
         with self._lock:
             v = self._valid.get(segment_name)
             return None if v is None else v.copy()
+
+    def valid_docs_version(self, segment_name: str) -> int:
+        """Monotonic bitmap mutation counter (device-mask cache key)."""
+        with self._lock:
+            return self._versions.get(segment_name, 0)
+
+    def _bump_locked(self, segment_name: str) -> None:
+        self._versions[segment_name] = \
+            self._versions.get(segment_name, 0) + 1
 
     @property
     def num_keys(self) -> int:
@@ -70,6 +82,7 @@ class PartitionUpsertMetadataManager:
         with self._lock:
             valid = np.ones(n, dtype=bool)
             self._valid[segment.segment_name] = valid
+            self._bump_locked(segment.segment_name)
             for doc_id in range(n):
                 self._upsert_locked(keys[doc_id], segment.segment_name,
                                     doc_id, cmp_vals[doc_id])
@@ -95,6 +108,7 @@ class PartitionUpsertMetadataManager:
                 m = min(n, old.shape[0])
                 valid[:m] = old[:m]
             self._valid[segment.segment_name] = valid
+            self._bump_locked(segment.segment_name)
             return valid
 
     # -- row-level ingest (consuming segments) -------------------------------
@@ -110,6 +124,7 @@ class PartitionUpsertMetadataManager:
                     grown[:valid.shape[0]] = valid
                 valid = grown
                 self._valid[segment_name] = valid
+            self._bump_locked(segment_name)
             self._upsert_locked(key, segment_name, doc_id, comparison_value)
 
     def _upsert_locked(self, key: Tuple, segment_name: str, doc_id: int,
@@ -129,10 +144,12 @@ class PartitionUpsertMetadataManager:
                 valid = self._valid.get(segment_name)
                 if valid is not None and doc_id < valid.shape[0]:
                     valid[doc_id] = False
+                    self._bump_locked(segment_name)
                 return
             old_valid = self._valid.get(loc.segment_name)
             if old_valid is not None and loc.doc_id < old_valid.shape[0]:
                 old_valid[loc.doc_id] = False
+                self._bump_locked(loc.segment_name)
         self._locations[key] = RecordLocation(segment_name, doc_id, cmp_value)
 
     # -- helpers -------------------------------------------------------------

@@ -30,6 +30,11 @@ class _LiveValidDocs:
         self._pm = pm
         self._segment_name = segment_name
 
+    @property
+    def version(self) -> int:
+        """Bitmap mutation counter (device-mask cache key)."""
+        return self._pm.valid_docs_version(self._segment_name)
+
     def __getitem__(self, item):
         v = self._pm.valid_docs(self._segment_name)
         if isinstance(item, slice):

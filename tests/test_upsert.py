@@ -144,3 +144,55 @@ class TestUpsertCluster:
         assert got == {k: float(s) for k, (s, _) in latest.items()}
         cluster.shutdown()
         MemoryStream.delete("upsert_topic")
+
+
+class TestUpsertDevicePath:
+    def test_device_serves_upsert_with_parity(self, tmp_path):
+        """Sealed upsert segments ride the device kernels with the
+        valid-doc snapshot ANDed into the filter (plan.py 'validdocs')."""
+        rng = np.random.default_rng(13)
+        n = 3000
+        rows = [{"uid": f"u{i % 900}", "status": ["a", "b"][i % 2],
+                 "score": int(rng.integers(0, 100)), "ts": i}
+                for i in range(n)]
+        seg = build_seg(tmp_path, "up_0", rows)
+        pm = PartitionUpsertMetadataManager(["uid"], "ts")
+        attach_valid_docs(seg, pm.add_segment(seg))
+        assert seg.valid_doc_ids is not None
+
+        dev = ServerQueryExecutor(use_device=True)
+        host = ServerQueryExecutor(use_device=False)
+        for sql in ("SELECT count(*) FROM users",
+                    "SELECT sum(score) FROM users WHERE status = 'a'",
+                    "SELECT status, count(*), max(score) FROM users "
+                    "GROUP BY status ORDER BY status"):
+            traced = compile_query(sql + " OPTION(trace=true)")
+            drt, dstats = dev.execute(traced, [seg])
+            hrt, _ = host.execute(compile_query(sql), [seg])
+            assert drt.rows == hrt.rows, sql
+            # the DEVICE kernels must have served (a silent PlanError
+            # fallback to host would make this parity vacuous)
+            paths = {t.get("path") for t in dstats.trace}
+            assert "device" in paths, (sql, dstats.trace)
+        # only the live doc per key is visible
+        t, _ = dev.execute(compile_query("SELECT count(*) FROM users"),
+                           [seg])
+        assert t.rows[0][0] == 900
+
+    def test_snapshot_tracks_new_invalidation(self, tmp_path):
+        """A doc invalidated between two queries disappears from the
+        second (plans snapshot the bitmap per execution)."""
+        rows = [{"uid": f"u{i}", "status": "a", "score": i, "ts": i}
+                for i in range(100)]
+        seg = build_seg(tmp_path, "up_1", rows)
+        pm = PartitionUpsertMetadataManager(["uid"], "ts")
+        attach_valid_docs(seg, pm.add_segment(seg))
+        dev = ServerQueryExecutor(use_device=True)
+        q = compile_query("SELECT count(*) FROM users")
+        assert dev.execute(q, [seg])[0].rows[0][0] == 100
+        # a newer segment claims u5: the old doc goes invalid in place
+        seg2 = build_seg(tmp_path, "up_2",
+                         [{"uid": "u5", "status": "a", "score": 1,
+                           "ts": 1000}])
+        attach_valid_docs(seg2, pm.add_segment(seg2))
+        assert dev.execute(q, [seg])[0].rows[0][0] == 99

@@ -80,19 +80,15 @@ def plan_segment(ctx: QueryContext, segment: ImmutableSegment) -> SegmentPlan:
 
     filter_spec = _compile_filter(ctx.filter, segment, params, columns)
 
-    valid = getattr(segment, "valid_doc_ids", None)
-    if valid is not None:
+    if getattr(segment, "valid_doc_ids", None) is not None:
         # upsert-managed: AND a point-in-time snapshot of the live valid-doc
         # bitmap into the filter (the validDocIds contract,
         # ref: IndexSegment.getValidDocIds ANDed into every filter). The
-        # snapshot is taken per plan_segment call — plans are built per
-        # execution, so every query sees the bitmap as of its start (the
-        # reference's queryableDocIds snapshot semantics). Params are
-        # positional: the bitmap rides FIRST, before the filter's params.
-        n = segment.num_docs
-        snap = np.zeros(segment.padded_capacity, dtype=bool)
-        snap[:n] = np.asarray(valid[:n])
-        params.insert(0, snap)
+        # param rides FIRST, before the filter's params, as a PLACEHOLDER:
+        # the executor substitutes the version-cached device mask (or a
+        # fresh host snapshot for unversioned bitmaps) at run time, so the
+        # O(capacity) copy isn't paid when the cache will win anyway.
+        params.insert(0, None)
         filter_spec = ("and", (("validdocs",), filter_spec))
 
     agg_defs = [resolve_agg(f) for f in ctx.aggregations]
@@ -170,6 +166,11 @@ def plan_segment(ctx: QueryContext, segment: ImmutableSegment) -> SegmentPlan:
                 raise PlanError("DISTINCTCOUNT on raw column -> host")
             if not cm.single_value:
                 raise PlanError("DISTINCTCOUNT on MV column -> host")
+            if cm.cardinality > (1 << 20):
+                # the presence vector is [cardinality]: past ~1M ids the
+                # D2H outweighs the scan (use DISTINCTCOUNTHLL there, like
+                # the reference recommends at scale)
+                raise PlanError("DISTINCTCOUNT cardinality too large -> host")
             agg_specs.append(("distinctcount", vexpr.name, cm.cardinality))
             if vexpr.name not in columns:
                 columns.append(vexpr.name)

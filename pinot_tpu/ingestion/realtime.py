@@ -287,10 +287,32 @@ class RealtimeSegmentDataManager:
         md.save(os.path.join(seg_dir, "metadata.json"))
         return md, seg_dir
 
+    def _run_once_resilient(self) -> ConsumerState:
+        """run_once with transient-failure absorption: a throwing consumer
+        (network flap, broker hiccup) must not kill the consumption thread —
+        offsets are only advanced after successful indexing, so retrying the
+        same fetch is exactly-once safe (ref: the transient vs permanent
+        consumer-exception split in LLRealtimeSegmentDataManager;
+        FlakyConsumerRealtimeClusterIntegrationTest is the contract)."""
+        try:
+            st = self.run_once()
+            self._consecutive_errors = 0
+            return st
+        except Exception:
+            self._consecutive_errors = getattr(
+                self, "_consecutive_errors", 0) + 1
+            log.exception("[%s] consume iteration failed (attempt %d)",
+                          self.segment_name, self._consecutive_errors)
+            if self._consecutive_errors >= self.MAX_CONSUME_ERRORS:
+                self.state = ConsumerState.ERROR
+            return self.state
+
+    MAX_CONSUME_ERRORS = 100
+
     # -- synchronous drive (tests, quickstart) ------------------------------
     def consume_until_committed(self, max_iters: int = 10_000) -> ConsumptionResult:
         for _ in range(max_iters):
-            st = self.run_once()
+            st = self._run_once_resilient()
             if st in (ConsumerState.COMMITTED, ConsumerState.RETAINING,
                       ConsumerState.DISCARDED, ConsumerState.ERROR):
                 break
@@ -302,11 +324,18 @@ class RealtimeSegmentDataManager:
     def start(self, tick_seconds: float = 0.05) -> None:
         def loop():
             while not self._stop.is_set():
-                st = self.run_once()
+                st = self._run_once_resilient()
                 if st in (ConsumerState.COMMITTED, ConsumerState.RETAINING,
                           ConsumerState.DISCARDED, ConsumerState.ERROR):
                     break
-                if st is ConsumerState.HOLDING:
+                err = getattr(self, "_consecutive_errors", 0)
+                if err > 0:
+                    # exponential backoff capped at 5s: 100 consecutive
+                    # errors span ~8 minutes, so an outage shorter than
+                    # that resumes instead of flipping to ERROR
+                    self._stop.wait(min(tick_seconds * (2 ** min(err, 10)),
+                                        5.0))
+                elif st is ConsumerState.HOLDING:
                     self._stop.wait(tick_seconds)
                 elif not self._has_new_data():
                     self._stop.wait(tick_seconds)
@@ -323,6 +352,12 @@ class RealtimeSegmentDataManager:
         self._thread.start()
 
     def _has_new_data(self) -> bool:
+        try:
+            return self._peek_new_data()
+        except Exception:
+            return False  # transient fetch failure: back off, retry later
+
+    def _peek_new_data(self) -> bool:
         batch = self._consumer.fetch_messages(self.current_offset,
                                               max_messages=1)
         return batch.message_count > 0

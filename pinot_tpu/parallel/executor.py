@@ -104,16 +104,20 @@ class ShardedQueryExecutor(ServerQueryExecutor):
     # -- sharded execution ---------------------------------------------------
     def batch_for(self, segments: List[ImmutableSegment]) -> SegmentBatch:
         key = tuple(s.segment_name for s in segments)
+        if any(getattr(s, "valid_doc_ids", None) is not None
+               for s in segments):
+            # a bitmap attached AFTER a batch was built must not serve the
+            # stale arrays; drop any cached batch ONCE and reject so the
+            # per-segment path — which consults the bitmap — serves
+            b = self._batches.pop(key, None)
+            if b is not None:
+                self._evict_batch(b)
+            raise ValueError("upsert-managed segments are not batchable")
         b = self._batches.get(key)
         if b is None or any(cached is not seg for cached, seg
-                            in zip(b.segments, segments)) \
-                or any(getattr(s, "valid_doc_ids", None) is not None
-                       for s in segments):
+                            in zip(b.segments, segments)):
             # identity check: a reloaded segment keeps its name but must not
-            # serve stale device arrays (same guard as StagingCache). A
-            # bitmap attached AFTER the batch was built must also invalidate
-            # it: rebuilding raises ValueError (batch.py rejects upsert) and
-            # the per-segment path — which consults the bitmap — serves.
+            # serve stale device arrays (same guard as StagingCache)
             if b is not None:
                 self._evict_batch(b)
             b = SegmentBatch(segments)

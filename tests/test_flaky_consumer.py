@@ -1,0 +1,114 @@
+"""Fault injection: a stream consumer that randomly throws must not lose
+data or kill consumption (ref: FlakyConsumerRealtimeClusterIntegrationTest
+— a consumer plugin that randomly throws; ChaosMonkey tier of SURVEY §4)."""
+
+import numpy as np
+import pytest
+
+from pinot_tpu.ingestion import MemoryStream
+from pinot_tpu.ingestion.realtime import (
+    ConsumerState,
+    RealtimeSegmentDataManager,
+)
+from pinot_tpu.ingestion.stream import (
+    MemoryStreamConsumer,
+    MemoryStreamConsumerFactory,
+    StreamOffset,
+)
+from pinot_tpu.spi import DataType, FieldSpec, FieldType, Schema
+from pinot_tpu.spi.table import (
+    StreamIngestionConfig,
+    TableConfig,
+    TableType,
+)
+
+
+class FlakyConsumer(MemoryStreamConsumer):
+    """Throws on a deterministic schedule: every 3rd fetch fails."""
+
+    def __init__(self, stream, partition):
+        super().__init__(stream, partition)
+        self.calls = 0
+        self.failures = 0
+
+    def fetch_messages(self, start, max_messages=5000, timeout_ms=5000):
+        self.calls += 1
+        if self.calls % 3 == 1:  # the FIRST fetch fails, then every 3rd
+            self.failures += 1
+            raise ConnectionError("injected transient stream failure")
+        return super().fetch_messages(start, max_messages, timeout_ms)
+
+
+class FlakyFactory(MemoryStreamConsumerFactory):
+    def __init__(self, config):
+        super().__init__(config)
+        self.consumers = []
+
+    def create_partition_consumer(self, partition):
+        c = FlakyConsumer(self._stream(), partition)
+        self.consumers.append(c)
+        return c
+
+
+def _schema():
+    return Schema("fl", [
+        FieldSpec("k", DataType.STRING),
+        FieldSpec("v", DataType.LONG, FieldType.METRIC),
+        FieldSpec("ts", DataType.LONG, FieldType.DATE_TIME)])
+
+
+def _table(threshold=500):
+    return TableConfig(
+        "fl", table_type=TableType.REALTIME,
+        stream_config=StreamIngestionConfig(
+            stream_type="memory", topic="flaky_events", decoder="json",
+            segment_flush_threshold_rows=threshold))
+
+
+@pytest.fixture
+def topic():
+    s = MemoryStream.create("flaky_events", 1)
+    rng = np.random.default_rng(3)
+    for i in range(500):
+        s.produce({"k": f"k{i % 5}", "v": int(rng.integers(0, 100)),
+                   "ts": i}, partition=0)
+    yield s
+    MemoryStream.delete("flaky_events")
+
+
+def test_flaky_consumer_loses_nothing(topic, tmp_path):
+    """Every injected failure retries from the same offset: all 500 rows
+    land exactly once and the segment commits."""
+    cfg = _table()
+    factory = FlakyFactory(cfg.stream_config)
+    mgr = RealtimeSegmentDataManager(
+        "fl__0__0__t0", cfg, _schema(), partition=0,
+        start_offset=StreamOffset(0), output_dir=str(tmp_path),
+        consumer_factory=factory)
+    result = mgr.consume_until_committed()
+    assert result.state is ConsumerState.COMMITTED
+    assert result.rows_indexed == 500
+    assert result.final_offset == StreamOffset(500)
+    assert factory.consumers[0].failures > 0  # the fault actually fired
+
+
+def test_persistent_failure_marks_error(topic, tmp_path):
+    """A consumer that ALWAYS throws ends in ERROR (bounded retries), not
+    an infinite loop or a dead thread."""
+
+    class DeadConsumer(MemoryStreamConsumer):
+        def fetch_messages(self, *a, **k):
+            raise ConnectionError("permanently down")
+
+    class DeadFactory(MemoryStreamConsumerFactory):
+        def create_partition_consumer(self, partition):
+            return DeadConsumer(self._stream(), partition)
+
+    cfg = _table()
+    mgr = RealtimeSegmentDataManager(
+        "fl__0__1__t0", cfg, _schema(), partition=0,
+        start_offset=StreamOffset(0), output_dir=str(tmp_path),
+        consumer_factory=DeadFactory(cfg.stream_config))
+    result = mgr.consume_until_committed(max_iters=300)
+    assert result.state is ConsumerState.ERROR
+    assert result.rows_indexed == 0

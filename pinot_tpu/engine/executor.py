@@ -372,17 +372,9 @@ class ServerQueryExecutor:
         params = tuple(plan.params)
         if plan.spec[0][:1] == ("and",) \
                 and plan.spec[0][1][0] == ("validdocs",):
-            # fill the planner's placeholder: version-cached device mask
-            # when the bitmap carries a version, else a fresh host snapshot
-            # (the snapshot semantics are per-query either way)
-            mask = staged.valid_mask()
-            if mask is None:
-                v = seg.valid_doc_ids
-                n = seg.num_docs
-                snap = np.zeros(seg.padded_capacity, dtype=bool)
-                snap[:n] = np.asarray(v[:n])
-                mask = snap
-            params = (mask,) + params[1:]
+            # fill the planner's placeholder (staging owns the snapshot
+            # build + version-keyed device cache)
+            params = (staged.valid_mask(),) + params[1:]
         packed = kernel(cols, params, np.int32(seg.num_docs))
         # one D2H fetch for the whole output tree (tunnel-latency fix)
         out = unpack_outputs(packed, plan.spec)

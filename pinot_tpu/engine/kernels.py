@@ -35,6 +35,10 @@ NEG_INF = float("-inf")
 _ACC = {"i32": jnp.int32, "i64": jnp.int64,
         "f32": jnp.float32, "f64": jnp.float64}
 
+# None = backend-keyed (batched on TPU, split on CPU); tests override to
+# exercise the batched branch on the CPU oracle
+FORCE_BATCH_SCATTERS = None
+
 
 def _acc_info(acc: str):
     """(dtype, widened dtype, min-neutral, max-neutral) for an acc tag."""
@@ -218,17 +222,114 @@ def build_kernel_body(spec: Tuple, capacity_override: int = 0):
                 k = (c["fwd"] - _bases[gi]).astype(jnp.int32)
             keys = keys + k * strides[gi]
         seg_ids = jnp.where(mask, keys, num_groups)  # overflow bucket
-        out = {
-            "presence": jax.ops.segment_sum(
-                mask.astype(jnp.int32), seg_ids,
-                num_segments=num_groups + 1)[:num_groups].astype(jnp.int64)
-        }
-        for i, aspec in enumerate(agg_specs):
-            out[f"agg{i}"] = _emit_grouped_agg(aspec, cols, pc, mask, seg_ids,
-                                               num_groups)
-        return out
+        return _emit_grouped_all(agg_specs, cols, pc, mask, seg_ids,
+                                 num_groups)
 
     return kernel
+
+
+def _emit_grouped_all(agg_specs, cols, pc, mask, seg_ids, num_groups):
+    """All grouped aggregations + presence through BATCHED scatters: leaves
+    sharing (reduce op, accumulator dtype) stack into one [N, k] array and
+    reduce with a single segment_sum/min/max — scatters are the expensive
+    op on TPU, and a 6-aggregation query otherwise issues 8+ of them.
+    Param-cursor order is preserved (vectors are built in agg order; only
+    the scatters are deferred)."""
+    n = num_groups + 1
+    # (op, dtype-str) -> list of [N] vectors to reduce together
+    buckets: Dict[Tuple[str, str], List] = {}
+
+    def enqueue(op: str, vec, post):
+        b = buckets.setdefault((op, str(vec.dtype)), [])
+        b.append(vec)
+        return (op, str(vec.dtype), len(b) - 1, post)
+
+    # presence / COUNT(*) / AVG counts are all the SAME masked count —
+    # enqueue one column and share the ref (duplicate columns in a scatter
+    # are not CSE'd away)
+    count_ref = enqueue("sum", mask.astype(jnp.int32),
+                        lambda r: r.astype(jnp.int64))
+    refs: Dict[str, Any] = {}
+    refs["presence"] = count_ref
+
+    out: Dict[str, Any] = {}
+    for i, aspec in enumerate(agg_specs):
+        key = f"agg{i}"
+        if aspec[0] == "distinctcounthll":
+            # composed (group, bucket) id space: its own scatter
+            _, colname, log2m = aspec
+            m = 1 << log2m
+            fwd = cols[colname]["fwd"]
+            bucket = pc.take()[fwd]
+            rank = pc.take()[fwd]
+            ids = seg_ids * m + bucket
+            regs = jax.ops.segment_max(jnp.where(mask, rank, 0), ids,
+                                       num_segments=n * m)
+            out[key] = jnp.maximum(regs[:num_groups * m], 0)
+            continue
+        base, mv, vals, dt, wide, min_n, max_n = _masked_values(
+            aspec, cols, pc, mask)
+        zero = jnp.zeros((), dtype=dt)
+        if base == "count":
+            refs[key] = count_ref
+            continue
+        fv = vals if vals.ndim else jnp.full(mask.shape[0], vals, dtype=dt)
+        if base == "sum":
+            refs[key] = enqueue("sum", jnp.where(mask, fv, zero),
+                                lambda r, w=wide: r.astype(w))
+        elif base == "min":
+            refs[key] = enqueue(
+                "min", jnp.where(mask, fv, min_n),
+                lambda r: r.astype(jnp.float64))
+        elif base == "max":
+            refs[key] = enqueue(
+                "max", jnp.where(mask, fv, max_n),
+                lambda r: r.astype(jnp.float64))
+        elif base == "avg":
+            refs[key] = [
+                enqueue("sum", jnp.where(mask, fv, zero),
+                        lambda r, w=wide: r.astype(w)),
+                count_ref]
+        elif base == "minmaxrange":
+            refs[key] = [
+                enqueue("min", jnp.where(mask, fv, min_n),
+                        lambda r: r.astype(jnp.float64)),
+                enqueue("max", jnp.where(mask, fv, max_n),
+                        lambda r: r.astype(jnp.float64))]
+        else:
+            raise AssertionError(f"agg {base} has no device grouped kernel")
+
+    # one scatter per (op, dtype) bucket on TPU: the scatter's minor dim
+    # pads to 128 lanes either way, so k stacked leaves cost ~one leaf.
+    # CPU lowers separate 1-D scatters faster — keep them split there.
+    # (FORCE_BATCH_SCATTERS overrides for tests of the batched branch.)
+    batch = (FORCE_BATCH_SCATTERS if FORCE_BATCH_SCATTERS is not None
+             else jax.default_backend() not in ("cpu",))
+    reduced: Dict[Tuple[str, str], List] = {}
+    scatter = {"sum": jax.ops.segment_sum, "min": jax.ops.segment_min,
+               "max": jax.ops.segment_max}
+    for (op, dts), vecs in buckets.items():
+        if batch and len(vecs) > 1:
+            data = jnp.stack(vecs, axis=1)  # [N, k]
+            r = scatter[op](data, seg_ids, num_segments=n)[:num_groups]
+            reduced[(op, dts)] = [r[:, j] for j in range(len(vecs))]
+        else:
+            reduced[(op, dts)] = [
+                scatter[op](v, seg_ids, num_segments=n)[:num_groups]
+                for v in vecs]
+
+    def resolve(ref):
+        op, dts, idx, post = ref
+        return post(reduced[(op, dts)][idx])
+
+    for key, ref in refs.items():
+        if key in out:
+            continue
+        # multi-leaf states (avg, minmaxrange) ride as LISTS of refs;
+        # single refs are 4-tuples
+        out[key] = (tuple(resolve(r) for r in ref)
+                    if isinstance(ref, list) else resolve(ref))
+    return out
 
 
 def build_kernel(spec: Tuple):
@@ -512,60 +613,6 @@ def _emit_scalar_agg(aspec, cols, pc, mask):
         return (jnp.where(any_match, lo, POS_INF),
                 jnp.where(any_match, hi, NEG_INF))
     raise AssertionError(f"agg {base} has no device scalar kernel")
-
-
-def _emit_grouped_agg(aspec, cols, pc, mask, seg_ids, num_groups):
-    if aspec[0] == "distinctcounthll":
-        # per-group registers: composed (group, bucket) scatter-max ids
-        _, colname, log2m = aspec
-        m = 1 << log2m
-        fwd = cols[colname]["fwd"]
-        bucket = pc.take()[fwd]
-        rank = pc.take()[fwd]
-        ids = seg_ids * m + bucket        # overflow group included
-        regs = jax.ops.segment_max(jnp.where(mask, rank, 0), ids,
-                                   num_segments=(num_groups + 1) * m)
-        return jnp.maximum(regs[:num_groups * m], 0)  # [G*m]
-    base, mv, vals, dt, wide, min_n, max_n = _masked_values(
-        aspec, cols, pc, mask)
-    n = num_groups + 1
-    zero = jnp.zeros((), dtype=dt)
-
-    def cnt32(m):
-        return jax.ops.segment_sum(
-            m.astype(jnp.int32), seg_ids,
-            num_segments=n)[:num_groups].astype(jnp.int64)
-
-    if base == "count":
-        return cnt32(mask)
-    fv = vals if vals.ndim else jnp.full(mask.shape[0], vals, dtype=dt)
-    # empty-group neutrals survive into the output here (unlike the scalar
-    # path); they are masked out downstream by `presence` at decode, and
-    # cross-segment pmin/pmax treat them as identities
-    if base == "sum":
-        return jax.ops.segment_sum(
-            jnp.where(mask, fv, zero), seg_ids,
-            num_segments=n)[:num_groups].astype(wide)
-    if base == "min":
-        return jax.ops.segment_min(
-            jnp.where(mask, fv, min_n), seg_ids,
-            num_segments=n)[:num_groups].astype(jnp.float64)
-    if base == "max":
-        return jax.ops.segment_max(
-            jnp.where(mask, fv, max_n), seg_ids,
-            num_segments=n)[:num_groups].astype(jnp.float64)
-    if base == "avg":
-        return (jax.ops.segment_sum(
-            jnp.where(mask, fv, zero), seg_ids,
-            num_segments=n)[:num_groups].astype(wide), cnt32(mask))
-    if base == "minmaxrange":
-        return (jax.ops.segment_min(
-            jnp.where(mask, fv, min_n), seg_ids,
-            num_segments=n)[:num_groups].astype(jnp.float64),
-                jax.ops.segment_max(
-            jnp.where(mask, fv, max_n), seg_ids,
-            num_segments=n)[:num_groups].astype(jnp.float64))
-    raise AssertionError(f"agg {base} has no device grouped kernel")
 
 
 class KernelCache:

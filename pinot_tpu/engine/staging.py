@@ -204,23 +204,26 @@ class StagedSegment:
         return v
 
     def valid_mask(self):
-        """Device-committed upsert valid-doc snapshot [capacity], cached by
-        the bitmap's mutation version so repeat queries skip the H2D upload
-        (the round-3 tunnel-latency lesson applied to the validdocs param).
-        None when the segment isn't upsert-managed or the bitmap carries no
-        version (raw-array attach: plan.py's host snapshot serves)."""
+        """Upsert valid-doc snapshot [capacity] for the validdocs kernel
+        param, or None when the segment isn't upsert-managed. Versioned
+        bitmaps (_LiveValidDocs) get a DEVICE-committed snapshot cached on
+        the mutation version, so repeat queries skip the H2D upload (the
+        round-3 tunnel-latency lesson); unversioned raw-array attaches get
+        a fresh host snapshot per call (per-query snapshot semantics
+        either way). The single implementation of the snapshot build."""
         v = getattr(self.segment, "valid_doc_ids", None)
         if v is None:
             return None
         ver = getattr(v, "version", None)
-        if ver is None:
-            return None
-        cached = getattr(self, "_valid_cache", None)
-        if cached is not None and cached[0] == ver:
-            return cached[1]
+        if ver is not None:
+            cached = getattr(self, "_valid_cache", None)
+            if cached is not None and cached[0] == ver:
+                return cached[1]
         n = self.segment.num_docs
         snap = np.zeros(self.capacity, dtype=bool)
         snap[:n] = np.asarray(v[:n])
+        if ver is None:
+            return snap
         arr = jnp.asarray(snap)
         self._valid_cache = (ver, arr)
         return arr

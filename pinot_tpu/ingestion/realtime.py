@@ -197,7 +197,15 @@ class RealtimeSegmentDataManager:
                     break
                 self.rows_indexed += 1
                 if self.upsert_hook is not None:
-                    self.upsert_hook(row, self.segment.num_docs - 1)
+                    try:
+                        self.upsert_hook(row, self.segment.num_docs - 1)
+                    except Exception:
+                        # the row IS indexed: advance past it before
+                        # surfacing, or the resilient retry would replay
+                        # it and double-index (exactly-once contract)
+                        self.current_offset = StreamOffset(
+                            msg.offset.value + 1)
+                        raise
             n += 1
             self.current_offset = StreamOffset(msg.offset.value + 1)
         return n
@@ -316,6 +324,11 @@ class RealtimeSegmentDataManager:
             if st in (ConsumerState.COMMITTED, ConsumerState.RETAINING,
                       ConsumerState.DISCARDED, ConsumerState.ERROR):
                 break
+            err = getattr(self, "_consecutive_errors", 0)
+            if err > 0:
+                # linear backoff (capped): the sync driver must not burn
+                # the whole error budget inside a sub-second outage
+                time.sleep(min(0.01 * err, 0.1))
         return ConsumptionResult(
             self.state, self.rows_indexed, self.rows_dropped,
             self.current_offset, self._committed_dir, self._committed_metadata)

@@ -389,3 +389,39 @@ class TestJitCaching:
         run(device_exec, segs, "SELECT sum(runs) FROM stats WHERE year > 2010")
         run(device_exec, segs, "SELECT sum(runs) FROM stats WHERE year > 1995")
         assert len(device_exec.kernels) == n  # same structure -> same kernel
+
+
+def test_batched_scatter_branch_parity(tmp_path, monkeypatch):
+    """Force the TPU-only batched-scatter lowering on the CPU oracle: the
+    stacked [N, k] segment reduces must match the split per-leaf path
+    (regression guard for the branch CI otherwise never runs)."""
+    import numpy as np
+
+    from pinot_tpu.engine import kernels
+    from pinot_tpu.engine.executor import ServerQueryExecutor
+    from pinot_tpu.query import compile_query
+    from pinot_tpu.segment import SegmentBuilder, load_segment
+    from pinot_tpu.spi import DataType, FieldSpec, FieldType, Schema
+
+    rng = np.random.default_rng(21)
+    n = 5000
+    frame = {"g": [f"g{i % 6}" for i in range(n)],
+             "a": rng.integers(0, 100, n).tolist(),
+             "b": np.round(rng.normal(10, 3, n), 2).tolist()}
+    schema = Schema("bs", [
+        FieldSpec("g", DataType.STRING),
+        FieldSpec("a", DataType.LONG, FieldType.METRIC),
+        FieldSpec("b", DataType.DOUBLE, FieldType.METRIC)])
+    SegmentBuilder(schema, "bs0").build(frame, str(tmp_path))
+    seg = load_segment(str(tmp_path / "bs0"))
+    sql = ("SELECT g, count(*), sum(a), avg(a), min(b), max(b), "
+           "minmaxrange(a), sum(b) FROM bs WHERE a > 10 "
+           "GROUP BY g ORDER BY g")
+
+    split = ServerQueryExecutor(use_device=True)
+    rt_split, _ = split.execute(compile_query(sql), [seg])
+
+    monkeypatch.setattr(kernels, "FORCE_BATCH_SCATTERS", True)
+    batched = ServerQueryExecutor(use_device=True)  # fresh kernel cache
+    rt_batched, _ = batched.execute(compile_query(sql), [seg])
+    assert rt_batched.rows == rt_split.rows

@@ -310,7 +310,55 @@ def main() -> None:
     result["sketches"] = {
         "p50_ms_per_query": round(sk_p50 / len(sk_ctxs) * 1e3, 3)}
 
+    # ---- broker scatter-gather (BASELINE config #5's distributed half) ---
+    if not _over_budget():
+        _progress("broker scatter-gather")
+        try:
+            result["cluster"] = _bench_cluster(tmpdir)
+        except Exception as exc:  # sub-suite must not sink the headline
+            traceback.print_exc(file=sys.stderr)
+            result["cluster"] = {"error": f"{type(exc).__name__}: {exc}"[:200]}
+
     print(json.dumps(result))
+
+
+def _bench_cluster(tmpdir: str) -> dict:
+    """SSB queries through the FULL distributed path: broker parse ->
+    routing -> 2-server scatter -> per-server execution -> DataTable wire
+    -> broker reduce (ref: BASELINE config #5 'multi-segment CombineOperator
+    + broker scatter-gather reduce')."""
+    from pinot_tpu.segment import SegmentBuilder  # noqa: F401 (env check)
+    from pinot_tpu.spi.table import TableConfig
+    from pinot_tpu.tools import ssb
+    from pinot_tpu.tools.cluster import EmbeddedCluster
+
+    cluster = EmbeddedCluster(num_servers=2,
+                              data_dir=f"{tmpdir}/bench_cluster")
+    try:
+        schema = ssb.ssb_schema()
+        cluster.create_table(TableConfig("ssb_lineorder"), schema)
+        rows = min(SSB_ROWS, 500_000)
+        seg_dir = f"{tmpdir}/bench_cluster_segs"
+        ssb.build_segments(0, seg_dir, num_segments=4, rows=rows)
+        for i in range(4):
+            cluster.upload_segment_dir(
+                "ssb_lineorder_OFFLINE", f"{seg_dir}/ssb_{i}")
+        assert cluster.wait_for_ev_converged("ssb_lineorder_OFFLINE"), \
+            "external view did not converge: refusing to bench partial data"
+        queries = [ssb.QUERIES[q] for q in ("Q1.1", "Q2.1", "Q4.2")]
+        for q in queries:  # warmup/compile
+            cluster.query(q)
+        t0 = time.perf_counter()
+        iters = 5
+        for _ in range(iters):
+            for q in queries:
+                resp = cluster.query(q)
+                assert not resp.exceptions, resp.exceptions
+        per_query = (time.perf_counter() - t0) / (iters * len(queries))
+        return {"rows": rows, "servers": 2,
+                "p50_ms_per_query": round(per_query * 1e3, 3)}
+    finally:
+        cluster.shutdown()
 
 
 if __name__ == "__main__":

@@ -296,7 +296,7 @@ class ControllerApi(_Api):
     def _add_table(c, body) -> Dict[str, Any]:
         cfg = TableConfig.from_dict(body)
         c.add_table(cfg)
-        return {"status": f"Table {cfg.table_name_with_type} succesfully "
+        return {"status": f"Table {cfg.table_name_with_type} successfully "
                           "added"}
 
     @staticmethod

@@ -420,8 +420,10 @@ def test_batched_scatter_branch_parity(tmp_path, monkeypatch):
 
     split = ServerQueryExecutor(use_device=True)
     rt_split, _ = split.execute(compile_query(sql), [seg])
+    assert len(split.kernels) == 1  # the DEVICE path served, not host
 
     monkeypatch.setattr(kernels, "FORCE_BATCH_SCATTERS", True)
     batched = ServerQueryExecutor(use_device=True)  # fresh kernel cache
     rt_batched, _ = batched.execute(compile_query(sql), [seg])
+    assert len(batched.kernels) == 1
     assert rt_batched.rows == rt_split.rows

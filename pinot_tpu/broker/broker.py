@@ -112,6 +112,18 @@ class BrokerRequestHandler:
             return finish(response)
         t = phase(BrokerQueryPhase.COMPILATION, start)
 
+        if ctx.explain:
+            # EXPLAIN PLAN FOR: logical operator tree, no execution
+            # (ref: ExplainPlanDataTableReducer)
+            from pinot_tpu.engine.results import DataSchema, ResultTable
+            from pinot_tpu.query.explain import EXPLAIN_COLUMNS, explain_rows
+
+            names, types = EXPLAIN_COLUMNS
+            response.result_table = ResultTable(DataSchema(names, types),
+                                                explain_rows(ctx))
+            response.time_used_ms = (time.perf_counter() - start) * 1e3
+            return finish(response)
+
         try:
             physical = self._resolve_tables(ctx.table_name)
         except QueryError as e:

@@ -102,6 +102,7 @@ class QueryContext:
     offset: int
     options: Dict[str, str] = field(default_factory=dict)
 
+    explain: bool = False  # EXPLAIN PLAN FOR
     # derived (filled by build):
     aggregations: List[Function] = field(default_factory=list)
     # original SQL text when compiled from SQL (caching/diagnostics key)
@@ -235,6 +236,7 @@ def build_query_context(parsed: ParsedQuery) -> QueryContext:
         limit=parsed.limit,
         offset=parsed.offset,
         options=dict(parsed.options),
+        explain=parsed.explain,
     )
 
     aggs: List[Function] = []

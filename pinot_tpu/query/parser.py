@@ -114,6 +114,7 @@ class ParsedQuery:
     limit: int = 10
     offset: int = 0
     options: Dict[str, str] = field(default_factory=dict)
+    explain: bool = False  # EXPLAIN PLAN FOR <sql>
 
 
 class _Parser:
@@ -513,9 +514,21 @@ class _FilterExpr(Expr):
         return str(self.filter)
 
 
+_EXPLAIN_RE = re.compile(r"^\s*EXPLAIN\s+PLAN\s+FOR\s+", re.I)
+
+
 def parse_sql(sql: str) -> ParsedQuery:
-    """Public entry (ref: CalciteSqlParser.compileToPinotQuery)."""
-    return _Parser(sql.strip().rstrip(";")).parse()
+    """Public entry (ref: CalciteSqlParser.compileToPinotQuery; EXPLAIN
+    PLAN FOR wraps any query, ref: the SqlCompilationException-free
+    explain path)."""
+    text = sql.strip().rstrip(";")
+    m = _EXPLAIN_RE.match(text)
+    explain = m is not None
+    if explain:
+        text = text[m.end():]
+    q = _Parser(text).parse()
+    q.explain = explain
+    return q
 
 
 def parse_expression(text: str) -> Expr:

@@ -229,3 +229,56 @@ class TestOptimizer:
     def test_fractional_limit_rejected(self):
         with pytest.raises(SqlParseError):
             parse_sql("SELECT a FROM t LIMIT 1.5")
+
+
+class TestExplainPlan:
+    """EXPLAIN PLAN FOR (ref: ExplainPlanDataTableReducer)."""
+
+    def test_parse_flag(self):
+        from pinot_tpu.query import compile_query
+
+        ctx = compile_query("explain plan for SELECT count(*) FROM t")
+        assert ctx.explain
+        assert not compile_query("SELECT count(*) FROM t").explain
+
+    def test_tree_shape(self):
+        from pinot_tpu.query import compile_query
+        from pinot_tpu.query.explain import explain_rows
+
+        rows = explain_rows(compile_query(
+            "EXPLAIN PLAN FOR SELECT region, sum(qty) FROM s "
+            "WHERE year > 2020 GROUP BY region"))
+        ops = [r[0] for r in rows]
+        assert ops[0].startswith("BROKER_REDUCE")
+        assert any(o.startswith("COMBINE_GROUP_BY") for o in ops)
+        assert any(o.startswith("GROUP_BY") for o in ops)
+        assert any(o.startswith("FILTER_RANGE") for o in ops)
+        # parent ids form a tree rooted at -1
+        ids = {r[1] for r in rows}
+        assert all(r[2] in ids or r[2] == -1 for r in rows)
+
+    def test_broker_explain_endpoint(self, tmp_path):
+        from pinot_tpu.segment import SegmentBuilder
+        from pinot_tpu.spi import DataType, FieldSpec, FieldType, Schema
+        from pinot_tpu.spi.table import TableConfig
+        from pinot_tpu.tools.cluster import EmbeddedCluster
+
+        schema = Schema("ex", [
+            FieldSpec("k", DataType.STRING),
+            FieldSpec("v", DataType.LONG, FieldType.METRIC)])
+        cluster = EmbeddedCluster(data_dir=str(tmp_path / "c"))
+        try:
+            cluster.create_table(TableConfig(table_name="ex"), schema)
+            SegmentBuilder(schema, "ex_0").build(
+                {"k": ["a", "b"] * 50, "v": list(range(100))},
+                str(tmp_path))
+            cluster.upload_segment_dir("ex_OFFLINE", str(tmp_path / "ex_0"))
+            cluster.wait_for_ev_converged("ex_OFFLINE")
+            resp = cluster.query(
+                "EXPLAIN PLAN FOR SELECT sum(v) FROM ex WHERE k = 'a'")
+            assert not resp.exceptions
+            cols = resp.result_table.schema.column_names
+            assert cols == ["Operator", "Operator_Id", "Parent_Id"]
+            assert resp.result_table.rows[0][0].startswith("BROKER_REDUCE")
+        finally:
+            cluster.shutdown()

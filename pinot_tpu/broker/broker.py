@@ -112,9 +112,16 @@ class BrokerRequestHandler:
             return finish(response)
         t = phase(BrokerQueryPhase.COMPILATION, start)
 
+        try:
+            physical = self._resolve_tables(ctx.table_name)
+        except QueryError as e:
+            response.add_exception(TABLE_DOES_NOT_EXIST_ERROR, str(e))
+            return finish(response)
+
         if ctx.explain:
-            # EXPLAIN PLAN FOR: logical operator tree, no execution
-            # (ref: ExplainPlanDataTableReducer)
+            # EXPLAIN PLAN FOR: logical operator tree, no execution — but
+            # AFTER table resolution, so explaining a nonexistent table
+            # errors like the real query would (ref: ExplainPlanDataTableReducer)
             from pinot_tpu.engine.results import DataSchema, ResultTable
             from pinot_tpu.query.explain import EXPLAIN_COLUMNS, explain_rows
 
@@ -122,12 +129,6 @@ class BrokerRequestHandler:
             response.result_table = ResultTable(DataSchema(names, types),
                                                 explain_rows(ctx))
             response.time_used_ms = (time.perf_counter() - start) * 1e3
-            return finish(response)
-
-        try:
-            physical = self._resolve_tables(ctx.table_name)
-        except QueryError as e:
-            response.add_exception(TABLE_DOES_NOT_EXIST_ERROR, str(e))
             return finish(response)
 
         # per-table QPS quota (ref: queryquota acquire before routing)

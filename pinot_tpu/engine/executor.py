@@ -64,6 +64,15 @@ class ServerQueryExecutor:
         ensure_x64()
         self.staging = StagingCache()
         self.kernels = KernelCache()
+        # (sql, segment) -> (segment identity, SegmentPlan): the per-segment
+        # analogue of the sharded executor's query cache — repeat queries
+        # skip predicate translation / LUT builds. Safe because params no
+        # longer embed mutable state (the upsert mask is a placeholder
+        # filled per run). LRU-bounded.
+        from collections import OrderedDict
+
+        self._plan_cache: "OrderedDict" = OrderedDict()
+        self._plan_cache_cap = 512
         self.pallas_kernels = PallasKernelCache()
         self.use_device = use_device
         # pallas kernels compile for real TPUs; on the CPU backend they run
@@ -229,7 +238,7 @@ class ServerQueryExecutor:
             return done(st, "startree")
         if self.use_device:
             try:
-                plan = plan_segment(ctx, seg)
+                plan = self._plan_for(ctx, seg)
                 return done(self._run_device_scalar(plan, seg, stats),
                             "device")
             except PlanError:
@@ -319,13 +328,37 @@ class ServerQueryExecutor:
             return done(st, "startree")
         if self.use_device:
             try:
-                plan = plan_segment(ctx, seg)
+                plan = self._plan_for(ctx, seg)
                 return done(self._run_device_grouped(plan, seg, stats),
                             "device")
             except PlanError:
                 pass
         return done(host_engine.host_group_by_segment(ctx, aggs, seg,
                                                       stats), "host")
+
+    def _plan_for(self, ctx: QueryContext, seg: ImmutableSegment):
+        """plan_segment with an LRU keyed on (sql, segment); a reloaded
+        segment (new object, same name) misses via the identity check."""
+        if ctx.sql is None:
+            return plan_segment(ctx, seg)
+        import weakref
+
+        # the key carries: the filter fingerprint (the hybrid split rewrites
+        # ctx.filter under the SAME sql as the time boundary advances) and
+        # bitmap presence (a valid-doc bitmap attached after caching must
+        # not serve the no-validdocs plan). The segment rides as a weakref:
+        # entries must not pin unloaded segments + their LUT params alive.
+        key = (ctx.sql, str(ctx.filter), seg.segment_name,
+               getattr(seg, "valid_doc_ids", None) is not None)
+        hit = self._plan_cache.get(key)
+        if hit is not None and hit[0]() is seg:
+            self._plan_cache.move_to_end(key)
+            return hit[1]
+        plan = plan_segment(ctx, seg)
+        self._plan_cache[key] = (weakref.ref(seg), plan)
+        if len(self._plan_cache) > self._plan_cache_cap:
+            self._plan_cache.popitem(last=False)
+        return plan
 
     def _run_device_grouped(self, plan: SegmentPlan, seg: ImmutableSegment,
                             stats: QueryStats) -> GroupByResult:

@@ -282,3 +282,14 @@ class TestExplainPlan:
             assert resp.result_table.rows[0][0].startswith("BROKER_REDUCE")
         finally:
             cluster.shutdown()
+
+
+def test_explain_unknown_table_errors(tmp_path):
+    from pinot_tpu.tools.cluster import EmbeddedCluster
+
+    cluster = EmbeddedCluster(data_dir=str(tmp_path / "c"))
+    try:
+        resp = cluster.query("EXPLAIN PLAN FOR SELECT count(*) FROM nope")
+        assert resp.exceptions  # same contract as the real query
+    finally:
+        cluster.shutdown()

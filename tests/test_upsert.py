@@ -196,3 +196,17 @@ class TestUpsertDevicePath:
                            "ts": 1000}])
         attach_valid_docs(seg2, pm.add_segment(seg2))
         assert dev.execute(q, [seg])[0].rows[0][0] == 99
+
+
+def test_plan_cache_respects_late_bitmap_attach(tmp_path):
+    """A valid-doc bitmap attached AFTER a query cached the plan must
+    invalidate it (the no-validdocs plan would count invalidated docs)."""
+    rows = [{"uid": f"u{i % 50}", "status": "a", "score": i, "ts": i}
+            for i in range(200)]
+    seg = build_seg(tmp_path, "pc_0", rows)
+    ex = ServerQueryExecutor(use_device=True)
+    q = compile_query("SELECT count(*) FROM users")
+    assert ex.execute(q, [seg])[0].rows[0][0] == 200  # plan cached, no bitmap
+    pm = PartitionUpsertMetadataManager(["uid"], "ts")
+    attach_valid_docs(seg, pm.add_segment(seg))
+    assert ex.execute(q, [seg])[0].rows[0][0] == 50  # fresh plan sees it

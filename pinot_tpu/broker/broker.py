@@ -69,6 +69,9 @@ class BrokerRequestHandler:
         self._pool = _DaemonPool(scatter_workers, "scatter")
         self.query_timeout_s = query_timeout_s
         self.metrics = MetricsRegistry(role="broker")
+        import threading as _threading
+
+        self._subq_local = _threading.local()
         self.quota = QueryQuotaManager(
             store,
             num_brokers_fn=lambda: max(
@@ -129,6 +132,12 @@ class BrokerRequestHandler:
             response.result_table = ResultTable(DataSchema(names, types),
                                                 explain_rows(ctx))
             response.time_used_ms = (time.perf_counter() - start) * 1e3
+            return finish(response)
+
+        try:
+            ctx = self._rewrite_subqueries(ctx)
+        except QueryError as e:
+            response.add_exception(QUERY_EXECUTION_ERROR, str(e))
             return finish(response)
 
         # per-table QPS quota (ref: queryquota acquire before routing)
@@ -195,6 +204,68 @@ class BrokerRequestHandler:
         return finish(response)
 
     # -- table resolution + hybrid split -------------------------------------
+    # -- IN_SUBQUERY (IdSet semijoin) ---------------------------------------
+    MAX_SUBQUERY_DEPTH = 3
+
+    def _rewrite_subqueries(self, ctx: QueryContext) -> QueryContext:
+        """``inSubquery(col, '<sql>')`` predicates: pre-execute the inner
+        query (typically ``SELECT idset(col) FROM ...``), then rewrite to
+        ``inIdSet(col, <serialized set>)`` so servers evaluate a plain
+        membership transform (ref: the broker-side IN_SUBQUERY rewrite +
+        server IdSet resolution, ServerQueryExecutorV1Impl.java:404-441)."""
+        from dataclasses import replace
+
+        from pinot_tpu.query.expressions import (
+            FilterNode,
+            Function,
+            Literal,
+        )
+
+        if ctx.filter is None:
+            return ctx
+
+        def walk(node: FilterNode) -> FilterNode:
+            if node.predicate is not None:
+                p = node.predicate
+                lhs = p.lhs
+                if (isinstance(lhs, Function)
+                        and lhs.name in ("insubquery", "in_subquery")):
+                    if len(lhs.args) != 2 \
+                            or not isinstance(lhs.args[1], Literal):
+                        raise QueryError(
+                            "inSubquery(column, 'sql literal') expected")
+                    inner_sql = str(lhs.args[1].value)
+                    tl = self._subq_local
+                    tl.depth = getattr(tl, "depth", 0) + 1
+                    try:
+                        if tl.depth > self.MAX_SUBQUERY_DEPTH:
+                            raise QueryError("IN_SUBQUERY nesting too deep")
+                        inner = self.handle_sql(inner_sql)
+                    finally:
+                        tl.depth -= 1
+                    if inner.has_exceptions or inner.result_table is None \
+                            or not inner.result_table.rows:
+                        raise QueryError(
+                            f"IN_SUBQUERY inner query failed: "
+                            f"{inner.exceptions[:1] or 'empty result'}")
+                    idset = inner.result_table.rows[0][0]
+                    if not isinstance(idset, str):
+                        raise QueryError(
+                            "IN_SUBQUERY inner query must produce IDSET()")
+                    new_lhs = Function("inidset",
+                                       (lhs.args[0], Literal(idset)))
+                    return FilterNode.pred(replace(p, lhs=new_lhs))
+                return node
+            kids = tuple(walk(c) for c in node.children)
+            if all(a is b for a, b in zip(kids, node.children)):
+                return node  # untouched subtree: no rebuild on the hot path
+            return FilterNode(node.op, children=kids, predicate=None)
+
+        new_filter = walk(ctx.filter)
+        if new_filter is ctx.filter:
+            return ctx
+        return replace(ctx, filter=new_filter)
+
     def _resolve_tables(self, raw_name: str) -> List[str]:
         """'myTable' -> its physical tables; explicit _OFFLINE/_REALTIME
         names pass through (ref: table resolution via TableCache)."""

@@ -69,10 +69,12 @@ class ServerQueryExecutor:
         # skip predicate translation / LUT builds. Safe because params no
         # longer embed mutable state (the upsert mask is a placeholder
         # filled per run). LRU-bounded.
+        import threading
         from collections import OrderedDict
 
         self._plan_cache: "OrderedDict" = OrderedDict()
         self._plan_cache_cap = 512
+        self._plan_cache_lock = threading.Lock()
         self.pallas_kernels = PallasKernelCache()
         self.use_device = use_device
         # pallas kernels compile for real TPUs; on the CPU backend they run
@@ -343,21 +345,32 @@ class ServerQueryExecutor:
             return plan_segment(ctx, seg)
         import weakref
 
-        # the key carries: the filter fingerprint (the hybrid split rewrites
-        # ctx.filter under the SAME sql as the time boundary advances) and
+        # the key carries: a filter FINGERPRINT (the hybrid split and the
+        # IN_SUBQUERY rewrite change ctx.filter under the SAME sql) and
         # bitmap presence (a valid-doc bitmap attached after caching must
-        # not serve the no-validdocs plan). The segment rides as a weakref:
+        # not serve the no-validdocs plan). The fingerprint is a digest
+        # memoized per ctx — str(filter) can embed large idset literals and
+        # must not be rebuilt per segment. The segment rides as a weakref:
         # entries must not pin unloaded segments + their LUT params alive.
-        key = (ctx.sql, str(ctx.filter), seg.segment_name,
+        fp = getattr(ctx, "_filter_fp", None)
+        if fp is None:
+            import hashlib
+
+            fp = hashlib.blake2b(str(ctx.filter).encode("utf-8"),
+                                 digest_size=16).hexdigest()
+            ctx._filter_fp = fp
+        key = (ctx.sql, fp, seg.segment_name,
                getattr(seg, "valid_doc_ids", None) is not None)
-        hit = self._plan_cache.get(key)
-        if hit is not None and hit[0]() is seg:
-            self._plan_cache.move_to_end(key)
-            return hit[1]
+        with self._plan_cache_lock:
+            hit = self._plan_cache.get(key)
+            if hit is not None and hit[0]() is seg:
+                self._plan_cache.move_to_end(key)
+                return hit[1]
         plan = plan_segment(ctx, seg)
-        self._plan_cache[key] = (weakref.ref(seg), plan)
-        if len(self._plan_cache) > self._plan_cache_cap:
-            self._plan_cache.popitem(last=False)
+        with self._plan_cache_lock:
+            self._plan_cache[key] = (weakref.ref(seg), plan)
+            if len(self._plan_cache) > self._plan_cache_cap:
+                self._plan_cache.popitem(last=False)
         return plan
 
     def _run_device_grouped(self, plan: SegmentPlan, seg: ImmutableSegment,

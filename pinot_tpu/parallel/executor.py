@@ -56,10 +56,12 @@ class ShardedQueryExecutor(ServerQueryExecutor):
         # queries skip planning AND the per-call H2D parameter uploads (each
         # a tunnel roundtrip on the serving path). LRU-bounded: dashboards
         # emitting unique literals must not pin device memory forever.
+        import threading
         from collections import OrderedDict
 
         self._query_cache: "OrderedDict[Tuple, Tuple]" = OrderedDict()
         self._query_cache_cap = 256
+        self._query_cache_lock = threading.Lock()
         # PallasSpec -> jitted sharded fused kernel (literal params stay
         # runtime args, so same-shape queries share the compile)
         self._pallas_sharded: Dict = {}
@@ -128,8 +130,9 @@ class ShardedQueryExecutor(ServerQueryExecutor):
         name = batch.metadata.segment_name
         for k in [k for k in self._device_cols if k[0] == name]:
             del self._device_cols[k]
-        for k in [k for k in self._query_cache if k[1] == name]:
-            del self._query_cache[k]
+        with self._query_cache_lock:
+            for k in [k for k in self._query_cache if k[1] == name]:
+                del self._query_cache[k]
 
     def _run_sharded(self, ctx: QueryContext,
                      segments: List[ImmutableSegment],
@@ -141,19 +144,21 @@ class ShardedQueryExecutor(ServerQueryExecutor):
 
         qkey = (ctx.sql if ctx.sql is not None else repr(ctx),
                 batch.metadata.segment_name, S)
-        cached = self._query_cache.get(qkey)
-        if cached is not None:
-            self._query_cache.move_to_end(qkey)
-        else:
+        with self._query_cache_lock:
+            cached = self._query_cache.get(qkey)
+            if cached is not None:
+                self._query_cache.move_to_end(qkey)
+        if cached is None:
             plan = plan_segment(ctx, batch)
             call_fn = self._build_pallas_call(plan, batch, S)
             is_pallas = call_fn is not None
             if call_fn is None:
                 call_fn = self._build_jnp_call(plan, batch, S)
             cached = (plan, call_fn, is_pallas)
-            self._query_cache[qkey] = cached
-            if len(self._query_cache) > self._query_cache_cap:
-                self._query_cache.popitem(last=False)
+            with self._query_cache_lock:
+                self._query_cache[qkey] = cached
+                if len(self._query_cache) > self._query_cache_cap:
+                    self._query_cache.popitem(last=False)
         plan, call_fn, is_pallas = cached
         num_docs = self._device_num_docs(batch, S)
 
@@ -177,9 +182,11 @@ class ShardedQueryExecutor(ServerQueryExecutor):
             # evict FIRST: _build_jnp_call may itself raise PlanError
             # (pallas pads tiles where the jnp path demands divisibility),
             # and the poisoned pallas entry must not survive that
-            self._query_cache.pop(qkey, None)
+            with self._query_cache_lock:
+                self._query_cache.pop(qkey, None)
             call_fn = self._build_jnp_call(plan, batch, S)
-            self._query_cache[qkey] = (plan, call_fn, False)
+            with self._query_cache_lock:
+                self._query_cache[qkey] = (plan, call_fn, False)
             is_pallas = False  # the trace must name the kernel that RAN
             packed = call_fn(num_docs)
         # ONE D2H fetch decodes the entire query result
@@ -340,4 +347,5 @@ class ShardedQueryExecutor(ServerQueryExecutor):
     def evict_batches(self) -> None:
         self._batches.clear()
         self._device_cols.clear()
-        self._query_cache.clear()
+        with self._query_cache_lock:
+            self._query_cache.clear()

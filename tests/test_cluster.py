@@ -230,3 +230,49 @@ class TestRealtimeCluster:
         assert deadline_rows == exp
         cluster.shutdown()
         MemoryStream.delete("hy_topic")
+
+
+def test_in_subquery_semijoin(tmp_path):
+    """inSubquery(col, 'SELECT idset(...)') = 1: the broker pre-executes
+    the inner query and rewrites to an inIdSet membership transform
+    (ref: the IN_SUBQUERY IdSet rewrite, ServerQueryExecutorV1Impl:404)."""
+    import numpy as np
+
+    from pinot_tpu.segment import SegmentBuilder
+    from pinot_tpu.spi import DataType, FieldSpec, FieldType, Schema
+    from pinot_tpu.spi.table import TableConfig
+    from pinot_tpu.tools.cluster import EmbeddedCluster
+
+    cluster = EmbeddedCluster(data_dir=str(tmp_path / "c"))
+    try:
+        users = Schema("users2", [
+            FieldSpec("uid", DataType.LONG),
+            FieldSpec("vip", DataType.STRING)])
+        events = Schema("events2", [
+            FieldSpec("uid", DataType.LONG),
+            FieldSpec("amount", DataType.LONG, FieldType.METRIC)])
+        cluster.create_table(TableConfig(table_name="users2"), users)
+        cluster.create_table(TableConfig(table_name="events2"), events)
+        rng = np.random.default_rng(7)
+        u = {"uid": list(range(100)),
+             "vip": ["y" if i % 10 == 0 else "n" for i in range(100)]}
+        e = {"uid": rng.integers(0, 100, 2000).tolist(),
+             "amount": rng.integers(1, 50, 2000).tolist()}
+        SegmentBuilder(users, "u0").build(u, str(tmp_path))
+        SegmentBuilder(events, "e0").build(e, str(tmp_path))
+        cluster.upload_segment_dir("users2_OFFLINE", str(tmp_path / "u0"))
+        cluster.upload_segment_dir("events2_OFFLINE", str(tmp_path / "e0"))
+        cluster.wait_for_ev_converged("users2_OFFLINE")
+        cluster.wait_for_ev_converged("events2_OFFLINE")
+
+        resp = cluster.query(
+            "SELECT sum(amount) FROM events2 WHERE "
+            "inSubquery(uid, 'SELECT idset(uid) FROM users2 "
+            "WHERE vip = ''y''') = 1")
+        assert not resp.exceptions, resp.exceptions
+        vips = {i for i in range(100) if i % 10 == 0}
+        expect = sum(a for uid, a in zip(e["uid"], e["amount"])
+                     if uid in vips)
+        assert resp.result_table.rows[0][0] == expect
+    finally:
+        cluster.shutdown()

@@ -134,19 +134,21 @@ class BrokerRequestHandler:
             response.time_used_ms = (time.perf_counter() - start) * 1e3
             return finish(response)
 
-        try:
-            ctx = self._rewrite_subqueries(ctx)
-        except QueryError as e:
-            response.add_exception(QUERY_EXECUTION_ERROR, str(e))
-            return finish(response)
-
-        # per-table QPS quota (ref: queryquota acquire before routing)
+        # per-table QPS quota FIRST: a throttled request must not get to
+        # trigger subquery execution work (ref: queryquota acquire before
+        # routing)
         for table in physical:
             if not self.quota.acquire(table):
                 response.add_exception(
                     TOO_MANY_REQUESTS_ERROR,
                     f"query quota exceeded for table {table}")
                 return finish(response)
+
+        try:
+            ctx = self._rewrite_subqueries(ctx)
+        except QueryError as e:
+            response.add_exception(QUERY_EXECUTION_ERROR, str(e))
+            return finish(response)
 
         tables: List[DataTable] = []
         servers_queried = set()
@@ -248,6 +250,11 @@ class BrokerRequestHandler:
                         raise QueryError(
                             f"IN_SUBQUERY inner query failed: "
                             f"{inner.exceptions[:1] or 'empty result'}")
+                    if (len(inner.result_table.rows) != 1
+                            or len(inner.result_table.rows[0]) != 1):
+                        raise QueryError(
+                            "IN_SUBQUERY inner query must return exactly "
+                            "one IDSET() value (no GROUP BY)")
                     idset = inner.result_table.rows[0][0]
                     if not isinstance(idset, str):
                         raise QueryError(

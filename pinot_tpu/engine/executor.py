@@ -36,6 +36,20 @@ from pinot_tpu.segment.immutable import ImmutableSegment
 from pinot_tpu.spi.config import CommonConstants
 
 
+def filter_fingerprint(ctx: QueryContext) -> str:
+    """Digest of the filter tree, memoized per ctx — cache keys must
+    distinguish same-SQL contexts whose filters were rewritten (hybrid
+    time boundary, IN_SUBQUERY idsets)."""
+    fp = getattr(ctx, "_filter_fp", None)
+    if fp is None:
+        import hashlib
+
+        fp = hashlib.blake2b(str(ctx.filter).encode("utf-8"),
+                             digest_size=16).hexdigest()
+        ctx._filter_fp = fp
+    return fp
+
+
 def _segment_tracer(ctx: QueryContext, stats: QueryStats, op: str, seg):
     """``done(result, path)`` pass-through that records a per-segment trace
     entry when the request carries trace=true (ref: TraceContext.java:46 —
@@ -352,14 +366,7 @@ class ServerQueryExecutor:
         # memoized per ctx — str(filter) can embed large idset literals and
         # must not be rebuilt per segment. The segment rides as a weakref:
         # entries must not pin unloaded segments + their LUT params alive.
-        fp = getattr(ctx, "_filter_fp", None)
-        if fp is None:
-            import hashlib
-
-            fp = hashlib.blake2b(str(ctx.filter).encode("utf-8"),
-                                 digest_size=16).hexdigest()
-            ctx._filter_fp = fp
-        key = (ctx.sql, fp, seg.segment_name,
+        key = (ctx.sql, filter_fingerprint(ctx), seg.segment_name,
                getattr(seg, "valid_doc_ids", None) is not None)
         with self._plan_cache_lock:
             hit = self._plan_cache.get(key)

@@ -23,6 +23,7 @@ from pinot_tpu.engine.executor import (
     ServerQueryExecutor,
     decode_grouped_result,
     decode_scalar_result,
+    filter_fingerprint,
 )
 from pinot_tpu.engine.plan import PlanError, SegmentPlan, plan_segment
 from pinot_tpu.engine.results import AggResult, GroupByResult, QueryStats
@@ -62,6 +63,7 @@ class ShardedQueryExecutor(ServerQueryExecutor):
         self._query_cache: "OrderedDict[Tuple, Tuple]" = OrderedDict()
         self._query_cache_cap = 256
         self._query_cache_lock = threading.Lock()
+        self._device_cols_lock = threading.Lock()
         # PallasSpec -> jitted sharded fused kernel (literal params stay
         # runtime args, so same-shape queries share the compile)
         self._pallas_sharded: Dict = {}
@@ -128,8 +130,9 @@ class ShardedQueryExecutor(ServerQueryExecutor):
 
     def _evict_batch(self, batch: SegmentBatch) -> None:
         name = batch.metadata.segment_name
-        for k in [k for k in self._device_cols if k[0] == name]:
-            del self._device_cols[k]
+        with self._device_cols_lock:
+            for k in [k for k in self._device_cols if k[0] == name]:
+                del self._device_cols[k]
         with self._query_cache_lock:
             for k in [k for k in self._query_cache if k[1] == name]:
                 del self._query_cache[k]
@@ -142,8 +145,11 @@ class ShardedQueryExecutor(ServerQueryExecutor):
         batch = self.batch_for(segments)
         S = pad_segments(batch.num_segments, self.mesh.shape[SEG_AXIS])
 
+        # the filter fingerprint distinguishes same-SQL contexts whose
+        # filter was rewritten (hybrid time boundary advancing, IN_SUBQUERY
+        # idset refresh) — without it a stale compiled plan would serve
         qkey = (ctx.sql if ctx.sql is not None else repr(ctx),
-                batch.metadata.segment_name, S)
+                filter_fingerprint(ctx), batch.metadata.segment_name, S)
         with self._query_cache_lock:
             cached = self._query_cache.get(qkey)
             if cached is not None:
@@ -300,7 +306,8 @@ class ShardedQueryExecutor(ServerQueryExecutor):
         from jax.sharding import NamedSharding, PartitionSpec as P
 
         key = (batch.metadata.segment_name, f"__pallas_{kind}:{name}", S)
-        staged = self._device_cols.get(key)
+        with self._device_cols_lock:
+            staged = self._device_cols.get(key)
         if staged is None:
             sharding = NamedSharding(
                 self.mesh, P(SEG_AXIS, DOC_AXIS, None, None))
@@ -318,7 +325,8 @@ class ShardedQueryExecutor(ServerQueryExecutor):
                 if host is None:
                     return None
                 staged = jax.device_put(host, sharding)
-            self._device_cols[key] = staged
+            with self._device_cols_lock:
+                self._device_cols[key] = staged
         return staged
 
     def _device_num_docs(self, batch: SegmentBatch, S: int):
@@ -328,24 +336,29 @@ class ShardedQueryExecutor(ServerQueryExecutor):
         from jax.sharding import NamedSharding, PartitionSpec as P
 
         key = (batch.metadata.segment_name, "__num_docs", S)
-        nd = self._device_cols.get(key)
+        with self._device_cols_lock:
+            nd = self._device_cols.get(key)
         if nd is None:
             nd = jax.device_put(batch.num_docs_array(pad_to=S),
                                 NamedSharding(self.mesh, P(SEG_AXIS)))
-            self._device_cols[key] = nd
+            with self._device_cols_lock:
+                self._device_cols[key] = nd
         return nd
 
     def _staged_column(self, batch: SegmentBatch, name: str, S: int) -> Dict:
         key = (batch.metadata.segment_name, name, S)
-        tree = self._device_cols.get(key)
+        with self._device_cols_lock:
+            tree = self._device_cols.get(key)
         if tree is None:
             tree = device_stage_column(
                 self.mesh, batch.stacked_column(name, pad_segments=S))
-            self._device_cols[key] = tree
+            with self._device_cols_lock:
+                self._device_cols[key] = tree
         return tree
 
     def evict_batches(self) -> None:
         self._batches.clear()
-        self._device_cols.clear()
+        with self._device_cols_lock:
+            self._device_cols.clear()
         with self._query_cache_lock:
             self._query_cache.clear()

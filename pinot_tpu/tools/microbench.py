@@ -1,0 +1,182 @@
+"""Storage/kernel microbenchmarks (the pinot-perf JMH-equivalent).
+
+Re-design of ``pinot-perf`` (41 JMH harnesses, e.g.
+``BenchmarkFixedBitSVForwardIndexReader``, ``BenchmarkScanDocIdIterators``,
+``BenchmarkCombineGroupBy``; run steps pinot-perf/README.md:28-39): a small
+timed-loop runner over the framework's own hot primitives. Usage:
+
+    python -m pinot_tpu.tools.microbench [name ...]
+
+Prints one line per benchmark: name, ops/s (or rows/s), per-op latency.
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+from typing import Callable, Dict, List, Tuple
+
+import numpy as np
+
+N_ROWS = 1 << 20
+
+
+def _timed(fn: Callable[[], None], min_time_s: float = 0.5,
+           warmup: int = 2) -> Tuple[float, int]:
+    """(seconds per call, iterations)."""
+    for _ in range(warmup):
+        fn()
+    iters = 0
+    t0 = time.perf_counter()
+    while True:
+        fn()
+        iters += 1
+        dt = time.perf_counter() - t0
+        if dt >= min_time_s:
+            return dt / iters, iters
+
+
+def bench_bitpack() -> Dict:
+    """Fixed-bit pack/unpack (ref: BenchmarkFixedBitSVForwardIndexReader)."""
+    from pinot_tpu import native
+
+    rng = np.random.default_rng(1)
+    ids = rng.integers(0, 1 << 7, N_ROWS).astype(np.int32)
+    packed = native.bitpack(ids, 7)
+    t_pack, _ = _timed(lambda: native.bitpack(ids, 7))
+    t_unpack, _ = _timed(lambda: native.bitunpack(packed, N_ROWS, 7))
+    return {"pack_Mrows_s": round(N_ROWS / t_pack / 1e6, 1),
+            "unpack_Mrows_s": round(N_ROWS / t_unpack / 1e6, 1),
+            "native": native.available()}
+
+
+def bench_varint_postings() -> Dict:
+    """Posting-list encode/decode (ref: RoaringBitmap benchmarks)."""
+    from pinot_tpu import native
+
+    rng = np.random.default_rng(2)
+    docs = np.unique(rng.integers(0, N_ROWS, N_ROWS // 4)).astype(np.int32)
+    blob = native.varint_encode(docs)
+    t_enc, _ = _timed(lambda: native.varint_encode(docs))
+    t_dec, _ = _timed(lambda: native.varint_decode(blob, len(docs)))
+    return {"encode_Mdocs_s": round(len(docs) / t_enc / 1e6, 1),
+            "decode_Mdocs_s": round(len(docs) / t_dec / 1e6, 1)}
+
+
+def bench_dictionary() -> Dict:
+    """Sorted-dictionary lookups (ref: BenchmarkDictionary)."""
+    from pinot_tpu.segment.dictionary import build_dictionary
+    from pinot_tpu.spi.data import DataType
+
+    vals = np.unique(np.random.default_rng(3).integers(0, 1 << 30, 100_000))
+    d = build_dictionary(vals, DataType.LONG)
+    probes = vals[::7]
+
+    def lookups():
+        for v in probes[:1000]:
+            d.index_of(int(v))
+
+    t, _ = _timed(lookups)
+    return {"index_of_Mops_s": round(1000 / t / 1e6, 3)}
+
+
+def bench_scan_kernel() -> Dict:
+    """Masked filtered-sum over 1M rows — the SVScanDocIdIterator analogue
+    (ref: BenchmarkScanDocIdIterators)."""
+    import jax
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(4)
+    fwd = jnp.asarray(rng.integers(0, 1000, N_ROWS).astype(np.int32))
+    vals = jnp.asarray(rng.random(N_ROWS).astype(np.float32))
+
+    @jax.jit
+    def scan(f, v, lo, hi):
+        m = (f >= lo) & (f <= hi)
+        return jnp.where(m, v, 0).sum(), m.sum()
+
+    lo, hi = jnp.int32(100), jnp.int32(300)
+    jax.block_until_ready(scan(fwd, vals, lo, hi))
+    t, _ = _timed(lambda: jax.block_until_ready(scan(fwd, vals, lo, hi)))
+    return {"Mrows_s": round(N_ROWS / t / 1e6, 1),
+            "backend": jax.default_backend()}
+
+
+def bench_group_by_kernel() -> Dict:
+    """Composed-key segment_sum (ref: BenchmarkCombineGroupBy)."""
+    import jax
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(5)
+    keys = jnp.asarray(rng.integers(0, 1024, N_ROWS).astype(np.int32))
+    vals = jnp.asarray(rng.random(N_ROWS).astype(np.float32))
+
+    # arrays as ARGUMENTS: closed-over constants get constant-folded and
+    # the measurement degenerates to returning a cached array
+    @jax.jit
+    def grouped(v, k):
+        return jax.ops.segment_sum(v, k, num_segments=1024)
+
+    jax.block_until_ready(grouped(vals, keys))
+    t, _ = _timed(lambda: jax.block_until_ready(grouped(vals, keys)))
+    return {"Mrows_s": round(N_ROWS / t / 1e6, 1)}
+
+
+def bench_datatable_wire() -> Dict:
+    """Binary columnar DataTable round-trip (ref: BenchmarkDataTableSerDe)."""
+    from pinot_tpu.common.datatable import DataTable
+    from pinot_tpu.engine.results import DataSchema, QueryStats
+
+    rng = np.random.default_rng(6)
+    n = 50_000
+    schema = DataSchema(["s", "i", "f"], ["STRING", "LONG", "DOUBLE"])
+    rows = [[f"key{i % 1000}", int(v), float(v) / 3]
+            for i, v in enumerate(rng.integers(0, 1 << 40, n))]
+    dt = DataTable.for_selection(schema, rows, QueryStats())
+    raw = dt.to_bytes()
+    t_ser, _ = _timed(lambda: dt.to_bytes())
+    t_de, _ = _timed(lambda: DataTable.from_bytes(raw))
+    return {"serialize_Mrows_s": round(n / t_ser / 1e6, 2),
+            "deserialize_Mrows_s": round(n / t_de / 1e6, 2),
+            "bytes_per_row": round(len(raw) / n, 1)}
+
+
+def bench_sql_parse() -> Dict:
+    """Parser throughput (ref: BenchmarkQueryParser equivalents)."""
+    from pinot_tpu.query import compile_query
+
+    sql = ("SELECT a, b, sum(x), avg(y) FROM t WHERE a IN ('p','q') AND "
+           "ts BETWEEN 100 AND 900 AND b != 'z' GROUP BY a, b "
+           "ORDER BY sum(x) DESC LIMIT 50")
+    t, _ = _timed(lambda: compile_query(sql))
+    return {"queries_per_s": round(1 / t, 0)}
+
+
+BENCHMARKS: Dict[str, Callable[[], Dict]] = {
+    "bitpack": bench_bitpack,
+    "varint_postings": bench_varint_postings,
+    "dictionary": bench_dictionary,
+    "scan_kernel": bench_scan_kernel,
+    "group_by_kernel": bench_group_by_kernel,
+    "datatable_wire": bench_datatable_wire,
+    "sql_parse": bench_sql_parse,
+}
+
+
+def main(names: List[str]) -> int:
+    import json
+
+    chosen = names or sorted(BENCHMARKS)
+    for name in chosen:
+        fn = BENCHMARKS.get(name)
+        if fn is None:
+            print(f"unknown benchmark {name!r}; have {sorted(BENCHMARKS)}",
+                  file=sys.stderr)
+            return 2
+        out = fn()
+        print(json.dumps({"bench": name, **out}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

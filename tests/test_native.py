@@ -122,3 +122,20 @@ class TestPackedSegmentFormat:
             f"SELECT count(*), sum(v) FROM t WHERE k = '{k0}'"), [seg])
         assert t.rows[0][0] == len(expected)
         assert t.rows[0][1] == float(sum(rows["v"][i] for i in expected))
+
+
+def test_microbench_smoke():
+    """Every microbenchmark runs and reports a positive rate (shrunk: the
+    suite only validates the harness, not the numbers)."""
+    import pinot_tpu.tools.microbench as mb
+
+    old = mb.N_ROWS
+    mb.N_ROWS = 1 << 14
+    try:
+        for name, fn in mb.BENCHMARKS.items():
+            out = fn()
+            rates = [v for k, v in out.items()
+                     if isinstance(v, (int, float)) and k != "bytes_per_row"]
+            assert rates and all(r > 0 for r in rates), (name, out)
+    finally:
+        mb.N_ROWS = old

@@ -135,6 +135,11 @@ class RemoteClusterStateStore(ClusterStateStore):
                 log.warning("state replica poll failed; retrying",
                             exc_info=True)
 
+    def reconnect(self, base_url: str) -> None:
+        """Point the replica at a restarted/relocated authority (the ZK
+        reconnect analogue); the poller resyncs on its next tick."""
+        self._base = base_url.rstrip("/")
+
     def close(self) -> None:
         self._stop.set()
 

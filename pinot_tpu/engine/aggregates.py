@@ -37,6 +37,7 @@ class AggDef:
     base: str               # family: count/sum/min/.../percentile
     mv: bool                # MV variant (arg is a multi-value column)
     percentile: Optional[float] = None  # percentile family only
+    precision: Optional[int] = None     # sumprecision's optional argument
     device_scalar: bool = True    # device kernel for filtered scalar agg
     device_grouped: bool = True   # device kernel for group-by agg
     result_type: str = "DOUBLE"   # DataSchema column type of the final value
@@ -76,11 +77,26 @@ _EMPTY: Dict[str, Any] = {
     "percentile": tuple,
     "percentiletdigest": lambda: TDigest().serialize(),
     "distinctcountthetasketch": lambda: ThetaSketch().serialize(),
+    "sumprecision": "0",  # exact decimal sum as a string-encoded Decimal
     "idset": frozenset(),
     # (time, value) of the chosen row, or None when no row matched yet
     "lastwithtime": None,
     "firstwithtime": None,
 }
+
+# one shared exact-decimal context (ref: BigDecimal addition is exact; 200
+# significant digits covers any realistic column sum — i64 values over
+# billions of rows need < 30)
+import decimal as _decimal
+
+_DEC_CTX = _decimal.Context(prec=200)
+
+
+def _decimal_add(a: str, b: str) -> str:
+    """Exact decimal addition: state is a string-encoded Decimal, immune
+    to f64 rounding across any merge order."""
+    return str(_DEC_CTX.add(_decimal.Decimal(a), _decimal.Decimal(b)))
+
 
 _MERGE: Dict[str, Callable[[Any, Any], Any]] = {
     "count": lambda a, b: a + b,
@@ -98,6 +114,7 @@ _MERGE: Dict[str, Callable[[Any, Any], Any]] = {
         TDigest.deserialize(b)).serialize(),
     "distinctcountthetasketch": lambda a, b: ThetaSketch.deserialize(a).merge(
         ThetaSketch.deserialize(b)).serialize(),
+    "sumprecision": _decimal_add,
     "idset": lambda a, b: frozenset(a) | frozenset(b),
     # deterministic across merge orders: lexicographic (time, value) extreme
     # (the reference keeps the row with the largest/smallest time; ties are
@@ -128,6 +145,19 @@ def _final_percentile(d: AggDef, s) -> float:
     # ref: PercentileAggregationFunction.extractFinalResult
     idx = int(vals.size * d.percentile / 100.0)
     return float(vals[min(idx, vals.size - 1)])
+
+
+def _final_sumprecision(d: AggDef, s: str):
+    """Integral sums finalize as exact python ints (JSON-safe, compare
+    numerically in ORDER BY / HAVING); fractional sums as the exact
+    decimal STRING (the reference's BigDecimal also renders textually).
+    The optional precision argument quantizes at finalize only."""
+    v = _decimal.Decimal(s)
+    if d.precision is not None:
+        v = +_decimal.Context(prec=d.precision).plus(v)
+    if v == v.to_integral_value():
+        return int(v)
+    return str(v)
 
 
 def _final_idset(d: AggDef, s) -> str:
@@ -172,6 +202,7 @@ _FINAL: Dict[str, Callable[[AggDef, Any], Any]] = {
     "distinctcountthetasketch": lambda d, s: (
         s.hex() if d.name.startswith("distinctcountrawthetasketch")
         else int(round(ThetaSketch.deserialize(s).estimate()))),
+    "sumprecision": lambda d, s: _final_sumprecision(d, s),
     "idset": _final_idset,
     "lastwithtime": lambda d, s: _final_withtime(d, s),
     "firstwithtime": lambda d, s: _final_withtime(d, s),
@@ -282,6 +313,13 @@ def _raw_filtered(d: AggDef, values, mask) -> list:
     return vals.tolist()
 
 
+def _host_sumprecision(d: AggDef, values, mask):
+    total = _decimal.Decimal(0)
+    for v in _raw_filtered(d, values, mask):
+        total = _DEC_CTX.add(total, _decimal.Decimal(str(v)))
+    return str(total)
+
+
 def _host_theta(d: AggDef, values, mask):
     return ThetaSketch.of(_raw_filtered(d, values, mask)).serialize()
 
@@ -324,6 +362,7 @@ _HOST: Dict[str, Callable] = {
     "percentile": _host_percentile,
     "percentiletdigest": _host_tdigest,
     "distinctcountthetasketch": _host_theta,
+    "sumprecision": _host_sumprecision,
     "idset": _host_idset,
     "lastwithtime": _host_withtime,
     "firstwithtime": _host_withtime,
@@ -347,6 +386,7 @@ _RESULT_TYPE = {
     "percentile": "DOUBLE",
     "percentiletdigest": "DOUBLE",
     "distinctcountthetasketch": "LONG",
+    "sumprecision": "STRING",
     "idset": "STRING",
     "lastwithtime": "DOUBLE",  # overridden by the dataType argument
     "firstwithtime": "DOUBLE",
@@ -396,6 +436,7 @@ def resolve_agg(fn: Function) -> AggDef:
         "percentile": "percentile", "percentileest": "percentile",
         "percentiletdigest": "percentiletdigest",
         "distinctcountthetasketch": "distinctcountthetasketch",
+        "sumprecision": "sumprecision",
         "distinctcountrawthetasketch": "distinctcountthetasketch",
         "idset": "idset",
         "lastwithtime": "lastwithtime",
@@ -407,6 +448,12 @@ def resolve_agg(fn: Function) -> AggDef:
     result_type = _RESULT_TYPE[family]
     if base_name in ("distinctcountrawhll", "distinctcountrawthetasketch"):
         result_type = "STRING"
+    precision = None
+    if family == "sumprecision" and len(fn.args) >= 2:
+        if not (isinstance(fn.args[1], Literal)
+                and isinstance(fn.args[1].value, int)):
+            raise QueryError("sumprecision precision must be an int literal")
+        precision = int(fn.args[1].value)
     if family in ("lastwithtime", "firstwithtime"):
         # 3rd argument is the value's data type label
         # (ref: LastWithTimeAggregationFunction 3-arg form)
@@ -426,6 +473,7 @@ def resolve_agg(fn: Function) -> AggDef:
         base=family,
         mv=mv,
         percentile=percentile,
+        precision=precision,
         device_scalar=(family in _DEVICE_SCALAR) and not mv or (mv and family in
                       {"count", "sum", "min", "max", "avg"}),
         device_grouped=(family in _DEVICE_GROUPED) and not mv,

@@ -77,6 +77,7 @@ class RemoteClusterStateStore(ClusterStateStore):
         self._timeout = timeout_s
         self._poll_interval = poll_interval_s
         self._remote_version = -1
+        self._epoch = 0
         self._stop = threading.Event()
         self._sync_once()  # fail fast if the authority is unreachable
         self._poller = threading.Thread(target=self._poll_loop, daemon=True,
@@ -94,8 +95,11 @@ class RemoteClusterStateStore(ClusterStateStore):
 
     # -- replica sync --------------------------------------------------------
     def _sync_once(self) -> None:
+        epoch = self._epoch
         out = self._call("/state/poll",
                          {"sinceVersion": self._remote_version})
+        if epoch != self._epoch:
+            return  # reconnect() raced this poll: discard the stale reply
         if "snapshot" in out:
             with self._lock:
                 removed = [k for k in self._data if k not in out["snapshot"]]
@@ -137,8 +141,14 @@ class RemoteClusterStateStore(ClusterStateStore):
 
     def reconnect(self, base_url: str) -> None:
         """Point the replica at a restarted/relocated authority (the ZK
-        reconnect analogue); the poller resyncs on its next tick."""
+        reconnect analogue) and force a FULL resync: the new authority's
+        version counter may be behind ours (restart from an older
+        snapshot), and mutations_since would otherwise report 'up to
+        date' forever. The epoch guard stops an in-flight poll against
+        the old authority from clobbering the reset."""
+        self._epoch += 1
         self._base = base_url.rstrip("/")
+        self._remote_version = -1
 
     def close(self) -> None:
         self._stop.set()

@@ -173,3 +173,25 @@ def test_lastwithtime_float_times(events, tmp_path):
     t, _ = ex.execute(compile_query(
         "SELECT lastwithtime(v, t, 'DOUBLE') FROM ft"), [seg])
     assert t.rows[0][0] == 1.0
+
+
+def test_sumprecision_exact(events, tmp_path):
+    """SUMPRECISION: exact decimal sum where f64 would round
+    (ref: SumPrecisionAggregationFunction over BigDecimal)."""
+    import pandas as pd
+    vals = [9007199254740993, 1, 9007199254740993]  # > 2^53: f64 rounds
+    df = pd.DataFrame({"g": ["a"] * 3, "v": vals})
+    schema = Schema("sp", [
+        FieldSpec("g", DataType.STRING),
+        FieldSpec("v", DataType.LONG, FieldType.METRIC)])
+    SegmentBuilder(schema, "sp0").build(
+        {c: df[c].tolist() for c in df.columns}, str(tmp_path))
+    SegmentBuilder(schema, "sp1").build(
+        {c: df[c].tolist() for c in df.columns}, str(tmp_path))
+    segs = [load_segment(str(tmp_path / "sp0")),
+            load_segment(str(tmp_path / "sp1"))]
+    ex = ServerQueryExecutor()
+    t, _ = ex.execute(compile_query("SELECT sumprecision(v) FROM sp"), segs)
+    # integral sums finalize as exact ints (float would have rounded)
+    assert t.rows[0][0] == sum(vals) * 2
+    assert float(sum(vals) * 2) != sum(vals) * 2 or True  # > 2^53 regime

@@ -84,18 +84,26 @@ _EMPTY: Dict[str, Any] = {
     "firstwithtime": None,
 }
 
-# one shared exact-decimal context (ref: BigDecimal addition is exact; 200
-# significant digits covers any realistic column sum — i64 values over
-# billions of rows need < 30)
 import decimal as _decimal
 
-_DEC_CTX = _decimal.Context(prec=200)
+
+def _exact_dec_add(a: "_decimal.Decimal",
+                   b: "_decimal.Decimal") -> "_decimal.Decimal":
+    """EXACT decimal addition (ref: BigDecimal.add is exact): the context
+    is sized to the operands' full digit span, so no rounding can occur
+    at any magnitude and merges are order-independent."""
+    if not a:
+        return b
+    if not b:
+        return a
+    hi = max(a.adjusted(), b.adjusted())
+    lo = min(a.as_tuple().exponent, b.as_tuple().exponent)
+    return _decimal.Context(prec=max(hi - lo + 2, 1)).add(a, b)
 
 
 def _decimal_add(a: str, b: str) -> str:
-    """Exact decimal addition: state is a string-encoded Decimal, immune
-    to f64 rounding across any merge order."""
-    return str(_DEC_CTX.add(_decimal.Decimal(a), _decimal.Decimal(b)))
+    """String-encoded exact decimal merge (wire-safe state)."""
+    return str(_exact_dec_add(_decimal.Decimal(a), _decimal.Decimal(b)))
 
 
 _MERGE: Dict[str, Callable[[Any, Any], Any]] = {
@@ -148,16 +156,18 @@ def _final_percentile(d: AggDef, s) -> float:
 
 
 def _final_sumprecision(d: AggDef, s: str):
-    """Integral sums finalize as exact python ints (JSON-safe, compare
-    numerically in ORDER BY / HAVING); fractional sums as the exact
-    decimal STRING (the reference's BigDecimal also renders textually).
-    The optional precision argument quantizes at finalize only."""
+    """Integral sums finalize as exact python ints; fractional sums as
+    floats — both JSON-safe AND mutually comparable, so ORDER BY / HAVING
+    over mixed groups work numerically. (Deviation from the reference's
+    BigDecimal string rendering: fractional finals may round to f64 at
+    DISPLAY; merge states stay exact throughout.) The optional precision
+    argument quantizes at finalize only."""
     v = _decimal.Decimal(s)
     if d.precision is not None:
-        v = +_decimal.Context(prec=d.precision).plus(v)
+        v = _decimal.Context(prec=d.precision).plus(v)
     if v == v.to_integral_value():
         return int(v)
-    return str(v)
+    return float(v)
 
 
 def _final_idset(d: AggDef, s) -> str:
@@ -316,7 +326,7 @@ def _raw_filtered(d: AggDef, values, mask) -> list:
 def _host_sumprecision(d: AggDef, values, mask):
     total = _decimal.Decimal(0)
     for v in _raw_filtered(d, values, mask):
-        total = _DEC_CTX.add(total, _decimal.Decimal(str(v)))
+        total = _exact_dec_add(total, _decimal.Decimal(str(v)))
     return str(total)
 
 
@@ -451,8 +461,10 @@ def resolve_agg(fn: Function) -> AggDef:
     precision = None
     if family == "sumprecision" and len(fn.args) >= 2:
         if not (isinstance(fn.args[1], Literal)
-                and isinstance(fn.args[1].value, int)):
-            raise QueryError("sumprecision precision must be an int literal")
+                and type(fn.args[1].value) is int
+                and fn.args[1].value >= 1):
+            raise QueryError(
+                "sumprecision precision must be an int literal >= 1")
         precision = int(fn.args[1].value)
     if family in ("lastwithtime", "firstwithtime"):
         # 3rd argument is the value's data type label

@@ -98,10 +98,10 @@ class RemoteClusterStateStore(ClusterStateStore):
         epoch = self._epoch
         out = self._call("/state/poll",
                          {"sinceVersion": self._remote_version})
-        if epoch != self._epoch:
-            return  # reconnect() raced this poll: discard the stale reply
         if "snapshot" in out:
             with self._lock:
+                if epoch != self._epoch:
+                    return  # reconnect raced: discard the stale reply
                 removed = [k for k in self._data if k not in out["snapshot"]]
                 self._data = out["snapshot"]
                 self._version = max(self._version, int(out["version"]))
@@ -118,6 +118,8 @@ class RemoteClusterStateStore(ClusterStateStore):
             muts = out.get("mutations", [])
             if muts:
                 with self._lock:
+                    if epoch != self._epoch:
+                        return
                     for m in muts:
                         if m["value"] is None:
                             self._data.pop(m["path"], None)
@@ -128,8 +130,13 @@ class RemoteClusterStateStore(ClusterStateStore):
                 self._drain_notifications()
             else:
                 with self._lock:
+                    if epoch != self._epoch:
+                        return
                     self._version = max(self._version, int(out["version"]))
-        self._remote_version = out["version"]
+        with self._lock:
+            if epoch != self._epoch:
+                return  # reconnect raced: keep the forced -1 resync marker
+            self._remote_version = out["version"]
 
     def _poll_loop(self) -> None:
         while not self._stop.wait(self._poll_interval):
@@ -146,9 +153,10 @@ class RemoteClusterStateStore(ClusterStateStore):
         snapshot), and mutations_since would otherwise report 'up to
         date' forever. The epoch guard stops an in-flight poll against
         the old authority from clobbering the reset."""
-        self._epoch += 1
-        self._base = base_url.rstrip("/")
-        self._remote_version = -1
+        with self._lock:
+            self._epoch += 1
+            self._base = base_url.rstrip("/")
+            self._remote_version = -1
 
     def close(self) -> None:
         self._stop.set()

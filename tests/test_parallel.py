@@ -264,3 +264,36 @@ class TestCompactGroupBy:
         hrt, _ = host.execute(ctx, wide_segs)
         assert drt.rows == hrt.rows
         assert len(drt.rows) > 8192
+
+
+def test_sharded_executor_concurrent_queries(tmp_path):
+    """16 threads through ONE ShardedQueryExecutor: the query/device-col
+    caches are shared mutable state on the serving path (locks added in
+    round 4) — results must stay correct under the race."""
+    import concurrent.futures
+
+    rng = np.random.default_rng(3)
+    schema = Schema("cc", [
+        FieldSpec("k", DataType.STRING),
+        FieldSpec("v", DataType.LONG, FieldType.METRIC)])
+    segs = []
+    expect = {}
+    frame = {"k": [f"k{i % 4}" for i in range(6000)],
+             "v": rng.integers(0, 100, 6000).tolist()}
+    for i in range(4):
+        SegmentBuilder(schema, f"cc_{i}").build(frame, str(tmp_path))
+        segs.append(load_segment(str(tmp_path / f"cc_{i}")))
+    for key in ("k0", "k1", "k2", "k3"):
+        expect[key] = 4 * sum(v for k, v in zip(frame["k"], frame["v"])
+                              if k == key)
+    ex = ShardedQueryExecutor()
+    queries = [f"SELECT sum(v) FROM cc WHERE k = '{k}'" for k in expect] * 8
+
+    def run(sql):
+        t, _ = ex.execute(compile_query(sql), segs)
+        return sql, t.rows[0][0]
+
+    with concurrent.futures.ThreadPoolExecutor(16) as pool:
+        for sql, got in pool.map(run, queries):
+            key = sql.split("'")[1]
+            assert got == expect[key], (sql, got)

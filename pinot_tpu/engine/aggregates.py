@@ -85,6 +85,7 @@ _EMPTY: Dict[str, Any] = {
 }
 
 import decimal as _decimal
+import math as _math
 
 
 def _exact_dec_add(a: "_decimal.Decimal",
@@ -92,6 +93,8 @@ def _exact_dec_add(a: "_decimal.Decimal",
     """EXACT decimal addition (ref: BigDecimal.add is exact): the context
     is sized to the operands' full digit span, so no rounding can occur
     at any magnitude and merges are order-independent."""
+    if not a.is_finite() or not b.is_finite():
+        return a + b  # NaN/Infinity propagate per IEEE decimal semantics
     if not a:
         return b
     if not b:
@@ -165,9 +168,13 @@ def _final_sumprecision(d: AggDef, s: str):
     v = _decimal.Decimal(s)
     if d.precision is not None:
         v = _decimal.Context(prec=d.precision).plus(v)
-    if v == v.to_integral_value():
+    if v.is_finite() and v == v.to_integral_value():
         return int(v)
-    return float(v)
+    f = float(v)
+    if _math.isinf(f) and v.is_finite():
+        # beyond f64 range: the exact decimal string beats silent inf
+        return str(v)
+    return f
 
 
 def _final_idset(d: AggDef, s) -> str:

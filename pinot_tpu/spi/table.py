@@ -308,12 +308,33 @@ class StreamIngestionConfig:
         decoder = m.get(prefix + "decoder.class.name", m.get("decoder", "json"))
         rows = int(m.get("realtime.segment.flush.threshold.rows",
                          m.get("realtime.segment.flush.threshold.size", 100_000)))
-        millis = int(m.get("realtime.segment.flush.threshold.time", 6 * 3600 * 1000))
+        millis = _duration_ms(
+            m.get("realtime.segment.flush.threshold.time", 6 * 3600 * 1000))
         props = {k: v for k, v in m.items()
                  if k not in ("streamType",)}
         return cls(stream_type=stream_type, topic=topic, decoder=decoder,
                    segment_flush_threshold_rows=rows,
                    segment_flush_threshold_millis=millis, properties=props)
+
+
+def _duration_ms(v: Any) -> int:
+    """Millis from an int, numeric string, or period string ('12h', '6d',
+    '30m', '45s', '500ms' — ref: TimeUtils.convertPeriodToMillis used for
+    realtime.segment.flush.threshold.time)."""
+    s = str(v).strip().lower()
+    try:
+        return int(s)
+    except ValueError:
+        pass
+    import re as _re
+
+    # compound periods compose ('1d12h', ref: Joda PeriodFormatter chain)
+    if not _re.fullmatch(r"(?:\d+\s*(?:ms|s|m|h|d)\s*)+", s):
+        raise ValueError(f"bad duration {v!r} (want millis or e.g. '6h')")
+    unit_ms = {"ms": 1, "s": 1000, "m": 60_000, "h": 3_600_000,
+               "d": 86_400_000}
+    return sum(int(n) * unit_ms[u]
+               for n, u in _re.findall(r"(\d+)\s*(ms|s|m|h|d)", s))
 
 
 @dataclass

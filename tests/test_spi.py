@@ -208,3 +208,27 @@ class TestPartitionFunctions:
     def test_unknown_function(self):
         with pytest.raises(ValueError):
             get_partition_function("nope", 2)
+
+
+def test_every_reference_example_config_loads():
+    """EVERY schema + table config bundled with the reference's quickstarts
+    must parse (the drop-in-compatibility contract; includes realtime
+    configs with '12h'-style flush durations and dateTimeFieldSpecs)."""
+    import glob
+    import os
+
+    base = "/root/reference/pinot-tools/src/main/resources/examples"
+    if not os.path.isdir(base):
+        import pytest
+        pytest.skip("reference checkout not present")
+    schemas = glob.glob(f"{base}/*/*/*_schema.json")
+    tables = glob.glob(f"{base}/*/*/*table_config.json")
+    assert len(schemas) >= 10 and len(tables) >= 10
+    for f in schemas:
+        s = Schema.from_file(f)
+        assert s.schema_name
+    for f in tables:
+        t = TableConfig.from_file(f)
+        assert t.table_name_with_type
+        if t.stream_config is not None:
+            assert t.stream_config.segment_flush_threshold_millis > 0

@@ -3,8 +3,9 @@
 TPU-native re-design of the reference's hottest loop — the per-segment
 ``Filter -> Projection -> GroupBy/Aggregate`` chain
 (``SVScanDocIdIterator.java:36`` predicate scan, ``PinotDataBitSet.java:25``
-bit extraction, ``DefaultGroupByExecutor`` scatter into group slots) — as ONE
-Pallas kernel over a ``(segments, tiles)`` grid:
+bit extraction, ``AggregationGroupByOrderByOperator.java:61-128`` execution,
+``DefaultGroupByExecutor`` scatter into group slots) — as ONE Pallas kernel
+over a ``(segments, tiles)`` grid:
 
 - forward indexes arrive as **planar bit-packed words** (engine/staging.py
   PackedColumn): a tile's value ``j`` lives in word ``j % W`` at bit slot
@@ -13,17 +14,27 @@ Pallas kernel over a ``(segments, tiles)`` grid:
 - the filter tree is compiled to an AND/OR/NOT expression over dictId
   interval tests (sorted dictionaries turn EQ/NEQ/RANGE into intervals, the
   vectorized form of dictionary-based predicate evaluators);
+- aggregation values may be **elementwise expressions** of staged columns
+  (``sum(lo_extendedprice * lo_discount)``): integer expressions evaluate
+  exactly in i32 (plan-time bound check), float expressions in f32;
 - sums/counts/avg are a **one-hot matmul on the MXU**: rows
-  ``[masked values..., mask] @ one_hot(keys)`` accumulate ``[aggs, groups]``
+  ``[value rows..., mask] @ one_hot(keys)`` accumulate ``[aggs, groups]``
   partials — the fixed-shape scatter-add replacement for
-  ``GroupByResultHolder``. Integer sums keep an exact i32 accumulator
-  (per-tile matmul results are exactly representable in f32 by a plan-time
-  bound, then rounded into i32); float sums accumulate f32;
+  ``GroupByResultHolder``. Exactness scheme:
+  - **integer sums** split each value into 12-bit limbs (``L`` limbs for a
+    plan-time ``max_abs`` bound): every per-tile limb partial is at most
+    ``4095 * PALLAS_TILE < 2^24`` — exactly representable in the f32 matmul.
+    Limb partials land in per-limb **i32 accumulators with a carry chain**
+    (base-2^12 positional rows, normalized every grid step), so provider-
+    wide sums are exact up to ~2^62 with no i64 math inside the kernel;
+  - **float sums** accumulate with Neumaier-compensated f32 pairs
+    (sum row + compensation row), recovering near-f64 accuracy over
+    hundreds of millions of rows;
 - min/max/minmaxrange reduce on the VPU per 128-group chunk;
 - scalar (non-group-by) aggregations are the same kernel with a single
   group (all keys 0);
-- per-segment matched-doc counts accumulate into a segment-indexed output
-  (QueryStats parity with the jnp path).
+- per-segment matched-doc counts accumulate into a segment-indexed i32
+  output (QueryStats parity with the jnp path).
 
 The same kernel body serves the per-segment executor (grid [1, T]) and the
 sharded combine (grid [S_local, T_local] per device under shard_map, partials
@@ -46,14 +57,22 @@ from pinot_tpu.engine.staging import PALLAS_TILE, StagedSegment
 
 # one-hot chunk width along the group dimension (lane count)
 _G_CHUNK = 128
-# max padded group count the pallas path handles (VMEM + unroll bound)
-MAX_PALLAS_GROUPS = 4096
-# per-tile int matmul partials must be exact in f32: max |value| * TILE < 2^24
+# max padded group count the pallas path handles (VMEM + unroll bound);
+# 8192 covers every SSB flight except the Q3.2+/Q4.3 city/brand key spaces
+# (those ride the jnp sparse-group ladder, engine/kernels.py)
+MAX_PALLAS_GROUPS = 8192
+# int values are split into limbs of this many bits so every per-tile limb
+# matmul partial is f32-exact: (2^12 - 1) * PALLAS_TILE < 2^24
+_LIMB_BITS = 12
+_LIMB_MASK = (1 << _LIMB_BITS) - 1
+# f32 can represent integers exactly below 2^24 (min/max value bound)
 _F32_EXACT = 1 << 24
 _I32_MAX = (1 << 31) - 1
 
 _POS = np.float32(np.inf)
 _NEG = np.float32(-np.inf)
+
+assert _LIMB_MASK * PALLAS_TILE < _F32_EXACT, "limb partials must be f32-exact"
 
 
 @dataclass(frozen=True)
@@ -70,9 +89,11 @@ class PallasSpec:
     group_idx: Tuple[int, ...]            # packed input idx per group col
     group_strides: Tuple[int, ...]
     num_groups_padded: int                # multiple of 128
-    # per agg: (base, value input idx | None); base in
-    # count/sum/avg/min/max/minmaxrange
-    aggs: Tuple[Tuple[str, Optional[int]], ...]
+    # per agg: (base, vexpr, limbs); base in count/sum/avg/min/max/minmaxrange;
+    # vexpr is a nested value expression: ("v", input_idx) |
+    # ("times"|"plus"|"minus", lhs, rhs); limbs = L for exact int sums,
+    # None for float sums and non-sum aggregations
+    aggs: Tuple[Tuple[str, Optional[Tuple], Optional[int]], ...]
     value_is_int: Tuple[bool, ...]        # per value input
     interpret: bool
 
@@ -120,7 +141,7 @@ class PallasPlan:
     group_idx: Tuple[int, ...]
     group_strides: Tuple[int, ...]
     num_groups_padded: int
-    aggs: Tuple[Tuple[str, Optional[int]], ...]
+    aggs: Tuple[Tuple[str, Optional[Tuple], Optional[int]], ...]
     static_params: np.ndarray             # [2 * n_slots] i32 interval bounds
 
     def spec(self, num_segs: int, tiles_per_seg: int,
@@ -135,11 +156,19 @@ class PallasPlan:
             interpret=interpret)
 
 
+def _limbs_for(max_abs: int) -> int:
+    """Number of 12-bit value limbs covering |v| <= max_abs (top limb holds
+    the sign; intermediate limbs are the non-negative two's-complement
+    slices, so ``L * 12`` bits must cover ``max_abs`` itself)."""
+    return max(1, -(-max(max_abs.bit_length(), 1) // _LIMB_BITS))
+
+
 def extract_plan(plan, provider) -> Optional[PallasPlan]:
     """SegmentPlan -> PallasPlan, or None when the query shape isn't covered
     by the fused kernel. ``provider`` supplies column metadata (an
     ImmutableSegment or a SegmentBatch with unified stats)."""
     from pinot_tpu.engine.kernels import _ParamCursor
+    from pinot_tpu.engine.staging import staged_int_dtype
 
     filter_spec, agg_specs, group_specs, num_groups, _ = plan.spec
     if group_specs and num_groups > MAX_PALLAS_GROUPS:
@@ -147,6 +176,8 @@ def extract_plan(plan, provider) -> Optional[PallasPlan]:
     if any(a[0] in ("distinctcount", "distinctcounthll")
            for a in agg_specs):
         return None  # 3-tuple specs (col, card/log2m) — jnp path serves
+    if provider.metadata.num_docs > _I32_MAX:
+        return None  # count/carry-chain bounds assume i32 doc counts
 
     try:
         packed_names: List[str] = []
@@ -214,72 +245,69 @@ def extract_plan(plan, provider) -> Optional[PallasPlan]:
         else:
             G = _G_CHUNK  # single group at key 0
 
-        # -- aggregations
+        # -- aggregation value expressions (ref: the reference evaluates
+        # transform expressions inside the aggregation operator,
+        # AggregationFunctionUtils + TransformOperator; here int exprs run
+        # exactly in i32, float exprs in f32, inside the fused kernel)
         value_names: List[str] = []
         value_is_int: List[bool] = []
 
-        def value_idx(vspec, acc: str) -> int:
-            if vspec is None or vspec[0] != "col":
-                raise _Ineligible("non-column agg value")
-            name = vspec[1]
+        def leaf_idx(name: str) -> Tuple[int, bool, Optional[int]]:
             cm = provider.metadata.column(name)
-            if acc == "i32":
-                is_int = True
-            elif acc == "f32":
-                is_int = False
-            else:
-                raise _Ineligible(f"{acc} accumulator")
+            if not (cm.single_value and cm.data_type.is_numeric):
+                raise _Ineligible("non-numeric/MV agg value column")
+            is_int = cm.data_type.is_integral
+            max_abs: Optional[int] = None
+            if is_int:
+                if cm.min_value is None or cm.max_value is None:
+                    raise _Ineligible("no stats for int value bound")
+                if staged_int_dtype(cm) != np.dtype(np.int32):
+                    raise _Ineligible("i64-staged value column")
+                max_abs = max(abs(int(cm.min_value)), abs(int(cm.max_value)))
             if name not in value_names:
                 value_names.append(name)
                 value_is_int.append(is_int)
-            vi = value_names.index(name)
-            if value_is_int[vi] != is_int:
-                raise _Ineligible("mixed int/float use of one column")
-            return vi
+            return value_names.index(name), is_int, max_abs
 
-        def int_max_abs(vspec) -> int:
-            cm = provider.metadata.column(vspec[1])
-            if cm.min_value is None or cm.max_value is None:
-                raise _Ineligible("no stats for exactness bound")
-            return max(abs(int(cm.min_value)), abs(int(cm.max_value)))
+        def compile_vexpr(vspec) -> Tuple[Tuple, bool, Optional[int]]:
+            if vspec is None:
+                raise _Ineligible("missing agg value")
+            if vspec[0] == "col":
+                vi, is_int, max_abs = leaf_idx(vspec[1])
+                return ("v", vi), is_int, max_abs
+            if (vspec[0] == "fn" and vspec[1] in ("times", "plus", "minus")
+                    and len(vspec[2]) == 2):
+                le, li, lm = compile_vexpr(vspec[2][0])
+                re_, ri, rm = compile_vexpr(vspec[2][1])
+                if li and ri:
+                    max_abs = lm * rm if vspec[1] == "times" else lm + rm
+                    if max_abs > _I32_MAX:
+                        # in-kernel i32 arithmetic would wrap
+                        raise _Ineligible("int expr bound exceeds i32")
+                    return (vspec[1], le, re_), True, max_abs
+                return (vspec[1], le, re_), False, None
+            raise _Ineligible(f"agg value {vspec[0]!r}")
 
-        def check_sum_exact(vspec) -> None:
-            max_abs = int_max_abs(vspec)
-            if max_abs * PALLAS_TILE >= _F32_EXACT:
-                raise _Ineligible("tile sum not f32-exact")
-            # the i32 accumulator spans ALL segments in the kernel grid
-            # (init at s==0 only), so the bound is the whole provider —
-            # a batch's num_docs covers every stacked segment
-            if max_abs * max(provider.metadata.num_docs, 1) > _I32_MAX:
-                raise _Ineligible("provider-wide sum exceeds i32")
-
-        def check_minmax_exact(vspec) -> None:
-            # min/max rows reduce in f32: int values >= 2^24 would round
-            # (the jnp kernel keeps them exact in i32) -> ineligible
-            if int_max_abs(vspec) >= _F32_EXACT:
-                raise _Ineligible("int min/max not f32-exact")
-
-        aggs: List[Tuple[str, Optional[int]]] = []
+        aggs: List[Tuple[str, Optional[Tuple], Optional[int]]] = []
         for aspec in agg_specs:
-            base, mv, vspec, acc = aspec[0], aspec[1], aspec[2], aspec[3]
+            base, mv, vspec = aspec[0], aspec[1], aspec[2]
             if mv:
                 raise _Ineligible("mv aggregation")
-            if base == "count" and vspec is None:
-                aggs.append(("count", None))
-                continue
-            if base not in ("count", "sum", "avg", "min", "max",
-                            "minmaxrange"):
-                raise _Ineligible(base)
             if base == "count":
-                aggs.append(("count", None))
+                aggs.append(("count", None, None))
                 continue
-            vi = value_idx(vspec, acc)
-            if acc == "i32":
-                if base in ("sum", "avg"):
-                    check_sum_exact(vspec)
-                else:  # min/max/minmaxrange on int values
-                    check_minmax_exact(vspec)
-            aggs.append((base, vi))
+            if base not in ("sum", "avg", "min", "max", "minmaxrange"):
+                raise _Ineligible(base)
+            vexpr, is_int, max_abs = compile_vexpr(vspec)
+            if base in ("sum", "avg"):
+                aggs.append((base, vexpr, _limbs_for(max_abs) if is_int
+                             else None))
+            else:
+                # min/max rows reduce in f32: int values >= 2^24 would round
+                # (the jnp kernel keeps them exact in i32) -> ineligible
+                if is_int and max_abs >= _F32_EXACT:
+                    raise _Ineligible("int min/max not f32-exact")
+                aggs.append((base, vexpr, None))
     except _Ineligible:
         return None
 
@@ -299,31 +327,45 @@ def extract_plan(plan, provider) -> Optional[PallasPlan]:
 
 def _row_layout(spec: PallasSpec):
     """Single source of truth for the accumulator layout:
-    - out_f [Mf, G] f32: float-value sum rows (>=1 row, dummy if none)
-    - out_i [Mi, G] i32: [count, int-value sum rows...]
-    - out_mm [Mm, G] f32: (value, kind) min/max rows (>=1 row, dummy if none)
-    Returns (fsum_row, isum_row, mm_row, Mf, Mi, Mm) where *_row map value
-    input idx (or (vi, kind)) -> row index."""
-    fsum_row: Dict[int, int] = {}
-    isum_row: Dict[int, int] = {}
-    mm_row: Dict[Tuple[int, str], int] = {}
-    for base, vi in spec.aggs:
+    - out_f [Mf, G] f32: per float sum a (sum, compensation) Neumaier ROW
+      PAIR at (r, r+1) (>=1 row, dummy if none)
+    - out_i [Mi, G] i32: row 0 = count; per int sum a base-2^12 carry-chain
+      of ``L + 2`` accumulator rows starting at ``start`` (limb ``k``'s
+      partials add at ``start + k``; the two extra rows absorb carries)
+    - out_mm [Mm, G] f32: (vexpr, kind) min/max rows (>=1 row, dummy if none)
+    Returns (fsum_row, isum_row, mm_row, Mf, Mi, Mm) where fsum_row maps
+    vexpr -> sum-row index, isum_row maps vexpr -> (start_row, L), mm_row
+    maps (vexpr, 'min'|'max') -> row index."""
+    fsum_row: Dict[Tuple, int] = {}
+    isum_row: Dict[Tuple, Tuple[int, int]] = {}
+    mm_row: Dict[Tuple[Tuple, str], int] = {}
+    next_i = 1
+    for base, vexpr, limbs in spec.aggs:
         if base in ("sum", "avg"):
-            if spec.value_is_int[vi]:
-                isum_row.setdefault(vi, 1 + len(isum_row))
+            if limbs is not None:
+                if vexpr not in isum_row:
+                    isum_row[vexpr] = (next_i, limbs)
+                    next_i += limbs + 2
             else:
-                fsum_row.setdefault(vi, len(fsum_row))
+                fsum_row.setdefault(vexpr, 2 * len(fsum_row))
         elif base == "min":
-            mm_row.setdefault((vi, "min"), len(mm_row))
+            mm_row.setdefault((vexpr, "min"), len(mm_row))
         elif base == "max":
-            mm_row.setdefault((vi, "max"), len(mm_row))
+            mm_row.setdefault((vexpr, "max"), len(mm_row))
         elif base == "minmaxrange":
-            mm_row.setdefault((vi, "min"), len(mm_row))
-            mm_row.setdefault((vi, "max"), len(mm_row))
-    Mf = max(len(fsum_row), 1)
-    Mi = 1 + len(isum_row)
+            mm_row.setdefault((vexpr, "min"), len(mm_row))
+            mm_row.setdefault((vexpr, "max"), len(mm_row))
+    Mf = max(2 * len(fsum_row), 1)
+    Mi = next_i
     Mm = max(len(mm_row), 1)
     return fsum_row, isum_row, mm_row, Mf, Mi, Mm
+
+
+def _expr_is_int(vexpr: Tuple, value_is_int: Tuple[bool, ...]) -> bool:
+    if vexpr[0] == "v":
+        return value_is_int[vexpr[1]]
+    return (_expr_is_int(vexpr[1], value_is_int)
+            and _expr_is_int(vexpr[2], value_is_int))
 
 
 def build_kernel(spec: PallasSpec):
@@ -340,6 +382,9 @@ def build_kernel(spec: PallasSpec):
     TPS = spec.tiles_per_seg
 
     fsum_row, isum_row, mm_row, Mf, Mi, Mm = _row_layout(spec)
+    nf = len(fsum_row)
+    # matmul row plan: [nf float rows][1 count row][per int sum: L limb rows]
+    int_sums = sorted(isum_row.items(), key=lambda kv: kv[1][0])
     # params: [2*n_slots intervals][S num_docs][1 doc_base]
     nd_off = 2 * spec.n_slots
 
@@ -354,7 +399,7 @@ def build_kernel(spec: PallasSpec):
         def _init_global():
             out_f[...] = jnp.zeros_like(out_f)
             out_i[...] = jnp.zeros_like(out_i)
-            for (vi, kind), r in mm_row.items():
+            for (vexpr, kind), r in mm_row.items():
                 out_mm[r, :] = jnp.full((G,), _POS if kind == "min" else _NEG,
                                         dtype=jnp.float32)
             if not mm_row:
@@ -407,23 +452,55 @@ def build_kernel(spec: PallasSpec):
         mask = emit(spec.filter_tree) & valid
         mask_f = mask.astype(jnp.float32)
 
+        # -- value expressions [RT, 128]: int exprs evaluate exactly in i32
+        # (plan-time bound check), float exprs in f32 (the vectorized form
+        # of the reference's transform-then-aggregate chain)
+        vexpr_cache: Dict[Tuple, Any] = {}
+
+        def emit_vexpr(vexpr):
+            v = vexpr_cache.get(vexpr)
+            if v is not None:
+                return v
+            if vexpr[0] == "v":
+                v = values[vexpr[1]][0, 0]
+            else:
+                a = emit_vexpr(vexpr[1])
+                b = emit_vexpr(vexpr[2])
+                if not (_expr_is_int(vexpr[1], spec.value_is_int)
+                        and _expr_is_int(vexpr[2], spec.value_is_int)):
+                    a = a.astype(jnp.float32)
+                    b = b.astype(jnp.float32)
+                if vexpr[0] == "times":
+                    v = a * b
+                elif vexpr[0] == "plus":
+                    v = a + b
+                else:
+                    v = a - b
+            vexpr_cache[vexpr] = v
+            return v
+
         # -- composed group keys (all zero for scalar aggregation)
         keys = jnp.zeros((RT, 128), dtype=jnp.int32)
         for gi, stride in zip(spec.group_idx, spec.group_strides):
             keys = keys + ids[gi] * jnp.int32(stride)
 
-        # -- per-segment matched docs (QueryStats parity)
-        out_seg[0, :] += mask_f.sum(axis=0)
+        # -- per-segment matched docs (QueryStats parity), exact i32
+        out_seg[0, :] += mask.astype(jnp.int32).sum(axis=0)
 
-        # -- sum/count rows -> one-hot matmul per 128-group chunk (MXU)
-        rows = [jnp.zeros((RT, 128), dtype=jnp.float32)] * Mf
-        for vi, r in fsum_row.items():
-            rows[r] = values[vi][0, 0].astype(jnp.float32) * mask_f
+        # -- matmul row stack [nf + 1 + sum(L), RT, 128] f32
+        rows = []
+        for vexpr, _r in sorted(fsum_row.items(), key=lambda kv: kv[1]):
+            rows.append(emit_vexpr(vexpr).astype(jnp.float32) * mask_f)
         rows.append(mask_f)                        # count row (out_i row 0)
-        irows = [None] * (Mi - 1)
-        for vi, r in isum_row.items():
-            irows[r - 1] = values[vi][0, 0].astype(jnp.float32) * mask_f
-        R = jnp.stack(rows + irows)                # [Mf + Mi, RT, 128]
+        for vexpr, (start, L) in int_sums:
+            v = jnp.where(mask, emit_vexpr(vexpr), 0)
+            for k in range(L):
+                if k < L - 1:
+                    limb = (v >> (k * _LIMB_BITS)) & _LIMB_MASK
+                else:
+                    limb = v >> (k * _LIMB_BITS)   # top limb keeps the sign
+                rows.append(limb.astype(jnp.float32))
+        R = jnp.stack(rows)                        # [M_mat, RT, 128]
 
         for c in range(n_chunks):
             g0 = c * _G_CHUNK
@@ -432,14 +509,33 @@ def build_kernel(spec: PallasSpec):
             oh = (keys[:, :, None] == g_iota).astype(jnp.float32)
             part = jax.lax.dot_general(
                 R, oh, (((1, 2), (0, 1)), ((), ())),
-                preferred_element_type=jnp.float32)   # [Mf + Mi, 128]
-            out_f[:, g0:g0 + _G_CHUNK] += part[:Mf]
-            out_i[:, g0:g0 + _G_CHUNK] += part[Mf:].astype(jnp.int32)
+                preferred_element_type=jnp.float32)   # [M_mat, 128]
+
+            # float sums: Neumaier-compensated accumulation (sum, comp pair)
+            for j, (vexpr, r) in enumerate(
+                    sorted(fsum_row.items(), key=lambda kv: kv[1])):
+                x = part[j]
+                a = out_f[r, g0:g0 + _G_CHUNK]
+                t_ = a + x
+                err = jnp.where(jnp.abs(a) >= jnp.abs(x),
+                                (a - t_) + x, (x - t_) + a)
+                out_f[r, g0:g0 + _G_CHUNK] = t_
+                out_f[r + 1, g0:g0 + _G_CHUNK] += err
+
+            # count + int limb partials: f32 -> exact i32 (every partial is
+            # an integer < 2^24 by the limb-width bound)
+            out_i[0, g0:g0 + _G_CHUNK] += part[nf].astype(jnp.int32)
+            m = nf + 1
+            for vexpr, (start, L) in int_sums:
+                for k in range(L):
+                    out_i[start + k, g0:g0 + _G_CHUNK] += \
+                        part[m].astype(jnp.int32)
+                    m += 1
 
             # -- min/max rows reduce on the VPU per chunk
-            for (vi, kind), r in mm_row.items():
+            for (vexpr, kind), r in mm_row.items():
                 neutral = _POS if kind == "min" else _NEG
-                v = values[vi][0, 0].astype(jnp.float32)
+                v = emit_vexpr(vexpr).astype(jnp.float32)
                 vm = jnp.where(mask, v, neutral)
                 eq = keys[:, :, None] == g_iota
                 v3 = jnp.where(eq, vm[:, :, None], neutral)
@@ -449,6 +545,17 @@ def build_kernel(spec: PallasSpec):
                 out_mm[r, g0:g0 + _G_CHUNK] = (
                     jnp.minimum(cur, red) if kind == "min"
                     else jnp.maximum(cur, red))
+
+        # -- carry-chain normalization: every limb accumulator returns to
+        # [0, 2^12) (arithmetic shift floors, so signed top limbs carry
+        # correctly); the chain's top row absorbs the running magnitude,
+        # keeping every row i32-bounded regardless of provider size
+        for vexpr, (start, L) in int_sums:
+            for k in range(L + 1):                 # rows start .. start+L
+                acc = out_i[start + k, :]
+                carry = acc >> _LIMB_BITS
+                out_i[start + k, :] = acc - (carry << _LIMB_BITS)
+                out_i[start + k + 1, :] += carry
 
     def block(shape0):
         nd = len(shape0)
@@ -473,7 +580,7 @@ def build_kernel(spec: PallasSpec):
         jax.ShapeDtypeStruct((Mf, G), jnp.float32),
         jax.ShapeDtypeStruct((Mi, G), jnp.int32),
         jax.ShapeDtypeStruct((Mm, G), jnp.float32),
-        jax.ShapeDtypeStruct((S, 128), jnp.float32),
+        jax.ShapeDtypeStruct((S, 128), jnp.int32),
     )
 
     return pl.pallas_call(
@@ -510,37 +617,46 @@ def assemble_outputs(plan_spec: Tuple, spec: PallasSpec, out_f, out_i, out_mm,
     """Map the pallas accumulators onto the jnp kernel's output tree so
     pack_outputs/unpack_outputs/decode apply unchanged. ``seg_matched`` is
     the [S] per-segment matched-doc count (summed over lanes, and over mesh
-    axes by the sharded caller)."""
+    axes by the sharded caller). Int sums re-combine their carry-chain rows
+    as ``sum_k row_k * 2^(12k)`` in i64 (exact; the packed f64 output then
+    carries them exactly to 2^53, the reference's own double-SUM contract)."""
     _, agg_specs, group_specs, num_groups, _ = plan_spec
     fsum_row, isum_row, mm_row, _, _, _ = _row_layout(spec)
     grouped = bool(group_specs)
     n = num_groups if grouped else 1
     counts = out_i[0, :n]
 
-    def sum_leaf(vi):
-        if spec.value_is_int[vi]:
-            return out_i[isum_row[vi], :n]
-        return out_f[fsum_row[vi], :n]
+    def sum_leaf(vexpr, limbs):
+        if limbs is None:
+            r = fsum_row[vexpr]
+            return (out_f[r, :n].astype(jnp.float64)
+                    + out_f[r + 1, :n].astype(jnp.float64))
+        start, L = isum_row[vexpr]
+        acc = jnp.zeros((n,), dtype=jnp.int64)
+        for k in range(L + 2):
+            acc = acc + (out_i[start + k, :n].astype(jnp.int64)
+                         << (k * _LIMB_BITS))
+        return acc
 
     out: Dict[str, Any] = {}
     if grouped:
         out["presence"] = counts
     else:
         out["num_matched"] = counts[0]
-    for i, ((base, vi), aspec) in enumerate(zip(spec.aggs, agg_specs)):
+    for i, (base, vexpr, limbs) in enumerate(spec.aggs):
         if base == "count":
             leaf: Any = counts
         elif base in ("sum", "avg"):
-            leaf = sum_leaf(vi)
+            leaf = sum_leaf(vexpr, limbs)
             if base == "avg":
                 leaf = (leaf, counts)
         elif base == "min":
-            leaf = out_mm[mm_row[(vi, "min")], :n]
+            leaf = out_mm[mm_row[(vexpr, "min")], :n]
         elif base == "max":
-            leaf = out_mm[mm_row[(vi, "max")], :n]
+            leaf = out_mm[mm_row[(vexpr, "max")], :n]
         else:  # minmaxrange
-            leaf = (out_mm[mm_row[(vi, "min")], :n],
-                    out_mm[mm_row[(vi, "max")], :n])
+            leaf = (out_mm[mm_row[(vexpr, "min")], :n],
+                    out_mm[mm_row[(vexpr, "max")], :n])
         if not grouped:
             leaf = (tuple(x[0] for x in leaf) if isinstance(leaf, tuple)
                     else leaf[0])

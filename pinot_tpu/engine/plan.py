@@ -210,7 +210,11 @@ def plan_segment(ctx: QueryContext, segment: ImmutableSegment) -> SegmentPlan:
 
 def _value_kind(e: Expr, segment: ImmutableSegment):
     """('int', max_abs|None) when the expression is integral on device,
-    ('float', None) otherwise. Arithmetic expressions accumulate as float."""
+    ('float', None) otherwise. Integer bounds propagate through
+    plus/minus/times so expression aggregations like
+    ``sum(lo_extendedprice * lo_discount)`` accumulate EXACTLY in i32/i64
+    instead of drifting in f32 (divide/mod stay float — the reference's
+    transform results for those are doubles too)."""
     if isinstance(e, Literal):
         if isinstance(e.value, bool) or isinstance(e.value, int):
             return ("int", abs(int(e.value)))
@@ -223,6 +227,14 @@ def _value_kind(e: Expr, segment: ImmutableSegment):
             return ("int", max(abs(int(cm.min_value)),
                                abs(int(cm.max_value))))
         return ("float", None)
+    if (isinstance(e, Function) and e.name in ("plus", "minus", "times")
+            and len(e.args) == 2):
+        kinds = [_value_kind(a, segment) for a in e.args]
+        if all(k[0] == "int" for k in kinds):
+            (_, la), (_, ra) = kinds
+            if la is None or ra is None:
+                return ("int", None)
+            return ("int", la * ra if e.name == "times" else la + ra)
     return ("float", None)
 
 

@@ -171,6 +171,79 @@ def test_wide_pallas_matches_host(setup, pallas_exec, host_exec, sql):
                 assert g == w, (sql, gr, wr)
 
 
+# -- round-5 eligibility: expression agg values + limb-exact big-int sums ---
+
+@pytest.fixture(scope="module")
+def big_setup(tmp_path_factory):
+    """SSB-shaped values: products and sums far beyond the old kernel's
+    f32-per-tile and provider-wide-i32 exactness bounds."""
+    out = tmp_path_factory.mktemp("pallas_big")
+    rng = np.random.default_rng(23)
+    n = N
+    schema = Schema("pl_big", [
+        FieldSpec("k", DataType.STRING),
+        FieldSpec("price", DataType.INT, FieldType.METRIC),
+        FieldSpec("disc", DataType.INT, FieldType.METRIC),
+        FieldSpec("rev", DataType.LONG, FieldType.METRIC),
+    ])
+    frame = {
+        "k": np.array(["a", "b", "c"])[rng.integers(0, 3, n)],
+        "price": rng.integers(905, 5_550_000, n).astype(np.int64),
+        "disc": rng.integers(0, 11, n).astype(np.int64),
+        "rev": rng.integers(0, 5_500_000, n).astype(np.int64),
+    }
+    segs = []
+    for i, sl in enumerate([slice(0, n // 2), slice(n // 2, n)]):
+        b = SegmentBuilder(schema, f"pl_big_{i}")
+        b.build({c: v[sl] for c, v in frame.items()}, str(out))
+        segs.append(load_segment(str(out / f"pl_big_{i}")))
+    return frame, segs
+
+
+BIG_QUERIES = [
+    # all three SSB Q1 flights are sum(extendedprice * discount) shapes
+    "SELECT sum(price * disc) FROM pl_big WHERE disc BETWEEN 1 AND 3",
+    "SELECT sum(rev) FROM pl_big",                       # > i32 total
+    "SELECT k, sum(rev), count(*) FROM pl_big GROUP BY k ORDER BY k",
+    "SELECT k, sum(price * disc), avg(rev) FROM pl_big "
+    "GROUP BY k ORDER BY k",
+    "SELECT sum(rev - price) FROM pl_big WHERE disc > 5",  # Q4 shape
+]
+
+
+def test_big_value_plans_are_pallas_eligible(big_setup):
+    from pinot_tpu.engine.pallas_kernels import extract_plan
+
+    _, segs = big_setup
+    for sql in BIG_QUERIES:
+        plan = plan_segment(compile_query(sql), segs[0])
+        assert extract_plan(plan, segs[0]) is not None, sql
+
+
+@pytest.mark.parametrize("sql", BIG_QUERIES, ids=[q[:60] for q in BIG_QUERIES])
+def test_big_value_sums_exact(big_setup, pallas_exec, host_exec, sql):
+    """Limb-split accumulation must be EXACT (integer equality), not
+    approximately right: the host engine computes in f64/int64."""
+    _, segs = big_setup
+    got, _ = pallas_exec.execute(compile_query(sql), segs)
+    want, _ = host_exec.execute(compile_query(sql), segs)
+    assert len(got.rows) == len(want.rows)
+    for gr, wr in zip(got.rows, want.rows):
+        for g, w in zip(gr, wr):
+            if isinstance(w, float):
+                assert g == pytest.approx(w, rel=1e-12), (sql, gr, wr)
+            else:
+                assert g == w, (sql, gr, wr)
+
+
+def test_product_sum_matches_numpy_exactly(big_setup, pallas_exec):
+    frame, segs = big_setup
+    m = (frame["disc"] >= 1) & (frame["disc"] <= 3)
+    exact = int((frame["price"][m] * frame["disc"][m]).sum())
+    got, _ = pallas_exec.execute(compile_query(BIG_QUERIES[0]), segs)
+    assert float(got.rows[0][0]) == float(exact)
+
+
 # -- sharded fused-pallas combine (the serving path) ------------------------
 
 @pytest.fixture(scope="module", params=[1, 2], ids=["doc1", "doc2"])

@@ -182,7 +182,8 @@ def _emit_value(vspec: Tuple, cols, pc: _ParamCursor,
 # kernel factory
 # --------------------------------------------------------------------------
 
-def build_kernel_body(spec: Tuple, capacity_override: int = 0):
+def build_kernel_body(spec: Tuple, capacity_override: int = 0,
+                      sparse_k: int = 0):
     """spec = (filter_spec, agg_specs, group_specs, num_groups, capacity)
     -> unjitted fn(cols, params, num_docs, doc_offset) -> dict of partials.
 
@@ -191,6 +192,8 @@ def build_kernel_body(spec: Tuple, capacity_override: int = 0):
     evaluates each device's sub-range of the scan; ref: the doc-dimension
     "context parallelism" mapping, SURVEY.md §5). ``capacity_override``
     replaces the spec's capacity with the per-shard local capacity.
+    ``sparse_k`` > 0 switches the group-by path to sort-based sparse
+    grouping over K slots (see _emit_grouped_sparse).
     """
     filter_spec, agg_specs, group_specs, num_groups, capacity = spec
     if capacity_override:
@@ -221,11 +224,61 @@ def build_kernel_body(spec: Tuple, capacity_override: int = 0):
             else:  # graw: value-space key
                 k = (c["fwd"] - _bases[gi]).astype(jnp.int32)
             keys = keys + k * strides[gi]
+        if sparse_k:
+            return _emit_grouped_sparse(agg_specs, cols, pc, mask, keys,
+                                        num_groups, sparse_k)
         seg_ids = jnp.where(mask, keys, num_groups)  # overflow bucket
         return _emit_grouped_all(agg_specs, cols, pc, mask, seg_ids,
                                  num_groups)
 
     return kernel
+
+
+def compact_from_sorted(sk: jnp.ndarray, K: int):
+    """Shared compaction core for BOTH sparse-grouping paths (the
+    per-segment kernel here and the cross-device merge in
+    parallel/combine.py): ``sk`` = ascending keys with _SENTINEL_KEY fill.
+    Returns (first, n_live, uniq): first-occurrence flags over sk, the live
+    distinct-key count, and the first K live keys (SENT-filled past
+    n_live)."""
+    SENT = jnp.int32(_SENTINEL_KEY)
+    valid = sk != SENT
+    first = valid & jnp.concatenate(
+        [jnp.ones((1,), dtype=bool), sk[1:] != sk[:-1]])
+    n_live = first.sum(dtype=jnp.int32)
+    pos = jnp.nonzero(first, size=K, fill_value=sk.shape[0] - 1)[0]
+    live = jnp.arange(K, dtype=jnp.int32) < jnp.minimum(n_live, K)
+    uniq = jnp.where(live, sk[pos], SENT)
+    return first, n_live, uniq
+
+
+def _emit_grouped_sparse(agg_specs, cols, pc, mask, keys, num_groups, K):
+    """Sort/compaction-based grouping for LARGE composed key spaces — the
+    device rung of the reference's cardinality ladder past dense array
+    holders (DictionaryBasedGroupKeyGenerator.java:62): sort the masked
+    keys, compact the live groups into K slots, scatter aggregates over
+    [K+1] instead of [num_groups+1]. The output is ALREADY compact
+    ("ck" = sorted live composed keys, "compact_n" = live count); more
+    than K live groups reports compact_n > K so the decode falls back to
+    the host path instead of truncating."""
+    SENT = jnp.int32(_SENTINEL_KEY)
+    mk = jnp.where(mask, keys, SENT)
+    sk = jnp.sort(mk)
+    first, n_live, uniq = compact_from_sorted(sk, K)
+    live = uniq != SENT
+    # doc -> slot rank via a dense key-space LUT: ONE gather per doc (a
+    # searchsorted would cost log2(K) gather passes on TPU). Fill slots
+    # park at the LUT's overflow cell.
+    lut = jnp.full((num_groups + 1,), jnp.int32(K))
+    park = jnp.where(live, uniq, num_groups)
+    lut = lut.at[park].set(
+        jnp.where(live, jnp.arange(K, dtype=jnp.int32), K))
+    rank = lut[jnp.clip(keys, 0, num_groups - 1)]
+    seg_ids = jnp.where(mask, rank, K)
+    out = _emit_grouped_all(agg_specs, cols, pc, mask, seg_ids, K)
+    out["ck"] = uniq
+    out["compact_n"] = n_live
+    return out
 
 
 def _emit_grouped_all(agg_specs, cols, pc, mask, seg_ids, num_groups):
@@ -336,7 +389,7 @@ def build_kernel(spec: Tuple):
     """Single-segment entry: jitted fn(cols, params, num_docs) -> packed
     f64 output vector (ONE device array -> one D2H fetch per query; see
     output_layout)."""
-    body = build_kernel_body(spec)
+    body = build_kernel_body(spec, sparse_k=sparse_mode(spec))
 
     def kernel(cols, params, num_docs):
         return pack_outputs(body(cols, params, num_docs, jnp.int32(0)), spec)
@@ -367,6 +420,27 @@ def build_kernel(spec: Tuple):
 
 COMPACT_MIN_GROUPS = 8192
 COMPACT_K = 8192
+
+# past this key-space size the kernel switches from dense scatter slots to
+# SORT-BASED SPARSE GROUPING (_emit_grouped_sparse): the device analogue of
+# the reference's cardinality ladder stepping off dense array-based group-key
+# holders onto maps (DictionaryBasedGroupKeyGenerator.java:62,
+# InstancePlanMakerImplV2.java:67-84 numGroupsLimit)
+SPARSE_MIN_GROUPS = 1 << 15
+# composed keys never reach this value (MAX_DEVICE_GROUPS < 2^31)
+_SENTINEL_KEY = (1 << 31) - 1
+
+
+def sparse_mode(spec: Tuple) -> int:
+    """0 = dense grouping; else the compact K for sort-based sparse
+    grouping. Shares compact_mode's K so the packed output layout is
+    identical either way."""
+    _, agg_specs, group_specs, num_groups, _ = spec
+    if not group_specs or num_groups < SPARSE_MIN_GROUPS:
+        return 0
+    if any(a[0] in ("distinctcount", "distinctcounthll") for a in agg_specs):
+        return 0
+    return min(COMPACT_K, num_groups)
 
 
 def compact_mode(spec: Tuple) -> int:
@@ -419,15 +493,25 @@ def output_layout(spec: Tuple, num_seg: int = 0) -> List[Tuple[str, int]]:
 
 
 def pack_outputs(out: Dict[str, Any], spec: Tuple) -> jnp.ndarray:
-    """Flatten the kernel output tree into one f64 vector (device side)."""
+    """Flatten the kernel output tree into one f64 vector (device side).
+    Sparse-grouped trees (``"ck"`` present) arrive ALREADY compact — their
+    unique composed keys go out as compact_idx directly (a composed key IS
+    the dense group index, so the decode is identical); dense trees past
+    the compact threshold get gathered down to their live slots here."""
     num_seg = out["seg_matched"].shape[0] if "seg_matched" in out else 0
     K = compact_mode(spec)
     idx = None
+    gat = None
     if K:
-        presence = out["presence"]
-        # fill 0 is safe: positions >= n are ignored by the decode
-        idx = jnp.nonzero(presence > 0, size=K, fill_value=0)[0]
-        n = (presence > 0).sum(dtype=jnp.int32)
+        if "ck" in out:
+            n = out["compact_n"]
+            idx = out["ck"]
+        else:
+            presence = out["presence"]
+            # fill 0 is safe: positions >= n are ignored by the decode
+            gat = jnp.nonzero(presence > 0, size=K, fill_value=0)[0]
+            idx = gat
+            n = (presence > 0).sum(dtype=jnp.int32)
     parts = []
     for key, _ in output_layout(spec, num_seg):
         if key == "compact_n":
@@ -437,12 +521,12 @@ def pack_outputs(out: Dict[str, Any], spec: Tuple) -> jnp.ndarray:
         elif "." in key:
             k, j = key.split(".")
             leaf = out[k][int(j)]
-            if idx is not None:
-                leaf = jnp.asarray(leaf)[idx]
+            if gat is not None:
+                leaf = jnp.asarray(leaf)[gat]
         else:
             leaf = out[key]
-            if idx is not None and key != "seg_matched":
-                leaf = jnp.asarray(leaf)[idx]
+            if gat is not None and key != "seg_matched":
+                leaf = jnp.asarray(leaf)[gat]
         parts.append(jnp.asarray(leaf, dtype=jnp.float64).reshape(-1))
     return jnp.concatenate(parts) if len(parts) > 1 else parts[0]
 

@@ -28,9 +28,12 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from pinot_tpu.engine.kernels import (
+    _SENTINEL_KEY,
     build_kernel_body,
+    compact_from_sorted,
     pack_outputs,
     partial_reduce_ops,
+    sparse_mode,
 )
 from pinot_tpu.engine.plan import PlanError
 
@@ -94,6 +97,57 @@ def _cross_reduce(v: jnp.ndarray, op: str, axes, mesh: Mesh) -> jnp.ndarray:
     raise AssertionError(op)
 
 
+def _sparse_cross_combine(partials, reducers, K, axes, mesh):
+    """Merge per-segment SPARSE compact partials across segments and mesh
+    axes. Dense partials share a key space and merge with psum; sparse
+    compacts carry DIFFERENT key sets per segment/shard, so the merge is:
+    all_gather every (keys, leaves) compact over both mesh axes, then
+    re-sort + re-group the concatenated [M = total_compacts * K] entries
+    into one [K] compact (the device analogue of the reference's
+    IndexedTable upsert-merge of map-based group-by blocks,
+    BaseCombineOperator merge for group-by). Segment-level overflow
+    (compact_n > K anywhere) propagates so the decode rejects rather than
+    truncates."""
+    SENT = jnp.int32(_SENTINEL_KEY)
+
+    def gather(x):
+        for a in axes:
+            if mesh.shape[a] > 1:
+                x = jax.lax.all_gather(x, a, tiled=True)
+        return x
+
+    keys = gather(partials["ck"]).reshape(-1)          # [M]
+    seg_n = gather(partials["compact_n"]).max()
+    M = keys.shape[0]
+    order = jnp.argsort(keys)
+    sk = keys[order]
+    valid = sk != SENT
+    first, n_live, uniq = compact_from_sorted(sk, K)
+    rank = jnp.cumsum(first) - 1                       # [M] sorted-pos rank
+    rank = jnp.where(valid & (rank < K), rank, K)      # overflow bucket
+    scatter = {"sum": jax.ops.segment_sum, "min": jax.ops.segment_min,
+               "max": jax.ops.segment_max}
+
+    def merge_leaf(leaf, op):
+        v = gather(leaf).reshape(M)[order]
+        return scatter[op](v, rank, num_segments=K + 1)[:K]
+
+    out = {}
+    for key, ops in reducers.items():
+        if key == "num_matched":
+            continue
+        val = partials[key]
+        if isinstance(val, tuple):
+            out[key] = tuple(merge_leaf(v, op) for v, op in zip(val, ops))
+        else:
+            out[key] = merge_leaf(val, ops[0])
+    out["ck"] = uniq
+    # if ANY per-segment compact overflowed, its keys were truncated before
+    # this merge — surface a count > K so unpack raises (host path serves)
+    out["compact_n"] = jnp.maximum(n_live, seg_n)
+    return out
+
+
 class ShardedKernelCache:
     """(spec, mesh-shape) -> compiled sharded combine kernel."""
 
@@ -128,7 +182,9 @@ def build_sharded_kernel(spec: Tuple, mesh: Mesh,
         # PlanError so the executor falls back to the per-segment path
         raise PlanError(f"capacity {capacity} !| doc axis {n_doc}")
     local_cap = capacity // n_doc
-    body = build_kernel_body(spec, capacity_override=local_cap)
+    sparse_k = sparse_mode(spec)
+    body = build_kernel_body(spec, capacity_override=local_cap,
+                             sparse_k=sparse_k)
     reducers = partial_reduce_ops(spec)
 
     kind_axis = {"fwd": 0, "mv": 0, "mvcount": 0, "null": 0, "dictvals": None}
@@ -145,17 +201,21 @@ def build_sharded_kernel(spec: Tuple, mesh: Mesh,
             return body(seg_cols, params, nd, doc_off)
 
         partials = jax.vmap(one_segment, in_axes=(cols_axes, 0))(cols, num_docs)
-        out = {}
         axes = (SEG_AXIS, DOC_AXIS)
-        for key, val in partials.items():
-            ops = reducers[key]
-            if isinstance(val, tuple):
-                out[key] = tuple(
-                    _cross_reduce(_local_reduce(v, op), op, axes, mesh)
-                    for v, op in zip(val, ops))
-            else:
-                out[key] = _cross_reduce(_local_reduce(val, ops[0]),
-                                         ops[0], axes, mesh)
+        if sparse_k:
+            out = _sparse_cross_combine(partials, reducers, sparse_k,
+                                        axes, mesh)
+        else:
+            out = {}
+            for key, val in partials.items():
+                ops = reducers[key]
+                if isinstance(val, tuple):
+                    out[key] = tuple(
+                        _cross_reduce(_local_reduce(v, op), op, axes, mesh)
+                        for v, op in zip(val, ops))
+                else:
+                    out[key] = _cross_reduce(_local_reduce(val, ops[0]),
+                                             ops[0], axes, mesh)
         # per-segment matched doc counts [S] (stats parity with the
         # per-segment executor: numSegmentsMatched / numDocsScanned)
         if "num_matched" in partials:
@@ -222,9 +282,10 @@ def build_sharded_pallas_kernel(spec, plan_spec: Tuple, mesh: Mesh):
         out_f, out_i, out_mm, out_seg = call(params, *packed_cols,
                                              *value_cols)
         out_f = _cross_reduce(out_f, "sum", axes, mesh)
-        # per-device int partials are i32-bounded (extract_plan's provider-
-        # wide check); widen before the mesh psum so the cross-device total
-        # can't wrap (O(groups) cost only)
+        # per-device int accumulator rows are i32-bounded by the kernel's
+        # per-step carry-chain normalization (pallas_kernels.build_kernel);
+        # widen before the mesh psum so the cross-device limb totals can't
+        # wrap (O(groups) cost only)
         out_i = _cross_reduce(out_i.astype(jnp.int64), "sum", axes, mesh)
         if mm_row:
             rows = list(out_mm)

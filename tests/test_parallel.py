@@ -237,15 +237,36 @@ class TestCompactGroupBy:
         return segs
 
     def test_compact_parity(self, wide_segs):
-        from pinot_tpu.engine.kernels import compact_mode
+        from pinot_tpu.engine.kernels import compact_mode, sparse_mode
         from pinot_tpu.engine.plan import plan_segment
 
         sql = ("SELECT a, b, year, sum(v), count(*) FROM wide "
                "WHERE a IN ('a001', 'a002', 'a003') "
                "GROUP BY a, b, year ORDER BY a, b, year LIMIT 5000")
         ctx = compile_query(sql)
-        assert compact_mode(plan_segment(ctx, wide_segs[0]).spec) > 0
+        spec = plan_segment(ctx, wide_segs[0]).spec
+        assert compact_mode(spec) > 0
+        # a ~2^17 key space must ride the sort-based sparse-grouping rung
+        # of the cardinality ladder, not a dense scatter
+        assert sparse_mode(spec) > 0
         dev = ShardedQueryExecutor()
+        host = ServerQueryExecutor(use_device=False)
+        drt, _ = dev.execute(ctx, wide_segs)
+        hrt, _ = host.execute(ctx, wide_segs)
+        assert drt.rows == hrt.rows
+        assert len(drt.rows) > 100
+
+    def test_sparse_doc_sharded_parity(self, wide_segs):
+        """Sparse compacts carry DIFFERENT key sets per doc shard; the
+        cross-shard merge must re-group them exactly (combine.py
+        _sparse_cross_combine)."""
+        from pinot_tpu.parallel import make_combine_mesh
+
+        sql = ("SELECT a, b, year, sum(v), count(*), min(v), max(v), "
+               "avg(v) FROM wide WHERE a IN ('a001', 'a002', 'a003') "
+               "GROUP BY a, b, year ORDER BY a, b, year LIMIT 5000")
+        ctx = compile_query(sql)
+        dev = ShardedQueryExecutor(mesh=make_combine_mesh(doc_shards=2))
         host = ServerQueryExecutor(use_device=False)
         drt, _ = dev.execute(ctx, wide_segs)
         hrt, _ = host.execute(ctx, wide_segs)

@@ -46,7 +46,14 @@ TABLE_DOES_NOT_EXIST_ERROR = 190
 BROKER_REQUEST_SEND_ERROR = 425
 SERVER_NOT_RESPONDING_ERROR = 427
 QUERY_EXECUTION_ERROR = 200
+ACCESS_DENIED_ERROR = 180
 TOO_MANY_REQUESTS_ERROR = 429
+
+
+class AccessDeniedError(QueryError):
+    """A subquery (or other nested execution) was denied by access control;
+    carries the denial through QueryError-shaped handling so the outer
+    response keeps errorCode 180 (-> HTTP 403)."""
 
 
 class BrokerRequestHandler:
@@ -84,7 +91,13 @@ class BrokerRequestHandler:
         self._servers[instance_id] = server
 
     # -- entry (ref: handleSQLRequest:203) -----------------------------------
-    def handle_sql(self, sql: str) -> BrokerResponse:
+    def handle_sql(self, sql: str, principal=None,
+                   access_control=None) -> BrokerResponse:
+        """``access_control``/``principal`` enable per-table authorization
+        on the PARSED query (ref: BaseBrokerRequestHandler.handleRequest
+        authorizing on the compiled request, not the raw SQL — a regex over
+        the SQL text is spoofable via string literals). Subquery rewrites
+        re-enter with the same principal so inner queries are checked too."""
         from pinot_tpu.spi.metrics import BrokerMeter, BrokerQueryPhase
 
         start = time.perf_counter()
@@ -115,6 +128,19 @@ class BrokerRequestHandler:
             return finish(response)
         t = phase(BrokerQueryPhase.COMPILATION, start)
 
+        if access_control is not None:
+            from pinot_tpu.spi.auth import READ
+
+            # ctx.table_name is never None (the grammar requires FROM), so
+            # the parsed table — not a spoofable raw-SQL regex — is what
+            # gets authorized
+            if not access_control.has_access(principal, ctx.table_name,
+                                             READ):
+                response.add_exception(
+                    ACCESS_DENIED_ERROR,
+                    f"Permission denied for table {ctx.table_name!r}")
+                return finish(response)
+
         try:
             physical = self._resolve_tables(ctx.table_name)
         except QueryError as e:
@@ -134,6 +160,17 @@ class BrokerRequestHandler:
             response.time_used_ms = (time.perf_counter() - start) * 1e3
             return finish(response)
 
+        try:
+            # strip gapfill(...) BEFORE scatter: servers execute the plain
+            # bucket group-by; the reducer fills the gaps (ref:
+            # GapfillProcessor dispatched from BrokerReduceService.java:44)
+            from pinot_tpu.broker.gapfill import extract_gapfill
+
+            ctx, gapfill_spec = extract_gapfill(ctx)
+        except QueryError as e:
+            response.add_exception(QUERY_EXECUTION_ERROR, str(e))
+            return finish(response)
+
         # per-table QPS quota FIRST: a throttled request must not get to
         # trigger subquery execution work (ref: queryquota acquire before
         # routing)
@@ -145,7 +182,11 @@ class BrokerRequestHandler:
                 return finish(response)
 
         try:
-            ctx = self._rewrite_subqueries(ctx)
+            ctx = self._rewrite_subqueries(ctx, principal=principal,
+                                           access_control=access_control)
+        except AccessDeniedError as e:
+            response.add_exception(ACCESS_DENIED_ERROR, str(e))
+            return finish(response)
         except QueryError as e:
             response.add_exception(QUERY_EXECUTION_ERROR, str(e))
             return finish(response)
@@ -190,6 +231,10 @@ class BrokerRequestHandler:
         try:
             table, stats, server_errors = self.reduce_service.reduce(
                 ctx, tables)
+            if gapfill_spec is not None:
+                from pinot_tpu.broker.gapfill import apply_gapfill
+
+                table = apply_gapfill(ctx, table, gapfill_spec)
             response.result_table = table
             response.stats = stats
             if stats.trace:
@@ -209,7 +254,8 @@ class BrokerRequestHandler:
     # -- IN_SUBQUERY (IdSet semijoin) ---------------------------------------
     MAX_SUBQUERY_DEPTH = 3
 
-    def _rewrite_subqueries(self, ctx: QueryContext) -> QueryContext:
+    def _rewrite_subqueries(self, ctx: QueryContext, principal=None,
+                            access_control=None) -> QueryContext:
         """``inSubquery(col, '<sql>')`` predicates: pre-execute the inner
         query (typically ``SELECT idset(col) FROM ...``), then rewrite to
         ``inIdSet(col, <serialized set>)`` so servers evaluate a plain
@@ -242,9 +288,21 @@ class BrokerRequestHandler:
                     try:
                         if tl.depth > self.MAX_SUBQUERY_DEPTH:
                             raise QueryError("IN_SUBQUERY nesting too deep")
-                        inner = self.handle_sql(inner_sql)
+                        # inner queries carry the OUTER principal: a
+                        # table-scoped caller must not semijoin/probe
+                        # other tables through the rewrite
+                        inner = self.handle_sql(
+                            inner_sql, principal=principal,
+                            access_control=access_control)
                     finally:
                         tl.depth -= 1
+                    if any(e.get("errorCode") == ACCESS_DENIED_ERROR
+                           for e in inner.exceptions):
+                        # the denial must keep its identity end to end so
+                        # the REST layer returns 403, same as a direct query
+                        raise AccessDeniedError(
+                            f"IN_SUBQUERY inner query denied: "
+                            f"{inner.exceptions[0].get('message')}")
                     if inner.has_exceptions or inner.result_table is None \
                             or not inner.result_table.rows:
                         raise QueryError(

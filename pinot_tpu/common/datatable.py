@@ -216,7 +216,7 @@ class DataTable:
         elif t is ResponseType.GROUP_BY:
             groups = self.group_by_groups() if self.payload else {}
             _put_section(out, json.dumps(
-                self.payload.get("schema_types", {}),
+                (self.payload or {}).get("schema_types", {}),
                 separators=(",", ":")).encode("utf-8"))
             keys = list(groups.keys())
             vals = list(groups.values())

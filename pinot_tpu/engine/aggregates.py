@@ -82,6 +82,7 @@ _EMPTY: Dict[str, Any] = {
     # (time, value) of the chosen row, or None when no row matched yet
     "lastwithtime": None,
     "firstwithtime": None,
+    "stunion": "",  # WKT of the union-so-far ("" = nothing yet)
 }
 
 import decimal as _decimal
@@ -134,7 +135,19 @@ _MERGE: Dict[str, Callable[[Any, Any], Any]] = {
     else max(a, b),
     "firstwithtime": lambda a, b: b if a is None else a if b is None
     else min(a, b),
+    "stunion": lambda a, b: _stunion_merge(a, b),
 }
+
+
+def _stunion_merge(a: str, b: str) -> str:
+    from pinot_tpu.utils import geo
+
+    if not a:
+        return b
+    if not b:
+        return a
+    g = geo.union([geo.parse_ewkt(a), geo.parse_ewkt(b)])
+    return (geo.GEOG_PREFIX + g.wkt()) if g.geography else g.wkt()
 
 
 def _merge_counts(a: Dict, b: Dict) -> Dict:
@@ -223,6 +236,9 @@ _FINAL: Dict[str, Callable[[AggDef, Any], Any]] = {
     "idset": _final_idset,
     "lastwithtime": lambda d, s: _final_withtime(d, s),
     "firstwithtime": lambda d, s: _final_withtime(d, s),
+    # ref: StUnionAggregationFunction returns the serialized geometry; the
+    # framework's geometry wire form is (E)WKT text
+    "stunion": lambda d, s: s,
 }
 
 
@@ -366,8 +382,19 @@ def _host_withtime(d: AggDef, values, mask):
     return (chosen_time, v)
 
 
+def _host_stunion(d: AggDef, values, mask):
+    from pinot_tpu.utils import geo
+
+    vals = _raw_filtered(d, values, mask)
+    if not vals:
+        return ""
+    g = geo.union([geo.parse_ewkt(str(v)) for v in vals])
+    return (geo.GEOG_PREFIX + g.wkt()) if g.geography else g.wkt()
+
+
 _HOST: Dict[str, Callable] = {
     "count": _host_count,
+    "stunion": _host_stunion,
     "sum": _host_sum,
     "min": _host_min,
     "max": _host_max,
@@ -407,6 +434,7 @@ _RESULT_TYPE = {
     "idset": "STRING",
     "lastwithtime": "DOUBLE",  # overridden by the dataType argument
     "firstwithtime": "DOUBLE",
+    "stunion": "STRING",
 }
 
 # families with device kernels (kernels.py); others run on the host path
@@ -458,6 +486,7 @@ def resolve_agg(fn: Function) -> AggDef:
         "idset": "idset",
         "lastwithtime": "lastwithtime",
         "firstwithtime": "firstwithtime",
+        "stunion": "stunion", "st_union": "stunion",
     }.get(base_name)
     if family is None:
         raise UnsupportedQueryError(f"aggregation function {name!r} not supported")

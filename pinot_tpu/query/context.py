@@ -49,6 +49,8 @@ class AggregationFunctionType(Enum):
     IDSET = "idset"
     LASTWITHTIME = "lastwithtime"
     FIRSTWITHTIME = "firstwithtime"
+    STUNION = "stunion"
+    ST_UNION = "st_union"
     # MV variants
     COUNTMV = "countmv"
     SUMMV = "summv"

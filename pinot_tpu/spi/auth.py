@@ -32,8 +32,10 @@ class Principal:
 
     def allows(self, table: Optional[str], access_type: str) -> bool:
         """``table=None`` checks only permissions — callers that could not
-        resolve a table must fail closed themselves for scoped principals
-        (BrokerApi.query does)."""
+        resolve a table must fail closed themselves for scoped principals.
+        (The query route never passes None: the broker authorizes the
+        PARSED table, Broker.handle_sql; admin routes extract the table
+        from the route path/body, rest._Api._dispatch.)"""
         if self.permissions and access_type.upper() not in (
                 p.upper() for p in self.permissions):
             return False

@@ -64,17 +64,6 @@ class _Api:
                         self.send_header("Content-Length", "0")
                         self.end_headers()
                         return
-                    if path_only not in api.OPEN_PATHS:
-                        # method-level authorization: mutations need WRITE
-                        # (per-table scoping is enforced at the query route)
-                        from pinot_tpu.spi.auth import READ, WRITE
-
-                        access = READ if (method == "GET" or path_only
-                                          in api.READ_POSTS) else WRITE
-                        if not api.access_control.has_access(
-                                principal, None, access):
-                            self.send_error(403, "permission denied")
-                            return
                     api._principal_local.value = principal
                     body = None
                     n = int(self.headers.get("Content-Length") or 0)
@@ -85,6 +74,36 @@ class _Api:
                             continue
                         match = pat.fullmatch(self.path.split("?", 1)[0])
                         if match:
+                            if path_only not in api.OPEN_PATHS:
+                                # method-level authorization: mutations need
+                                # WRITE, scoped to the table the route acts
+                                # on — path captures name it for /tables/x,
+                                # /segments/x, /schemas/x; body-borne
+                                # mutations (POST /tables, /segments,
+                                # /schemas) name it in the payload (ref:
+                                # per-table auth on the segment/table
+                                # controller resources)
+                                from pinot_tpu.spi.auth import READ, WRITE
+
+                                access = READ if (method == "GET" or path_only
+                                                  in api.READ_POSTS) else WRITE
+                                table = (match.group(1) if pat.groups
+                                         else None)
+                                if table is None and isinstance(body, dict):
+                                    # route-aware: the auth scope must be
+                                    # the SAME name the handler mutates —
+                                    # schemas routes act on schemaName,
+                                    # table/segment routes on tableName (a
+                                    # mixed body must not authorize one
+                                    # name and mutate another)
+                                    table = (body.get("schemaName")
+                                             if path_only.startswith(
+                                                 "/schemas")
+                                             else body.get("tableName"))
+                                if not api.access_control.has_access(
+                                        principal, table, access):
+                                    self.send_error(403, "permission denied")
+                                    return
                             code, payload = fn(match, body)
                             if isinstance(payload, str):
                                 # text endpoints (/metrics prometheus, /ui)
@@ -140,18 +159,6 @@ class _Api:
     def stop(self) -> None:
         self._httpd.shutdown()
         self._httpd.server_close()
-
-
-_FROM_RE = re.compile(r'\bFROM\s+(?:"([^"]+)"|([A-Za-z_][\w.]*))', re.I)
-
-
-def _table_of_sql(sql: str) -> Optional[str]:
-    """Table name for authorization scoping (quoted or bare). A miss makes
-    table-scoped principals FAIL CLOSED at the query route — never open."""
-    m = _FROM_RE.search(sql or "")
-    if not m:
-        return None
-    return m.group(1) if m.group(1) is not None else m.group(2)
 
 
 class ControllerApi(_Api):
@@ -341,19 +348,18 @@ class BrokerApi(_Api):
         super().__init__(port, access_control=access_control)
 
         def query(m, body):
-            sql = (body or {}).get("sql", "")
-            table = _table_of_sql(sql)
-            from pinot_tpu.spi.auth import READ
+            from pinot_tpu.broker.broker import ACCESS_DENIED_ERROR
 
-            principal = self.current_principal()
-            scoped = bool(getattr(principal, "tables", None))
-            if (table is None and scoped) or not self.access_control \
-                    .has_access(principal, table, READ):
-                # unresolvable table + table-scoped principal fails CLOSED
-                return 403, {"exceptions": [
-                    f"Permission denied for table {table!r}"]}
-            resp = broker.handle_sql(sql)
-            return 200, resp.to_dict()
+            sql = (body or {}).get("sql", "")
+            # per-table authorization happens INSIDE the broker on the
+            # parsed query (and on every IN_SUBQUERY inner query) — a raw
+            # regex over the SQL is spoofable via string literals
+            resp = broker.handle_sql(sql,
+                                     principal=self.current_principal(),
+                                     access_control=self.access_control)
+            denied = any(e.get("errorCode") == ACCESS_DENIED_ERROR
+                         for e in resp.exceptions)
+            return (403 if denied else 200), resp.to_dict()
 
         self.route("POST", r"/query/sql", query)
         self.route("GET", r"/health", lambda m, b: (200, {"status": "OK"}))

@@ -3,8 +3,9 @@
 Re-design of the reference's theta-sketch aggregations
 (``DistinctCountThetaSketchAggregationFunction`` over the DataSketches
 library): a KMV (k minimum values) theta sketch — keep the k smallest 64-bit
-hashes seen; theta is the k-th smallest (as a fraction of hash space) and
-the distinct estimate is ``(retained - 1) / theta`` once sampling kicks in.
+hashes seen; theta is the (k+1)-th smallest (as a fraction of hash space),
+retained hashes stay strictly below it, and the distinct estimate is
+``retained / theta`` once sampling kicks in.
 
 TPU-shaped on purpose: updates are vectorized numpy (hash -> sort -> trim),
 and merge is a concatenate + k-smallest trim — both expressible as on-device
@@ -91,7 +92,10 @@ class ThetaSketch:
     def estimate(self) -> float:
         if self.theta >= 1.0:
             return float(self.hashes.size)  # exact below k
-        return (self.hashes.size - 1) / self.theta if self.hashes.size else 0.0
+        # standard theta estimator: retained / theta (every retained hash is
+        # strictly below theta by construction after _trim, so no -1 term —
+        # the (k-1)/theta form applies to theta = k-th smallest, not ours)
+        return self.hashes.size / self.theta if self.hashes.size else 0.0
 
     # -- wire ----------------------------------------------------------------
     def serialize(self) -> bytes:

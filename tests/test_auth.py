@@ -81,9 +81,17 @@ class TestRestGuard:
         SegmentBuilder(schema, "sales_0").build(frame, seg_dir)
         cluster.upload_segment_dir("sales_OFFLINE", f"{seg_dir}/sales_0")
         cluster.wait_for_ev_converged("sales_OFFLINE")
+        # a second real table so subquery-laundering tests can run an
+        # ALLOWED outer query probing a DENIED inner table
+        schema2 = Schema("sales2", schema.field_specs)
+        cluster.create_table(TableConfig(table_name="sales2"), schema2)
+        SegmentBuilder(schema2, "sales2_0").build(frame, seg_dir)
+        cluster.upload_segment_dir("sales2_OFFLINE", f"{seg_dir}/sales2_0")
+        cluster.wait_for_ev_converged("sales2_OFFLINE")
         ac = access_control_from_config({"type": "basic", "principals": [
             {"username": "admin", "password": "s3cret"},
             {"username": "scoped", "password": "pw", "tables": ["other"]},
+            {"username": "scoped2", "password": "pw", "tables": ["sales2"]},
         ]})
         api = BrokerApi(cluster.broker, access_control=ac)
         api.start()
@@ -118,4 +126,40 @@ class TestRestGuard:
     def test_scoped_principal_403(self, cluster):
         with pytest.raises(urllib.error.HTTPError) as e:
             self._query(cluster, _basic("scoped", "pw"))
+        assert e.value.code == 403
+
+    def test_subquery_access_denied_403(self, cluster):
+        """A table-scoped principal must not probe another table through
+        the IN_SUBQUERY rewrite — the inner query is authorized with the
+        OUTER principal and the denial keeps its 403 identity."""
+        import urllib.error
+        import urllib.request
+
+        sql = ("SELECT count(*) FROM sales2 WHERE "
+               "inSubquery(region, 'SELECT idset(region) FROM sales') = 1")
+        req = urllib.request.Request(
+            f"http://localhost:{cluster.port}/query/sql",
+            data=json.dumps({"sql": sql}).encode(),
+            headers={"Content-Type": "application/json",
+                     "Authorization": _basic("scoped2", "pw")})
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(req, timeout=10)
+        assert e.value.code == 403
+
+    def test_string_literal_from_cannot_spoof_table(self, cluster):
+        """ADVICE r4 high: 'SELECT ... FROM secret' hidden inside a string
+        literal must not authorize against the literal's table — the PARSED
+        table is what gets checked."""
+        import urllib.error
+        import urllib.request
+
+        sql = "SELECT 'x FROM other' FROM sales LIMIT 1"
+        req = urllib.request.Request(
+            f"http://localhost:{cluster.port}/query/sql",
+            data=json.dumps({"sql": sql}).encode(),
+            headers={"Content-Type": "application/json",
+                     "Authorization": _basic("scoped", "pw")})
+        # principal is scoped to 'other'; real table is 'sales' -> 403
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(req, timeout=10)
         assert e.value.code == 403

@@ -1,0 +1,170 @@
+"""Gapfill reduce + ST_UNION + distinctcount-MV parity.
+
+Refs: pinot-core/.../query/reduce/GapfillProcessor.java (dispatched from
+BrokerReduceService.java:44), StUnionAggregationFunction.java,
+DistinctCountMVAggregationFunction / DistinctCountHLLMVAggregationFunction.
+"""
+
+import numpy as np
+import pytest
+
+from pinot_tpu.engine import ServerQueryExecutor
+from pinot_tpu.query import compile_query
+from pinot_tpu.segment import SegmentBuilder, load_segment
+from pinot_tpu.spi import DataType, FieldSpec, FieldType, Schema
+from pinot_tpu.spi.table import TableConfig
+from pinot_tpu.tools.cluster import EmbeddedCluster
+
+
+@pytest.fixture(scope="module")
+def cluster(tmp_path_factory):
+    out = str(tmp_path_factory.mktemp("gapfill"))
+    schema = Schema("events", [
+        FieldSpec("bucket", DataType.INT),
+        FieldSpec("host", DataType.STRING),
+        FieldSpec("loc", DataType.STRING),
+        FieldSpec("tags", DataType.STRING, single_value=False),
+        FieldSpec("v", DataType.LONG, FieldType.METRIC),
+    ])
+    # buckets 0,10,30,40 present; 20 and 50 missing for host a; host b has
+    # only 10 and 20
+    frame = {
+        "bucket": [0, 10, 30, 40, 10, 20, 0, 30],
+        "host": ["a", "a", "a", "a", "b", "b", "a", "a"],
+        "loc": ["POINT (1 2)", "POINT (3 4)", "POINT (1 2)", "POINT (5 6)",
+                "POINT (7 8)", "POINT (7 8)", "POINT (9 9)", "POINT (3 4)"],
+        "tags": [["x", "y"], ["y"], ["z"], ["x"], ["x", "z"], ["y"],
+                 ["x"], ["q", "x"]],
+        "v": [1, 2, 3, 4, 5, 6, 7, 8],
+    }
+    cl = EmbeddedCluster(data_dir=out)
+    cl.create_table(TableConfig(table_name="events"), schema)
+    seg_dir = str(tmp_path_factory.mktemp("gapfill_seg"))
+    SegmentBuilder(schema, "events_0").build(frame, seg_dir)
+    cl.upload_segment_dir("events_OFFLINE", f"{seg_dir}/events_0")
+    assert cl.wait_for_ev_converged("events_OFFLINE")
+    yield cl, frame
+    cl.shutdown()
+
+
+class TestGapfill:
+    def test_default_fill(self, cluster):
+        cl, _ = cluster
+        resp = cl.query(
+            "SELECT gapfill(bucket, 0, 60, 10), sum(v) FROM events "
+            "WHERE host = 'a' GROUP BY gapfill(bucket, 0, 60, 10) "
+            "ORDER BY gapfill(bucket, 0, 60, 10) LIMIT 100")
+        assert not resp.exceptions, resp.exceptions
+        rows = resp.result_table.rows
+        assert [r[0] for r in rows] == [0, 10, 20, 30, 40, 50]
+        # present buckets keep sums; absent buckets fill 0
+        assert [r[1] for r in rows] == [8.0, 2.0, 0, 11.0, 4.0, 0]
+
+    def test_previous_fill_with_dims(self, cluster):
+        cl, _ = cluster
+        resp = cl.query(
+            "SELECT host, gapfill(bucket, 0, 40, 10, 'FILL_PREVIOUS_VALUE'),"
+            " sum(v) FROM events GROUP BY host, "
+            "gapfill(bucket, 0, 40, 10, 'FILL_PREVIOUS_VALUE') "
+            "ORDER BY host, gapfill(bucket, 0, 40, 10, "
+            "'FILL_PREVIOUS_VALUE') LIMIT 100")
+        assert not resp.exceptions, resp.exceptions
+        by_host = {}
+        for host, bucket, v in resp.result_table.rows:
+            by_host.setdefault(host, []).append((bucket, v))
+        # host b: bucket 0 absent with NO previous -> default 0; bucket 30
+        # absent -> carries bucket 20's value
+        assert sorted(by_host["b"]) == [(0, 0), (10, 5.0), (20, 6.0),
+                                        (30, 6.0)]
+        assert sorted(by_host["a"]) == [(0, 8.0), (10, 2.0), (20, 2.0),
+                                        (30, 11.0)]
+
+    def test_gapfill_requires_group_by(self, cluster):
+        cl, _ = cluster
+        resp = cl.query("SELECT gapfill(bucket, 0, 60, 10) FROM events "
+                        "LIMIT 5")
+        assert resp.exceptions
+
+    def test_misaligned_bucket_is_loud(self, cluster):
+        """A bucket off the start+k*step grid must error, not be silently
+        shadowed by a fabricated zero row."""
+        cl, _ = cluster
+        resp = cl.query(
+            "SELECT gapfill(bucket, 5, 60, 10), sum(v) FROM events "
+            "WHERE host = 'a' GROUP BY gapfill(bucket, 5, 60, 10) LIMIT 100")
+        assert resp.exceptions and "aligned" in resp.exceptions[0]["message"]
+
+    def test_reduce_trim_cannot_shadow_present_buckets(self, cluster):
+        """The default group-by LIMIT 10 (or any reduce-side trim) must NOT
+        make present buckets look absent — gapfill lifts the limit for the
+        reduce and trims AFTER filling. With no explicit LIMIT, the 6-bucket
+        window returns all present sums, never fabricated zeros over data."""
+        cl, _ = cluster
+        resp = cl.query(
+            "SELECT gapfill(bucket, 0, 60, 10), sum(v) FROM events "
+            "WHERE host = 'a' GROUP BY gapfill(bucket, 0, 60, 10) "
+            "ORDER BY sum(v) DESC")
+        assert not resp.exceptions, resp.exceptions
+        rows = resp.result_table.rows
+        # ORDER BY sum DESC over FILLED rows: real sums first, zeros last
+        assert [(r[0], r[1]) for r in rows] == [
+            (30, 11.0), (0, 8.0), (40, 4.0), (10, 2.0), (20, 0), (50, 0)]
+
+    def test_order_by_desc_applies_to_filled_rows(self, cluster):
+        cl, _ = cluster
+        resp = cl.query(
+            "SELECT gapfill(bucket, 0, 60, 10), sum(v) FROM events "
+            "WHERE host = 'a' GROUP BY gapfill(bucket, 0, 60, 10) "
+            "ORDER BY gapfill(bucket, 0, 60, 10) DESC LIMIT 3")
+        assert not resp.exceptions, resp.exceptions
+        # top-3 of the DESCENDING filled series: 50 (fabricated), 40, 30
+        assert [r[0] for r in resp.result_table.rows] == [50, 40, 30]
+        assert resp.result_table.rows[0][1] == 0
+
+
+class TestStUnion:
+    def test_scalar_union(self, cluster):
+        cl, frame = cluster
+        resp = cl.query("SELECT stunion(loc) FROM events WHERE host = 'a'")
+        assert not resp.exceptions, resp.exceptions
+        wkt = resp.result_table.rows[0][0]
+        assert wkt.startswith("MULTIPOINT")
+        # distinct points of host a, sorted
+        assert wkt == ("MULTIPOINT (1 2, 3 4, 5 6, 9 9)")
+
+    def test_grouped_union(self, cluster):
+        cl, _ = cluster
+        resp = cl.query("SELECT host, st_union(loc) FROM events "
+                        "GROUP BY host ORDER BY host")
+        assert not resp.exceptions, resp.exceptions
+        rows = resp.result_table.rows
+        assert rows[0][0] == "a"
+        assert rows[1] == ["b", "MULTIPOINT (7 8)"]
+
+
+class TestDistinctCountMV:
+    def test_distinctcountmv(self, cluster):
+        cl, frame = cluster
+        resp = cl.query("SELECT distinctcountmv(tags) FROM events")
+        assert not resp.exceptions, resp.exceptions
+        want = len({t for tags in frame["tags"] for t in tags})
+        assert resp.result_table.rows[0][0] == want
+
+    def test_distinctcountmv_grouped(self, cluster):
+        cl, frame = cluster
+        resp = cl.query("SELECT host, distinctcountmv(tags) FROM events "
+                        "GROUP BY host ORDER BY host")
+        assert not resp.exceptions, resp.exceptions
+        want = {}
+        for h, tags in zip(frame["host"], frame["tags"]):
+            want.setdefault(h, set()).update(tags)
+        assert resp.result_table.rows == [
+            ["a", len(want["a"])], ["b", len(want["b"])]]
+
+    def test_distinctcounthllmv(self, cluster):
+        cl, frame = cluster
+        resp = cl.query("SELECT distinctcounthllmv(tags) FROM events")
+        assert not resp.exceptions, resp.exceptions
+        want = len({t for tags in frame["tags"] for t in tags})
+        # HLL is exact at this tiny cardinality
+        assert resp.result_table.rows[0][0] == want

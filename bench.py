@@ -1,56 +1,503 @@
-"""Benchmark: TPU query path vs the host (numpy) execution path.
+"""Benchmark: SSB on the TPU query path vs an external CPU baseline.
+
+Architecture (round-5 redesign; round-4 postmortem: four fixed-timeout
+probes burned 640s, fell back to CPU, and the "146x" denominator was this
+framework's own host engine — a strawman):
+
+- **supervisor** (default entry): fights for the real chip across the WHOLE
+  time budget. It launches worker subprocesses that init the backend and run
+  the suites IN THAT PROCESS (a separate probe process leaves a gap the
+  flapping tunnel falls into); a worker whose backend init hangs self-kills
+  via a watchdog thread. Partial results stream to a JSONL file per
+  sub-suite, so a mid-run tunnel flap still leaves numbers. When the
+  remaining budget hits the CPU reserve, one forced-CPU worker fills in
+  whatever sub-suites the chip never served. Per-sub-suite ``backend`` tags
+  make any fallback LOUD in the output.
+- **worker** (``--worker``): builds/loads the SSB table (parallel segment
+  builder, manifest-keyed reuse across attempts), runs the sub-suites, and
+  appends one JSON line each to BENCH_RESULT_FILE.
 
 Workloads (BASELINE.json configs):
-- **SSB** (headline, config #5): flattened Star Schema Benchmark Q1.1-Q4.3
-  (pinot_tpu/tools/ssb.py; ref: contrib/pinot-druid-benchmark/README.md) over
-  a multi-segment table through the sharded device combine, parity-gated
-  against the host engine. Scale via BENCH_SSB_ROWS (default 3,000,000 —
-  SF 0.5; SF 1 = 6,000,000).
-- **micro** (configs #1/#2): the round-2/3 7-query suite (filtered
-  aggregations + dictionary group-bys, 8 x 131k rows) for cross-round
-  continuity.
-- **star-tree** (config #3): SUM/COUNT group-by served from StarTreeV2
-  pre-aggregated records vs the same query forced to scan.
-- **sketches** (config #4): DISTINCTCOUNTHLL + PERCENTILETDIGEST.
+- **SSB** (headline, config #5): Q1.1-Q4.3 over a multi-segment table via
+  the sharded device combine; p50 AND p99 per query; parity-gated against
+  the EXTERNAL pandas baseline (pinot_tpu/tools/ssb_baseline.py — the
+  vs_baseline denominator; ref harness pair:
+  contrib/pinot-druid-benchmark/README.md:1-60, pinot-perf BenchmarkQueryEngine).
+- **QPS** (ref: pinot-tools/.../perf/QueryRunner.java): closed-loop
+  multi-thread throughput + latency percentiles on three SSB flights.
+- **micro** (configs #1/#2): the round-2/3 7-query suite vs the host engine
+  (kept ONLY for cross-round continuity; not the headline baseline).
+- **star-tree** (config #3) and **sketches** (config #4).
+- **cluster**: 2-server broker scatter-gather over the full wire path.
 
-Prints ONE JSON line {"metric", "value", "unit", "vs_baseline", ...} where
-value is the device p50 SSB latency and vs_baseline is host/device (>1 =>
-the TPU path is faster). Sub-suite results ride in extra keys.
+Prints ONE JSON line {"metric", "value", "unit", "vs_baseline", ...}:
+value = device p50 SSB ms/query, vs_baseline = pandas_baseline / device.
 """
 
 from __future__ import annotations
 
 import json
 import os
+import subprocess
 import sys
 import tempfile
+import threading
 import time
 import traceback
 
 import numpy as np
 
-MICRO_SEGMENTS = 8
-MICRO_DOCS = 131_072
-SSB_ROWS = int(os.environ.get("BENCH_SSB_ROWS", 3_000_000))
+_T_START = time.time()
+TIME_BUDGET_S = float(os.environ.get("BENCH_TIME_BUDGET_S", 2100))
+CPU_RESERVE_S = float(os.environ.get("BENCH_CPU_RESERVE_S", 700))
+# SSB scale per backend: the chip takes SF >= 4; the CPU fallback keeps the
+# round-4 scale so cross-round numbers stay comparable
+TPU_SSB_ROWS = int(os.environ.get("BENCH_SSB_ROWS", 24_000_000))
+CPU_SSB_ROWS = int(os.environ.get("BENCH_CPU_SSB_ROWS", 3_000_000))
+NUM_SEGMENTS = int(os.environ.get("BENCH_SSB_SEGMENTS", 8))
+INIT_TIMEOUT_S = 150
 WARMUP = 1
 ITERS = 5
-# wall-clock budget: past this, remaining sub-suites are skipped so the
-# driver ALWAYS gets the headline JSON line even when first-compiles crawl
-# through a degraded TPU tunnel (round-4 postmortem: a healthy bench run
-# finishes in ~3 min on CPU; the tunnel added 20-40s per compile)
-# generous default: 4 failed tunnel probes alone burn ~640s before the CPU
-# fallback starts measuring, and the clock starts at import
-TIME_BUDGET_S = float(os.environ.get("BENCH_TIME_BUDGET_S", 2100))
-_T_START = time.time()
+
+SUITES = ("ssb", "qps", "micro", "startree", "sketches", "cluster")
 
 
-def _progress(msg: str) -> None:
+def _log(msg: str) -> None:
     print(f"bench[{time.time() - _T_START:7.1f}s] {msg}", file=sys.stderr,
           flush=True)
 
 
-def _over_budget() -> bool:
-    return time.time() - _T_START > TIME_BUDGET_S
+# ==========================================================================
+# supervisor
+# ==========================================================================
+
+def supervise() -> None:
+    deadline = _T_START + TIME_BUDGET_S
+    result_file = os.environ.get("BENCH_RESULT_FILE") or os.path.join(
+        tempfile.mkdtemp(prefix="bench_res_"), "results.jsonl")
+    data_dir = os.environ.get("BENCH_DATA_DIR") or tempfile.mkdtemp(
+        prefix="bench_data_")
+    results: dict = {}
+    tpu_attempts = 0
+
+    def merge() -> None:
+        try:
+            with open(result_file) as f:
+                for line in f:
+                    try:
+                        rec = json.loads(line)
+                    except ValueError:
+                        continue
+                    suite = rec.pop("suite", None)
+                    if suite is None:
+                        continue
+                    # a real-chip result is never overwritten by a CPU one
+                    if (suite in results
+                            and results[suite].get("backend") != "cpu"
+                            and rec.get("backend") == "cpu"):
+                        continue
+                    results[suite] = rec
+            open(result_file, "w").close()
+        except FileNotFoundError:
+            pass
+
+    def run_worker(backend: str, timeout: float, rows: int) -> int:
+        env = dict(os.environ,
+                   BENCH_RESULT_FILE=result_file,
+                   BENCH_DATA_DIR=data_dir,
+                   BENCH_WANT_BACKEND=backend,
+                   BENCH_WORKER_ROWS=str(rows),
+                   BENCH_WORKER_DEADLINE=str(deadline - (
+                       CPU_RESERVE_S if backend != "cpu" else 30)),
+                   BENCH_SKIP_SUITES=",".join(
+                       s for s, r in results.items()
+                       if r.get("backend") != "cpu" and "error" not in r))
+        _log(f"launching {backend} worker (timeout {timeout:.0f}s, "
+             f"rows {rows}, skip [{env['BENCH_SKIP_SUITES']}])")
+        try:
+            proc = subprocess.run(
+                [sys.executable, os.path.abspath(__file__), "--worker"],
+                timeout=max(timeout, 60), env=env)
+            return proc.returncode
+        except subprocess.TimeoutExpired:
+            _log(f"{backend} worker timed out")
+            return -1
+
+    while True:
+        remaining = deadline - time.time()
+        if remaining < CPU_RESERVE_S + 120:
+            break
+        tpu_attempts += 1
+        rc = run_worker("tpu", remaining - CPU_RESERVE_S, TPU_SSB_ROWS)
+        merge()
+        done_on_chip = [s for s in SUITES
+                        if results.get(s, {}).get("backend")
+                        not in (None, "cpu")
+                        and "error" not in results.get(s, {})]
+        _log(f"tpu attempt {tpu_attempts} rc={rc}; chip-served suites: "
+             f"{done_on_chip}")
+        if len(done_on_chip) == len(SUITES):
+            break
+        if rc in (3, 4):
+            # backend init hung / tunnel handed us no chip: wait a bit for
+            # the tunnel to flap back before burning another attempt
+            time.sleep(min(60, max(5, deadline - time.time()
+                                   - CPU_RESERVE_S - 60)))
+    merge()
+    missing = [s for s in SUITES if s not in results
+               or "error" in results[s]]
+    if missing:
+        _log(f"CPU reserve pass for {missing}")
+        run_worker("cpu", deadline - time.time() - 30, CPU_SSB_ROWS)
+        merge()
+    emit(results, tpu_attempts)
+
+
+def emit(results: dict, tpu_attempts: int) -> None:
+    ssb = results.get("ssb", {})
+    out = {
+        "metric": "ssb_suite_p50_latency",
+        "value": ssb.get("p50_ms_per_query"),
+        "unit": "ms/query",
+        "vs_baseline": ssb.get("vs_baseline"),
+        "backend": ssb.get("backend", "none"),
+        "baseline_engine": ssb.get("baseline_engine"),
+        "tpu_attempts": tpu_attempts,
+        "suite_backends": {s: results.get(s, {}).get("backend", "missing")
+                           for s in SUITES},
+    }
+    for s in SUITES:
+        if s in results:
+            out[s] = results[s]
+    print(json.dumps(out), flush=True)
+
+
+# ==========================================================================
+# worker
+# ==========================================================================
+
+def _init_backend(want: str) -> str:
+    if want == "cpu":
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        import jax
+
+        # the axon plugin overrides the env var; config wins
+        jax.config.update("jax_platforms", "cpu")
+        jax.devices()
+        return jax.default_backend()
+
+    ok = threading.Event()
+
+    def watchdog():
+        if not ok.wait(INIT_TIMEOUT_S):
+            print("bench worker: backend init hung; self-terminating",
+                  file=sys.stderr, flush=True)
+            os._exit(3)
+
+    threading.Thread(target=watchdog, daemon=True).start()
+    import jax
+
+    try:
+        jax.devices()
+    except Exception:
+        traceback.print_exc(file=sys.stderr)
+        os._exit(4)
+    ok.set()
+    backend = jax.default_backend()
+    if backend == "cpu":
+        os._exit(4)  # wanted the chip; the supervisor decides what's next
+    return backend
+
+
+class _Worker:
+    def __init__(self):
+        self.backend = _init_backend(os.environ["BENCH_WANT_BACKEND"])
+        self.rows = int(os.environ["BENCH_WORKER_ROWS"])
+        self.deadline = float(os.environ["BENCH_WORKER_DEADLINE"])
+        self.result_file = os.environ["BENCH_RESULT_FILE"]
+        self.data_dir = os.environ["BENCH_DATA_DIR"]
+        self.skip = set(filter(None,
+                               os.environ.get("BENCH_SKIP_SUITES", "")
+                               .split(",")))
+        from pinot_tpu.engine import ServerQueryExecutor
+        from pinot_tpu.parallel import ShardedQueryExecutor
+
+        self.dev = ShardedQueryExecutor()
+        self.host = ServerQueryExecutor(use_device=False)
+        self.ssb_segs = None
+        self.build_s = 0.0
+
+    def over(self, need: float = 30.0) -> bool:
+        return time.time() + need > self.deadline
+
+    def record(self, suite: str, rec: dict) -> None:
+        rec = dict(rec, suite=suite, backend=rec.get("backend", self.backend))
+        with open(self.result_file, "a") as f:
+            f.write(json.dumps(rec) + "\n")
+            f.flush()
+            os.fsync(f.fileno())
+        _log(f"recorded {suite}: {rec.get('p50_ms_per_query', '')}")
+
+    def run(self) -> None:
+        for suite, fn in (("ssb", self.bench_ssb),
+                          ("qps", self.bench_qps),
+                          ("micro", self.bench_micro),
+                          ("startree", self.bench_startree),
+                          ("sketches", self.bench_sketches),
+                          ("cluster", self.bench_cluster)):
+            if suite in self.skip:
+                _log(f"{suite}: already chip-served, skipping")
+                continue
+            if self.over(60):
+                _log(f"{suite}: budget exhausted, stopping worker")
+                break
+            try:
+                self.record(suite, fn())
+            except Exception as exc:
+                traceback.print_exc(file=sys.stderr)
+                self.record(suite, {
+                    "error": f"{type(exc).__name__}: {exc}"[:300]})
+
+    # -- data ---------------------------------------------------------------
+    def segments(self):
+        from pinot_tpu.segment import load_segment
+        from pinot_tpu.tools import ssb
+
+        if self.ssb_segs is not None:
+            return self.ssb_segs
+        manifest = os.path.join(self.data_dir, "manifest.json")
+        want = {"rows": self.rows, "segments": NUM_SEGMENTS}
+        have = None
+        try:
+            with open(manifest) as f:
+                have = json.load(f)
+        except (FileNotFoundError, ValueError):
+            pass
+        if have == want:
+            _log(f"loading {NUM_SEGMENTS} prebuilt SSB segments")
+            self.ssb_segs = [
+                load_segment(os.path.join(self.data_dir, f"ssb_{i}"))
+                for i in range(NUM_SEGMENTS)]
+            self.build_s = 0.0
+        else:
+            _log(f"building SSB segments ({self.rows} rows, "
+                 f"{NUM_SEGMENTS} segments, {os.cpu_count()} cpus)")
+            t0 = time.perf_counter()
+            self.ssb_segs = ssb.build_segments(
+                0, self.data_dir, num_segments=NUM_SEGMENTS, rows=self.rows)
+            self.build_s = time.perf_counter() - t0
+            with open(manifest, "w") as f:
+                json.dump(want, f)
+            _log(f"built in {self.build_s:.1f}s")
+        return self.ssb_segs
+
+    def baseline_frame(self):
+        from pinot_tpu.tools import ssb, ssb_baseline
+
+        return ssb_baseline.make_frame(
+            ssb.generate_table(NUM_SEGMENTS, self.rows))
+
+    # -- sub-suites ---------------------------------------------------------
+    def bench_ssb(self) -> dict:
+        from pinot_tpu.query import compile_query
+        from pinot_tpu.tools import ssb, ssb_baseline
+
+        segs = self.segments()
+        # explicit LIMIT: the engine applies the reference's default
+        # group-by LIMIT 10 otherwise, and the baseline computes FULL
+        # group sets (the SSB flights' intended result)
+        ctxs = {qid: compile_query(q + " LIMIT 100000")
+                for qid, q in ssb.QUERIES.items()}
+
+        _log("ssb: pandas baseline (build frame)")
+        df = self.baseline_frame()
+        base_ms = {}
+        parity_fail = []
+        for qid, ctx in ctxs.items():
+            _log(f"ssb {qid}: baseline + device compile + parity")
+            want = ssb_baseline.run_query(df, qid)
+            t0 = time.perf_counter()
+            want = ssb_baseline.run_query(df, qid)
+            base_ms[qid] = (time.perf_counter() - t0) * 1e3
+            got, _ = self.dev.execute(ctx, segs)   # compiles + warms
+            if not ssb_baseline.rows_match(got.rows, want, rel=1e-6):
+                parity_fail.append(qid)
+        if parity_fail:
+            raise AssertionError(f"SSB parity vs pandas failed: "
+                                 f"{parity_fail}")
+
+        per_q50, per_q99 = {}, {}
+        for qid, ctx in ctxs.items():
+            _log(f"ssb {qid}: timing device path")
+            samples = []
+            for _ in range(WARMUP):
+                self.dev.execute(ctx, segs)
+            for _ in range(ITERS):
+                t0 = time.perf_counter()
+                self.dev.execute(ctx, segs)
+                samples.append((time.perf_counter() - t0) * 1e3)
+            per_q50[qid] = float(np.percentile(samples, 50))
+            per_q99[qid] = float(np.percentile(samples, 99))
+        n = len(ctxs)
+        dev50 = sum(per_q50.values()) / n
+        base50 = sum(base_ms.values()) / n
+        return {
+            "rows": self.rows,
+            "sf": round(self.rows / ssb.ROWS_PER_SF, 3),
+            "build_s": round(self.build_s, 1),
+            "p50_ms_per_query": round(dev50, 3),
+            "p99_ms_per_query": round(sum(per_q99.values()) / n, 3),
+            "vs_baseline": round(base50 / dev50, 3),
+            "baseline_engine": "pandas-vectorized-categorical",
+            "baseline_ms_per_query": round(base50, 2),
+            "per_query_ms": {q: round(v, 2) for q, v in per_q50.items()},
+            "per_query_p99_ms": {q: round(v, 2) for q, v in per_q99.items()},
+            "pallas_kernels": len(self.dev._pallas_sharded),
+            "parity": "ok",
+        }
+
+    def bench_qps(self) -> dict:
+        """Closed-loop multi-thread throughput (ref: QueryRunner.java
+        multiThreadedQueryRunner: numThreads issuing back-to-back, report
+        QPS + latency percentiles)."""
+        import concurrent.futures
+
+        from pinot_tpu.query import compile_query
+        from pinot_tpu.tools import ssb
+
+        segs = self.segments()
+        qids = ("Q1.1", "Q2.1", "Q3.2")
+        ctxs = [compile_query(ssb.QUERIES[q] + " LIMIT 100000")
+                for q in qids]
+        for ctx in ctxs:
+            self.dev.execute(ctx, segs)   # compile/warm
+        seconds = 8.0
+        threads = 4
+        lat: list = []
+        lock = threading.Lock()
+        stop_at = time.perf_counter() + seconds
+
+        def pump(i: int) -> int:
+            done = 0
+            while time.perf_counter() < stop_at:
+                ctx = ctxs[(i + done) % len(ctxs)]
+                t0 = time.perf_counter()
+                self.dev.execute(ctx, segs)
+                dt = (time.perf_counter() - t0) * 1e3
+                with lock:
+                    lat.append(dt)
+                done += 1
+            return done
+
+        t0 = time.perf_counter()
+        with concurrent.futures.ThreadPoolExecutor(threads) as pool:
+            total = sum(pool.map(pump, range(threads)))
+        wall = time.perf_counter() - t0
+        arr = np.asarray(lat)
+        return {
+            "queries": list(qids), "threads": threads,
+            "qps": round(total / wall, 2),
+            "p50_ms": round(float(np.percentile(arr, 50)), 3),
+            "p95_ms": round(float(np.percentile(arr, 95)), 3),
+            "p99_ms": round(float(np.percentile(arr, 99)), 3),
+        }
+
+    def bench_micro(self) -> dict:
+        from pinot_tpu.query import compile_query
+
+        tmp = tempfile.mkdtemp(prefix="bench_micro_", dir=self.data_dir)
+        segs = _build_micro(tmp)
+        ctxs = [compile_query(q) for q in MICRO_QUERIES]
+        for ctx in ctxs:
+            drt, _ = self.dev.execute(ctx, segs)
+            hrt, _ = self.host.execute(ctx, segs)
+            _assert_parity(ctx.sql, drt.rows, hrt.rows)
+        # r2/r3 methodology (WARMUP=2/ITERS=7 both sides) for cross-round
+        # comparability of the micro number
+        dev_p50, _ = _time_suite(lambda c: self.dev.execute(c, segs),
+                                 ctxs, iters=7, warmup=2)
+        host_p50, _ = _time_suite(lambda c: self.host.execute(c, segs),
+                                  ctxs, iters=7, warmup=2)
+        return {"p50_ms_per_query": round(dev_p50 / len(ctxs) * 1e3, 3),
+                "vs_host_engine": round(host_p50 / dev_p50, 3)}
+
+    def bench_startree(self) -> dict:
+        from pinot_tpu.query import compile_query
+
+        tmp = tempfile.mkdtemp(prefix="bench_st_", dir=self.data_dir)
+        segs = _build_startree(tmp)
+        self._st_segs = segs
+        st_ctx = compile_query(STARTREE_QUERY)
+        st_rt, st_stats = self.dev.execute(st_ctx, segs)
+        scan_ctx = compile_query(STARTREE_QUERY
+                                 + " OPTION(useStarTree=false)")
+        scan_rt, _ = self.dev.execute(scan_ctx, segs)
+        _assert_parity("startree", st_rt.rows, scan_rt.rows)
+        st_p50, _ = _time_suite(lambda c: self.dev.execute(c, segs),
+                                [st_ctx])
+        scan_p50, _ = _time_suite(lambda c: self.dev.execute(c, segs),
+                                  [scan_ctx])
+        return {"ms": round(st_p50 * 1e3, 3),
+                "scan_ms": round(scan_p50 * 1e3, 3),
+                "docs_scanned": st_stats.num_docs_scanned}
+
+    def bench_sketches(self) -> dict:
+        from pinot_tpu.query import compile_query
+
+        segs = getattr(self, "_st_segs", None)
+        if segs is None:
+            tmp = tempfile.mkdtemp(prefix="bench_sk_", dir=self.data_dir)
+            segs = _build_startree(tmp)
+        ctxs = [compile_query(q) for q in SKETCH_QUERIES]
+        for ctx in ctxs:
+            self.dev.execute(ctx, segs)
+        p50, _ = _time_suite(lambda c: self.dev.execute(c, segs), ctxs,
+                             iters=3)
+        return {"p50_ms_per_query": round(p50 / len(ctxs) * 1e3, 3)}
+
+    def bench_cluster(self) -> dict:
+        """SSB through the FULL distributed path: broker parse -> routing ->
+        2-server scatter -> DataTable wire -> broker reduce (BASELINE
+        config #5's distributed half)."""
+        from pinot_tpu.spi.table import TableConfig
+        from pinot_tpu.tools import ssb
+        from pinot_tpu.tools.cluster import EmbeddedCluster
+
+        cluster = EmbeddedCluster(
+            num_servers=2, data_dir=os.path.join(self.data_dir, "cluster"))
+        try:
+            cluster.create_table(TableConfig("ssb_lineorder"),
+                                 ssb.ssb_schema())
+            rows = min(self.rows, 500_000)
+            seg_dir = os.path.join(self.data_dir, "cluster_segs")
+            ssb.build_segments(0, seg_dir, num_segments=4, rows=rows)
+            for i in range(4):
+                cluster.upload_segment_dir(
+                    "ssb_lineorder_OFFLINE", f"{seg_dir}/ssb_{i}")
+            assert cluster.wait_for_ev_converged("ssb_lineorder_OFFLINE"), \
+                "external view did not converge: refusing a partial bench"
+            queries = [ssb.QUERIES[q] for q in ("Q1.1", "Q2.1", "Q4.2")]
+            for q in queries:
+                cluster.query(q)
+            t0 = time.perf_counter()
+            iters = 5
+            for _ in range(iters):
+                for q in queries:
+                    resp = cluster.query(q)
+                    assert not resp.exceptions, resp.exceptions
+            per = (time.perf_counter() - t0) / (iters * len(queries))
+            return {"rows": rows, "servers": 2,
+                    "p50_ms_per_query": round(per * 1e3, 3)}
+        finally:
+            cluster.shutdown()
+
+
+# ==========================================================================
+# micro/star-tree fixtures (configs #1-#4; unchanged from round 4)
+# ==========================================================================
+
+MICRO_SEGMENTS = 8
+MICRO_DOCS = 131_072
 
 MICRO_QUERIES = [
     "SELECT count(*), sum(qty) FROM sales WHERE region = 'east'",
@@ -142,7 +589,6 @@ def _assert_parity(name, dev_rows, host_rows):
     for dr, hr in zip(dev_rows, host_rows):
         for d, h in zip(dr, hr):
             if isinstance(h, float):
-                # device float aggregation is f32/f64 mixed; host is f64
                 assert abs(d - h) <= 1e-4 * max(1.0, abs(h)), (name, d, h)
             else:
                 assert d == h, (name, d, h)
@@ -163,207 +609,14 @@ def _time_suite(run, ctxs, iters=ITERS, warmup=WARMUP):
             float(np.percentile(samples, 99)))
 
 
-def _init_backend() -> str:
-    """Initialize a jax backend, surviving TPU-tunnel failures.
-
-    Round-1 postmortem: the bench's single shot at real hardware died in
-    ``jax.devices()`` and captured nothing — and backend init can either
-    raise (UNAVAILABLE) or hang outright, so the probe must run in a
-    subprocess with a hard timeout. If the preferred backend fails twice,
-    fall back to the host platform so a number is always produced (the
-    output records which backend ran)."""
-    import subprocess
-
-    # round-4 postmortem: tunnel health OSCILLATES — init sometimes hangs
-    # for minutes then recovers, so be patient before giving up on the chip
-    for attempt in range(4):
-        try:
-            probe = subprocess.run(
-                [sys.executable, "-c",
-                 "import jax; jax.devices(); print(jax.default_backend())"],
-                capture_output=True, text=True, timeout=150)
-            if probe.returncode == 0:
-                break
-            print(f"bench: backend probe {attempt + 1} failed:\n"
-                  f"{probe.stderr[-500:]}", file=sys.stderr)
-        except subprocess.TimeoutExpired:
-            print(f"bench: backend probe {attempt + 1} timed out",
-                  file=sys.stderr)
-        time.sleep(10.0)
-    else:
-        print("bench: falling back to CPU host platform", file=sys.stderr)
-        os.environ["JAX_PLATFORMS"] = "cpu"
-
-        import jax
-
-        jax.config.update("jax_platforms", "cpu")
-
-    import jax
-
-    jax.devices()
-    return jax.default_backend()
-
+# ==========================================================================
 
 def main() -> None:
-    backend = _init_backend()
-
-    from pinot_tpu.engine import ServerQueryExecutor
-    from pinot_tpu.parallel import ShardedQueryExecutor
-    from pinot_tpu.query import compile_query
-    from pinot_tpu.tools import ssb
-
-    tmpdir = tempfile.mkdtemp(prefix="bench_segs_")
-    device_ex = ShardedQueryExecutor()
-    host_ex = ServerQueryExecutor(use_device=False)
-
-    result = {"metric": "ssb_suite_p50_latency", "unit": "ms/query",
-              "backend": backend}
-
-    # ---- SSB (headline) --------------------------------------------------
-    _progress(f"building SSB segments ({SSB_ROWS} rows)")
-    t0 = time.perf_counter()
-    ssb_segs = ssb.build_segments(0, tmpdir, num_segments=8, rows=SSB_ROWS)
-    build_s = time.perf_counter() - t0
-    ssb_ctxs = {qid: compile_query(q) for qid, q in ssb.QUERIES.items()}
-
-    host_times = {}
-    for qid, ctx in ssb_ctxs.items():
-        _progress(f"SSB {qid}: device compile+run / host / parity")
-        dev_rt, _ = device_ex.execute(ctx, ssb_segs)
-        host_rt, _ = host_ex.execute(ctx, ssb_segs)  # warmup (symmetric)
-        _assert_parity(qid, dev_rt.rows, host_rt.rows)
-        p50, _ = _time_suite(lambda c: host_ex.execute(c, ssb_segs),
-                             [ctx], iters=1, warmup=0)
-        host_times[qid] = p50
-
-    per_query = {}
-    for qid, ctx in ssb_ctxs.items():
-        _progress(f"SSB {qid}: timing device path")
-        p50, _ = _time_suite(lambda c: device_ex.execute(c, ssb_segs),
-                             [ctx], iters=ITERS, warmup=WARMUP)
-        per_query[qid] = p50
-    dev_suite = sum(per_query.values())
-    host_suite = sum(host_times.values())
-    n = len(ssb_ctxs)
-    result["value"] = round(dev_suite / n * 1e3, 3)
-    result["vs_baseline"] = round(host_suite / dev_suite, 3)
-    result["ssb"] = {
-        "rows": SSB_ROWS,
-        "sf": round(SSB_ROWS / ssb.ROWS_PER_SF, 3),
-        "build_s": round(build_s, 1),
-        "host_ms_per_query": round(host_suite / n * 1e3, 1),
-        "per_query_ms": {q: round(v * 1e3, 1) for q, v in per_query.items()},
-        "pallas_kernels": len(device_ex._pallas_sharded),
-    }
-
-    # ---- micro suite (configs #1/#2, cross-round continuity) -------------
-    if _over_budget():
-        _progress("time budget exhausted after SSB: emitting headline only")
-        result["truncated"] = "time budget: micro/startree/sketches skipped"
-        print(json.dumps(result))
+    if "--worker" in sys.argv:
+        _Worker().run()
         return
-    _progress("micro suite")
-    micro_segs = _build_micro(tmpdir)
-    micro_ctxs = [compile_query(q) for q in MICRO_QUERIES]
-    for ctx in micro_ctxs:
-        dev_rt, _ = device_ex.execute(ctx, micro_segs)
-        host_rt, _ = host_ex.execute(ctx, micro_segs)
-        _assert_parity(ctx.sql, dev_rt.rows, host_rt.rows)
-    # r2/r3 methodology (WARMUP=2/ITERS=7 BOTH sides) for cross-round
-    # comparability of the micro number
-    dev_p50, _ = _time_suite(lambda c: device_ex.execute(c, micro_segs),
-                             micro_ctxs, iters=7, warmup=2)
-    host_p50, _ = _time_suite(lambda c: host_ex.execute(c, micro_segs),
-                              micro_ctxs, iters=7, warmup=2)
-    result["micro"] = {
-        "p50_ms_per_query": round(dev_p50 / len(micro_ctxs) * 1e3, 3),
-        "vs_baseline": round(host_p50 / dev_p50, 3),
-    }
-
-    # ---- star-tree + sketches (configs #3/#4) ----------------------------
-    if _over_budget():
-        _progress("time budget exhausted after micro: emitting result")
-        result["truncated"] = "time budget: startree/sketches skipped"
-        print(json.dumps(result))
-        return
-    _progress("star-tree + sketches")
-    st_segs = _build_startree(tmpdir)
-    st_ctx = compile_query(STARTREE_QUERY)
-    st_rt, st_stats = device_ex.execute(st_ctx, st_segs)
-    scan_ctx = compile_query(STARTREE_QUERY + " OPTION(useStarTree=false)")
-    scan_rt, _ = device_ex.execute(scan_ctx, st_segs)
-    _assert_parity("startree", st_rt.rows, scan_rt.rows)
-    st_p50, _ = _time_suite(lambda c: device_ex.execute(c, st_segs), [st_ctx])
-    scan_p50, _ = _time_suite(lambda c: device_ex.execute(c, st_segs),
-                              [scan_ctx])
-    result["startree"] = {
-        "ms": round(st_p50 * 1e3, 3),
-        "scan_ms": round(scan_p50 * 1e3, 3),
-        "docs_scanned": st_stats.num_docs_scanned,
-    }
-
-    sk_ctxs = [compile_query(q) for q in SKETCH_QUERIES]
-    for ctx in sk_ctxs:
-        device_ex.execute(ctx, st_segs)
-    sk_p50, _ = _time_suite(lambda c: device_ex.execute(c, st_segs), sk_ctxs,
-                            iters=3)
-    result["sketches"] = {
-        "p50_ms_per_query": round(sk_p50 / len(sk_ctxs) * 1e3, 3)}
-
-    # ---- broker scatter-gather (BASELINE config #5's distributed half) ---
-    if not _over_budget():
-        _progress("broker scatter-gather")
-        try:
-            result["cluster"] = _bench_cluster(tmpdir)
-        except Exception as exc:  # sub-suite must not sink the headline
-            traceback.print_exc(file=sys.stderr)
-            result["cluster"] = {"error": f"{type(exc).__name__}: {exc}"[:200]}
-
-    print(json.dumps(result))
-
-
-def _bench_cluster(tmpdir: str) -> dict:
-    """SSB queries through the FULL distributed path: broker parse ->
-    routing -> 2-server scatter -> per-server execution -> DataTable wire
-    -> broker reduce (ref: BASELINE config #5 'multi-segment CombineOperator
-    + broker scatter-gather reduce')."""
-    from pinot_tpu.segment import SegmentBuilder  # noqa: F401 (env check)
-    from pinot_tpu.spi.table import TableConfig
-    from pinot_tpu.tools import ssb
-    from pinot_tpu.tools.cluster import EmbeddedCluster
-
-    cluster = EmbeddedCluster(num_servers=2,
-                              data_dir=f"{tmpdir}/bench_cluster")
     try:
-        schema = ssb.ssb_schema()
-        cluster.create_table(TableConfig("ssb_lineorder"), schema)
-        rows = min(SSB_ROWS, 500_000)
-        seg_dir = f"{tmpdir}/bench_cluster_segs"
-        ssb.build_segments(0, seg_dir, num_segments=4, rows=rows)
-        for i in range(4):
-            cluster.upload_segment_dir(
-                "ssb_lineorder_OFFLINE", f"{seg_dir}/ssb_{i}")
-        assert cluster.wait_for_ev_converged("ssb_lineorder_OFFLINE"), \
-            "external view did not converge: refusing to bench partial data"
-        queries = [ssb.QUERIES[q] for q in ("Q1.1", "Q2.1", "Q4.2")]
-        for q in queries:  # warmup/compile
-            cluster.query(q)
-        t0 = time.perf_counter()
-        iters = 5
-        for _ in range(iters):
-            for q in queries:
-                resp = cluster.query(q)
-                assert not resp.exceptions, resp.exceptions
-        per_query = (time.perf_counter() - t0) / (iters * len(queries))
-        return {"rows": rows, "servers": 2,
-                "p50_ms_per_query": round(per_query * 1e3, 3)}
-    finally:
-        cluster.shutdown()
-
-
-if __name__ == "__main__":
-    try:
-        main()
+        supervise()
     except Exception as exc:  # never leave the round without a JSON line
         traceback.print_exc(file=sys.stderr)
         print(json.dumps({
@@ -374,3 +627,7 @@ if __name__ == "__main__":
             "error": f"{type(exc).__name__}: {exc}"[:500],
         }))
         sys.exit(0)
+
+
+if __name__ == "__main__":
+    main()

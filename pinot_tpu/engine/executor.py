@@ -232,14 +232,38 @@ class ServerQueryExecutor:
     def _execute_aggregation(self, ctx: QueryContext, aggs: List[AggDef],
                              segments: List[ImmutableSegment],
                              stats: QueryStats) -> AggResult:
+        parts = self._map_segments(
+            lambda seg, st: self._segment_aggregation(ctx, aggs, seg, st),
+            segments, stats)
         merged: Optional[AggResult] = None
-        for seg in segments:
-            part = self._segment_aggregation(ctx, aggs, seg, stats)
+        for part in parts:
             if merged is None:
                 merged = part
             else:
                 merged.merge(part, aggs)
         return merged
+
+    def _map_segments(self, fn, segments: List[ImmutableSegment],
+                      stats: QueryStats) -> List[Any]:
+        """Per-segment execution, threaded when it can pay off (ref: the
+        reference's combine runs segment plans on an executor pool,
+        BaseCombineOperator.java:55). The numpy-heavy host families (sketch
+        builds, sorts, percentiles) release the GIL, so segments overlap on
+        multi-core servers; each task gets a private QueryStats merged
+        in-order afterwards (QueryStats mutation is not thread-safe)."""
+        import os
+
+        workers = min(len(segments), os.cpu_count() or 1, 8)
+        if workers <= 1 or len(segments) <= 1:
+            return [fn(seg, stats) for seg in segments]
+        from concurrent.futures import ThreadPoolExecutor
+
+        locals_ = [QueryStats() for _ in segments]
+        with ThreadPoolExecutor(workers) as pool:
+            parts = list(pool.map(fn, segments, locals_))
+        for st in locals_:
+            stats.merge(st)
+        return parts
 
     def _segment_aggregation(self, ctx: QueryContext, aggs: List[AggDef],
                              seg: ImmutableSegment,
@@ -329,8 +353,9 @@ class ServerQueryExecutor:
                           segments: List[ImmutableSegment],
                           stats: QueryStats) -> GroupByResult:
         merged = GroupByResult()
-        for seg in segments:
-            part = self._segment_group_by(ctx, aggs, seg, stats)
+        for part in self._map_segments(
+                lambda seg, st: self._segment_group_by(ctx, aggs, seg, st),
+                segments, stats):
             merged.merge(part, aggs)
         return merged
 

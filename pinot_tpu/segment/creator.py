@@ -48,6 +48,23 @@ from pinot_tpu.utils.partition import get_partition_function
 COLUMNS_DIR = "columns"
 
 
+def _sorted_factorize(arr: np.ndarray):
+    """(sorted unique values, int64 dictIds) for a flat value array.
+
+    Hash-based ``pd.factorize`` + a cardinality-sized sort: O(n + k log k)
+    vs the O(n log n) full-column sort of ``np.unique(return_inverse=True)``
+    — the segment-build hot spot at SSB scale (profiling: ~70% of build
+    time was argsorting 375k-row string columns whose cardinality is 25)."""
+    import pandas as pd
+
+    codes, uniq = pd.factorize(arr, use_na_sentinel=False)
+    uniq = np.asarray(uniq)
+    order = np.argsort(uniq, kind="stable")
+    rank = np.empty(order.shape[0], dtype=np.int64)
+    rank[order] = np.arange(order.shape[0], dtype=np.int64)
+    return uniq[order], rank[codes]
+
+
 def compute_dir_crc(col_dir: str) -> int:
     """CRC over all index files in canonical (sorted-filename) order, for
     refresh detection (ref: creation.meta CRC, V1Constants.java:56).
@@ -391,16 +408,14 @@ class SegmentBuilder:
 
         if fs.data_type.is_numeric:
             flat_arr = np.asarray(flat, dtype=fs.data_type.stored_np)
-            dict_values = np.unique(flat_arr)  # sorted unique
+            dict_values, dict_ids_flat = _sorted_factorize(flat_arr)
             dictionary = build_dictionary(dict_values, fs.data_type)
-            dict_ids_flat = np.searchsorted(dict_values, flat_arr).astype(np.int64)
         elif isinstance(flat, np.ndarray):
             # vectorized string dictionary build (numpy sorts ASCII the
             # same way python does)
-            uniq_arr, dict_ids_flat = np.unique(flat, return_inverse=True)
+            uniq_arr, dict_ids_flat = _sorted_factorize(flat)
             dictionary = build_dictionary([str(v) for v in uniq_arr],
                                           fs.data_type)
-            dict_ids_flat = dict_ids_flat.astype(np.int64)
         else:
             uniq = sorted(set(flat))
             dictionary = build_dictionary(uniq, fs.data_type)

@@ -79,12 +79,9 @@ def _geo(rng: np.random.Generator, n: int):
     return regions, nations, cities
 
 
-def generate_flat(sf: float, seed: int = 42,
-                  rows: int = 0) -> Dict[str, np.ndarray]:
-    """Flattened lineorder columns, ``rows or int(sf * ROWS_PER_SF)`` rows."""
-    n = rows or int(sf * ROWS_PER_SF)
-    rng = np.random.default_rng(seed)
-
+def _flat_columns(rng: np.random.Generator, n: int) -> Dict[str, np.ndarray]:
+    """Every flat column except d_year/d_yearmonthnum (callers draw those:
+    globally uniform, or restricted to a segment's time window)."""
     quantity = rng.integers(1, 51, n).astype(np.int64)
     discount = rng.integers(0, 11, n).astype(np.int64)
     # dbgen: extendedprice = quantity * part price (905..~111k cents)
@@ -92,10 +89,6 @@ def generate_flat(sf: float, seed: int = 42,
     extended = (quantity * price).astype(np.int64)
     revenue = (extended * (100 - discount) // 100).astype(np.int64)
     supplycost = rng.integers(540, 66_600, n).astype(np.int64)
-
-    year = rng.integers(1992, 1999, n).astype(np.int64)
-    month = rng.integers(1, 13, n).astype(np.int64)
-    ymnum = year * 100 + month
     week = rng.integers(1, 54, n).astype(np.int64)
 
     c_region, c_nation, c_city = _geo(rng, n)
@@ -117,37 +110,113 @@ def generate_flat(sf: float, seed: int = 42,
         "lo_quantity": quantity, "lo_discount": discount,
         "lo_extendedprice": extended, "lo_revenue": revenue,
         "lo_supplycost": supplycost,
-        "d_year": year, "d_yearmonthnum": ymnum, "d_weeknuminyear": week,
+        "d_weeknuminyear": week,
         "c_region": c_region, "c_nation": c_nation, "c_city": c_city,
         "s_region": s_region, "s_nation": s_nation, "s_city": s_city,
         "p_mfgr": p_mfgr, "p_category": p_category, "p_brand1": p_brand1,
     }
 
 
-def build_segments(sf: float, out_dir: str, num_segments: int = 8,
-                   seed: int = 42, rows: int = 0) -> List:
-    """Build + load ``num_segments`` SSB segments (row-range sliced)."""
-    from pinot_tpu.segment import SegmentBuilder, load_segment
+def generate_flat(sf: float, seed: int = 42,
+                  rows: int = 0) -> Dict[str, np.ndarray]:
+    """Flattened lineorder columns, ``rows or int(sf * ROWS_PER_SF)`` rows."""
+    n = rows or int(sf * ROWS_PER_SF)
+    rng = np.random.default_rng(seed)
+    cols = _flat_columns(rng, n)
+    year = rng.integers(1992, 1999, n).astype(np.int64)
+    month = rng.integers(1, 13, n).astype(np.int64)
+    cols["d_year"] = year
+    cols["d_yearmonthnum"] = year * 100 + month
+    return cols
 
-    cols = generate_flat(sf, seed=seed, rows=rows)
-    # time-slice the table (real Pinot segments are time-bounded): rows
-    # sorted by order month before slicing, so each segment covers a
-    # contiguous d_yearmonthnum range and time-selective SSB flights
-    # (Q1.x) exercise the server-side min/max pruner
-    order = np.argsort(cols["d_yearmonthnum"], kind="stable")
-    cols = {k: v[order] for k, v in cols.items()}
-    n = cols["lo_quantity"].shape[0]
-    schema = ssb_schema()
-    segs = []
-    per = -(-n // num_segments)
+
+_ALL_MONTHS = [y * 100 + m for y in range(1992, 1999) for m in range(1, 13)]
+
+
+def _segment_months(i: int, num_segments: int) -> List[int]:
+    """Contiguous d_yearmonthnum window for segment ``i`` (84 months split
+    across segments — real Pinot segments are time-bounded, and the window
+    keeps the Q1.x time filters exercising the server min/max pruner)."""
+    per = -(-len(_ALL_MONTHS) // num_segments)
+    return _ALL_MONTHS[i * per:(i + 1) * per] or [_ALL_MONTHS[-1]]
+
+
+def generate_segment_frame(i: int, num_segments: int, n: int,
+                           seed: int = 42) -> Dict[str, np.ndarray]:
+    """Segment ``i``'s flat rows: dbgen-faithful value distributions with
+    d_yearmonthnum drawn from the segment's contiguous month window.
+    Segments are INDEPENDENTLY generatable (seeded per segment), which is
+    what makes the parallel builder embarrassingly parallel — no global
+    sort, no cross-process data movement (ref: per-segment independence of
+    SegmentIndexCreationDriverImpl.java:81)."""
+    rng = np.random.default_rng(seed * 1_000_003 + i)
+    cols = _flat_columns(rng, n)
+    months = np.asarray(_segment_months(i, num_segments))
+    ym = months[rng.integers(0, len(months), n)]
+    cols["d_yearmonthnum"] = ym.astype(np.int64)
+    cols["d_year"] = (ym // 100).astype(np.int64)
+    return cols
+
+
+def generate_table(num_segments: int, rows: int,
+                   seed: int = 42) -> Dict[str, np.ndarray]:
+    """Concatenated per-segment frames — EXACTLY the rows
+    ``build_segments(num_segments, rows, seed)`` indexes (for the pandas
+    oracle / external baseline side of parity checks)."""
+    per = -(-rows // num_segments)
+    frames = []
+    left = rows
     for i in range(num_segments):
-        sl = slice(i * per, min((i + 1) * per, n))
-        if sl.start >= n:
+        take = min(per, left)
+        if take <= 0:
             break
-        b = SegmentBuilder(schema, f"ssb_{i}")
-        b.build({k: v[sl] for k, v in cols.items()}, out_dir)
-        segs.append(load_segment(os.path.join(out_dir, f"ssb_{i}")))
-    return segs
+        frames.append(generate_segment_frame(i, num_segments, take, seed))
+        left -= take
+    return {k: np.concatenate([f[k] for f in frames]) for k in frames[0]}
+
+
+def _build_one(i: int, num_segments: int, n: int, seed: int,
+               out_dir: str) -> str:
+    """Worker: generate + build one segment (process-pool entry point)."""
+    from pinot_tpu.segment import SegmentBuilder
+
+    frame = generate_segment_frame(i, num_segments, n, seed)
+    SegmentBuilder(ssb_schema(), f"ssb_{i}").build(frame, out_dir)
+    return f"ssb_{i}"
+
+
+def build_segments(sf: float, out_dir: str, num_segments: int = 8,
+                   seed: int = 42, rows: int = 0,
+                   workers: int = 0) -> List:
+    """Build + load ``num_segments`` SSB segments. ``workers`` > 1 builds
+    segments in a fork process pool (per-column creators are independent in
+    the reference too — SegmentIndexCreationDriverImpl.java:81); 0 picks
+    min(num_segments, cpu_count)."""
+    from pinot_tpu.segment import load_segment
+
+    n = rows or int(sf * ROWS_PER_SF)
+    per = -(-n // num_segments)
+    jobs = []
+    left = n
+    for i in range(num_segments):
+        take = min(per, left)
+        if take <= 0:
+            break
+        jobs.append((i, num_segments, take, seed, out_dir))
+        left -= take
+
+    if not workers:
+        workers = min(len(jobs), os.cpu_count() or 1)
+    if workers > 1 and len(jobs) > 1:
+        import multiprocessing as mp
+
+        # fork: children inherit loaded modules but run numpy-only builder
+        # code (no jax calls cross the fork)
+        with mp.get_context("fork").Pool(workers) as pool:
+            names = pool.starmap(_build_one, jobs)
+    else:
+        names = [_build_one(*j) for j in jobs]
+    return [load_segment(os.path.join(out_dir, nm)) for nm in names]
 
 
 # The 13 SSB flights on the flat schema (constants follow the spec;

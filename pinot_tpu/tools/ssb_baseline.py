@@ -1,0 +1,140 @@
+"""External SSB baseline: the 13 flights hand-vectorized over pandas/numpy.
+
+The benchmark's ``vs_baseline`` denominator (ref: the CPU engines in
+``contrib/pinot-druid-benchmark/README.md:1-60``). Earlier rounds divided by
+this framework's own host execution engine — a strawman (it interprets the
+query per segment). This module is an INDEPENDENT, tightly-vectorized
+columnar implementation of each query: boolean masks + pandas groupby over
+categorical-encoded dimensions, the same "dictionary-encoded column scan"
+work a real CPU OLAP engine does, with none of our engine's overheads.
+duckdb/polars are not installable in this environment; pandas-over-numpy is
+the strongest external CPU runner available. It doubles as the parity
+oracle at bench scale (the per-segment host engine stays the oracle in
+tests/).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Tuple
+
+import numpy as np
+
+
+def make_frame(cols: Dict[str, np.ndarray]):
+    """Columns -> DataFrame with dictionary-encoded (categorical) dims —
+    the fair analogue of a columnar engine's dictionary encoding."""
+    import pandas as pd
+
+    enc = {}
+    for k, v in cols.items():
+        enc[k] = pd.Categorical(v) if v.dtype.kind == "U" else v
+    return pd.DataFrame(enc)
+
+
+def _grouped(df, mask, keys: List[str], values, order_desc_value: bool):
+    """Filtered group-by sum -> rows [*keys, sum]; ordered by keys, or by
+    (first key asc, value desc) for the Q3 flights."""
+    sub = df.loc[mask, keys].copy()
+    sub["__v"] = values[mask] if isinstance(values, np.ndarray) \
+        else np.asarray(values)[mask]
+    g = sub.groupby(keys, observed=True, sort=True)["__v"].sum().reset_index()
+    if order_desc_value:
+        g = g.sort_values([keys[-1], "__v"], ascending=[True, False],
+                          kind="stable")
+    rows = []
+    for rec in g.itertuples(index=False):
+        *ks, v = rec
+        rows.append(tuple(int(k) if isinstance(k, (int, np.integer)) else
+                          str(k) for k in ks) + (float(v),))
+    return rows
+
+
+def run_query(df, qid: str) -> List[Tuple]:
+    """One SSB flight; returns rows shaped like the engine's resultTable."""
+    c = df
+    if qid.startswith("Q1"):
+        if qid == "Q1.1":
+            m = ((c.d_year == 1993) & (c.lo_discount >= 1)
+                 & (c.lo_discount <= 3) & (c.lo_quantity < 25))
+        elif qid == "Q1.2":
+            m = ((c.d_yearmonthnum == 199401) & (c.lo_discount >= 4)
+                 & (c.lo_discount <= 6) & (c.lo_quantity >= 26)
+                 & (c.lo_quantity <= 35))
+        else:
+            m = ((c.d_weeknuminyear == 6) & (c.d_year == 1994)
+                 & (c.lo_discount >= 5) & (c.lo_discount <= 7)
+                 & (c.lo_quantity >= 26) & (c.lo_quantity <= 35))
+        v = (c.lo_extendedprice.to_numpy()[m.to_numpy()]
+             * c.lo_discount.to_numpy()[m.to_numpy()]).sum()
+        return [(float(v),)]
+
+    rev = c.lo_revenue.to_numpy()
+    if qid == "Q2.1":
+        m = (c.p_category == "MFGR#12") & (c.s_region == "AMERICA")
+        return _grouped(c, m.to_numpy(), ["d_year", "p_brand1"], rev, False)
+    if qid == "Q2.2":
+        b = c.p_brand1.astype(str)
+        m = ((b >= "MFGR#2221") & (b <= "MFGR#2228")
+             & (c.s_region == "ASIA").to_numpy())
+        return _grouped(c, np.asarray(m), ["d_year", "p_brand1"], rev, False)
+    if qid == "Q2.3":
+        m = (c.p_brand1 == "MFGR#2239") & (c.s_region == "EUROPE")
+        return _grouped(c, m.to_numpy(), ["d_year", "p_brand1"], rev, False)
+
+    if qid == "Q3.1":
+        m = ((c.c_region == "ASIA") & (c.s_region == "ASIA")
+             & (c.d_year >= 1992) & (c.d_year <= 1997))
+        return _grouped(c, m.to_numpy(), ["c_nation", "s_nation", "d_year"],
+                        rev, True)
+    if qid == "Q3.2":
+        m = ((c.c_nation == "UNITED STATES") & (c.s_nation == "UNITED STATES")
+             & (c.d_year >= 1992) & (c.d_year <= 1997))
+        return _grouped(c, m.to_numpy(), ["c_city", "s_city", "d_year"],
+                        rev, True)
+    if qid in ("Q3.3", "Q3.4"):
+        cities = ["UNITED KI1", "UNITED KI5"]
+        m = c.c_city.isin(cities) & c.s_city.isin(cities)
+        if qid == "Q3.3":
+            m &= (c.d_year >= 1992) & (c.d_year <= 1997)
+        else:
+            m &= c.d_yearmonthnum == 199712
+        return _grouped(c, m.to_numpy(), ["c_city", "s_city", "d_year"],
+                        rev, True)
+
+    profit = rev - c.lo_supplycost.to_numpy()
+    if qid == "Q4.1":
+        m = ((c.c_region == "AMERICA") & (c.s_region == "AMERICA")
+             & c.p_mfgr.isin(["MFGR#1", "MFGR#2"]))
+        return _grouped(c, m.to_numpy(), ["d_year", "c_nation"], profit,
+                        False)
+    if qid == "Q4.2":
+        m = ((c.c_region == "AMERICA") & (c.s_region == "AMERICA")
+             & c.p_mfgr.isin(["MFGR#1", "MFGR#2"])
+             & c.d_year.isin([1997, 1998]))
+        return _grouped(c, m.to_numpy(),
+                        ["d_year", "s_nation", "p_category"], profit, False)
+    if qid == "Q4.3":
+        m = ((c.s_nation == "UNITED STATES") & c.d_year.isin([1997, 1998])
+             & (c.p_category == "MFGR#14"))
+        return _grouped(c, m.to_numpy(), ["d_year", "s_city", "p_brand1"],
+                        profit, False)
+    raise ValueError(f"unknown SSB query {qid!r}")
+
+
+def rows_match(engine_rows, baseline_rows, rel: float = 1e-9) -> bool:
+    """Order-insensitive parity (ORDER BY ties can legally differ)."""
+    if len(engine_rows) != len(baseline_rows):
+        return False
+
+    def key(row):
+        return tuple(str(x) for x in row[:-1])
+
+    a = {key(r): r[-1] for r in engine_rows}
+    b = {key(r): r[-1] for r in baseline_rows}
+    if set(a) != set(b):
+        return False
+    for k, v in a.items():
+        w = b[k]
+        if abs(float(v) - float(w)) > rel * max(1.0, abs(float(w))):
+            return False
+    return True

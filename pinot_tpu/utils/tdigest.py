@@ -31,9 +31,13 @@ class TDigest:
     @classmethod
     def of(cls, values: Sequence[float],
            compression: float = DEFAULT_COMPRESSION) -> "TDigest":
-        v = np.asarray(values, dtype=np.float64)
+        # unit weights: a plain value sort IS the centroid order, so the
+        # build pays ONE np.sort instead of compressed()'s argsort+gather
+        # (the per-segment sketch hot spot — round-5 profile: 2 full-column
+        # argsorts per build)
+        v = np.sort(np.asarray(values, dtype=np.float64))
         d = cls(compression, v, np.ones(v.shape[0]))
-        return d.compressed()
+        return d.compressed(presorted=True)
 
     def merge(self, other: "TDigest") -> "TDigest":
         d = TDigest(self.compression,
@@ -41,7 +45,7 @@ class TDigest:
                     np.concatenate([self.weights, other.weights]))
         return d.compressed()
 
-    def compressed(self) -> "TDigest":
+    def compressed(self, presorted: bool = False) -> "TDigest":
         """Cluster sorted centroids by unit steps of the k1 scale function —
         fully vectorized: each point's quantile midpoint maps to a k value,
         and points sharing ``floor(k)`` merge into one centroid (weighted
@@ -49,8 +53,11 @@ class TDigest:
         n = self.means.shape[0]
         if n == 0:
             return self
-        order = np.argsort(self.means, kind="stable")
-        means, weights = self.means[order], self.weights[order]
+        if presorted:
+            means, weights = self.means, self.weights
+        else:
+            order = np.argsort(self.means, kind="stable")
+            means, weights = self.means[order], self.weights[order]
         total = weights.sum()
         c = self.compression
 
@@ -59,9 +66,12 @@ class TDigest:
         k = c / (2 * math.pi) * np.arcsin(2 * q - 1)  # k1 scale, range ±c/4
         cluster = np.floor(k - k[0]).astype(np.int64)
         # monotone guard (numerical noise), then dense renumbering — unit
-        # k-steps can skip integers for isolated heavy points
+        # k-steps can skip integers for isolated heavy points. ``cluster``
+        # is nondecreasing after the accumulate, so renumbering is a
+        # diff/cumsum, NOT np.unique (which would argsort the column again)
         cluster = np.maximum.accumulate(cluster)
-        _, cluster = np.unique(cluster, return_inverse=True)
+        cluster = np.cumsum(np.concatenate(
+            [[0], (np.diff(cluster) > 0).astype(np.int64)]))
         n_out = int(cluster[-1]) + 1
 
         w_out = np.zeros(n_out)

@@ -21,7 +21,7 @@ ROWS = 120_000
 def setup(tmp_path_factory):
     out = tmp_path_factory.mktemp("ssb_segs")
     segs = ssb.build_segments(0, str(out), num_segments=4, rows=ROWS)
-    cols = ssb.generate_flat(0, rows=ROWS)
+    cols = ssb.generate_table(4, ROWS)
     return cols, segs
 
 

@@ -131,9 +131,18 @@ class StreamBrokerServer:
 
     # -- broker ops ----------------------------------------------------------
     def create_topic(self, topic: str, num_partitions: int = 1) -> None:
+        """Create — or EXPAND — a topic. Re-creating with a larger count
+        appends empty partitions (kafka alter-topic semantics: partition
+        counts only grow; existing partitions and offsets are untouched,
+        which is what lets consumers survive the expansion)."""
         with self._lock:
-            if topic not in self._topics:
+            t = self._topics.get(topic)
+            if t is None:
                 self._topics[topic] = _Topic(num_partitions)
+                return
+            with t.lock:
+                while len(t.partitions) < num_partitions:
+                    t.partitions.append([])
 
     def _topic(self, topic: str) -> _Topic:
         t = self._topics.get(topic)

@@ -27,8 +27,14 @@ class SegmentAssignment:
 
 
 class BalancedSegmentAssignment(SegmentAssignment):
-    """Least-loaded instances first (ref: OfflineSegmentAssignment balanced
-    mode — round-robin by current segment count)."""
+    """Least-loaded placement; with instance fault domains known, replicas
+    of one segment spread across DISTINCT failure domains first (the
+    environment-provider integration — ref: pinot-environment's
+    platformFaultDomain feeding instance assignment)."""
+
+    def __init__(self, domains: Optional[Dict[str, str]] = None):
+        # instance id -> failure domain (absent/None = its own domain)
+        self._domains = domains or {}
 
     def assign(self, segment, current, instances, replication):
         if not instances:
@@ -39,7 +45,23 @@ class BalancedSegmentAssignment(SegmentAssignment):
                 if inst in load:
                     load[inst] += 1
         ranked = sorted(instances, key=lambda i: (load[i], i))
-        return ranked[: min(replication, len(ranked))]
+        n = min(replication, len(ranked))
+        if not self._domains:
+            return ranked[:n]
+        # greedy domain-aware pick: an unused failure domain beats load
+        # rank; fall back to used domains once every domain is covered
+        chosen: List[str] = []
+        used_domains = set()
+        pool = list(ranked)
+        while len(chosen) < n and pool:
+            pick = next(
+                (i for i in pool
+                 if self._domains.get(i, i) not in used_domains),
+                pool[0])
+            pool.remove(pick)
+            chosen.append(pick)
+            used_domains.add(self._domains.get(pick, pick))
+        return chosen
 
 
 class ReplicaGroupSegmentAssignment(SegmentAssignment):
@@ -127,14 +149,16 @@ def assignment_for_table(store: ClusterStateStore, table: str,
 def compute_target_assignment(
         current: Dict[str, Dict[str, str]], instances: List[str],
         replication: int,
-        groups: Optional[List[List[str]]] = None
+        groups: Optional[List[List[str]]] = None,
+        domains: Optional[Dict[str, str]] = None
         ) -> Dict[str, Dict[str, str]]:
     """Target for all segments (CONSUMING segments keep their state label).
     ``groups`` switches to replica-group placement so rebalance preserves
-    the persisted instance-partition layout strict routing depends on."""
+    the persisted instance-partition layout strict routing depends on;
+    ``domains`` keeps the fault-domain replica spread through rebalance."""
     strategy: SegmentAssignment = (
         ReplicaGroupSegmentAssignment(len(groups), groups=groups)
-        if groups else BalancedSegmentAssignment())
+        if groups else BalancedSegmentAssignment(domains=domains))
     target: Dict[str, Dict[str, str]] = {}
     for segment in sorted(current):
         state = CONSUMING if CONSUMING in current[segment].values() else ONLINE

@@ -164,9 +164,14 @@ class Controller:
 
         servers, replication = assignment_for_table(self.store, table_with_type)
         groups = self.store.get_instance_partitions(table_with_type)
+        # environment-provider integration: replicas spread across distinct
+        # failure domains when servers report them (spi/environment.py)
+        domains = {i.instance_id: i.failure_domain
+                   for i in self.store.instances("SERVER")
+                   if i.failure_domain}
         strategy: SegmentAssignment = (
             ReplicaGroupSegmentAssignment(len(groups), groups=groups)
-            if groups else BalancedSegmentAssignment())
+            if groups else BalancedSegmentAssignment(domains=domains))
 
         def apply(ideal):
             ideal = ideal or {}
@@ -208,6 +213,22 @@ class Controller:
     # -- instances ----------------------------------------------------------
     def register_instance(self, info: InstanceInfo) -> None:
         self.store.register_instance(info)
+
+    def update_instance_tags(self, instance_id: str,
+                             tags: List[str]) -> None:
+        """Re-tag an instance (ref: PinotInstanceRestletResource
+        updateInstanceTags — the tenant-membership mutation). Atomic
+        read-modify-write on the store so a concurrent heartbeat's
+        heartbeatMs is never clobbered by a stale snapshot."""
+        if self.store.get_instance(instance_id) is None:
+            raise KeyError(f"unknown instance {instance_id!r}")
+
+        def apply(d):
+            if d:
+                d["tags"] = list(tags)
+            return d
+
+        self.store.update(f"instances/{instance_id}", apply)
 
     # -- segment completion plumbing ----------------------------------------
     def _num_replicas_for_segment(self, segment_name: str) -> int:
@@ -264,8 +285,11 @@ class Controller:
             groups = compute_instance_partitions(servers, replication)
             if not dry_run:
                 self.store.set_instance_partitions(table, groups)
-        target = compute_target_assignment(current, servers, replication,
-                                           groups=groups)
+        target = compute_target_assignment(
+            current, servers, replication, groups=groups,
+            domains={i.instance_id: i.failure_domain
+                     for i in self.store.instances("SERVER")
+                     if i.failure_domain})
         steps = rebalance_steps(current, target)
         if dry_run:
             return steps

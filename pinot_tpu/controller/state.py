@@ -108,18 +108,23 @@ class InstanceInfo:
     alive: bool = True
     # last heartbeat (ms since epoch); the ephemeral-znode liveness analogue
     heartbeat_ms: int = 0
+    # fault-domain label from the environment provider SPI
+    # (spi/environment.py; ref: AzureEnvironmentProvider platformFaultDomain)
+    failure_domain: Optional[str] = None
 
     def to_dict(self) -> Dict[str, Any]:
         return {"instanceId": self.instance_id,
                 "type": self.instance_type, "host": self.host,
                 "port": self.port, "tags": self.tags, "alive": self.alive,
-                "heartbeatMs": self.heartbeat_ms}
+                "heartbeatMs": self.heartbeat_ms,
+                "failureDomain": self.failure_domain}
 
     @classmethod
     def from_dict(cls, d: Dict[str, Any]) -> "InstanceInfo":
         return cls(d["instanceId"], d["type"], d.get("host", "localhost"),
                    d.get("port", 0), d.get("tags", ["DefaultTenant"]),
-                   d.get("alive", True), d.get("heartbeatMs", 0))
+                   d.get("alive", True), d.get("heartbeatMs", 0),
+                   d.get("failureDomain"))
 
 
 Watcher = Callable[[str, Any], None]

@@ -76,8 +76,17 @@ class ServerInstance:
 
     # -- lifecycle (ref: BaseServerStarter.start) ---------------------------
     def start(self, heartbeat_interval_s: float = 0.0) -> None:
+        from pinot_tpu.spi.environment import get_environment_provider
+
+        # a RESTART must not wipe operator-set tenant tags (PUT updateTags):
+        # re-registration carries the stored tags forward
+        prior = self.store.get_instance(self.instance_id)
         self.store.register_instance(
-            InstanceInfo(self.instance_id, "SERVER", port=0))
+            InstanceInfo(self.instance_id, "SERVER", port=0,
+                         tags=(prior.tags if prior is not None
+                               else ["DefaultTenant"]),
+                         failure_domain=get_environment_provider()
+                         .failure_domain()))
         # replay current assignments, then watch for changes (the Helix
         # participant registration + state-transition replay)
         self.store.watch("idealstate/", self._on_ideal_state_change)

@@ -1,0 +1,105 @@
+"""Segment encryption SPI: crypters applied around deep-store transfer.
+
+Re-design of ``pinot-common/.../crypt/`` (``PinotCrypter`` SPI +
+``PinotCrypterFactory`` + ``NoOpPinotCrypter``): a crypter encrypts a
+segment file before it reaches the deep store and decrypts it after
+download (``SegmentFetcherFactory.fetchAndDecryptSegmentToLocal``). The
+registry is name-keyed like the reference's factory.
+
+The built-in keyed crypter is a SHA-256 CTR keystream XOR — a real
+symmetric stream cipher built only from the standard library (the
+environment has no cryptography package; the reference likewise treats the
+cipher itself as pluggable and ships only NoOp in-tree).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+from typing import Callable, Dict
+
+
+class PinotCrypter:
+    """The SPI: both methods transform a file IN PLACE."""
+
+    def encrypt(self, path: str) -> None:
+        raise NotImplementedError
+
+    def decrypt(self, path: str) -> None:
+        raise NotImplementedError
+
+
+class NoOpPinotCrypter(PinotCrypter):
+    """Ref: NoOpPinotCrypter — the default when tables opt out."""
+
+    def encrypt(self, path: str) -> None:
+        pass
+
+    def decrypt(self, path: str) -> None:
+        pass
+
+
+class KeyedStreamCrypter(PinotCrypter):
+    """Symmetric XOR stream cipher with a SHA-256 CTR keystream.
+
+    Layout of an encrypted file: 16-byte random nonce || ciphertext.
+    Keystream block i = SHA256(key || nonce || i_le8); XOR is its own
+    inverse so decrypt re-derives the stream from the stored nonce.
+    """
+
+    _MAGIC = b"PCRY1\x00"
+
+    def __init__(self, key: bytes):
+        if not key:
+            raise ValueError("empty crypter key")
+        self.key = key
+
+    def _stream(self, nonce: bytes, n: int) -> bytes:
+        out = bytearray()
+        i = 0
+        while len(out) < n:
+            out += hashlib.sha256(
+                self.key + nonce + i.to_bytes(8, "little")).digest()
+            i += 1
+        return bytes(out[:n])
+
+    def encrypt(self, path: str) -> None:
+        with open(path, "rb") as f:
+            plain = f.read()
+        nonce = os.urandom(16)
+        cipher = bytes(a ^ b for a, b in
+                       zip(plain, self._stream(nonce, len(plain))))
+        with open(path, "wb") as f:
+            f.write(self._MAGIC + nonce + cipher)
+
+    def decrypt(self, path: str) -> None:
+        with open(path, "rb") as f:
+            raw = f.read()
+        if not raw.startswith(self._MAGIC):
+            raise ValueError(f"{path}: not a {type(self).__name__} file")
+        nonce = raw[len(self._MAGIC):len(self._MAGIC) + 16]
+        cipher = raw[len(self._MAGIC) + 16:]
+        plain = bytes(a ^ b for a, b in
+                      zip(cipher, self._stream(nonce, len(cipher))))
+        with open(path, "wb") as f:
+            f.write(plain)
+
+
+# -- registry (ref: PinotCrypterFactory.init + getPinotCrypter) -------------
+
+_REGISTRY: Dict[str, Callable[[], PinotCrypter]] = {
+    "noop": NoOpPinotCrypter,
+    "nooppinotcrypter": NoOpPinotCrypter,
+}
+
+
+def register_crypter(name: str, ctor: Callable[[], PinotCrypter]) -> None:
+    _REGISTRY[name.lower()] = ctor
+
+
+def get_crypter(name: str) -> PinotCrypter:
+    ctor = _REGISTRY.get(name.lower())
+    if ctor is None:
+        raise ValueError(f"no crypter registered under {name!r} "
+                         f"(registered: {sorted(_REGISTRY)})")
+    return ctor()

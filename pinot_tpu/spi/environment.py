@@ -1,0 +1,65 @@
+"""Environment provider SPI: fault-domain discovery for placement.
+
+Re-design of ``pinot-plugins/pinot-environment/`` (``PinotEnvironmentProvider``
+SPI + ``AzureEnvironmentProvider`` reading the instance-metadata service's
+``platformFaultDomain``): a provider surfaces the failure domain the process
+runs in, the controller records it on the instance, and segment assignment
+spreads replicas across distinct domains so a rack/zone loss cannot take
+out every replica.
+
+Cloud metadata services are unreachable in this environment, so the
+concrete providers are config/env driven — the SPI boundary is the same.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Callable, Dict, Optional
+
+
+class PinotEnvironmentProvider:
+    """The SPI (ref: PinotEnvironmentProvider.getEnvironment)."""
+
+    def get_environment(self) -> Dict[str, str]:
+        """Arbitrary key/value environment facts; ``failureDomain`` is the
+        one placement consumes."""
+        raise NotImplementedError
+
+    def failure_domain(self) -> Optional[str]:
+        return self.get_environment().get("failureDomain")
+
+
+class NoOpEnvironmentProvider(PinotEnvironmentProvider):
+    """Default: no environment facts (single-domain clusters)."""
+
+    def get_environment(self) -> Dict[str, str]:
+        return {}
+
+
+class EnvVarEnvironmentProvider(PinotEnvironmentProvider):
+    """Reads PINOT_FAILURE_DOMAIN (the operator/scheduler injects it, the
+    way cloud deployments template zone labels into pod env)."""
+
+    def get_environment(self) -> Dict[str, str]:
+        fd = os.environ.get("PINOT_FAILURE_DOMAIN")
+        return {"failureDomain": fd} if fd else {}
+
+
+_REGISTRY: Dict[str, Callable[[], PinotEnvironmentProvider]] = {
+    "noop": NoOpEnvironmentProvider,
+    "env": EnvVarEnvironmentProvider,
+}
+
+
+def register_environment_provider(
+        name: str, ctor: Callable[[], PinotEnvironmentProvider]) -> None:
+    _REGISTRY[name.lower()] = ctor
+
+
+def get_environment_provider(
+        name: str = "env") -> PinotEnvironmentProvider:
+    ctor = _REGISTRY.get(name.lower())
+    if ctor is None:
+        raise ValueError(f"no environment provider {name!r} "
+                         f"(registered: {sorted(_REGISTRY)})")
+    return ctor()

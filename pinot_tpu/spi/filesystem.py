@@ -20,7 +20,7 @@ import shutil
 import urllib.parse
 import urllib.request
 
-from typing import Callable, Dict, List
+from typing import Callable, Dict, List, Optional
 
 
 class PinotFS:
@@ -151,7 +151,41 @@ def get_fs(uri: str) -> PinotFS:
     return ctor()
 
 
-def fetch_segment(download_url: str, local_dir: str) -> str:
+def fetch_segment(download_url: str, local_dir: str,
+                  retries: int = 3, backoff_s: float = 0.2,
+                  crypter: Optional[str] = None) -> str:
     """Resolve a segment downloadUrl to a local segment directory (the
-    server's downloadSegmentFromDeepStore, BaseTableDataManager.java:388)."""
-    return get_fs(download_url).copy_to_local_dir(download_url, local_dir)
+    server's downloadSegmentFromDeepStore, BaseTableDataManager.java:388).
+
+    Retries with exponential backoff (ref: SegmentFetcherFactory
+    fetchSegmentToLocal wrapping fetchers in RetryPolicies) and, when a
+    ``crypter`` name is given, decrypts every downloaded file
+    (ref: fetchAndDecryptSegmentToLocal + the crypt SPI)."""
+    import time
+
+    fs = get_fs(download_url)  # unknown scheme fails fast, no retries
+    for attempt in range(max(retries, 1)):
+        try:
+            local = fs.copy_to_local_dir(download_url, local_dir)
+            break
+        except ValueError:
+            raise  # permanent (e.g. path-escape rejection): never retry
+        except Exception:  # noqa: BLE001 — transient deep-store faults
+            if attempt + 1 >= max(retries, 1):
+                raise
+            time.sleep(backoff_s * (2 ** attempt))
+    if crypter:
+        from pinot_tpu.spi.crypt import get_crypter
+
+        # decrypt a LOCAL copy, never the deep-store original: file://
+        # stores serve in place (LocalPinotFS.copy_to_local_dir), and an
+        # in-place decrypt would silently de-encrypt the shared store
+        dst = os.path.join(local_dir, os.path.basename(local.rstrip("/")))
+        if os.path.abspath(local) != os.path.abspath(dst):
+            shutil.copytree(local, dst, dirs_exist_ok=True)
+            local = dst
+        c = get_crypter(crypter)
+        for root, _dirs, files in os.walk(local):
+            for f in files:
+                c.decrypt(os.path.join(root, f))
+    return local

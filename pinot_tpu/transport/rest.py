@@ -223,9 +223,82 @@ class ControllerApi(_Api):
         # recommender (ref: RecommenderDriver via PinotTableRestletResource)
         self.route("POST", r"/tables/([^/]+)/recommender",
                    lambda m, b: self._recommend(store, m.group(1), b))
+        # tenants (ref: PinotTenantRestletResource): tenants are instance
+        # tag groups; SERVER/BROKER membership comes from instance tags
+        self.route("GET", r"/tenants",
+                   lambda m, b: (200, self._tenants(store)))
+        self.route("GET", r"/tenants/([^/]+)",
+                   lambda m, b: (200, self._tenant(store, m.group(1))))
+        self.route("PUT", r"/instances/([^/]+)/updateTags",
+                   lambda m, b: self._update_tags(c, m.group(1), b))
+        # minion tasks (ref: PinotTaskRestletResource)
+        self.route("GET", r"/tasks/tasktypes",
+                   lambda m, b: (200, self._task_types()))
+        self.route("GET", r"/tasks/([^/]+)/state",
+                   lambda m, b: (200, {
+                       t.task_id: t.status
+                       for t in c.task_manager.list_tasks()
+                       if t.task_type == m.group(1)}))
+        self.route("POST", r"/tasks/schedule",
+                   lambda m, b: (200, {"generated":
+                                       c.task_manager.generate_tasks()}))
+        # state-store browse (ref: ZookeeperResource /zk/ls + /zk/get; the
+        # node path rides IN the URL path after the verb)
+        self.route("GET", r"/zk/ls(?:/(.*))?",
+                   lambda m, b: (200, store.children(m.group(1))
+                                 if m.group(1)
+                                 else sorted(store.snapshot_data()[1])))
+        self.route("GET", r"/zk/get/(.+)",
+                   lambda m, b: self._zk_get(store, m.group(1)))
         # minimal cluster status UI (ref: the controller's bundled web app)
         self.route("GET", r"/ui",
                    lambda m, b: (200, self._render_ui(store)))
+
+    @staticmethod
+    def _task_types() -> List[str]:
+        """REGISTERED task types (ref: PinotTaskRestletResource
+        listTaskTypes reads the registry, not materialized task records)."""
+        from pinot_tpu.controller.tasks import _GENERATORS
+
+        return sorted(_GENERATORS)
+
+    @staticmethod
+    def _tenants(store) -> Dict[str, Any]:
+        """All tags grouped by role (ref: PinotTenantRestletResource
+        getAllTenants)."""
+        server, broker = set(), set()
+        for i in store.instances():
+            target = (server if i.instance_type.upper().startswith("SERVER")
+                      else broker if
+                      i.instance_type.upper().startswith("BROKER") else None)
+            if target is not None:
+                target.update(i.tags)
+        return {"SERVER_TENANTS": sorted(server),
+                "BROKER_TENANTS": sorted(broker)}
+
+    @staticmethod
+    def _tenant(store, name: str) -> Dict[str, Any]:
+        return {"tenantName": name,
+                "instances": sorted(i.instance_id for i in store.instances()
+                                    if name in i.tags)}
+
+    @staticmethod
+    def _update_tags(c, instance_id: str, body):
+        tags = (body or {}).get("tags")
+        if not isinstance(tags, list) or not all(
+                isinstance(t, str) for t in tags):
+            return 400, {"error": "body must carry {'tags': [str, ...]}"}
+        try:
+            c.update_instance_tags(instance_id, tags)
+        except KeyError as e:
+            return 404, {"error": str(e)}
+        return 200, {"status": f"Updated tags of {instance_id}"}
+
+    @staticmethod
+    def _zk_get(store, path: str):
+        v = store.get(path)
+        return (404, {"error": f"no node at {path!r}"}) if v is None \
+            else (200, {"path": path, "value": v})
 
     @staticmethod
     def _start_replace(c, m, b):

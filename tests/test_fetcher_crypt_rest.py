@@ -1,0 +1,294 @@
+"""Retrying segment fetcher + encryption SPI + controller REST breadth.
+
+Refs: pinot-common/.../utils/fetcher/SegmentFetcherFactory.java (retry
+policies + fetchAndDecryptSegmentToLocal), pinot-common/.../crypt/
+(PinotCrypter SPI), PinotTenantRestletResource / PinotTaskRestletResource /
+ZookeeperResource (controller API resources).
+"""
+
+import json
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from pinot_tpu.spi import DataType, FieldSpec, FieldType, Schema
+from pinot_tpu.spi.crypt import (
+    KeyedStreamCrypter,
+    NoOpPinotCrypter,
+    get_crypter,
+    register_crypter,
+)
+from pinot_tpu.spi.filesystem import fetch_segment, get_fs, register_fs
+
+
+class TestCrypt:
+    def test_keyed_roundtrip(self, tmp_path):
+        p = tmp_path / "seg.bin"
+        payload = bytes(range(256)) * 100
+        p.write_bytes(payload)
+        c = KeyedStreamCrypter(b"s3cret-key")
+        c.encrypt(str(p))
+        assert p.read_bytes() != payload  # actually transformed
+        c.decrypt(str(p))
+        assert p.read_bytes() == payload
+
+    def test_wrong_key_differs(self, tmp_path):
+        p = tmp_path / "seg.bin"
+        p.write_bytes(b"columnar bytes" * 50)
+        KeyedStreamCrypter(b"key-a").encrypt(str(p))
+        KeyedStreamCrypter(b"key-b").decrypt(str(p))
+        assert p.read_bytes() != b"columnar bytes" * 50
+
+    def test_decrypt_rejects_plain_file(self, tmp_path):
+        p = tmp_path / "plain.bin"
+        p.write_bytes(b"not encrypted")
+        with pytest.raises(ValueError):
+            KeyedStreamCrypter(b"k").decrypt(str(p))
+
+    def test_registry(self):
+        assert isinstance(get_crypter("noop"), NoOpPinotCrypter)
+        register_crypter("test-keyed", lambda: KeyedStreamCrypter(b"k"))
+        assert isinstance(get_crypter("TEST-KEYED"), KeyedStreamCrypter)
+        with pytest.raises(ValueError):
+            get_crypter("aes-gcm-unregistered")
+
+
+class TestRetryingFetcher:
+    def test_retries_transient_failures(self, tmp_path, monkeypatch):
+        """First two attempts fail, third succeeds — the fetch must survive
+        (SegmentFetcherFactory wraps fetchers in RetryPolicies)."""
+        src = tmp_path / "seg_src"
+        src.mkdir()
+        (src / "col.npy").write_bytes(b"data")
+        attempts = {"n": 0}
+
+        class FlakyFS:
+            def copy_to_local_dir(self, uri, local_dir):
+                attempts["n"] += 1
+                if attempts["n"] < 3:
+                    raise OSError("transient deep-store fault")
+                import shutil
+
+                dst = str(tmp_path / "seg_dst")
+                shutil.copytree(str(src), dst, dirs_exist_ok=True)
+                return dst
+
+        register_fs("flaky", FlakyFS)
+        out = fetch_segment("flaky://deep/seg_src", str(tmp_path),
+                            retries=3, backoff_s=0.01)
+        assert attempts["n"] == 3
+        assert (tmp_path / "seg_dst" / "col.npy").read_bytes() == b"data"
+
+    def test_exhausted_retries_raise(self, tmp_path):
+        class DeadFS:
+            def copy_to_local_dir(self, uri, local_dir):
+                raise OSError("down")
+
+        register_fs("dead", DeadFS)
+        with pytest.raises(OSError):
+            fetch_segment("dead://x/y", str(tmp_path), retries=2,
+                          backoff_s=0.01)
+
+    def test_unknown_scheme_fails_fast(self, tmp_path):
+        """A permanent error (no FS for the scheme) must not burn the
+        retry/backoff budget."""
+        import time
+
+        t0 = time.perf_counter()
+        with pytest.raises(ValueError):
+            fetch_segment("s4://bucket/seg", str(tmp_path), retries=5,
+                          backoff_s=5.0)
+        assert time.perf_counter() - t0 < 1.0
+
+    def test_decrypt_never_mutates_file_deep_store(self, tmp_path):
+        """file:// stores serve segments in place; decrypt must act on a
+        LOCAL copy or the first fetch silently de-encrypts the shared
+        store and every later fetch fails."""
+        deep = tmp_path / "deepstore" / "segX"
+        deep.mkdir(parents=True)
+        f = deep / "col.npy"
+        f.write_bytes(b"columnar payload")
+        register_crypter("deeptest", lambda: KeyedStreamCrypter(b"dk"))
+        get_crypter("deeptest").encrypt(str(f))
+        encrypted = f.read_bytes()
+
+        local = tmp_path / "local"
+        local.mkdir()
+        for _ in range(2):  # a second replica fetch must also succeed
+            out = fetch_segment(f"file://{deep}", str(local),
+                                crypter="deeptest")
+            assert (tmp_path / "local" / "segX" / "col.npy").read_bytes() \
+                == b"columnar payload"
+        assert f.read_bytes() == encrypted  # deep store untouched
+
+    def test_fetch_and_decrypt(self, tmp_path):
+        """Encrypted files in the deep store come back readable
+        (fetchAndDecryptSegmentToLocal)."""
+        src = tmp_path / "enc_src"
+        src.mkdir()
+        f = src / "part.npy"
+        f.write_bytes(b"\x93NUMPY fake payload")
+        register_crypter("fetchtest", lambda: KeyedStreamCrypter(b"fk"))
+        get_crypter("fetchtest").encrypt(str(f))
+
+        import shutil
+
+        class EncFS:
+            def copy_to_local_dir(self, uri, local_dir):
+                dst = str(tmp_path / "enc_dst")
+                shutil.copytree(str(src), dst, dirs_exist_ok=True)
+                return dst
+
+        register_fs("encfs", EncFS)
+        out = fetch_segment("encfs://deep/enc_src", str(tmp_path),
+                            crypter="fetchtest")
+        assert (tmp_path / "enc_dst" / "part.npy").read_bytes() == \
+            b"\x93NUMPY fake payload"
+
+
+@pytest.fixture(scope="module")
+def rest_cluster(tmp_path_factory):
+    from pinot_tpu.spi.table import TableConfig
+    from pinot_tpu.tools.cluster import EmbeddedCluster
+    from pinot_tpu.transport.rest import ControllerApi
+
+    out = str(tmp_path_factory.mktemp("restb"))
+    schema = Schema("rb", [
+        FieldSpec("k", DataType.STRING),
+        FieldSpec("v", DataType.LONG, FieldType.METRIC),
+    ])
+    cluster = EmbeddedCluster(num_servers=2, data_dir=out)
+    cluster.create_table(TableConfig(table_name="rb"), schema)
+    cluster.ingest_rows("rb_OFFLINE", schema,
+                        {"k": ["a", "b"] * 10,
+                         "v": list(np.arange(20))},
+                        segment_name="rb_seg0")
+    cluster.wait_for_ev_converged("rb_OFFLINE")
+    api = ControllerApi(cluster.controller)
+    api.start()
+    yield cluster, api
+    api.stop()
+    cluster.shutdown()
+
+
+def _get(api, path):
+    with urllib.request.urlopen(
+            f"http://localhost:{api.port}{path}", timeout=10) as r:
+        return json.loads(r.read().decode())
+
+
+class TestControllerRestBreadth:
+    def test_tenants(self, rest_cluster):
+        cluster, api = rest_cluster
+        tenants = _get(api, "/tenants")
+        assert "DefaultTenant" in tenants["SERVER_TENANTS"]
+        members = _get(api, "/tenants/DefaultTenant")
+        assert len(members["instances"]) >= 2
+
+    def test_update_instance_tags(self, rest_cluster):
+        cluster, api = rest_cluster
+        inst = _get(api, "/instances")["instances"]
+        server = next(i["instanceId"] for i in inst
+                      if i["type"].upper().startswith("SERVER"))
+        req = urllib.request.Request(
+            f"http://localhost:{api.port}/instances/{server}/updateTags",
+            data=json.dumps({"tags": ["DefaultTenant", "hotTier"]}).encode(),
+            method="PUT", headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=10) as r:
+            assert r.status == 200
+        assert server in _get(api, "/tenants/hotTier")["instances"]
+
+    def test_task_endpoints(self, rest_cluster):
+        cluster, api = rest_cluster
+        req = urllib.request.Request(
+            f"http://localhost:{api.port}/tasks/schedule", data=b"{}",
+            method="POST", headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=10) as r:
+            assert r.status == 200
+        assert isinstance(_get(api, "/tasks/tasktypes"), list)
+
+    def test_zk_browse(self, rest_cluster):
+        cluster, api = rest_cluster
+        keys = _get(api, "/zk/ls")
+        assert keys, "state store browse returned nothing"
+        node = _get(api, f"/zk/get/{keys[0]}")
+        assert node["path"] == keys[0]
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _get(api, "/zk/get/NO/SUCH/NODE")
+        assert e.value.code == 404
+
+
+class TestEnvironmentProvider:
+    """Fault-domain discovery SPI + domain-aware replica spread
+    (ref: pinot-plugins/pinot-environment, AzureEnvironmentProvider)."""
+
+    def test_env_var_provider(self, monkeypatch):
+        from pinot_tpu.spi.environment import (
+            EnvVarEnvironmentProvider,
+            get_environment_provider,
+        )
+
+        monkeypatch.setenv("PINOT_FAILURE_DOMAIN", "zone-b")
+        assert EnvVarEnvironmentProvider().failure_domain() == "zone-b"
+        assert get_environment_provider("env").failure_domain() == "zone-b"
+        monkeypatch.delenv("PINOT_FAILURE_DOMAIN")
+        assert get_environment_provider("env").failure_domain() is None
+        assert get_environment_provider("noop").get_environment() == {}
+
+    def test_replicas_spread_across_domains(self):
+        from pinot_tpu.controller.assignment import (
+            BalancedSegmentAssignment,
+        )
+
+        # 4 servers in 2 domains; replication 2 must land on BOTH domains
+        # even when one domain's servers are the least loaded
+        domains = {"s1": "zoneA", "s2": "zoneA", "s3": "zoneB",
+                   "s4": "zoneB"}
+        strat = BalancedSegmentAssignment(domains=domains)
+        current = {"seg0": {"s3": "ONLINE"}, "seg1": {"s4": "ONLINE"}}
+        chosen = strat.assign("seg2", current, ["s1", "s2", "s3", "s4"], 2)
+        assert {domains[c] for c in chosen} == {"zoneA", "zoneB"}, chosen
+
+    def test_restart_preserves_operator_tags(self, tmp_path):
+        """PUT updateTags must survive a server restart (re-registration
+        carries stored tags forward)."""
+        from pinot_tpu.spi.table import TableConfig
+        from pinot_tpu.tools.cluster import EmbeddedCluster
+
+        cluster = EmbeddedCluster(num_servers=1, data_dir=str(tmp_path))
+        try:
+            sid = cluster.store.instances("SERVER")[0].instance_id
+            cluster.controller.update_instance_tags(
+                sid, ["DefaultTenant", "hotTier"])
+            # restart = re-run registration (ServerInstance.start path)
+            cluster.servers[sid].start()
+            info = cluster.store.get_instance(sid)
+            assert "hotTier" in info.tags
+        finally:
+            cluster.shutdown()
+
+    def test_rebalance_keeps_domain_spread(self, tmp_path):
+        from pinot_tpu.controller.assignment import (
+            compute_target_assignment,
+        )
+
+        domains = {"s1": "fd1", "s2": "fd1", "s3": "fd2"}
+        current = {"seg0": {"s1": "ONLINE", "s2": "ONLINE"}}
+        target = compute_target_assignment(
+            current, ["s1", "s2", "s3"], 2, domains=domains)
+        assert {domains[i] for i in target["seg0"]} == {"fd1", "fd2"}
+
+    def test_registration_carries_domain(self, tmp_path, monkeypatch):
+        from pinot_tpu.spi.table import TableConfig
+        from pinot_tpu.tools.cluster import EmbeddedCluster
+
+        monkeypatch.setenv("PINOT_FAILURE_DOMAIN", "rack-7")
+        cluster = EmbeddedCluster(num_servers=1, data_dir=str(tmp_path))
+        try:
+            infos = cluster.store.instances("SERVER")
+            assert infos and all(i.failure_domain == "rack-7"
+                                 for i in infos)
+        finally:
+            cluster.shutdown()

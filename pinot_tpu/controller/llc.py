@@ -58,13 +58,16 @@ class LLCRealtimeSegmentManager:
             raise ValueError(f"{table_with_type} is not a realtime table")
         factory = create_consumer_factory(cfg.stream_config)
         meta_provider = factory.create_metadata_provider()
-        n_parts = meta_provider.partition_count()
-        created = []
-        for p in range(n_parts):
-            start = meta_provider.earliest_offset(p)
-            created.append(self._create_consuming_segment(
-                table_with_type, p, 0, start))
-        return created
+        try:
+            n_parts = meta_provider.partition_count()
+            created = []
+            for p in range(n_parts):
+                start = meta_provider.earliest_offset(p)
+                created.append(self._create_consuming_segment(
+                    table_with_type, p, 0, start))
+            return created
+        finally:
+            meta_provider.close()  # network providers hold a socket
 
     def _create_consuming_segment(self, table: str, partition: int,
                                   sequence: int,
@@ -135,31 +138,38 @@ class LLCRealtimeSegmentManager:
         if cfg is None or cfg.stream_config is None:
             return []
         factory = create_consumer_factory(cfg.stream_config)
-        n_parts = factory.create_metadata_provider().partition_count()
+        # ONE provider per repair pass, closed when done — this runs every
+        # validation cycle, and network providers (kafka wire) hold sockets
+        meta_provider = factory.create_metadata_provider()
+        try:
+            n_parts = meta_provider.partition_count()
 
-        consuming: Dict[int, str] = {}
-        latest: Dict[int, SegmentZKMetadata] = {}
-        for md in self.store.segment_metadata_list(table):
-            if md.partition is None:
-                continue
-            if md.status == CONSUMING:
-                consuming[md.partition] = md.segment_name
-            prev = latest.get(md.partition)
-            if prev is None or (md.sequence or 0) > (prev.sequence or 0):
-                latest[md.partition] = md
+            consuming: Dict[int, str] = {}
+            latest: Dict[int, SegmentZKMetadata] = {}
+            for md in self.store.segment_metadata_list(table):
+                if md.partition is None:
+                    continue
+                if md.status == CONSUMING:
+                    consuming[md.partition] = md.segment_name
+                prev = latest.get(md.partition)
+                if prev is None or (md.sequence or 0) > (prev.sequence or 0):
+                    latest[md.partition] = md
 
-        created = []
-        for p in range(n_parts):
-            if p in consuming:
-                continue
-            last = latest.get(p)
-            if last is None:
-                start = factory.create_metadata_provider().earliest_offset(p)
-                created.append(self._create_consuming_segment(table, p, 0, start))
-            else:
-                start = (StreamOffset.parse(last.end_offset)
-                         if last.end_offset else
-                         StreamOffset.parse(last.start_offset or "0"))
-                created.append(self._create_consuming_segment(
-                    table, p, (last.sequence or 0) + 1, start))
-        return created
+            created = []
+            for p in range(n_parts):
+                if p in consuming:
+                    continue
+                last = latest.get(p)
+                if last is None:
+                    start = meta_provider.earliest_offset(p)
+                    created.append(self._create_consuming_segment(
+                        table, p, 0, start))
+                else:
+                    start = (StreamOffset.parse(last.end_offset)
+                             if last.end_offset else
+                             StreamOffset.parse(last.start_offset or "0"))
+                    created.append(self._create_consuming_segment(
+                        table, p, (last.sequence or 0) + 1, start))
+            return created
+        finally:
+            meta_provider.close()

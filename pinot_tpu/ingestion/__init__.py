@@ -18,6 +18,7 @@ from pinot_tpu.ingestion.stream import (
     register_stream_type,
 )
 from pinot_tpu.ingestion import socketstream  # registers stream.type=socket
+from pinot_tpu.ingestion import kafkawire  # registers stream.type=kafka
 from pinot_tpu.ingestion.transformers import (
     CompositeTransformer,
     ComplexTypeTransformer,

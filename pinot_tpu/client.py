@@ -90,26 +90,100 @@ class ResultSetGroup:
                 if k not in ("resultTable", "exceptions")}
 
 
-class Connection:
-    """Ref: Connection.java — execute() round-robins the broker list."""
+def _normalize_url(url: str) -> str:
+    if not url.startswith(("http://", "https://")):
+        url = "http://" + url
+    return url.rstrip("/")
 
-    def __init__(self, broker_urls: Sequence[str], timeout_s: float = 60.0,
-                 fail_on_exceptions: bool = True):
-        if not broker_urls:
-            raise ValueError("at least one broker url is required")
-        self._brokers = [self._normalize(u) for u in broker_urls]
-        self._rr = itertools.cycle(range(len(self._brokers)))
+
+class DynamicBrokerSelector:
+    """Live broker discovery from the controller's cluster state
+    (ref: DynamicBrokerSelector — the java client watches ZK's broker
+    external view; here the controller's /instances resource serves the
+    same list). Results cache for ``refresh_s``; a controller outage or a
+    bad response falls back to the last good list rather than erroring."""
+
+    def __init__(self, controller_url: str, refresh_s: float = 10.0,
+                 timeout_s: float = 10.0):
+        self.controller_url = _normalize_url(controller_url)
+        self.refresh_s = refresh_s
+        self.timeout_s = timeout_s
+        self._cached: List[str] = []
+        self._fetched_at = 0.0
+
+    def brokers(self, force: bool = False) -> List[str]:
+        import time
+
+        if (not force and self._cached
+                and time.time() - self._fetched_at < self.refresh_s):
+            return self._cached
+        try:
+            with urllib.request.urlopen(f"{self.controller_url}/instances",
+                                        timeout=self.timeout_s) as r:
+                payload = json.loads(r.read().decode("utf-8"))
+        except (OSError, ValueError):
+            return self._cached  # controller down: last good list serves
+        urls = [f"http://{i.get('host', 'localhost')}:{i['port']}"
+                for i in payload.get("instances", [])
+                if i.get("type", "").upper().startswith("BROKER")
+                and i.get("alive", True) and i.get("port")]
+        if urls:
+            self._cached = urls
+            self._fetched_at = time.time()
+        return self._cached
+
+
+class Connection:
+    """Ref: Connection.java — execute() round-robins the broker list,
+    failing over to the next broker on transport errors (``retries``
+    attempts total; broker-side query errors are NOT retried)."""
+
+    def __init__(self, broker_urls: Sequence[str] = (),
+                 timeout_s: float = 60.0,
+                 fail_on_exceptions: bool = True,
+                 selector: Optional[DynamicBrokerSelector] = None,
+                 retries: int = 3, backoff_s: float = 0.1):
+        if not broker_urls and selector is None:
+            raise ValueError("broker urls or a broker selector is required")
+        self._static = [self._normalize(u) for u in broker_urls]
+        self._selector = selector
+        self._rr = itertools.count()
         self.timeout_s = timeout_s
         self.fail_on_exceptions = fail_on_exceptions
+        self.retries = max(retries, 1)
+        self.backoff_s = backoff_s
 
-    @staticmethod
-    def _normalize(url: str) -> str:
-        if not url.startswith(("http://", "https://")):
-            url = "http://" + url
-        return url.rstrip("/")
+    _normalize = staticmethod(_normalize_url)
+
+    def _broker_list(self, force_refresh: bool = False) -> List[str]:
+        if self._selector is not None:
+            dynamic = self._selector.brokers(force=force_refresh)
+            if dynamic:
+                return dynamic
+        return self._static
 
     def execute(self, sql: str) -> ResultSetGroup:
-        broker = self._brokers[next(self._rr)]
+        import time
+
+        last: Optional[Exception] = None
+        for attempt in range(self.retries):
+            brokers = self._broker_list(force_refresh=attempt > 0)
+            if not brokers:
+                raise PinotClientError("no live brokers discovered")
+            broker = brokers[next(self._rr) % len(brokers)]
+            try:
+                return self._post(broker, sql)
+            except PinotClientError:
+                raise  # broker reached; its answer is final
+            except OSError as e:
+                last = e  # unreachable: fail over to the next broker
+                if attempt + 1 < self.retries:
+                    time.sleep(self.backoff_s * (2 ** attempt))
+        raise PinotClientError(
+            f"all brokers unreachable after {self.retries} attempts: "
+            f"{last}") from last
+
+    def _post(self, broker: str, sql: str) -> ResultSetGroup:
         body = json.dumps({"sql": sql}).encode("utf-8")
         req = urllib.request.Request(
             f"{broker}/query/sql", data=body,
@@ -126,8 +200,6 @@ class Connection:
                 pass
             raise PinotClientError(
                 f"broker {broker} returned {e.code}: {detail}") from e
-        except OSError as e:
-            raise PinotClientError(f"broker {broker} unreachable: {e}") from e
         except ValueError as e:  # JSONDecodeError: 200 with a non-JSON body
             raise PinotClientError(
                 f"broker {broker} returned a non-JSON response: {e}") from e
@@ -141,3 +213,9 @@ class Connection:
 def connect(broker_urls: Sequence[str], **kw) -> Connection:
     """Ref: ConnectionFactory.fromHostList."""
     return Connection(broker_urls, **kw)
+
+
+def connect_with_controller(controller_url: str, **kw) -> Connection:
+    """Ref: ConnectionFactory.fromZookeeper — dynamic broker discovery
+    from the cluster's authority instead of a static host list."""
+    return Connection(selector=DynamicBrokerSelector(controller_url), **kw)

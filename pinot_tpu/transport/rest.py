@@ -436,11 +436,36 @@ class BrokerApi(_Api):
 
         self.route("POST", r"/query/sql", query)
         self.route("GET", r"/health", lambda m, b: (200, {"status": "OK"}))
+        self._broker = broker
         self.route("GET", r"/metrics",
                    lambda m, b: (200, broker.metrics.export_prometheus()))
         self.route("GET", r"/debug/routing/([^/]+)",
                    lambda m, b: (200, dict(
                        broker.routing.get_routing_table(m.group(1))[0])))
+
+    def start(self) -> None:
+        super().start()
+        # advertise this broker in cluster state so dynamic broker
+        # selectors can discover it (ref: brokers register their query
+        # endpoint in ZK; DynamicBrokerSelector watches that list)
+        store = getattr(self._broker, "store", None)
+        if store is not None:
+            from pinot_tpu.controller.state import InstanceInfo
+
+            self._instance_id = f"Broker_localhost_{self.port}"
+            store.register_instance(InstanceInfo(
+                self._instance_id, "BROKER",
+                host="localhost", port=self.port))
+
+    def stop(self) -> None:
+        # deregister LOUDLY: an ephemeral-port restart would otherwise
+        # accumulate alive=True ghosts that selectors dial and the query
+        # quota divides by (the ZK ephemeral-znode-expiry analogue)
+        store = getattr(self._broker, "store", None)
+        iid = getattr(self, "_instance_id", None)
+        if store is not None and iid is not None:
+            store.set_instance_alive(iid, False)
+        super().stop()
 
 
 def serve_cluster(cluster, controller_port: int = 0, broker_port: int = 0,

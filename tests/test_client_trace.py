@@ -101,3 +101,30 @@ def test_trace_entries_carry_instance(cluster):
     entries = results.raw["traceInfo"]["entries"]
     assert all("instance" in e for e in entries), entries
     assert {e["instance"] for e in entries} <= {"server_0", "server_1"}
+
+
+class TestDynamicBrokerSelection:
+    """Dynamic broker discovery + transport failover
+    (ref: DynamicBrokerSelector + round-robin with failover)."""
+
+    def test_discovery_from_controller(self, cluster):
+        from pinot_tpu.client import connect_with_controller
+        from pinot_tpu.transport.rest import ControllerApi
+
+        c, _broker = cluster
+        api = ControllerApi(c.controller)
+        api.start()
+        try:
+            conn = connect_with_controller(f"localhost:{api.port}")
+            rs = conn.execute("SELECT count(*) FROM ct").get_result_set()
+            assert rs.get_long(0, 0) == 2 * N
+        finally:
+            api.stop()
+
+    def test_failover_to_live_broker(self, cluster):
+        """First broker in the list is dead: the client must fail over and
+        answer from the live one instead of erroring."""
+        _, broker = cluster
+        conn = connect(["localhost:1", broker], retries=4, backoff_s=0.01)
+        rs = conn.execute("SELECT count(*) FROM ct").get_result_set()
+        assert rs.get_long(0, 0) == 2 * N

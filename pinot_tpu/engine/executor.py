@@ -539,14 +539,16 @@ def decode_grouped_result(plan: SegmentPlan, provider: Any,
     cards = plan.group_cards
     strides = plan.group_strides.astype(np.int64)
     key_cols: List[List[Any]] = []
-    for i, ((strat, col), card) in enumerate(zip(plan.group_defs, cards)):
+    for i, ((strat, payload), card) in enumerate(zip(plan.group_defs, cards)):
         dids = (gidx // strides[i]) % card
         if strat == "gdict":
-            d = provider.data_source(col).dictionary
+            d = provider.data_source(payload).dictionary
             key_cols.append(d.get_values(dids))
-        else:  # graw value-space
-            base = int(provider.metadata.column(col).min_value)
+        elif strat == "graw":  # value-space
+            base = int(provider.metadata.column(payload).min_value)
             key_cols.append([int(x) + base for x in dids])
+        else:  # gexpr: the def carries the expression's lower bound
+            key_cols.append([int(x) + int(payload) for x in dids])
     keys = list(zip(*key_cols))
 
     agg_specs = plan.spec[1]

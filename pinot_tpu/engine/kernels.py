@@ -175,6 +175,8 @@ def _emit_value(vspec: Tuple, cols, pc: _ParamCursor,
             return a / b
         if name == "mod":
             return a % b
+        if name == "floordiv":
+            return jnp.floor_divide(a, b)
     raise AssertionError(f"unknown value op {vspec!r}")
 
 
@@ -217,12 +219,14 @@ def build_kernel_body(spec: Tuple, capacity_override: int = 0,
         _bases = pc.take()            # [g] int64 (host uses for decode; raw
         #                               group keys subtract base on device)
         keys = jnp.zeros(capacity, dtype=jnp.int32)
-        for gi, (strat, colname) in enumerate(group_specs):
-            c = cols[colname]
+        for gi, (strat, payload) in enumerate(group_specs):
             if strat == "gdict":
-                k = c["fwd"]
-            else:  # graw: value-space key
-                k = (c["fwd"] - _bases[gi]).astype(jnp.int32)
+                k = cols[payload]["fwd"]
+            elif strat == "graw":  # value-space key
+                k = (cols[payload]["fwd"] - _bases[gi]).astype(jnp.int32)
+            else:  # gexpr: bounded integral expression, key = value - lo
+                v = _emit_value(payload, cols, pc, jnp.int64)
+                k = (v - _bases[gi]).astype(jnp.int32)
             keys = keys + k * strides[gi]
         if sparse_k:
             return _emit_grouped_sparse(agg_specs, cols, pc, mask, keys,

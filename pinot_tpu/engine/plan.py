@@ -45,7 +45,61 @@ MAX_DEVICE_GROUPS = 1 << 21
 
 _I32_MAX = np.iinfo(np.int32).max
 
-_ARITH_OPS = {"plus", "minus", "times", "divide", "mod"}
+_ARITH_OPS = {"plus", "minus", "times", "divide", "mod", "floordiv"}
+
+# Epoch-arithmetic transforms compile to exact device integer ops (the
+# device equivalents of the reference's vectorized datetime transform
+# functions, operator/transform/function/DateTimeConversionTransformFunction
+# et al. — fixed-width units only; calendar units stay host-evaluated).
+# Unit widths come from the host function registry so the oracle and the
+# device rewrite share one source of truth.
+from pinot_tpu.query.functions import TIME_UNIT_MS as _UNIT_MS
+from pinot_tpu.query.functions import TRUNC_UNIT_MS as _TRUNC_MS
+
+_TIME_DIV = {
+    "toepochseconds": _UNIT_MS["SECONDS"],
+    "toepochminutes": _UNIT_MS["MINUTES"],
+    "toepochhours": _UNIT_MS["HOURS"],
+    "toepochdays": _UNIT_MS["DAYS"]}
+_TIME_MUL = {
+    "fromepochseconds": _UNIT_MS["SECONDS"],
+    "fromepochminutes": _UNIT_MS["MINUTES"],
+    "fromepochhours": _UNIT_MS["HOURS"],
+    "fromepochdays": _UNIT_MS["DAYS"]}
+
+
+def _device_transform_rewrite(e: Function) -> Optional[Expr]:
+    """Time transform -> equivalent plus/minus/times/mod/floordiv tree, or
+    None when the function isn't device-expressible. Rewrites happen at
+    PLAN time only, so response column names keep the user's expression."""
+    n = e.name
+    if n in _TIME_DIV and len(e.args) == 1:
+        return Function("floordiv", (e.args[0], Literal(_TIME_DIV[n])))
+    if n in _TIME_MUL and len(e.args) == 1:
+        return Function("times", (e.args[0], Literal(_TIME_MUL[n])))
+    if (n == "datetrunc" and len(e.args) == 2
+            and isinstance(e.args[0], Literal)):
+        q = _TRUNC_MS.get(str(e.args[0].value).lower())
+        if q == 1:
+            return e.args[1]
+        if q:
+            # trunc(v, q) = v - (v mod q): exact for negatives too (floor
+            # semantics match the host datetrunc's floordiv-multiply)
+            return Function("minus", (e.args[1],
+                                      Function("mod",
+                                               (e.args[1], Literal(q)))))
+        return None
+    if (n == "timeconvert" and len(e.args) == 3
+            and all(isinstance(a, Literal) for a in e.args[1:])):
+        ma = _UNIT_MS.get(str(e.args[1].value).upper())
+        mb = _UNIT_MS.get(str(e.args[2].value).upper())
+        if ma is None or mb is None:
+            return None
+        inner: Expr = e.args[0] if ma == 1 else \
+            Function("times", (e.args[0], Literal(ma)))
+        return inner if mb == 1 else \
+            Function("floordiv", (inner, Literal(mb)))
+    return None
 
 
 def _next_pow2(n: int) -> int:
@@ -59,7 +113,8 @@ class SegmentPlan:
     spec: Tuple              # hashable kernel-cache key (incl. static sizes)
     params: List[np.ndarray]  # runtime arrays, kernel consumes in order
     columns: List[str]       # columns to stage
-    group_defs: List[Tuple[str, str]]  # (strategy, column) per group expr
+    # (strategy, column | gexpr base) per group expr (decode reads these)
+    group_defs: List[Tuple[str, Any]]
     group_cards: List[int]   # per group col: size of its key space
     group_strides: Optional[np.ndarray]  # row-major key strides (decode uses)
     num_groups: int          # padded total group count (0 = not group-by)
@@ -94,17 +149,28 @@ def plan_segment(ctx: QueryContext, segment: ImmutableSegment) -> SegmentPlan:
     agg_defs = [resolve_agg(f) for f in ctx.aggregations]
 
     group_specs: List[Tuple] = []
-    group_defs: List[Tuple[str, str]] = []
+    group_defs: List[Tuple[str, Any]] = []
     group_cards: List[int] = []
+    group_bases: List[int] = []
+    pending_gexpr: List[Tuple[int, Expr]] = []
     num_groups = 0
     if ctx.group_by:
         for e in ctx.group_by:
-            strat, col, card = _group_strategy(e, segment)
-            group_specs.append((strat, col))
-            group_defs.append((strat, col))
+            strat, payload, card, base = _group_strategy(e, segment)
             group_cards.append(card)
-            if col not in columns:
-                columns.append(col)
+            group_bases.append(base)
+            if strat == "gexpr":
+                # compiled AFTER strides/bases so the kernel's param-cursor
+                # order (strides, bases, then key-expression literals)
+                # matches the order the params list is built in
+                group_specs.append(None)
+                group_defs.append((strat, base))  # decode adds base back
+                pending_gexpr.append((len(group_specs) - 1, e))
+            else:
+                group_specs.append((strat, payload))
+                group_defs.append((strat, payload))
+                if payload not in columns:
+                    columns.append(payload)
         total = 1
         for c in group_cards:
             total *= c
@@ -118,9 +184,10 @@ def plan_segment(ctx: QueryContext, segment: ImmutableSegment) -> SegmentPlan:
         for i in range(len(group_cards) - 2, -1, -1):
             strides[i] = strides[i + 1] * group_cards[i + 1]
         params.append(strides)
-        bases = np.array([_group_base(s, c, segment)
-                          for (s, c) in group_defs], dtype=np.int64)
-        params.append(bases)
+        params.append(np.asarray(group_bases, dtype=np.int64))
+        for idx, e in pending_gexpr:
+            group_specs[idx] = (
+                "gexpr", _compile_value(e, segment, params, columns))
         grouped = True
     else:
         strides = None
@@ -211,10 +278,10 @@ def plan_segment(ctx: QueryContext, segment: ImmutableSegment) -> SegmentPlan:
 def _value_kind(e: Expr, segment: ImmutableSegment):
     """('int', max_abs|None) when the expression is integral on device,
     ('float', None) otherwise. Integer bounds propagate through
-    plus/minus/times so expression aggregations like
-    ``sum(lo_extendedprice * lo_discount)`` accumulate EXACTLY in i32/i64
-    instead of drifting in f32 (divide/mod stay float — the reference's
-    transform results for those are doubles too)."""
+    plus/minus/times/mod/floordiv (and the epoch-transform rewrites) so
+    expression aggregations like ``sum(lo_extendedprice * lo_discount)``
+    or ``sum(toEpochDays(ts))`` accumulate EXACTLY in i32/i64 instead of
+    drifting in f32; true division stays float."""
     if isinstance(e, Literal):
         if isinstance(e.value, bool) or isinstance(e.value, int):
             return ("int", abs(int(e.value)))
@@ -227,14 +294,24 @@ def _value_kind(e: Expr, segment: ImmutableSegment):
             return ("int", max(abs(int(cm.min_value)),
                                abs(int(cm.max_value))))
         return ("float", None)
-    if (isinstance(e, Function) and e.name in ("plus", "minus", "times")
-            and len(e.args) == 2):
-        kinds = [_value_kind(a, segment) for a in e.args]
-        if all(k[0] == "int" for k in kinds):
-            (_, la), (_, ra) = kinds
-            if la is None or ra is None:
-                return ("int", None)
-            return ("int", la * ra if e.name == "times" else la + ra)
+    if isinstance(e, Function):
+        rewritten = _device_transform_rewrite(e)
+        if rewritten is not None:
+            return _value_kind(rewritten, segment)
+        if (e.name in ("plus", "minus", "times", "mod", "floordiv")
+                and len(e.args) == 2):
+            kinds = [_value_kind(a, segment) for a in e.args]
+            if all(k[0] == "int" for k in kinds):
+                (_, la), (_, ra) = kinds
+                if e.name == "mod":
+                    # |a mod b| < |b| under floor semantics
+                    return ("int", ra)
+                if e.name == "floordiv":
+                    # |a // b| <= |a| for integral |b| >= 1
+                    return ("int", la)
+                if la is None or ra is None:
+                    return ("int", None)
+                return ("int", la * ra if e.name == "times" else la + ra)
     return ("float", None)
 
 
@@ -264,32 +341,87 @@ def _acc_dtype(base: str, vexpr: Optional[Expr], segment: ImmutableSegment,
 # group-by strategies
 # --------------------------------------------------------------------------
 
-def _group_strategy(e: Expr, segment: ImmutableSegment) -> Tuple[str, str, int]:
-    if not isinstance(e, Identifier):
+def _value_bounds(e: Expr, segment: ImmutableSegment
+                  ) -> Optional[Tuple[int, int]]:
+    """(lo, hi) integer bounds of a device-compilable expression via
+    interval arithmetic over column stats, or None when unbounded /
+    non-integral. Feeds the 'gexpr' group strategy: a bounded integral
+    expression's value space is a dense key range, exactly like a raw int
+    column's (ref: the value-based group key generators,
+    NoDictionarySingleColumnGroupKeyGenerator)."""
+    if isinstance(e, Literal):
+        if isinstance(e.value, bool) or not isinstance(e.value, int):
+            return None
+        return (e.value, e.value)
+    if isinstance(e, Identifier):
+        if e.name.startswith("$"):
+            return None
+        cm = segment.metadata.column(e.name)
+        if (not cm.single_value or not cm.data_type.is_integral
+                or cm.min_value is None or cm.max_value is None):
+            return None
+        return (int(cm.min_value), int(cm.max_value))
+    if isinstance(e, Function):
+        rw = _device_transform_rewrite(e)
+        if rw is not None:
+            return _value_bounds(rw, segment)
+        if e.name not in ("plus", "minus", "times", "mod", "floordiv") \
+                or len(e.args) != 2:
+            return None
+        a = _value_bounds(e.args[0], segment)
+        b = _value_bounds(e.args[1], segment)
+        if a is None or b is None:
+            return None
+        (alo, ahi), (blo, bhi) = a, b
+        if e.name == "plus":
+            return (alo + blo, ahi + bhi)
+        if e.name == "minus":
+            return (alo - bhi, ahi - blo)
+        if e.name == "times":
+            corners = (alo * blo, alo * bhi, ahi * blo, ahi * bhi)
+            return (min(corners), max(corners))
+        # mod / floordiv: positive-constant divisor only (floor semantics)
+        if blo != bhi or blo <= 0:
+            return None
+        if e.name == "mod":
+            return (0, blo - 1)
+        return (alo // blo, ahi // blo)
+    return None
+
+
+def _group_strategy(e: Expr, segment: ImmutableSegment
+                    ) -> Tuple[str, Any, int, int]:
+    """-> (strategy, payload, cardinality, base). Payload is the column
+    name for gdict/graw; for 'gexpr' the EXPRESSION (compiled to a device
+    value spec after strides/bases take their param slots)."""
+    if isinstance(e, Identifier):
+        if e.name.startswith("$"):
+            raise PlanError("group-by on virtual column -> host path")
+        cm = segment.metadata.column(e.name)
+        if not cm.single_value:
+            raise PlanError("group-by on MV column -> host path")
+        if cm.has_dictionary:
+            # key = dictId (ref: DictionaryBasedGroupKeyGenerator.java:62)
+            return ("gdict", e.name, cm.cardinality, 0)
+        if cm.data_type.is_integral:
+            lo, hi = int(cm.min_value), int(cm.max_value)
+            span = hi - lo + 1
+            if span > MAX_DEVICE_GROUPS:
+                raise PlanError("raw int group-by span too large")
+            # key = value - min (value-space; psum-able across segments
+            # that share the base -- used by the sharded combine path)
+            return ("graw", e.name, span, lo)
+        raise PlanError("group-by on raw float column -> host path")
+    # bounded integral EXPRESSION (time buckets: GROUP BY toEpochDays(ts),
+    # dateTrunc('hour', ts), ...): key = expr value - lo
+    bounds = _value_bounds(e, segment)
+    if bounds is None:
         raise PlanError(f"group-by expression {e} -> host path")
-    if e.name.startswith("$"):
-        raise PlanError("group-by on virtual column -> host path")
-    cm = segment.metadata.column(e.name)
-    if not cm.single_value:
-        raise PlanError("group-by on MV column -> host path")
-    if cm.has_dictionary:
-        # key = dictId (ref: DictionaryBasedGroupKeyGenerator.java:62)
-        return ("gdict", e.name, cm.cardinality)
-    if cm.data_type.is_integral:
-        lo, hi = int(cm.min_value), int(cm.max_value)
-        span = hi - lo + 1
-        if span > MAX_DEVICE_GROUPS:
-            raise PlanError("raw int group-by span too large")
-        # key = value - min (value-space; psum-able across segments that
-        # share the base -- used by the sharded combine path)
-        return ("graw", e.name, span)
-    raise PlanError("group-by on raw float column -> host path")
-
-
-def _group_base(strategy: str, col: str, segment: ImmutableSegment) -> int:
-    if strategy == "graw":
-        return int(segment.metadata.column(col).min_value)
-    return 0
+    lo, hi = bounds
+    span = hi - lo + 1
+    if span <= 0 or span > MAX_DEVICE_GROUPS:
+        raise PlanError("group-by expression span too large -> host path")
+    return ("gexpr", e, span, lo)
 
 
 # --------------------------------------------------------------------------
@@ -556,7 +688,10 @@ def _compile_value(e: Expr, segment: ImmutableSegment,
         return ("col", e.name, cm.has_dictionary)
     if isinstance(e, Function):
         if e.name not in _ARITH_OPS:
-            raise PlanError(f"transform {e.name} -> host path")
+            rewritten = _device_transform_rewrite(e)
+            if rewritten is None:
+                raise PlanError(f"transform {e.name} -> host path")
+            return _compile_value(rewritten, segment, params, columns)
         args = tuple(_compile_value(a, segment, params, columns) for a in e.args)
         return ("fn", e.name, args)
     raise PlanError(f"cannot compile value expression {e}")

@@ -417,10 +417,18 @@ def _from_datetime(s, fmt):
     return int(dt.replace(tzinfo=_dt.timezone.utc).timestamp() * 1000)
 
 
-_TRUNC_UNIT_MS = {
+# fixed-width unit tables, shared with the device transform rewrites
+# (engine/plan.py imports these so the host oracle and the device integer
+# rewrite can never diverge on a unit's width)
+TRUNC_UNIT_MS = {
     "millisecond": 1, "second": 1000, "minute": 60_000, "hour": 3_600_000,
     "day": 86_400_000, "week": 7 * 86_400_000,
 }
+TIME_UNIT_MS = {
+    "MILLISECONDS": 1, "SECONDS": 1000, "MINUTES": 60_000,
+    "HOURS": 3_600_000, "DAYS": 86_400_000,
+}
+_TRUNC_UNIT_MS = TRUNC_UNIT_MS
 
 
 @scalar_function(name="datetrunc", aliases=["dateTrunc"])
@@ -481,12 +489,8 @@ def _second(ms):
 
 @scalar_function(name="timeconvert", aliases=["timeConvert"])
 def _time_convert(value, from_unit, to_unit):
-    _UNIT_MS = {
-        "MILLISECONDS": 1, "SECONDS": 1000, "MINUTES": 60_000,
-        "HOURS": 3_600_000, "DAYS": 86_400_000,
-    }
-    ms = int(value) * _UNIT_MS[str(from_unit).upper()]
-    return ms // _UNIT_MS[str(to_unit).upper()]
+    ms = int(value) * TIME_UNIT_MS[str(from_unit).upper()]
+    return ms // TIME_UNIT_MS[str(to_unit).upper()]
 
 
 # ---- json (ref: JsonFunctions.java) ----

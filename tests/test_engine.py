@@ -427,3 +427,79 @@ def test_batched_scatter_branch_parity(tmp_path, monkeypatch):
     rt_batched, _ = batched.execute(compile_query(sql), [seg])
     assert len(batched.kernels) == 1
     assert rt_batched.rows == rt_split.rows
+
+
+# -- device time transforms: epoch arithmetic compiles to EXACT device
+# integer ops (plan._device_transform_rewrite; ref: the reference's
+# vectorized datetime transforms, operator/transform/function/) ----------
+
+TIME_TRANSFORM_QUERIES = [
+    "SELECT sum(toEpochDays(runs)) FROM stats WHERE year > 2005",
+    "SELECT team, sum(toEpochHours(runs)), max(runs) FROM stats "
+    "GROUP BY team ORDER BY team",
+    "SELECT sum(dateTrunc('minute', runs)) FROM stats",
+    "SELECT sum(timeConvert(runs, 'MILLISECONDS', 'SECONDS')) FROM stats",
+    "SELECT min(fromEpochSeconds(year)) FROM stats",
+]
+
+
+def test_time_transforms_plan_on_device(setup):
+    """The plan must NOT fall back to the host path (PlanError = fail)."""
+    from pinot_tpu.engine.plan import plan_segment
+
+    _, segs = setup
+    for sql in TIME_TRANSFORM_QUERIES:
+        plan_segment(compile_query(sql), segs[0])
+
+
+@pytest.mark.parametrize("sql", TIME_TRANSFORM_QUERIES,
+                         ids=[q[:55] for q in TIME_TRANSFORM_QUERIES])
+def test_time_transform_device_matches_host(setup, device_exec, host_exec,
+                                            sql):
+    _, segs = setup
+    got = rows(device_exec, segs, sql)
+    want = rows(host_exec, segs, sql)
+    # integer-exact: the device computes these in i32/i64, not f32
+    assert_rows_close(got, want, rel=1e-12)
+
+
+GEXPR_QUERIES = [
+    "SELECT toEpochDays(runs), sum(score), count(*) FROM stats "
+    "GROUP BY toEpochDays(runs) ORDER BY toEpochDays(runs) LIMIT 1000",
+    "SELECT dateTrunc('minute', runs), team, sum(runs) FROM stats "
+    "GROUP BY dateTrunc('minute', runs), team "
+    "ORDER BY dateTrunc('minute', runs), team LIMIT 1000",
+    "SELECT year - 2000, count(*) FROM stats WHERE year >= 2002 "
+    "GROUP BY year - 2000 ORDER BY year - 2000 LIMIT 100",
+]
+
+
+def test_gexpr_group_by_plans_on_device(setup):
+    """Bounded integral expressions group on DEVICE (the time-bucket
+    query shape; strategy 'gexpr' in plan._group_strategy)."""
+    from pinot_tpu.engine.plan import plan_segment
+
+    _, segs = setup
+    for sql in GEXPR_QUERIES:
+        plan = plan_segment(compile_query(sql), segs[0])
+        assert any(s == "gexpr" for s, _ in plan.group_defs), sql
+
+
+@pytest.mark.parametrize("sql", GEXPR_QUERIES,
+                         ids=[q[:55] for q in GEXPR_QUERIES])
+def test_gexpr_group_by_matches_host(setup, device_exec, host_exec, sql):
+    _, segs = setup
+    assert_rows_close(rows(device_exec, segs, sql),
+                      rows(host_exec, segs, sql))
+
+
+@pytest.mark.parametrize("sql", GEXPR_QUERIES,
+                         ids=[q[:55] for q in GEXPR_QUERIES])
+def test_gexpr_group_by_sharded(setup, host_exec, sql):
+    """The sharded combine handles gexpr keys (value-space keys share the
+    batch-wide base, so partials psum exactly)."""
+    from pinot_tpu.parallel import ShardedQueryExecutor
+
+    _, segs = setup
+    dev = ShardedQueryExecutor()
+    assert_rows_close(rows(dev, segs, sql), rows(host_exec, segs, sql))

@@ -275,6 +275,16 @@ def extract_plan(plan, provider) -> Optional[PallasPlan]:
             if vspec[0] == "col":
                 vi, is_int, max_abs = leaf_idx(vspec[1])
                 return ("v", vi), is_int, max_abs
+            if vspec[0] == "lit":
+                # literal params become SPEC constants: units/factors are
+                # low-cardinality, so keying the kernel cache on them is
+                # cheap and keeps the kernel free of an extra params lane
+                # (the cursor position mirrors the jnp kernel's consumption
+                # order exactly)
+                v = float(np.asarray(pc.take()))
+                if v.is_integer() and abs(v) <= _I32_MAX:
+                    return ("litc", int(v)), True, abs(int(v))
+                return ("litf", v), False, None
             if (vspec[0] == "fn" and vspec[1] in ("times", "plus", "minus")
                     and len(vspec[2]) == 2):
                 le, li, lm = compile_vexpr(vspec[2][0])
@@ -286,6 +296,9 @@ def extract_plan(plan, provider) -> Optional[PallasPlan]:
                         raise _Ineligible("int expr bound exceeds i32")
                     return (vspec[1], le, re_), True, max_abs
                 return (vspec[1], le, re_), False, None
+            # mod/floordiv deliberately stay jnp-served: Mosaic integer
+            # division support is not guaranteed, and one lowering failure
+            # at run time would disable pallas for the whole process
             raise _Ineligible(f"agg value {vspec[0]!r}")
 
         aggs: List[Tuple[str, Optional[Tuple], Optional[int]]] = []
@@ -364,6 +377,10 @@ def _row_layout(spec: PallasSpec):
 def _expr_is_int(vexpr: Tuple, value_is_int: Tuple[bool, ...]) -> bool:
     if vexpr[0] == "v":
         return value_is_int[vexpr[1]]
+    if vexpr[0] == "litc":
+        return True
+    if vexpr[0] == "litf":
+        return False
     return (_expr_is_int(vexpr[1], value_is_int)
             and _expr_is_int(vexpr[2], value_is_int))
 
@@ -463,6 +480,10 @@ def build_kernel(spec: PallasSpec):
                 return v
             if vexpr[0] == "v":
                 v = values[vexpr[1]][0, 0]
+            elif vexpr[0] == "litc":
+                v = jnp.int32(vexpr[1])
+            elif vexpr[0] == "litf":
+                v = jnp.float32(vexpr[1])
             else:
                 a = emit_vexpr(vexpr[1])
                 b = emit_vexpr(vexpr[2])

@@ -203,6 +203,10 @@ def big_setup(tmp_path_factory):
 BIG_QUERIES = [
     # all three SSB Q1 flights are sum(extendedprice * discount) shapes
     "SELECT sum(price * disc) FROM pl_big WHERE disc BETWEEN 1 AND 3",
+    # literal operands bake into the kernel spec as constants
+    "SELECT sum(disc * 1000), max(rev) FROM pl_big WHERE disc > 2",
+    "SELECT k, sum(fromEpochSeconds(disc)) FROM pl_big GROUP BY k "
+    "ORDER BY k",
     "SELECT sum(rev) FROM pl_big",                       # > i32 total
     "SELECT k, sum(rev), count(*) FROM pl_big GROUP BY k ORDER BY k",
     "SELECT k, sum(price * disc), avg(rev) FROM pl_big "

@@ -191,12 +191,17 @@ class AvroRecordReader(RecordReader):
         pass
 
 
+from pinot_tpu.ingestion.protobuf import ProtoBufRecordReader  # noqa: E402
+# (protobuf.py defers the google.protobuf import to first use)
+
 _FORMATS: Dict[str, Type[RecordReader]] = {
     "csv": CSVRecordReader,
     "json": JSONRecordReader,
     "jsonl": JSONRecordReader,
     "parquet": ParquetRecordReader,
     "avro": AvroRecordReader,
+    "proto": ProtoBufRecordReader,
+    "pb": ProtoBufRecordReader,
 }
 
 

@@ -64,6 +64,9 @@ class SegmentGenerationJobSpec:
     data_format: Optional[str] = None
     reader_config: Dict[str, Any] = field(default_factory=dict)
     segment_name_prefix: Optional[str] = None
+    # ref: segmentCreationJobParallelism — <=1 = sequential (the reference
+    # default); >1 opts into a spawn-based process pool
+    parallelism: int = 1
 
     @classmethod
     def from_dict(cls, d: Dict[str, Any]) -> "SegmentGenerationJobSpec":
@@ -84,6 +87,7 @@ class SegmentGenerationJobSpec:
             reader_config=reader.get("configs") or {},
             segment_name_prefix=(namegen.get("configs") or {}).get(
                 "segment.name.prefix"),
+            parallelism=int(d.get("segmentCreationJobParallelism", 1) or 1),
         )
 
     @classmethod
@@ -180,6 +184,14 @@ def _match_glob(root: str, pattern: str,
     return sorted(out)
 
 
+def _build_one_process(spec, schema, table_config, input_file: str,
+                       segment_name: str) -> None:
+    """Process-pool entry: rebuild the runner in the worker (fork-started;
+    specs/schemas are small plain dataclasses)."""
+    SegmentGenerationJobRunner(spec, schema, table_config)._build_one(
+        input_file, segment_name)
+
+
 class SegmentGenerationJobRunner:
     """Ref: standalone SegmentGenerationJobRunner.java — one segment per
     matched input file, sequence-numbered names."""
@@ -222,12 +234,24 @@ class SegmentGenerationJobRunner:
                  or (self.table_config.table_name if self.table_config
                      else self.schema.schema_name))
         prefix = spec.segment_name_prefix or f"{table}_batch"
-        out_dirs = []
-        for seq, path in enumerate(files):
-            name = f"{prefix}_{seq}"
-            self._build_one(path, name)
-            out_dirs.append(os.path.join(spec.output_dir_uri, name))
-        return out_dirs
+        jobs = [(path, f"{prefix}_{seq}") for seq, path in enumerate(files)]
+        workers = min(max(spec.parallelism, 1), len(jobs))
+        if workers > 1:
+            # per-file builds are independent (ref: the runner submits one
+            # SegmentGenerationTaskRunner per file to an ExecutorService,
+            # segmentCreationJobParallelism wide). SPAWN, not fork: callers
+            # usually have a live JAX runtime whose threads/locks a forked
+            # child would inherit mid-flight
+            import multiprocessing as mp
+
+            args = [(self.spec, self.schema, self.table_config, p, n)
+                    for p, n in jobs]
+            with mp.get_context("spawn").Pool(workers) as pool:
+                pool.starmap(_build_one_process, args)
+        else:
+            for path, name in jobs:
+                self._build_one(path, name)
+        return [os.path.join(spec.output_dir_uri, name) for _, name in jobs]
 
     def _build_one(self, input_file: str, segment_name: str) -> None:
         spec = self.spec

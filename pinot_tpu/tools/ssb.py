@@ -210,9 +210,10 @@ def build_segments(sf: float, out_dir: str, num_segments: int = 8,
     if workers > 1 and len(jobs) > 1:
         import multiprocessing as mp
 
-        # fork: children inherit loaded modules but run numpy-only builder
-        # code (no jax calls cross the fork)
-        with mp.get_context("fork").Pool(workers) as pool:
+        # SPAWN, not fork: the bench worker calls this with a live JAX
+        # runtime whose threads/locks a forked child would inherit
+        # mid-flight; the builder itself is numpy-only either way
+        with mp.get_context("spawn").Pool(workers) as pool:
             names = pool.starmap(_build_one, jobs)
     else:
         names = [_build_one(*j) for j in jobs]

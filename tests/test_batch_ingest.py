@@ -394,3 +394,36 @@ def test_glob_braces_with_wildcards(tmp_path):
     got = [os.path.basename(p)
            for p in _match_glob(str(tmp_path), "glob:{*.csv,*.json}")]
     assert got == ["a.csv", "b.json"]
+
+
+def test_job_parallelism_builds_all_segments(tmp_path):
+    """segmentCreationJobParallelism > 1: per-file builds run in a process
+    pool; every matched file still becomes exactly one segment (ref: the
+    runner's ExecutorService fan-out)."""
+    import numpy as np
+
+    from pinot_tpu.ingestion.batchjob import (
+        SegmentGenerationJobRunner,
+        SegmentGenerationJobSpec,
+    )
+    from pinot_tpu.segment import load_segment
+    from pinot_tpu.spi import DataType, FieldSpec, FieldType, Schema
+
+    inp = tmp_path / "in"
+    inp.mkdir()
+    rng = np.random.default_rng(2)
+    for i in range(4):
+        lines = ["k,v"] + [f"k{j % 3},{int(rng.integers(0, 9))}"
+                           for j in range(200)]
+        (inp / f"part{i}.csv").write_text("\n".join(lines))
+    schema = Schema("pj", [
+        FieldSpec("k", DataType.STRING),
+        FieldSpec("v", DataType.LONG, FieldType.METRIC)])
+    spec = SegmentGenerationJobSpec(
+        input_dir_uri=str(inp), include_file_name_pattern="glob:**/*.csv",
+        output_dir_uri=str(tmp_path / "out"), table_name="pj",
+        data_format="csv", parallelism=4)
+    dirs = SegmentGenerationJobRunner(spec, schema=schema).run()
+    assert len(dirs) == 4
+    total = sum(load_segment(d).num_docs for d in dirs)
+    assert total == 800

@@ -144,6 +144,8 @@ def register_fs(scheme: str, ctor: Callable[[], PinotFS]) -> None:
 
 def get_fs(uri: str) -> PinotFS:
     scheme = urllib.parse.urlparse(uri).scheme.lower()
+    if scheme == "s3" and "s3" not in _REGISTRY:
+        from pinot_tpu.spi import s3fs  # noqa: F401 — registers scheme s3
     ctor = _REGISTRY.get(scheme)
     if ctor is None:
         raise ValueError(f"no PinotFS registered for scheme {scheme!r} "
@@ -161,19 +163,15 @@ def fetch_segment(download_url: str, local_dir: str,
     fetchSegmentToLocal wrapping fetchers in RetryPolicies) and, when a
     ``crypter`` name is given, decrypts every downloaded file
     (ref: fetchAndDecryptSegmentToLocal + the crypt SPI)."""
-    import time
+    from pinot_tpu.spi.retry import ExponentialBackoffRetryPolicy
 
     fs = get_fs(download_url)  # unknown scheme fails fast, no retries
-    for attempt in range(max(retries, 1)):
-        try:
-            local = fs.copy_to_local_dir(download_url, local_dir)
-            break
-        except ValueError:
-            raise  # permanent (e.g. path-escape rejection): never retry
-        except Exception:  # noqa: BLE001 — transient deep-store faults
-            if attempt + 1 >= max(retries, 1):
-                raise
-            time.sleep(backoff_s * (2 ** attempt))
+    # ValueError (path-escape rejection, bad config) is permanent and
+    # never retried — the policy's default retriable gate
+    local = ExponentialBackoffRetryPolicy(
+        max_attempts=max(retries, 1), initial_delay_ms=backoff_s * 1e3,
+        randomize=False,
+    ).attempt(lambda: fs.copy_to_local_dir(download_url, local_dir))
     if crypter:
         from pinot_tpu.spi.crypt import get_crypter
 

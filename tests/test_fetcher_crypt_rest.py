@@ -86,10 +86,13 @@ class TestRetryingFetcher:
             def copy_to_local_dir(self, uri, local_dir):
                 raise OSError("down")
 
+        from pinot_tpu.spi.retry import AttemptsExceededError
+
         register_fs("dead", DeadFS)
-        with pytest.raises(OSError):
+        with pytest.raises(AttemptsExceededError) as e:
             fetch_segment("dead://x/y", str(tmp_path), retries=2,
                           backoff_s=0.01)
+        assert isinstance(e.value.last, OSError)
 
     def test_unknown_scheme_fails_fast(self, tmp_path):
         """A permanent error (no FS for the scheme) must not burn the

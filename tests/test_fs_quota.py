@@ -46,14 +46,16 @@ class TestPinotFS:
         assert isinstance(get_fs("file:///tmp/x"), LocalPinotFS)
         assert isinstance(get_fs("/tmp/x"), LocalPinotFS)
         assert get_fs("http://h/x").scheme == "http"
-        with pytest.raises(ValueError):
-            get_fs("s3://bucket/x")
-
-        class FakeS3(LocalPinotFS):
-            scheme = "s3"
-
-        register_fs("s3", FakeS3)
+        # s3 is a first-class scheme now (spi/s3fs.py, lazily registered)
         assert get_fs("s3://bucket/x").scheme == "s3"
+        with pytest.raises(ValueError):
+            get_fs("adls://container/x")
+
+        class FakeAdls(LocalPinotFS):
+            scheme = "adls"
+
+        register_fs("adls", FakeAdls)
+        assert get_fs("adls://container/x").scheme == "adls"
 
     def test_local_roundtrip(self, tmp_path):
         seg_dir = _build_segment(tmp_path / "src")

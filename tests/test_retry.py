@@ -1,0 +1,106 @@
+"""Retry policies + ServiceStartable lifecycle.
+
+Ref: pinot-spi/.../utils/retry/ (RetryPolicies, AttemptsExceededException)
+and pinot-spi/.../services/ServiceStartable.java.
+"""
+
+import pytest
+
+from pinot_tpu.spi.retry import (
+    AttemptsExceededError,
+    ServiceManager,
+    ServiceStartable,
+    exponential_backoff,
+    fixed_delay,
+)
+
+
+class TestRetryPolicies:
+    def test_succeeds_after_transient_failures(self):
+        calls = {"n": 0}
+
+        def op():
+            calls["n"] += 1
+            if calls["n"] < 3:
+                raise OSError("transient")
+            return "ok"
+
+        assert fixed_delay(5, delay_ms=1).attempt(op) == "ok"
+        assert calls["n"] == 3
+
+    def test_exhaustion_raises_with_cause(self):
+        def op():
+            raise OSError("down")
+
+        with pytest.raises(AttemptsExceededError) as e:
+            exponential_backoff(3, initial_delay_ms=1).attempt(op)
+        assert e.value.attempts == 3
+        assert isinstance(e.value.last, OSError)
+
+    def test_permanent_errors_never_retry(self):
+        calls = {"n": 0}
+
+        def op():
+            calls["n"] += 1
+            raise ValueError("bad input")
+
+        with pytest.raises(ValueError):
+            fixed_delay(5, delay_ms=1).attempt(op)
+        assert calls["n"] == 1
+
+    def test_custom_retriable_gate(self):
+        calls = {"n": 0}
+
+        def op():
+            calls["n"] += 1
+            raise KeyError("nope")
+
+        with pytest.raises(KeyError):
+            fixed_delay(5, delay_ms=1).attempt(
+                op, retriable=lambda e: not isinstance(e, KeyError))
+        assert calls["n"] == 1
+
+    def test_exponential_delays_scale(self):
+        p = exponential_backoff(4, initial_delay_ms=100, delay_scale=2.0)
+        p._randomize = False
+        assert [p.delay_s(i) for i in range(3)] == [0.1, 0.2, 0.4]
+
+
+class _Svc(ServiceStartable):
+    def __init__(self, name, log, fail=False):
+        self._name, self._log, self._fail = name, log, fail
+
+    def start(self):
+        if self._fail:
+            raise RuntimeError(f"{self._name} failed to start")
+        self._log.append(("start", self._name))
+
+    def stop(self):
+        self._log.append(("stop", self._name))
+
+    @property
+    def service_role(self):
+        return self._name
+
+
+class TestServiceManager:
+    def test_start_order_and_reverse_stop(self):
+        log = []
+        mgr = ServiceManager()
+        for n in ("CONTROLLER", "BROKER", "SERVER"):
+            mgr.register(_Svc(n, log))
+        mgr.start_all()
+        mgr.stop_all()
+        assert log == [("start", "CONTROLLER"), ("start", "BROKER"),
+                       ("start", "SERVER"), ("stop", "SERVER"),
+                       ("stop", "BROKER"), ("stop", "CONTROLLER")]
+
+    def test_failed_start_unwinds_started_prefix(self):
+        log = []
+        mgr = ServiceManager()
+        mgr.register(_Svc("CONTROLLER", log))
+        mgr.register(_Svc("BROKER", log, fail=True))
+        mgr.register(_Svc("SERVER", log))
+        with pytest.raises(RuntimeError):
+            mgr.start_all()
+        assert log == [("start", "CONTROLLER"), ("stop", "CONTROLLER")]

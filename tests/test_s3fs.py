@@ -1,0 +1,125 @@
+"""S3 PinotFS: SigV4-signed REST protocol against a verifying endpoint.
+
+Ref: pinot-plugins/pinot-file-system/pinot-s3 S3PinotFS — here the client
+speaks the S3 REST API itself (ListObjectsV2/Get/Put/Delete with AWS
+Signature V4); the mock endpoint recomputes every signature from the
+shared secret, so a signing bug fails the suite, not production.
+"""
+
+import urllib.error
+
+import numpy as np
+import pytest
+
+from pinot_tpu.spi import DataType, FieldSpec, FieldType, Schema
+from pinot_tpu.spi.filesystem import fetch_segment
+from pinot_tpu.spi.s3fs import MockS3Server, S3PinotFS, sign_request
+
+
+@pytest.fixture()
+def s3():
+    srv = MockS3Server().start()
+    fs = S3PinotFS(endpoint=srv.endpoint, access_key=srv.access_key,
+                   secret_key=srv.secret_key, region=srv.region)
+    yield srv, fs
+    srv.stop()
+
+
+class TestSigV4:
+    def test_known_vector_shape(self):
+        """Signature is deterministic and carries the scope/headers the
+        service recomputes from."""
+        import datetime
+
+        now = datetime.datetime(2026, 7, 30, 12, 0, 0,
+                                tzinfo=datetime.timezone.utc)
+        h = sign_request("GET", "http://localhost:9000/bucket/key", {},
+                         b"", "AK", "SK", "us-east-1", now=now)
+        assert h["x-amz-date"] == "20260730T120000Z"
+        assert "Credential=AK/20260730/us-east-1/s3/aws4_request" \
+            in h["Authorization"]
+        again = sign_request("GET", "http://localhost:9000/bucket/key", {},
+                             b"", "AK", "SK", "us-east-1", now=now)
+        assert h["Authorization"] == again["Authorization"]
+
+    def test_wrong_secret_is_rejected(self, s3):
+        srv, _ = s3
+        bad = S3PinotFS(endpoint=srv.endpoint, access_key=srv.access_key,
+                        secret_key="wrong", region=srv.region)
+        with pytest.raises(urllib.error.HTTPError) as e:
+            bad.list_files("s3://b/x")
+        assert e.value.code == 403
+
+
+class TestRoundtrip:
+    def test_upload_list_download_delete(self, s3, tmp_path):
+        srv, fs = s3
+        src = tmp_path / "seg_src"
+        (src / "sub").mkdir(parents=True)
+        (src / "a.npy").write_bytes(b"alpha")
+        (src / "sub" / "b.npy").write_bytes(b"beta")
+        fs.copy_from_local_dir(str(src), "s3://deepstore/tables/t/seg_0")
+        assert sorted(fs.list_files("s3://deepstore/tables/t/seg_0")) == [
+            "tables/t/seg_0/a.npy", "tables/t/seg_0/sub/b.npy"]
+        out = fs.copy_to_local_dir("s3://deepstore/tables/t/seg_0",
+                                   str(tmp_path / "dl"))
+        assert (tmp_path / "dl" / "seg_0" / "a.npy").read_bytes() == b"alpha"
+        assert (tmp_path / "dl" / "seg_0" / "sub" / "b.npy").read_bytes() \
+            == b"beta"
+        fs.delete("s3://deepstore/tables/t/seg_0")
+        assert fs.list_files("s3://deepstore/tables/t/seg_0") == []
+
+    def test_pagination_and_special_keys(self, s3, tmp_path):
+        """ListObjectsV2 pagination follows continuation tokens; keys with
+        spaces sign correctly (no double-encoding); directory markers and
+        missing prefixes behave."""
+        srv, fs = s3
+        srv.page_size = 3
+        src = tmp_path / "many"
+        src.mkdir()
+        for i in range(10):
+            (src / f"file {i:02d}.bin").write_bytes(bytes([i]))
+        fs.copy_from_local_dir(str(src), "s3://b/pfx/many")
+        keys = fs.list_files("s3://b/pfx/many")
+        assert len(keys) == 10  # 4 pages of 3
+        # console-style directory marker must be skipped, not an error
+        srv.objects["b/pfx/many/"] = b""
+        out = fs.copy_to_local_dir("s3://b/pfx/many", str(tmp_path / "dl"))
+        assert (tmp_path / "dl" / "many" / "file 07.bin").read_bytes() \
+            == bytes([7])
+        with pytest.raises(FileNotFoundError):
+            fs.copy_to_local_dir("s3://b/pfx/NOPE", str(tmp_path / "dl2"))
+        assert fs.exists("s3://b/pfx/many")
+        assert not fs.exists("s3://b/pfx/NOPE")
+
+    def test_segment_through_s3_deep_store(self, s3, tmp_path, monkeypatch):
+        """The server download path (fetch_segment) resolves s3:// URLs:
+        build -> upload -> fetch via the registry -> load -> query."""
+        from pinot_tpu.engine import ServerQueryExecutor
+        from pinot_tpu.query import compile_query
+        from pinot_tpu.segment import SegmentBuilder, load_segment
+
+        srv, fs = s3
+        monkeypatch.setenv("PINOT_S3_ENDPOINT", srv.endpoint)
+        monkeypatch.setenv("AWS_ACCESS_KEY_ID", srv.access_key)
+        monkeypatch.setenv("AWS_SECRET_ACCESS_KEY", srv.secret_key)
+        monkeypatch.setenv("AWS_REGION", srv.region)
+
+        schema = Schema("s3t", [
+            FieldSpec("k", DataType.STRING),
+            FieldSpec("v", DataType.LONG, FieldType.METRIC)])
+        rng = np.random.default_rng(4)
+        frame = {"k": ["a", "b"] * 100,
+                 "v": rng.integers(0, 10, 200).tolist()}
+        SegmentBuilder(schema, "s3t_0").build(frame, str(tmp_path))
+        fs.copy_from_local_dir(str(tmp_path / "s3t_0"),
+                               "s3://deepstore/segments/s3t_0")
+
+        local = fetch_segment("s3://deepstore/segments/s3t_0",
+                              str(tmp_path / "fetched"))
+        seg = load_segment(local)
+        ex = ServerQueryExecutor(use_device=False)
+        rt, _ = ex.execute(
+            compile_query("SELECT count(*), sum(v) FROM s3t"), [seg])
+        assert rt.rows[0][0] == 200
+        assert rt.rows[0][1] == float(sum(frame["v"]))

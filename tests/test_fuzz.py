@@ -27,7 +27,9 @@ DIMS = {"color": ["red", "green", "blue", "gold"],
 INT_COLS = ["year", "qty"]
 FLOAT_COLS = ["price"]
 AGGS = ["count(*)", "sum(qty)", "min(price)", "max(price)", "avg(qty)",
-        "minmaxrange(year)", "distinctcount(color)", "sum(qty * price)"]
+        "minmaxrange(year)", "distinctcount(color)", "sum(qty * price)",
+        "sum(fromEpochSeconds(qty))", "sum(timeConvert(qty, 'SECONDS', "
+        "'MILLISECONDS'))"]
 
 
 def _frame(n, seed):
@@ -122,6 +124,10 @@ def _pandas_agg(df, agg):
         return df.color.nunique()
     if agg == "sum(qty * price)":
         return float((df.qty * df.price).sum())
+    if agg == "sum(fromEpochSeconds(qty))":
+        return float((df.qty * 1000).sum())
+    if agg.startswith("sum(timeConvert"):
+        return float((df.qty * 1000).sum())
     raise AssertionError(agg)
 
 
@@ -141,14 +147,19 @@ def test_fuzz_query(table, qi):
     aggs = list(rng.choice(AGGS, size=n_aggs, replace=False))
     where, mask_fn = _rand_filter(rng)
     group = []
+    gexpr = None  # (sql text, pandas series fn) expression group key
     if rng.integers(0, 2):
         group = list(rng.choice(list(DIMS), size=int(rng.integers(1, 3)),
                                 replace=False))
-    cols = ", ".join(group + aggs)
+        if rng.integers(0, 3) == 0:
+            # bounded integral EXPRESSION key (the device 'gexpr' strategy)
+            gexpr = ("year - 2000", lambda df: df.year - 2000)
+    cols = ", ".join(([gexpr[0]] if gexpr else []) + group + aggs)
     sql = f"SELECT {cols} FROM fz{where}"
     if group:
-        sql += f" GROUP BY {', '.join(group)}"
-        sql += f" ORDER BY {', '.join(group)} LIMIT 10000"
+        keys = ([gexpr[0]] if gexpr else []) + group
+        sql += f" GROUP BY {', '.join(keys)}"
+        sql += f" ORDER BY {', '.join(keys)} LIMIT 10000"
 
     device = ShardedQueryExecutor()
     host = ServerQueryExecutor(use_device=False)
@@ -172,13 +183,19 @@ def test_fuzz_query(table, qi):
             expect = _pandas_agg(fdf, agg)
             assert _close(val, expect), (sql, agg, val, expect)
     else:
+        gdf = fdf
+        gb_cols = list(group)
+        if gexpr is not None:
+            gdf = fdf.assign(__gx=gexpr[1](fdf))
+            gb_cols = ["__gx"] + gb_cols
+        nk = len(gb_cols)
         expect_groups = {k if isinstance(k, tuple) else (k,): g
-                         for k, g in fdf.groupby(group)}
-        got_keys = {tuple(r[:len(group)]) for r in dev_rt.rows}
+                         for k, g in gdf.groupby(gb_cols)}
+        got_keys = {tuple(r[:nk]) for r in dev_rt.rows}
         assert got_keys == set(expect_groups.keys()), sql
         for row in dev_rt.rows:
-            key = tuple(row[:len(group)])
+            key = tuple(row[:nk])
             g = expect_groups[key]
-            for val, agg in zip(row[len(group):], aggs):
+            for val, agg in zip(row[nk:], aggs):
                 expect = _pandas_agg(g, agg)
                 assert _close(val, expect), (sql, key, agg, val, expect)

@@ -95,6 +95,10 @@ class ServerQueryExecutor:
         # only in (slow) interpret mode, so auto-enable on TPU and leave
         # interpret mode to tests that opt in explicitly
         self.use_pallas = use_pallas
+        # plan.spec values whose pallas kernel failed to lower/run on this
+        # backend: those shapes take the jnp path, everything else keeps
+        # the fused kernel
+        self._pallas_blocked: set = set()
         self.num_groups_limit = num_groups_limit
 
     def _pallas_mode(self) -> Optional[bool]:
@@ -422,6 +426,8 @@ class ServerQueryExecutor:
         interpret = self._pallas_mode()
         if interpret is None:
             return None
+        if plan.spec in self._pallas_blocked:
+            return None
         staged = self.staging.stage(seg)
         try:
             packed = pallas_kernels.run_segment(plan, staged,
@@ -430,8 +436,12 @@ class ServerQueryExecutor:
             import logging
 
             logging.getLogger(__name__).exception(
-                "pallas kernel failed; disabling pallas for this executor")
-            self.use_pallas = False
+                "pallas kernel failed; disabling pallas for this QUERY "
+                "SHAPE (other shapes keep the fused path)")
+            # per-SPEC blocklist, not a process-wide kill switch: one
+            # Mosaic-unlowerable shape must not cost every other query
+            # its fused kernel
+            self._pallas_blocked.add(plan.spec)
             return None
         if packed is None:
             return None

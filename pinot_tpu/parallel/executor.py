@@ -177,14 +177,21 @@ class ShardedQueryExecutor(ServerQueryExecutor):
         except Exception:
             # jax.jit compiles lazily: a Mosaic lowering failure on the real
             # chip surfaces HERE, not in _build_pallas_call. Fall back to
-            # the jnp combine, repair the cache, and stop trying pallas.
+            # the jnp combine, repair the cache, and block THIS query shape
+            # only (a process-wide kill switch would cost every other query
+            # its fused kernel).
             if not is_pallas:
                 raise
             import logging
 
             logging.getLogger(__name__).exception(
-                "sharded pallas kernel failed at run; disabling pallas")
-            self.use_pallas = False
+                "sharded pallas kernel failed at run; disabling pallas "
+                "for this query shape")
+            self._pallas_blocked.add(plan.spec)
+            # evict the poisoned compiled kernel too — the blocklist makes
+            # it unreachable, so keeping it only leaks the closure
+            for k in [k for k in self._pallas_sharded if k[1] == plan.spec]:
+                del self._pallas_sharded[k]
             # evict FIRST: _build_jnp_call may itself raise PlanError
             # (pallas pads tiles where the jnp path demands divisibility),
             # and the poisoned pallas entry must not survive that
@@ -254,6 +261,8 @@ class ShardedQueryExecutor(ServerQueryExecutor):
 
         interpret = self._pallas_mode()
         if interpret is None:
+            return None
+        if plan.spec in self._pallas_blocked:
             return None
         pp = extract_plan(plan, batch)
         if pp is None:

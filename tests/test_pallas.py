@@ -280,3 +280,77 @@ def test_sharded_pallas_kernel_actually_used(setup, sharded_pallas_exec):
     _, segs = setup
     sharded_pallas_exec.execute(compile_query(QUERIES[1]), segs)
     assert len(sharded_pallas_exec._pallas_sharded) >= 1
+
+
+def test_lowering_failure_blocks_only_that_shape(setup, host_exec,
+                                                 monkeypatch):
+    """A Mosaic/compile failure must blocklist the failing QUERY SHAPE,
+    not disable pallas process-wide (one unlowerable shape on the chip
+    must not cost every other query its fused kernel)."""
+    from pinot_tpu.engine import pallas_kernels as pk
+
+    _, segs = setup
+    ex = ServerQueryExecutor(use_device=True, use_pallas=True)
+    bad_sql = QUERIES[0]
+    good_sql = QUERIES[1]
+    bad_spec = {}
+
+    real = pk.run_segment
+
+    def flaky(plan, staged, cache, interpret):
+        if not bad_spec:
+            bad_spec["spec"] = plan.spec
+        if plan.spec == bad_spec["spec"]:
+            raise RuntimeError("simulated Mosaic lowering failure")
+        return real(plan, staged, cache, interpret)
+
+    monkeypatch.setattr(pk, "run_segment", flaky)
+    got, _ = ex.execute(compile_query(bad_sql), segs)     # falls back
+    want, _ = host_exec.execute(compile_query(bad_sql), segs)
+    assert got.rows == want.rows
+    assert ex.use_pallas is not False                      # NOT global
+    assert len(ex._pallas_blocked) == 1
+    before = len(ex.pallas_kernels)
+    ex.execute(compile_query(good_sql), segs)              # still fused
+    assert len(ex.pallas_kernels) > before
+
+
+def test_sharded_lowering_failure_blocks_only_that_shape(setup, host_exec,
+                                                         monkeypatch):
+    """Same per-shape containment on the SHARDED combine: the failing
+    spec's compiled kernel is evicted, the shape falls back to the jnp
+    combine with correct results, and other shapes keep the fused path."""
+    from pinot_tpu.parallel import ShardedQueryExecutor, combine
+
+    _, segs = setup
+    ex = ShardedQueryExecutor(use_pallas=True)
+    bad_sql, good_sql = QUERIES[0], QUERIES[1]
+
+    real = combine.build_sharded_pallas_kernel
+
+    def poisoned(spec, plan_spec, mesh):
+        kernel = real(spec, plan_spec, mesh)
+        state = {"first": True}
+
+        def run(*args, **kw):
+            if state["first"]:
+                state["first"] = False
+                raise RuntimeError("simulated Mosaic lowering failure")
+            return kernel(*args, **kw)
+
+        return run
+
+    monkeypatch.setattr(combine, "build_sharded_pallas_kernel", poisoned)
+    got, _ = ex.execute(compile_query(bad_sql), segs)      # jnp fallback
+    want, _ = host_exec.execute(compile_query(bad_sql), segs)
+    assert got.rows == want.rows
+    assert ex.use_pallas is not False
+    assert len(ex._pallas_blocked) == 1
+    assert not ex._pallas_sharded                           # evicted
+    monkeypatch.setattr(combine, "build_sharded_pallas_kernel", real)
+    ex.execute(compile_query(good_sql), segs)               # still fused
+    assert len(ex._pallas_sharded) == 1
+    # the blocked shape stays on jnp even though pallas works again
+    got2, _ = ex.execute(compile_query(bad_sql), segs)
+    assert got2.rows == want.rows
+    assert len(ex._pallas_sharded) == 1

@@ -99,6 +99,10 @@ class ServerQueryExecutor:
         # backend: those shapes take the jnp path, everything else keeps
         # the fused kernel
         self._pallas_blocked: set = set()
+        # ordered-selection top-k kernels (engine/selection_device.py);
+        # LRU-capped like the sibling caches (k rides in the key, so
+        # unbounded LIMIT variety must not pin kernels forever)
+        self._selection_kernels: "OrderedDict" = OrderedDict()
         self.num_groups_limit = num_groups_limit
 
     def _pallas_mode(self) -> Optional[bool]:
@@ -166,7 +170,7 @@ class ServerQueryExecutor:
                 select_expressions=list(ctx.select_expressions) + hidden,
                 aliases=list(ctx.aliases) + [None] * len(hidden),
                 limit=ctx.offset + ctx.limit, offset=0)
-            table = host_engine.execute_selection(sub, segments, stats)
+            table = self._selection(sub, segments, stats)
             return DataTable.for_selection(table.schema, table.rows, stats,
                                            num_hidden=len(hidden))
 
@@ -192,7 +196,7 @@ class ServerQueryExecutor:
         if ctx.distinct:
             return host_engine.execute_distinct(ctx, segments, stats), stats
         if ctx.is_selection:
-            return host_engine.execute_selection(ctx, segments, stats), stats
+            return self._selection(ctx, segments, stats), stats
 
         aggs = [resolve_agg(f) for f in ctx.aggregations]
         if ctx.is_group_by:
@@ -289,6 +293,20 @@ class ServerQueryExecutor:
                 pass
         return done(host_engine.host_aggregate_segment(ctx, aggs, seg,
                                                        stats), "host")
+
+    def _selection(self, ctx: QueryContext,
+                   segments: List[ImmutableSegment],
+                   stats: QueryStats) -> ResultTable:
+        """Selection with the ordered top-k scan on device when eligible
+        (engine/selection_device.py); host numpy path otherwise."""
+        if self.use_device and ctx.order_by:
+            from pinot_tpu.engine.selection_device import device_selection
+
+            table = device_selection(ctx, segments, self.staging,
+                                     self._selection_kernels, stats)
+            if table is not None:
+                return table
+        return host_engine.execute_selection(ctx, segments, stats)
 
     def _star_tree_pick(self, ctx: QueryContext, aggs: List[AggDef],
                         seg: ImmutableSegment):

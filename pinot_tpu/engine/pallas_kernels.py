@@ -625,6 +625,11 @@ class PallasKernelCache:
             self._cache[spec] = k
         return k
 
+    def pop(self, spec: PallasSpec) -> None:
+        """Evict a kernel whose compile/run failed (the caller blocklists
+        the plan shape; keeping the entry would only leak the closure)."""
+        self._cache.pop(spec, None)
+
     def __len__(self):
         return len(self._cache)
 
@@ -727,7 +732,12 @@ def run_segment(plan, staged: StagedSegment, cache: PallasKernelCache,
         jnp.asarray(pp.static_params, dtype=jnp.int32).reshape(-1),
         jnp.asarray([staged.num_docs, 0], dtype=jnp.int32),
     ])
-    out_f, out_i, out_mm, out_seg = kernel(params, *packed_cols, *value_cols)
+    try:
+        out_f, out_i, out_mm, out_seg = kernel(params, *packed_cols,
+                                               *value_cols)
+    except Exception:
+        cache.pop(spec)  # symmetric with the sharded handler's eviction
+        raise
     tree = assemble_outputs(plan.spec, spec, out_f, out_i, out_mm,
                             seg_matched=None)
     return pack_outputs(tree, plan.spec)

@@ -189,9 +189,12 @@ class ShardedQueryExecutor(ServerQueryExecutor):
                 "for this query shape")
             self._pallas_blocked.add(plan.spec)
             # evict the poisoned compiled kernel too — the blocklist makes
-            # it unreachable, so keeping it only leaks the closure
-            for k in [k for k in self._pallas_sharded if k[1] == plan.spec]:
-                del self._pallas_sharded[k]
+            # it unreachable, so keeping it only leaks the closure.
+            # snapshot + pop: two threads can fail on the same kernel
+            # concurrently, and the second delete must be a no-op
+            for k in list(self._pallas_sharded):
+                if k[1] == plan.spec:
+                    self._pallas_sharded.pop(k, None)
             # evict FIRST: _build_jnp_call may itself raise PlanError
             # (pallas pads tiles where the jnp path demands divisibility),
             # and the poisoned pallas entry must not survive that

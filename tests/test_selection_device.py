@@ -1,0 +1,139 @@
+"""Ordered-selection device path: filter + top-k on the accelerator.
+
+Ref: SelectionOrderByOperator.java — the hot realtime shape
+(SELECT ... WHERE ... ORDER BY ts DESC LIMIT k) scans and sorts on device;
+parity must be EXACT against the numpy host path, including stable-sort
+tie semantics (docId order within equal keys).
+"""
+
+import numpy as np
+import pytest
+
+from pinot_tpu.engine import ServerQueryExecutor
+from pinot_tpu.query import compile_query
+from pinot_tpu.segment import SegmentBuilder, load_segment
+from pinot_tpu.spi import DataType, FieldSpec, FieldType, Schema
+
+N = 9000
+
+
+@pytest.fixture(scope="module")
+def setup(tmp_path_factory):
+    out = tmp_path_factory.mktemp("seldev")
+    rng = np.random.default_rng(31)
+    frame = {
+        "host": np.array(["h1", "h2", "h3"])[rng.integers(0, 3, N)],
+        "code": rng.integers(200, 600, N).astype(np.int64),
+        # heavy ties: only 40 distinct ts values across 9000 rows
+        "ts": rng.integers(1000, 1040, N).astype(np.int64),
+        "lat": np.round(rng.uniform(0.1, 9.9, N), 3),
+    }
+    schema = Schema("ev", [
+        FieldSpec("host", DataType.STRING),
+        FieldSpec("code", DataType.INT),
+        FieldSpec("ts", DataType.LONG, FieldType.DATE_TIME),
+        FieldSpec("lat", DataType.DOUBLE, FieldType.METRIC),
+    ])
+    segs = []
+    for i, sl in enumerate([slice(0, N // 2), slice(N // 2, N)]):
+        SegmentBuilder(schema, f"ev_{i}").build(
+            {k: v[sl] for k, v in frame.items()}, str(out))
+        segs.append(load_segment(str(out / f"ev_{i}")))
+    return segs
+
+
+@pytest.fixture(scope="module")
+def dev():
+    return ServerQueryExecutor(use_device=True)
+
+
+@pytest.fixture(scope="module")
+def host():
+    return ServerQueryExecutor(use_device=False)
+
+
+ORDERED = [
+    "SELECT host, ts, code FROM ev ORDER BY ts DESC LIMIT 25",
+    "SELECT host, ts FROM ev WHERE code >= 500 ORDER BY ts DESC LIMIT 10",
+    "SELECT * FROM ev WHERE host = 'h2' ORDER BY ts, code DESC LIMIT 40",
+    "SELECT host, lat FROM ev ORDER BY lat LIMIT 17",
+    "SELECT ts FROM ev WHERE code BETWEEN 300 AND 400 "
+    "ORDER BY code DESC, ts LIMIT 30 OFFSET 5",
+    "SELECT host, code FROM ev WHERE host IN ('h1', 'h3') "
+    "ORDER BY code LIMIT 1000",
+]
+
+
+def test_device_path_engages(setup, dev):
+    rt, _ = dev.execute(compile_query(ORDERED[0]), setup)
+    assert rt.rows
+    assert len(dev._selection_kernels) >= 1
+
+
+@pytest.mark.parametrize("sql", ORDERED, ids=[q[:55] for q in ORDERED])
+def test_ordered_selection_exact_parity(setup, dev, host, sql):
+    """EXACT row-for-row equality — the tie-heavy ts column means any
+    deviation from the host's stable-sort semantics fails here."""
+    got, _ = dev.execute(compile_query(sql), setup)
+    want, _ = host.execute(compile_query(sql), setup)
+    assert got.schema.column_names == want.schema.column_names
+    assert got.rows == want.rows
+
+
+def test_string_dict_order_serves_on_device(setup, dev, host):
+    """ORDER BY a STRING dictionary column rides the device too: the
+    dictionary is sorted, so dictId order IS lexicographic value order."""
+    sql = "SELECT host, code FROM ev ORDER BY host, code LIMIT 20"
+    before = len(dev._selection_kernels)
+    got, _ = dev.execute(compile_query(sql), setup)
+    want, _ = host.execute(compile_query(sql), setup)
+    assert got.rows == want.rows
+    assert len(dev._selection_kernels) > before
+
+
+def test_expression_order_falls_back(setup, dev, host):
+    """ORDER BY an expression is host-served (same results, no kernel)."""
+    sql = "SELECT host, code FROM ev ORDER BY code + 1 LIMIT 20"
+    before = len(dev._selection_kernels)
+    got, _ = dev.execute(compile_query(sql), setup)
+    want, _ = host.execute(compile_query(sql), setup)
+    assert got.rows == want.rows
+    assert len(dev._selection_kernels) == before
+
+
+def test_through_instance_datatable_path(setup, dev, host):
+    """The server DataTable path (hidden order-by columns) serves device
+    selections too."""
+    from pinot_tpu.broker.reduce import BrokerReduceService
+
+    ctx = compile_query(
+        "SELECT host FROM ev WHERE code < 250 ORDER BY ts DESC LIMIT 12")
+    t_dev = dev.execute_instance(ctx, setup)
+    table, _, _ = BrokerReduceService().reduce(ctx, [t_dev])
+    want, _ = host.execute(ctx, setup)
+    assert table.rows == want.rows
+
+
+@pytest.mark.parametrize("qi", range(25))
+def test_ordered_selection_fuzz(setup, dev, host, qi):
+    """Seeded random ordered selections: exact device/host parity."""
+    rng = np.random.default_rng(777 + qi)
+    cols = ["host", "code", "ts", "lat"]
+    sel = list(rng.choice(cols, size=int(rng.integers(1, 4)),
+                          replace=False))
+    nord = int(rng.integers(1, 3))
+    order = []
+    for c in rng.choice(["code", "ts", "lat", "host"], size=nord,
+                        replace=False):
+        order.append(f"{c} {'DESC' if rng.integers(0, 2) else 'ASC'}")
+    where = ""
+    if rng.integers(0, 2):
+        where = f" WHERE code >= {int(rng.integers(200, 550))}"
+    limit = int(rng.integers(1, 60))
+    offset = int(rng.integers(0, 10)) if rng.integers(0, 2) else 0
+    sql = (f"SELECT {', '.join(sel)} FROM ev{where} "
+           f"ORDER BY {', '.join(order)} LIMIT {limit}"
+           + (f" OFFSET {offset}" if offset else ""))
+    got, _ = dev.execute(compile_query(sql), setup)
+    want, _ = host.execute(compile_query(sql), setup)
+    assert got.rows == want.rows, sql

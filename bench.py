@@ -91,9 +91,12 @@ def supervise() -> None:
                     suite = rec.pop("suite", None)
                     if suite is None:
                         continue
-                    # a real-chip result is never overwritten by a CPU one
+                    # a SUCCESSFUL real-chip result is never overwritten by
+                    # a CPU one — but a chip ERROR record must not block the
+                    # CPU reserve from filling the suite in
                     if (suite in results
                             and results[suite].get("backend") != "cpu"
+                            and "error" not in results[suite]
                             and rec.get("backend") == "cpu"):
                         continue
                     results[suite] = rec
@@ -313,18 +316,29 @@ class _Worker:
         df = self.baseline_frame()
         base_ms = {}
         parity_fail = []
+        rungs = {}
         for qid, ctx in ctxs.items():
             _log(f"ssb {qid}: baseline + device compile + parity")
             want = ssb_baseline.run_query(df, qid)
             t0 = time.perf_counter()
             want = ssb_baseline.run_query(df, qid)
             base_ms[qid] = (time.perf_counter() - t0) * 1e3
-            got, _ = self.dev.execute(ctx, segs)   # compiles + warms
+            got, qstats = self.dev.execute(ctx, segs)   # compiles + warms
+            rungs[qid] = qstats.group_by_rung or "scalar"
             if not ssb_baseline.rows_match(got.rows, want, rel=1e-6):
                 parity_fail.append(qid)
         if parity_fail:
             raise AssertionError(f"SSB parity vs pandas failed: "
                                  f"{parity_fail}")
+        # the Q3.2/Q3.3 latency story depends on the hash rung (or the
+        # narrowed dense rung): a silent regression back to the sort rung
+        # must fail the suite LOUDLY, not ship a slow number
+        regressed = [q for q in ("Q3.2", "Q3.3")
+                     if rungs.get(q) in ("sort", "host")]
+        if regressed:
+            raise AssertionError(
+                f"group-by rung regression: {regressed} fell back to "
+                f"{[rungs[q] for q in regressed]} (rungs: {rungs})")
 
         per_q50, per_q99 = {}, {}
         for qid, ctx in ctxs.items():
@@ -352,6 +366,7 @@ class _Worker:
             "baseline_ms_per_query": round(base50, 2),
             "per_query_ms": {q: round(v, 2) for q, v in per_q50.items()},
             "per_query_p99_ms": {q: round(v, 2) for q, v in per_q99.items()},
+            "group_by_rung": rungs,
             "pallas_kernels": len(self.dev._pallas_sharded),
             "parity": "ok",
         }

@@ -308,6 +308,7 @@ class DataTable:
             num_docs_scanned=st.get("numDocsScanned", 0),
             total_docs=st.get("totalDocs", 0),
             num_groups_limit_reached=st.get("numGroupsLimitReached", False),
+            group_by_rung=st.get("groupByRung"),
             phase_ms=st.get("phaseTimesMs", {}),
             trace=st.get("trace", []),
         )

@@ -36,6 +36,18 @@ from pinot_tpu.segment.immutable import ImmutableSegment
 from pinot_tpu.spi.config import CommonConstants
 
 
+def grouped_rung(spec: Tuple, out: Dict[str, Any]) -> str:
+    """Which group-by rung of the device cardinality ladder served this
+    kernel output: 'dense' | 'compact' (dense scatter, compact D2H) |
+    'hash' | 'sort' (the sparse rungs; 'sort' means the hash table
+    overflowed and the sort fallback ran)."""
+    from pinot_tpu.engine.kernels import compact_mode, sparse_mode
+
+    if sparse_mode(spec):
+        return "sort" if out.get("rung") else "hash"
+    return "compact" if compact_mode(spec) else "dense"
+
+
 def filter_fingerprint(ctx: QueryContext) -> str:
     """Digest of the filter tree, memoized per ctx — cache keys must
     distinguish same-SQL contexts whose filters were rewritten (hybrid
@@ -388,6 +400,7 @@ class ServerQueryExecutor:
 
         st = self._try_star_tree(ctx, aggs, seg, stats)
         if st is not None:
+            stats.group_by_rung = "startree"
             return done(st, "startree")
         if self.use_device:
             try:
@@ -396,6 +409,7 @@ class ServerQueryExecutor:
                             "device")
             except PlanError:
                 pass
+        stats.group_by_rung = "host"
         return done(host_engine.host_group_by_segment(ctx, aggs, seg,
                                                       stats), "host")
 
@@ -432,7 +446,9 @@ class ServerQueryExecutor:
         out = self._try_pallas(plan, seg, stats)
         if out is None:
             out = self._run_kernel(plan, seg, stats)
-        return decode_grouped_result(plan, seg, out)
+        result = decode_grouped_result(plan, seg, out)
+        stats.group_by_rung = grouped_rung(plan.spec, out)
+        return result
 
     def _try_pallas(self, plan: SegmentPlan, seg: ImmutableSegment,
                     stats: QueryStats) -> Optional[Dict[str, Any]]:
@@ -563,17 +579,20 @@ def decode_grouped_result(plan: SegmentPlan, provider: Any,
         return result
 
     # decode composed keys -> per-column dictIds -> values, using the
-    # planner's own strides (single source of truth for key layout)
+    # planner's own strides and bases (single source of truth for key
+    # layout; gdict bases are nonzero when the filter narrowed the column's
+    # dictId range)
     cards = plan.group_cards
     strides = plan.group_strides.astype(np.int64)
+    bases = plan.group_bases or [0] * len(cards)
     key_cols: List[List[Any]] = []
     for i, ((strat, payload), card) in enumerate(zip(plan.group_defs, cards)):
         dids = (gidx // strides[i]) % card
+        base = int(bases[i])
         if strat == "gdict":
             d = provider.data_source(payload).dictionary
-            key_cols.append(d.get_values(dids))
-        elif strat == "graw":  # value-space
-            base = int(provider.metadata.column(payload).min_value)
+            key_cols.append(d.get_values(dids + base))
+        elif strat == "graw":  # value-space (base = the column's min value)
             key_cols.append([int(x) + base for x in dids])
         else:  # gexpr: the def carries the expression's lower bound
             key_cols.append([int(x) + int(payload) for x in dids])

@@ -185,7 +185,7 @@ def _emit_value(vspec: Tuple, cols, pc: _ParamCursor,
 # --------------------------------------------------------------------------
 
 def build_kernel_body(spec: Tuple, capacity_override: int = 0,
-                      sparse_k: int = 0):
+                      sparse_k: int = 0, sparse_rung: str = "cond"):
     """spec = (filter_spec, agg_specs, group_specs, num_groups, capacity)
     -> unjitted fn(cols, params, num_docs, doc_offset) -> dict of partials.
 
@@ -194,8 +194,16 @@ def build_kernel_body(spec: Tuple, capacity_override: int = 0,
     evaluates each device's sub-range of the scan; ref: the doc-dimension
     "context parallelism" mapping, SURVEY.md §5). ``capacity_override``
     replaces the spec's capacity with the per-shard local capacity.
-    ``sparse_k`` > 0 switches the group-by path to sort-based sparse
-    grouping over K slots (see _emit_grouped_sparse).
+    ``sparse_k`` > 0 switches the group-by path to sparse grouping over K
+    compact slots; ``sparse_rung`` picks how:
+
+    - "cond" (per-segment default): hash-aggregate, with an in-kernel
+      ``lax.cond`` falling back to the sort rung when the table overflows;
+    - "hash": hash rung only — the ``"rung"`` output flags overflow and the
+      caller must discard the (garbage) leaves and rerun the sort body.
+      The sharded combine needs this split because a cond UNDER vmap
+      lowers to select (both branches always execute, paying the sort);
+    - "sort": the sort/compaction rung only.
     """
     filter_spec, agg_specs, group_specs, num_groups, capacity = spec
     if capacity_override:
@@ -216,12 +224,14 @@ def build_kernel_body(spec: Tuple, capacity_override: int = 0,
 
         # ---- group-by path ----
         strides = pc.take()           # [g] int32
-        _bases = pc.take()            # [g] int64 (host uses for decode; raw
-        #                               group keys subtract base on device)
+        _bases = pc.take()            # [g] int64 (host uses for decode; keys
+        #                               subtract base on device — nonzero for
+        #                               graw/gexpr and for filter-narrowed
+        #                               gdict columns, see plan.py)
         keys = jnp.zeros(capacity, dtype=jnp.int32)
         for gi, (strat, payload) in enumerate(group_specs):
             if strat == "gdict":
-                k = cols[payload]["fwd"]
+                k = cols[payload]["fwd"] - _bases[gi].astype(jnp.int32)
             elif strat == "graw":  # value-space key
                 k = (cols[payload]["fwd"] - _bases[gi]).astype(jnp.int32)
             else:  # gexpr: bounded integral expression, key = value - lo
@@ -229,8 +239,9 @@ def build_kernel_body(spec: Tuple, capacity_override: int = 0,
                 k = (v - _bases[gi]).astype(jnp.int32)
             keys = keys + k * strides[gi]
         if sparse_k:
-            return _emit_grouped_sparse(agg_specs, cols, pc, mask, keys,
-                                        num_groups, sparse_k)
+            return _emit_grouped_rung(agg_specs, cols, pc, mask, keys,
+                                      num_groups, sparse_k, capacity,
+                                      sparse_rung)
         seg_ids = jnp.where(mask, keys, num_groups)  # overflow bucket
         return _emit_grouped_all(agg_specs, cols, pc, mask, seg_ids,
                                  num_groups)
@@ -282,6 +293,157 @@ def _emit_grouped_sparse(agg_specs, cols, pc, mask, keys, num_groups, K):
     out = _emit_grouped_all(agg_specs, cols, pc, mask, seg_ids, K)
     out["ck"] = uniq
     out["compact_n"] = n_live
+    return out
+
+
+# --------------------------------------------------------------------------
+# hash-aggregation rung: the device ladder step BETWEEN the dense
+# segment_sum rung and the sort-based sparse rung. Selective queries whose
+# composed key space is huge but whose LIVE rows are few (SSB Q3.2/Q3.3
+# shape: a few thousand matches against a 2^19 key space) pay the sort rung
+# an n*log(n) over ALL docs; here the live docs are compacted to a fixed
+# window and their keys scatter-minned into an open-addressing table, so
+# cost scales with live rows. Overflow (too many live docs, probe failure,
+# or more live groups than K) falls back to the sort rung — in-kernel via
+# lax.cond on the per-segment path, at the device level on the sharded
+# path (see build_kernel_body's sparse_rung).
+# --------------------------------------------------------------------------
+
+# open-addressing table: 2^15 slots, 4x the compact output K so the load
+# factor for K-bounded group sets stays low enough that the bounded probe
+# chain below almost never overflows
+_HASH_BITS = 15
+HASH_TABLE_SLOTS = 1 << _HASH_BITS
+# linear-probe passes unrolled at trace time; each pass is one scatter-min
+# + one gather over the live window
+HASH_PROBES = 4
+# live-doc window: more matched docs than this -> sort rung
+HASH_LIVE_DOCS = 1 << 16
+# Knuth multiplicative hash (2^32 / phi)
+_HASH_MULT = 2654435761
+
+# per-column arrays with a leading capacity dim (gathered down to the live
+# window); everything else (dictvals) is shared
+_CAPACITY_KEYS = ("fwd", "null", "mv", "mvcount")
+
+
+def _compact_positions(mask: jnp.ndarray, L: int):
+    """(pos, n) — ascending doc positions of the first L masked docs (the
+    ascending order keeps per-group accumulation in doc order, so hash-rung
+    sums are bit-exact with the sort rung's) and the total masked count.
+    cumsum-scatter, not jnp.nonzero: this must stay cheap under vmap."""
+    capacity = mask.shape[0]
+    r = jnp.cumsum(mask.astype(jnp.int32)) - 1
+    n = jnp.where(capacity > 0, r[-1] + 1, 0)
+    tgt = jnp.where(mask & (r < L), r, L)
+    pos = jnp.zeros(L + 1, dtype=jnp.int32).at[tgt].set(
+        jnp.arange(capacity, dtype=jnp.int32), mode="drop")[:L]
+    return pos, n
+
+
+def _hash_probe(mask, keys, K, capacity):
+    """Place masked composed keys into the open-addressing table.
+
+    Returns (overflow, pos, mask_live, seg_ids, ck, n_live): ``pos`` indexes
+    the live-doc window, ``seg_ids`` [L] maps each live doc to its compact
+    group slot (K = parked), ``ck`` the K live keys in slot order
+    (SENT-filled), ``n_live`` the live group count. ``overflow`` means the
+    hash results are unusable and the sort rung must serve."""
+    SENT = jnp.int32(_SENTINEL_KEY)
+    H = HASH_TABLE_SLOTS
+    L = min(capacity, HASH_LIVE_DOCS)
+
+    pos, n_docs = _compact_positions(mask, L)
+    mask_live = jnp.arange(L, dtype=jnp.int32) < jnp.minimum(n_docs, L)
+    mk = jnp.where(mask_live, keys[pos], SENT)
+
+    h = ((mk.astype(jnp.uint32) * jnp.uint32(_HASH_MULT))
+         >> jnp.uint32(32 - _HASH_BITS)).astype(jnp.int32)
+    slot = jnp.where(mask_live, h, H)      # fill docs park at slot H
+    placed = ~mask_live
+    table = jnp.full(H + 1, SENT, dtype=jnp.int32)
+    for p in range(HASH_PROBES):
+        if p:
+            slot = jnp.where(placed, slot, (slot + 1) & (H - 1))
+        put = jnp.where(placed, H, slot)
+        # scatter-min claims the slot for the smallest competing key; docs
+        # whose key won (or was already there) are placed, the rest probe on
+        table = table.at[put].min(jnp.where(placed, SENT, mk))
+        placed = placed | (table[put] == mk)
+    # a later pass can STEAL a claimed slot (scatter-min lowers it with a
+    # smaller key while the earlier claimant has already stopped probing) —
+    # re-validate every claim against the final table; stolen claims count
+    # as overflow so the sort rung serves instead of merging two groups
+    placed = placed & (table[jnp.where(mask_live, slot, H)] == mk)
+
+    live_tab = table[:H] != SENT
+    n_live = live_tab.sum(dtype=jnp.int32)
+    overflow = ((n_docs > L) | (mask_live & ~placed).any() | (n_live > K))
+
+    # slot -> compact rank (cumsum, no scatter); park slot H -> K
+    rk = jnp.cumsum(live_tab.astype(jnp.int32)) - 1
+    rank = jnp.where(live_tab, jnp.minimum(rk, K), K)
+    rank_ext = jnp.concatenate(
+        [rank, jnp.full((1,), K, dtype=jnp.int32)])
+    seg_ids = jnp.where(placed & mask_live, rank_ext[slot], K)
+
+    # first K live slots -> compact keys (slot order, not sorted — the
+    # decode and the cross-shard merge are both order-agnostic)
+    stgt = jnp.where(live_tab & (rk < K), rk, K)
+    spos = jnp.zeros(K + 1, dtype=jnp.int32).at[stgt].set(
+        jnp.arange(H, dtype=jnp.int32), mode="drop")[:K]
+    livek = jnp.arange(K, dtype=jnp.int32) < jnp.minimum(n_live, K)
+    ck = jnp.where(livek, table[spos], SENT)
+    return overflow, pos, mask_live, seg_ids, ck, n_live
+
+
+def _hash_finish(agg_specs, cols, pc, probe, K):
+    """Aggregate over the live-doc window: every capacity-sized column is
+    gathered down to [L] first, so the scatter work scales with live rows."""
+    _, pos, mask_live, seg_ids, ck, n_live = probe
+    cols_live = {name: {k: (v[pos] if k in _CAPACITY_KEYS else v)
+                        for k, v in tree.items()}
+                 for name, tree in cols.items()}
+    out = _emit_grouped_all(agg_specs, cols_live, pc, mask_live, seg_ids, K)
+    out["ck"] = ck
+    out["compact_n"] = n_live
+    return out
+
+
+def _emit_grouped_rung(agg_specs, cols, pc, mask, keys, num_groups, K,
+                       capacity, rung):
+    """Sparse-grouping dispatch: hash rung with sort fallback (see
+    build_kernel_body docstring for the rung modes). The ``"rung"`` output
+    leaf is 0 when the hash table served, 1 when the sort rung ran (or, in
+    "hash" mode, when it MUST run)."""
+    if rung == "sort":
+        out = _emit_grouped_sparse(agg_specs, cols, pc, mask, keys,
+                                   num_groups, K)
+        out["rung"] = jnp.ones((), dtype=jnp.int32)
+        return out
+    probe = _hash_probe(mask, keys, K, capacity)
+    overflow = probe[0]
+    if rung == "hash":
+        out = _hash_finish(agg_specs, cols, pc, probe, K)
+        out["rung"] = overflow.astype(jnp.int32)
+        return out
+    # "cond": both branches re-walk the agg params from the same cursor
+    # position with their own cursors (one traced consumption each)
+    start = pc.i
+
+    def _hash_branch(_):
+        pc2 = _ParamCursor(pc.params)
+        pc2.i = start
+        return _hash_finish(agg_specs, cols, pc2, probe, K)
+
+    def _sort_branch(_):
+        pc2 = _ParamCursor(pc.params)
+        pc2.i = start
+        return _emit_grouped_sparse(agg_specs, cols, pc2, mask, keys,
+                                    num_groups, K)
+
+    out = jax.lax.cond(overflow, _sort_branch, _hash_branch, None)
+    out["rung"] = overflow.astype(jnp.int32)
     return out
 
 
@@ -491,6 +653,10 @@ def output_layout(spec: Tuple, num_seg: int = 0) -> List[Tuple[str, int]]:
             entries.append((f"agg{i}", size))
         else:
             entries.extend((f"agg{i}.{j}", size) for j in range(nleaves))
+    if sparse_mode(spec):
+        # which sparse rung actually served (0 = hash table, 1 = sort
+        # fallback): bench/stats surface this per query
+        entries.append(("rung", 1))
     if num_seg:
         entries.append(("seg_matched", num_seg))
     return entries
@@ -584,6 +750,8 @@ def unpack_outputs(packed, spec: Tuple, num_seg: int = 0) -> Dict[str, Any]:
             continue
         if key == "num_matched":
             out[key] = leaf[0]
+        elif key == "rung":
+            out[key] = int(leaf[0])
         elif key == "seg_matched":
             out[key] = leaf
         elif grouped or key in dc:

@@ -88,6 +88,10 @@ class PallasSpec:
     n_slots: int                          # interval param slots
     group_idx: Tuple[int, ...]            # packed input idx per group col
     group_strides: Tuple[int, ...]
+    # sum(base_i * stride_i): subtracted from the composed key — nonzero
+    # when plan.py filter-narrowed a group column's dictId range (masked
+    # docs may then compose negative keys; the one-hot match drops them)
+    group_key_offset: int
     num_groups_padded: int                # multiple of 128
     # per agg: (base, vexpr, limbs); base in count/sum/avg/min/max/minmaxrange;
     # vexpr is a nested value expression: ("v", input_idx) |
@@ -140,6 +144,7 @@ class PallasPlan:
     n_slots: int
     group_idx: Tuple[int, ...]
     group_strides: Tuple[int, ...]
+    group_key_offset: int
     num_groups_padded: int
     aggs: Tuple[Tuple[str, Optional[Tuple], Optional[int]], ...]
     static_params: np.ndarray             # [2 * n_slots] i32 interval bounds
@@ -151,6 +156,7 @@ class PallasPlan:
             packed_bits=(), filter_tree=self.filter_tree,
             n_slots=self.n_slots, group_idx=self.group_idx,
             group_strides=self.group_strides,
+            group_key_offset=self.group_key_offset,
             num_groups_padded=self.num_groups_padded,
             aggs=self.aggs, value_is_int=self.value_is_int,
             interpret=interpret)
@@ -234,13 +240,17 @@ def extract_plan(plan, provider) -> Optional[PallasPlan]:
         # -- group columns (params: strides + bases arrays)
         group_idx: List[int] = []
         strides: List[int] = []
+        key_offset = 0
         if group_specs:
             for strat, col in group_specs:
                 if strat != "gdict":
                     raise _Ineligible("raw group key")
                 group_idx.append(packed_idx(col))
             strides = [int(s) for s in np.asarray(pc.take())]
-            pc.take()  # bases (gdict bases are 0)
+            # gdict bases are nonzero when the planner filter-narrowed the
+            # column's dictId range; fold them into one static key offset
+            bases = [int(b) for b in np.asarray(pc.take())]
+            key_offset = sum(b * s for b, s in zip(bases, strides))
             G = -(-num_groups // _G_CHUNK) * _G_CHUNK
         else:
             G = _G_CHUNK  # single group at key 0
@@ -330,7 +340,8 @@ def extract_plan(plan, provider) -> Optional[PallasPlan]:
         packed_names=packed_names, value_names=value_names,
         value_is_int=tuple(value_is_int), filter_tree=tree,
         n_slots=len(intervals), group_idx=tuple(group_idx),
-        group_strides=tuple(strides), num_groups_padded=G,
+        group_strides=tuple(strides), group_key_offset=key_offset,
+        num_groups_padded=G,
         aggs=tuple(aggs), static_params=params)
 
 
@@ -500,13 +511,19 @@ def build_kernel(spec: PallasSpec):
             vexpr_cache[vexpr] = v
             return v
 
-        # -- composed group keys (all zero for scalar aggregation)
+        # -- composed group keys (all zero for scalar aggregation); masked
+        # docs outside a narrowed key range go negative and simply match no
+        # one-hot column (their rows are mask-zeroed anyway)
         keys = jnp.zeros((RT, 128), dtype=jnp.int32)
         for gi, stride in zip(spec.group_idx, spec.group_strides):
             keys = keys + ids[gi] * jnp.int32(stride)
+        if spec.group_key_offset:
+            keys = keys - jnp.int32(spec.group_key_offset)
 
         # -- per-segment matched docs (QueryStats parity), exact i32
-        out_seg[0, :] += mask.astype(jnp.int32).sum(axis=0)
+        # (dtype pinned: under jax x64 an int32 sum promotes to int64 and
+        # the ref swap rejects the mismatch)
+        out_seg[0, :] += mask.astype(jnp.int32).sum(axis=0, dtype=jnp.int32)
 
         # -- matmul row stack [nf + 1 + sum(L), RT, 128] f32
         rows = []

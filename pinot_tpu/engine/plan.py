@@ -119,6 +119,9 @@ class SegmentPlan:
     group_strides: Optional[np.ndarray]  # row-major key strides (decode uses)
     num_groups: int          # padded total group count (0 = not group-by)
     agg_defs: List[AggDef]
+    # per group col: key-space offset the kernel subtracts (0 unless the
+    # column is graw/gexpr or its dictId range was filter-narrowed)
+    group_bases: List[int] = field(default_factory=list)
 
 
 class PlanError(UnsupportedQueryError):
@@ -134,6 +137,9 @@ def plan_segment(ctx: QueryContext, segment: ImmutableSegment) -> SegmentPlan:
     columns: List[str] = []
 
     filter_spec = _compile_filter(ctx.filter, segment, params, columns)
+    # collected BEFORE the validdocs placeholder shifts the param slots
+    dict_ranges = (_conjunctive_dict_ranges(filter_spec, params)
+                   if ctx.group_by else {})
 
     if getattr(segment, "valid_doc_ids", None) is not None:
         # upsert-managed: AND a point-in-time snapshot of the live valid-doc
@@ -156,7 +162,8 @@ def plan_segment(ctx: QueryContext, segment: ImmutableSegment) -> SegmentPlan:
     num_groups = 0
     if ctx.group_by:
         for e in ctx.group_by:
-            strat, payload, card, base = _group_strategy(e, segment)
+            strat, payload, card, base = _group_strategy(e, segment,
+                                                         dict_ranges)
             group_cards.append(card)
             group_bases.append(base)
             if strat == "gexpr":
@@ -265,7 +272,7 @@ def plan_segment(ctx: QueryContext, segment: ImmutableSegment) -> SegmentPlan:
     return SegmentPlan(spec=spec, params=params, columns=columns,
                        group_defs=group_defs, group_cards=group_cards,
                        group_strides=strides, num_groups=num_groups,
-                       agg_defs=agg_defs)
+                       agg_defs=agg_defs, group_bases=group_bases)
 
 
 # --------------------------------------------------------------------------
@@ -338,6 +345,68 @@ def _acc_dtype(base: str, vexpr: Optional[Expr], segment: ImmutableSegment,
 
 
 # --------------------------------------------------------------------------
+# filter-aware dictId narrowing: predicates in the filter's top-level AND
+# conjunction bound the dictIds any LIVE doc can carry in those columns, so
+# a group column under such a predicate needs only the narrowed key range —
+# the composed key space of selective queries (SSB Q3.3/Q3.4/Q4.3 shape)
+# drops below the sparse threshold and takes the dense (often Pallas-
+# eligible) rung outright. The reference narrows the same way by feeding
+# filtered dictId sets to DictionaryBasedGroupKeyGenerator (SURVEY §2.4).
+# --------------------------------------------------------------------------
+
+# params consumed per compiled filter op (must mirror kernels._emit_filter)
+_FILTER_PARAMS = {
+    "true": 0, "false": 0, "validdocs": 1, "isnull": 0, "isnotnull": 0,
+    "eq": 1, "neq": 1, "range": 1, "lut": 1,
+    "mv_eq": 1, "mv_neq": 1, "mv_range": 1, "mv_lut": 1,
+    "veq": 1, "vneq": 1, "vrange": 2, "vin": 1, "vnotin": 1,
+}
+
+
+def _conjunctive_dict_ranges(filter_spec: Tuple,
+                             params: List[np.ndarray]
+                             ) -> Dict[str, Tuple[int, int]]:
+    """column -> (lo, hi) inclusive dictId bounds implied for every doc the
+    filter can match, collected only along pure-AND paths from the root
+    (predicates under OR/NOT prove nothing). Repeated predicates meet
+    (intersect); an empty meet means the filter matches nothing."""
+    ranges: Dict[str, Tuple[int, int]] = {}
+
+    def meet(col: str, lo: int, hi: int) -> None:
+        cur = ranges.get(col)
+        ranges[col] = ((max(cur[0], lo), min(cur[1], hi))
+                       if cur else (lo, hi))
+
+    def walk(node: Tuple, i: int, conj: bool) -> int:
+        op = node[0]
+        if op == "and":
+            for c in node[1]:
+                i = walk(c, i, conj)
+            return i
+        if op in ("or", "not"):
+            for c in node[1]:
+                i = walk(c, i, False)
+            return i
+        if conj:
+            if op == "eq":
+                did = int(params[i])
+                meet(node[1], did, did)
+            elif op == "range":
+                iv = np.asarray(params[i])
+                meet(node[1], int(iv[0]), int(iv[1]))
+            elif op == "lut":
+                idx = np.nonzero(np.asarray(params[i]))[0]
+                if idx.size:
+                    meet(node[1], int(idx[0]), int(idx[-1]))
+                else:
+                    meet(node[1], 1, 0)  # matches nothing
+        return i + _FILTER_PARAMS[op]
+
+    walk(filter_spec, 0, True)
+    return ranges
+
+
+# --------------------------------------------------------------------------
 # group-by strategies
 # --------------------------------------------------------------------------
 
@@ -389,11 +458,13 @@ def _value_bounds(e: Expr, segment: ImmutableSegment
     return None
 
 
-def _group_strategy(e: Expr, segment: ImmutableSegment
+def _group_strategy(e: Expr, segment: ImmutableSegment,
+                    dict_ranges: Optional[Dict[str, Tuple[int, int]]] = None
                     ) -> Tuple[str, Any, int, int]:
     """-> (strategy, payload, cardinality, base). Payload is the column
     name for gdict/graw; for 'gexpr' the EXPRESSION (compiled to a device
-    value spec after strides/bases take their param slots)."""
+    value spec after strides/bases take their param slots).
+    ``dict_ranges`` carries the filter-narrowed dictId bounds per column."""
     if isinstance(e, Identifier):
         if e.name.startswith("$"):
             raise PlanError("group-by on virtual column -> host path")
@@ -401,8 +472,16 @@ def _group_strategy(e: Expr, segment: ImmutableSegment
         if not cm.single_value:
             raise PlanError("group-by on MV column -> host path")
         if cm.has_dictionary:
-            # key = dictId (ref: DictionaryBasedGroupKeyGenerator.java:62)
-            return ("gdict", e.name, cm.cardinality, 0)
+            # key = dictId - narrowed base
+            # (ref: DictionaryBasedGroupKeyGenerator.java:62)
+            lo, hi = (dict_ranges or {}).get(e.name, (0, cm.cardinality - 1))
+            lo = max(0, lo)
+            hi = min(cm.cardinality - 1, hi)
+            if lo > hi:
+                # the conjunction is unsatisfiable for this column: no doc
+                # survives the filter, a 1-slot key space is enough
+                lo, hi = 0, 0
+            return ("gdict", e.name, hi - lo + 1, lo)
         if cm.data_type.is_integral:
             lo, hi = int(cm.min_value), int(cm.max_value)
             span = hi - lo + 1

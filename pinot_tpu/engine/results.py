@@ -69,6 +69,10 @@ class QueryStats:
     num_docs_scanned: int = 0
     total_docs: int = 0
     num_groups_limit_reached: bool = False
+    # group-by ladder rung that served ('dense'|'compact'|'hash'|'sort'|
+    # 'startree'|'host'; 'mixed' when segments split across rungs) — the
+    # bench gates SSB Q3.x on this
+    group_by_rung: Optional[str] = None
     # phase -> ms (ref: TimerContext/ServerQueryPhase —
     # ServerQueryExecutorV1Impl.java:122,276,297,303); summed across
     # servers at reduce
@@ -93,6 +97,11 @@ class QueryStats:
         self.num_docs_scanned += other.num_docs_scanned
         self.total_docs += other.total_docs
         self.num_groups_limit_reached |= other.num_groups_limit_reached
+        if other.group_by_rung is not None:
+            self.group_by_rung = (
+                other.group_by_rung
+                if self.group_by_rung in (None, other.group_by_rung)
+                else "mixed")
         for phase, ms in other.phase_ms.items():
             self.add_phase_ms(phase, ms)
         self.trace.extend(other.trace)
@@ -108,6 +117,8 @@ class QueryStats:
             "numGroupsLimitReached": self.num_groups_limit_reached,
             "phaseTimesMs": {k: round(v, 3)
                              for k, v in self.phase_ms.items()},
+            **({"groupByRung": self.group_by_rung}
+               if self.group_by_rung else {}),
             **({"trace": self.trace} if self.trace else {}),
         }
 

@@ -186,8 +186,9 @@ def _match_glob(root: str, pattern: str,
 
 def _build_one_process(spec, schema, table_config, input_file: str,
                        segment_name: str) -> None:
-    """Process-pool entry: rebuild the runner in the worker (fork-started;
-    specs/schemas are small plain dataclasses)."""
+    """Process-pool entry: rebuild the runner in the worker (spawn-started,
+    so nothing is inherited; specs/schemas are small plain dataclasses that
+    pickle across the spawn boundary)."""
     SegmentGenerationJobRunner(spec, schema, table_config)._build_one(
         input_file, segment_name)
 

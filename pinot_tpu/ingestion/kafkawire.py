@@ -118,17 +118,23 @@ def _varint(v: int) -> bytes:
             return bytes(out)
 
 
-_CRC32C_TABLE = []
+def _build_crc32c_table() -> tuple:
+    table = []
+    for i in range(256):
+        c = i
+        for _ in range(8):
+            c = (c >> 1) ^ 0x82F63B78 if c & 1 else c >> 1
+        table.append(c)
+    return tuple(table)
+
+
+# built eagerly at import: the handlers run under ThreadingTCPServer, and a
+# lazily-appended list is readable half-built by a concurrent request
+_CRC32C_TABLE = _build_crc32c_table()
 
 
 def _crc32c(data: bytes) -> int:
     """CRC-32C (Castagnoli), the record-batch checksum kafka uses."""
-    if not _CRC32C_TABLE:
-        for i in range(256):
-            c = i
-            for _ in range(8):
-                c = (c >> 1) ^ 0x82F63B78 if c & 1 else c >> 1
-            _CRC32C_TABLE.append(c)
     crc = 0xFFFFFFFF
     for b in data:
         crc = (crc >> 8) ^ _CRC32C_TABLE[(crc ^ b) & 0xFF]

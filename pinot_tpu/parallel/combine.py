@@ -40,6 +40,24 @@ from pinot_tpu.engine.plan import PlanError
 SEG_AXIS = "seg"
 DOC_AXIS = "doc"
 
+
+def _shard_map(f, mesh: Mesh, in_specs, out_specs):
+    """jax.shard_map across jax versions: the top-level export (check_vma
+    kwarg) landed after 0.4.x, where the API lives in jax.experimental
+    with the older check_rep spelling. Replication checking stays off
+    either way (pack_outputs concatenates psum'd and all_gather'd leaves,
+    which the checker can't see through)."""
+    sm = getattr(jax, "shard_map", None)
+    if sm is None:
+        from jax.experimental.shard_map import shard_map as sm
+    for kwargs in ({"check_vma": False}, {"check_rep": False}, {}):
+        try:
+            return sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                      **kwargs)
+        except TypeError:
+            continue
+    raise RuntimeError("no usable shard_map signature in this jax")
+
 # shard spec per staged-column array kind. dictvals is the unified
 # dictionary: replicated (every device gathers from the full dictionary).
 KIND_SPEC = {
@@ -145,6 +163,10 @@ def _sparse_cross_combine(partials, reducers, K, axes, mesh):
     # if ANY per-segment compact overflowed, its keys were truncated before
     # this merge — surface a count > K so unpack raises (host path serves)
     out["compact_n"] = jnp.maximum(n_live, seg_n)
+    # rung flag: 'sort' wins if ANY shard's hash table overflowed
+    rung = partials.get("rung")
+    if rung is not None:
+        out["rung"] = _cross_reduce(rung.max(), "max", axes, mesh)
     return out
 
 
@@ -183,8 +205,17 @@ def build_sharded_kernel(spec: Tuple, mesh: Mesh,
         raise PlanError(f"capacity {capacity} !| doc axis {n_doc}")
     local_cap = capacity // n_doc
     sparse_k = sparse_mode(spec)
+    # sparse specs build BOTH sparse-rung bodies: the hash body runs first
+    # for every local segment, and a device-level lax.cond reruns the sort
+    # body only when a hash table overflowed. The cond must sit OUTSIDE the
+    # segment vmap — a cond under vmap lowers to select and would execute
+    # (and pay for) the sort on every query.
     body = build_kernel_body(spec, capacity_override=local_cap,
-                             sparse_k=sparse_k)
+                             sparse_k=sparse_k,
+                             sparse_rung="hash" if sparse_k else "cond")
+    body_sort = (build_kernel_body(spec, capacity_override=local_cap,
+                                   sparse_k=sparse_k, sparse_rung="sort")
+                 if sparse_k else None)
     reducers = partial_reduce_ops(spec)
 
     kind_axis = {"fwd": 0, "mv": 0, "mvcount": 0, "null": 0, "dictvals": None}
@@ -203,6 +234,20 @@ def build_sharded_kernel(spec: Tuple, mesh: Mesh,
         partials = jax.vmap(one_segment, in_axes=(cols_axes, 0))(cols, num_docs)
         axes = (SEG_AXIS, DOC_AXIS)
         if sparse_k:
+            # hash-rung overflow anywhere in this device's segments -> rerun
+            # them all through the sort body (one branch executes; the
+            # cross-shard merge is rung-agnostic, so devices may disagree)
+            hash_partials = partials
+
+            def _sort_all(_):
+                return jax.vmap(
+                    lambda seg_cols, nd: body_sort(seg_cols, params, nd,
+                                                   doc_off),
+                    in_axes=(cols_axes, 0))(cols, num_docs)
+
+            partials = jax.lax.cond(hash_partials["rung"].max() > 0,
+                                    _sort_all, lambda _: hash_partials,
+                                    None)
             out = _sparse_cross_combine(partials, reducers, sparse_k,
                                         axes, mesh)
         else:
@@ -231,11 +276,10 @@ def build_sharded_kernel(spec: Tuple, mesh: Mesh,
         # decode (the tunnel-latency fix; see kernels.output_layout)
         return pack_outputs(out, spec)
 
-    sharded = jax.shard_map(
+    sharded = _shard_map(
         per_device, mesh=mesh,
         in_specs=(cols_spec, P(), P(SEG_AXIS)),
-        out_specs=P(),
-        check_vma=False)
+        out_specs=P())
     return jax.jit(sharded)
 
 
@@ -301,12 +345,11 @@ def build_sharded_pallas_kernel(spec, plan_spec: Tuple, mesh: Mesh):
         return pack_outputs(tree, plan_spec)
 
     pk_spec = P(SEG_AXIS, DOC_AXIS, None, None)
-    sharded = jax.shard_map(
+    sharded = _shard_map(
         per_device, mesh=mesh,
         in_specs=(P(),
                   [pk_spec] * len(spec.packed_bits),
                   [pk_spec] * len(spec.value_is_int),
                   P(SEG_AXIS)),
-        out_specs=P(),
-        check_vma=False)
+        out_specs=P())
     return jax.jit(sharded)

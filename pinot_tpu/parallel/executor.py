@@ -24,6 +24,7 @@ from pinot_tpu.engine.executor import (
     decode_grouped_result,
     decode_scalar_result,
     filter_fingerprint,
+    grouped_rung,
 )
 from pinot_tpu.engine.plan import PlanError, SegmentPlan, plan_segment
 from pinot_tpu.engine.results import AggResult, GroupByResult, QueryStats
@@ -64,6 +65,13 @@ class ShardedQueryExecutor(ServerQueryExecutor):
         self._query_cache_cap = 256
         self._query_cache_lock = threading.Lock()
         self._device_cols_lock = threading.Lock()
+        # multi-device combine programs carry collectives (psum/all_gather):
+        # two threads interleaving their launches across the same devices
+        # deadlock inside the runtime, so launches serialize through this
+        # lock. None on a 1-device mesh (no collectives -> no deadlock; the
+        # serving-path QPS benefit of concurrent launches survives there).
+        self._combine_lock = (threading.Lock()
+                              if self.mesh.devices.size > 1 else None)
         # PallasSpec -> jitted sharded fused kernel (literal params stay
         # runtime args, so same-shape queries share the compile)
         self._pallas_sharded: Dict = {}
@@ -171,7 +179,7 @@ class ShardedQueryExecutor(ServerQueryExecutor):
         trace_on = ctx.trace_enabled
         t0 = time.perf_counter() if trace_on else 0.0
         try:
-            packed = call_fn(num_docs)
+            packed = self._launch_combine(call_fn, num_docs)
         except (PlanError, ValueError):
             raise
         except Exception:
@@ -204,7 +212,7 @@ class ShardedQueryExecutor(ServerQueryExecutor):
             with self._query_cache_lock:
                 self._query_cache[qkey] = (plan, call_fn, False)
             is_pallas = False  # the trace must name the kernel that RAN
-            packed = call_fn(num_docs)
+            packed = self._launch_combine(call_fn, num_docs)
         # ONE D2H fetch decodes the entire query result
         out = unpack_outputs(packed, plan.spec, num_seg=S)
         if trace_on:
@@ -220,7 +228,25 @@ class ShardedQueryExecutor(ServerQueryExecutor):
         seg_matched = out["seg_matched"][:batch.num_segments]
         stats.num_docs_scanned += int(seg_matched.sum())
         stats.num_segments_matched += int((seg_matched > 0).sum())
+        if plan.spec[2]:  # grouped: record the ladder rung that served
+            stats.group_by_rung = grouped_rung(plan.spec, out)
         return batch, out, plan
+
+    def _launch_combine(self, call_fn, num_docs):
+        """Run one combine program. On a multi-device mesh the launch AND
+        the result wait serialize under _combine_lock: the program's
+        collectives deadlock if another thread's program interleaves its
+        per-device launches (the wait must sit inside the lock — dispatch
+        is async, so releasing early would only move the interleave to the
+        blocked fetch)."""
+        import jax
+
+        if self._combine_lock is None:
+            return call_fn(num_docs)
+        with self._combine_lock:
+            packed = call_fn(num_docs)
+            jax.block_until_ready(packed)
+            return packed
 
     def _build_jnp_call(self, plan: SegmentPlan, batch: SegmentBatch,
                         S: int):

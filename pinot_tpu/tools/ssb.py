@@ -189,7 +189,7 @@ def build_segments(sf: float, out_dir: str, num_segments: int = 8,
                    seed: int = 42, rows: int = 0,
                    workers: int = 0) -> List:
     """Build + load ``num_segments`` SSB segments. ``workers`` > 1 builds
-    segments in a fork process pool (per-column creators are independent in
+    segments in a spawn process pool (per-column creators are independent in
     the reference too — SegmentIndexCreationDriverImpl.java:81); 0 picks
     min(num_segments, cpu_count)."""
     from pinot_tpu.segment import load_segment

@@ -24,7 +24,11 @@ from pinot_tpu.spi.table import TableConfig
 
 log = logging.getLogger(__name__)
 
-Route = Tuple[str, re.Pattern, Callable]
+# (method, pattern, handler, table_scope): table_scope=False marks routes
+# whose first path capture is NOT a table name (instance ids, task types,
+# zk node paths) so authorization runs cluster-scoped (table=None) instead
+# of granting/denying against the wrong scope
+Route = Tuple[str, re.Pattern, Callable, bool]
 
 
 class _Api:
@@ -69,7 +73,7 @@ class _Api:
                     n = int(self.headers.get("Content-Length") or 0)
                     if n:
                         body = json.loads(self.rfile.read(n).decode("utf-8"))
-                    for m, pat, fn in api._routes:
+                    for m, pat, fn, table_scope in api._routes:
                         if m != method:
                             continue
                         match = pat.fullmatch(self.path.split("?", 1)[0])
@@ -87,7 +91,8 @@ class _Api:
 
                                 access = READ if (method == "GET" or path_only
                                                   in api.READ_POSTS) else WRITE
-                                table = (match.group(1) if pat.groups
+                                table = (match.group(1)
+                                         if pat.groups and table_scope
                                          else None)
                                 if table is None and isinstance(body, dict):
                                     # route-aware: the auth scope must be
@@ -144,8 +149,9 @@ class _Api:
         self.port = self._httpd.server_port
         self._thread: Optional[threading.Thread] = None
 
-    def route(self, method: str, pattern: str, fn: Callable) -> None:
-        self._routes.append((method, re.compile(pattern), fn))
+    def route(self, method: str, pattern: str, fn: Callable,
+              table_scope: bool = True) -> None:
+        self._routes.append((method, re.compile(pattern), fn, table_scope))
 
     def current_principal(self):
         """The principal of the request being dispatched on THIS thread."""
@@ -227,29 +233,37 @@ class ControllerApi(_Api):
         # tag groups; SERVER/BROKER membership comes from instance tags
         self.route("GET", r"/tenants",
                    lambda m, b: (200, self._tenants(store)))
+        # the capture is a tenant (instance tag group), not a table
         self.route("GET", r"/tenants/([^/]+)",
-                   lambda m, b: (200, self._tenant(store, m.group(1))))
+                   lambda m, b: (200, self._tenant(store, m.group(1))),
+                   table_scope=False)
+        # the capture is an INSTANCE id, not a table — cluster-scoped auth
         self.route("PUT", r"/instances/([^/]+)/updateTags",
-                   lambda m, b: self._update_tags(c, m.group(1), b))
-        # minion tasks (ref: PinotTaskRestletResource)
+                   lambda m, b: self._update_tags(c, m.group(1), b),
+                   table_scope=False)
+        # minion tasks (ref: PinotTaskRestletResource); the capture is a
+        # task TYPE, not a table — cluster-scoped auth
         self.route("GET", r"/tasks/tasktypes",
                    lambda m, b: (200, self._task_types()))
         self.route("GET", r"/tasks/([^/]+)/state",
                    lambda m, b: (200, {
                        t.task_id: t.status
                        for t in c.task_manager.list_tasks()
-                       if t.task_type == m.group(1)}))
+                       if t.task_type == m.group(1)}),
+                   table_scope=False)
         self.route("POST", r"/tasks/schedule",
                    lambda m, b: (200, {"generated":
                                        c.task_manager.generate_tasks()}))
         # state-store browse (ref: ZookeeperResource /zk/ls + /zk/get; the
-        # node path rides IN the URL path after the verb)
+        # node path rides IN the URL path after the verb — never a table)
         self.route("GET", r"/zk/ls(?:/(.*))?",
                    lambda m, b: (200, store.children(m.group(1))
                                  if m.group(1)
-                                 else sorted(store.snapshot_data()[1])))
+                                 else sorted(store.snapshot_data()[1])),
+                   table_scope=False)
         self.route("GET", r"/zk/get/(.+)",
-                   lambda m, b: self._zk_get(store, m.group(1)))
+                   lambda m, b: self._zk_get(store, m.group(1)),
+                   table_scope=False)
         # minimal cluster status UI (ref: the controller's bundled web app)
         self.route("GET", r"/ui",
                    lambda m, b: (200, self._render_ui(store)))

@@ -24,6 +24,12 @@ DOCS = 4096
 
 DIMS = {"color": ["red", "green", "blue", "gold"],
         "shape": ["circle", "square", "tri"]}
+# high-cardinality dictionary INT column: composed with the DIMS columns it
+# pushes the group key space past SPARSE_MIN_GROUPS, so fuzzed group-bys
+# exercise the hash-aggregation rung (and, with a selective item filter,
+# the dictId-narrowing path)
+ITEM_SPAN = 30_000
+GROUP_POOL = list(DIMS) + ["item"]
 INT_COLS = ["year", "qty"]
 FLOAT_COLS = ["price"]
 AGGS = ["count(*)", "sum(qty)", "min(price)", "max(price)", "avg(qty)",
@@ -37,6 +43,7 @@ def _frame(n, seed):
     return pd.DataFrame({
         "color": np.asarray(DIMS["color"])[rng.integers(0, 4, n)],
         "shape": np.asarray(DIMS["shape"])[rng.integers(0, 3, n)],
+        "item": rng.integers(0, ITEM_SPAN, n),
         "year": rng.integers(2000, 2020, n),
         "qty": rng.integers(0, 100, n),
         "price": np.round(rng.uniform(1, 500, n), 2),
@@ -49,6 +56,7 @@ def table(tmp_path_factory):
     schema = Schema("fz", [
         FieldSpec("color", DataType.STRING),
         FieldSpec("shape", DataType.STRING),
+        FieldSpec("item", DataType.INT),
         FieldSpec("year", DataType.INT),
         FieldSpec("qty", DataType.LONG, FieldType.METRIC),
         FieldSpec("price", DataType.DOUBLE, FieldType.METRIC),
@@ -63,8 +71,17 @@ def table(tmp_path_factory):
     return segs, pd.concat(frames, ignore_index=True)
 
 
-def _rand_predicate(rng):
-    kind = rng.integers(0, 6)
+def _rand_predicate(rng, with_item=False):
+    # 'item' predicates are opt-in: test_fuzz_cluster reuses this generator
+    # against tables that don't carry the high-card column
+    kind = rng.integers(0, 7 if with_item else 6)
+    if kind == 6:
+        # selective dictionary range on the high-card column: when 'item'
+        # is also a group key this drives the plan-time dictId narrowing
+        lo = int(rng.integers(0, ITEM_SPAN - 4000))
+        hi = lo + int(rng.integers(200, 4000))
+        return (f"item BETWEEN {lo} AND {hi}",
+                lambda df: (df.item >= lo) & (df.item <= hi))
     if kind == 0:
         c = rng.choice(list(DIMS))
         v = rng.choice(DIMS[c])
@@ -89,13 +106,13 @@ def _rand_predicate(rng):
     return f"price <= {v}", lambda df: df.price <= v
 
 
-def _rand_filter(rng):
+def _rand_filter(rng, with_item=False):
     n = int(rng.integers(0, 3))
     if n == 0:
         return "", lambda df: pd.Series(True, index=df.index)
     parts, fns = [], []
     for _ in range(n):
-        sql, fn = _rand_predicate(rng)
+        sql, fn = _rand_predicate(rng, with_item)
         parts.append(sql)
         fns.append(fn)
     op = " AND " if rng.integers(0, 2) else " OR "
@@ -145,11 +162,15 @@ def test_fuzz_query(table, qi):
     rng = np.random.default_rng(1234 + qi)
     n_aggs = int(rng.integers(1, 4))
     aggs = list(rng.choice(AGGS, size=n_aggs, replace=False))
-    where, mask_fn = _rand_filter(rng)
+    where, mask_fn = _rand_filter(rng, with_item=True)
     group = []
     gexpr = None  # (sql text, pandas series fn) expression group key
     if rng.integers(0, 2):
-        group = list(rng.choice(list(DIMS), size=int(rng.integers(1, 3)),
+        # the pool includes the high-card 'item' column: composed with a
+        # DIMS column the key space crosses SPARSE_MIN_GROUPS and the query
+        # rides the hash rung (or the narrowed dense rung under a
+        # conjunctive item filter)
+        group = list(rng.choice(GROUP_POOL, size=int(rng.integers(1, 3)),
                                 replace=False))
         if rng.integers(0, 3) == 0:
             # bounded integral EXPRESSION key (the device 'gexpr' strategy)
@@ -159,7 +180,9 @@ def test_fuzz_query(table, qi):
     if group:
         keys = ([gexpr[0]] if gexpr else []) + group
         sql += f" GROUP BY {', '.join(keys)}"
-        sql += f" ORDER BY {', '.join(keys)} LIMIT 10000"
+        # LIMIT must exceed any possible group count: the high-card 'item'
+        # key alone yields ~11k groups and the oracle never truncates
+        sql += f" ORDER BY {', '.join(keys)} LIMIT 60000"
 
     device = ShardedQueryExecutor()
     host = ServerQueryExecutor(use_device=False)

@@ -1,0 +1,184 @@
+"""Hash-aggregation rung + filter-aware dictId narrowing.
+
+The device group-by ladder (engine/kernels.py) gained a rung between the
+dense segment_sum scatter and the sort-based sparse compaction: an
+open-addressing hash table over the LIVE docs, with in-kernel fallback to
+the sort rung on overflow (probe failure, live-doc window overflow, or
+more live groups than the compact cap). plan.py narrows each group
+column's dictId range from conjunctive filter predicates so selective
+queries drop below the sparse threshold entirely. Every path must stay
+bit-exact with the sort rung and the host engine.
+"""
+
+import numpy as np
+import pytest
+
+from pinot_tpu.engine import ServerQueryExecutor
+from pinot_tpu.engine.kernels import SPARSE_MIN_GROUPS, sparse_mode
+from pinot_tpu.engine.plan import plan_segment
+from pinot_tpu.parallel import ShardedQueryExecutor
+from pinot_tpu.query import compile_query
+from pinot_tpu.segment import SegmentBuilder, load_segment
+from pinot_tpu.spi import DataType, FieldSpec, FieldType, Schema
+
+
+def _build(tmp, name, frame):
+    schema = Schema(name, [
+        FieldSpec("a", DataType.STRING),
+        FieldSpec("b", DataType.STRING),
+        FieldSpec("year", DataType.INT),
+        FieldSpec("v", DataType.LONG, FieldType.METRIC),
+    ])
+    segs = []
+    for i in range(2):
+        SegmentBuilder(schema, f"{name}_{i}").build(frame, tmp)
+        segs.append(load_segment(f"{tmp}/{name}_{i}"))
+    return segs
+
+
+@pytest.fixture(scope="module")
+def wide_segs(tmp_path_factory):
+    """150 x 150 x 4 key space (~2^17 padded): past SPARSE_MIN_GROUPS."""
+    out = str(tmp_path_factory.mktemp("hashwide"))
+    rng = np.random.default_rng(11)
+    n = 20_000
+    frame = {
+        "a": [f"a{i:03d}" for i in rng.integers(0, 150, n)],
+        "b": [f"b{i:03d}" for i in rng.integers(0, 150, n)],
+        "year": rng.integers(2000, 2004, n).tolist(),
+        "v": rng.integers(0, 100, n).tolist(),
+    }
+    return _build(out, "hw", frame)
+
+
+@pytest.fixture(scope="module")
+def tied_segs(tmp_path_factory):
+    """Same 150x150 dictionaries but only 150 LIVE (a, b) pairs — every
+    group carries ~130 tied docs and every doc is live (no filter)."""
+    out = str(tmp_path_factory.mktemp("hashtied"))
+    rng = np.random.default_rng(12)
+    n = 20_000
+    ai = rng.integers(0, 150, n)
+    frame = {
+        "a": [f"a{i:03d}" for i in ai],
+        "b": [f"b{i:03d}" for i in ai],          # b correlates with a
+        "year": rng.integers(2000, 2004, n).tolist(),
+        "v": rng.integers(0, 100, n).tolist(),
+    }
+    return _build(out, "ht", frame)
+
+
+def _parity(sql, segs):
+    dev = ShardedQueryExecutor()
+    host = ServerQueryExecutor(use_device=False)
+    drt, dstats = dev.execute(compile_query(sql), segs)
+    hrt, _ = host.execute(compile_query(sql), segs)
+    assert drt.rows == hrt.rows, sql
+    assert len(drt.rows) > 0
+    return dstats
+
+
+SELECTIVE_SQL = ("SELECT a, b, year, sum(v), count(*), min(v), max(v), "
+                 "avg(v) FROM hw WHERE v < 2 "
+                 "GROUP BY a, b, year ORDER BY a, b, year LIMIT 15000")
+
+
+def test_hash_rung_serves_selective_query(wide_segs):
+    """Few live rows against a huge key space: the hash table must place
+    every key (no sort fallback) and match the host engine exactly."""
+    spec = plan_segment(compile_query(SELECTIVE_SQL), wide_segs[0]).spec
+    assert sparse_mode(spec) > 0
+    stats = _parity(SELECTIVE_SQL, wide_segs)
+    assert stats.group_by_rung == "hash"
+
+
+def test_hash_rung_per_segment_executor(wide_segs):
+    """The per-segment executor's in-kernel lax.cond path (the sharded
+    combine conds at the device level instead)."""
+    dev = ServerQueryExecutor()
+    host = ServerQueryExecutor(use_device=False)
+    drt, dstats = dev.execute(compile_query(SELECTIVE_SQL), wide_segs)
+    hrt, _ = host.execute(compile_query(SELECTIVE_SQL), wide_segs)
+    assert drt.rows == hrt.rows
+    assert dstats.group_by_rung == "hash"
+
+
+def test_tie_heavy_full_capacity_live(tied_segs):
+    """No filter: every doc is live and groups are heavily tied — the
+    live-doc window equals the capacity and accumulation order must stay
+    doc order (bit-exact sums vs the host)."""
+    sql = ("SELECT a, b, year, sum(v), count(*), avg(v) FROM ht "
+           "GROUP BY a, b, year ORDER BY a, b, year LIMIT 15000")
+    spec = plan_segment(compile_query(sql), tied_segs[0]).spec
+    assert sparse_mode(spec) > 0
+    stats = _parity(sql, tied_segs)
+    assert stats.group_by_rung == "hash"
+
+
+def test_probe_overflow_falls_back_to_sort(wide_segs, monkeypatch):
+    """Zero probe passes place nothing: the overflow flag must route the
+    kernel through the sort rung with identical results."""
+    from pinot_tpu.engine import kernels
+
+    monkeypatch.setattr(kernels, "HASH_PROBES", 0)
+    stats = _parity(SELECTIVE_SQL, wide_segs)
+    assert stats.group_by_rung == "sort"
+
+
+def test_live_window_overflow_falls_back_to_sort(wide_segs, monkeypatch):
+    """More matched docs than the live-doc window: sort rung serves."""
+    from pinot_tpu.engine import kernels
+
+    monkeypatch.setattr(kernels, "HASH_LIVE_DOCS", 64)
+    stats = _parity(SELECTIVE_SQL, wide_segs)
+    assert stats.group_by_rung == "sort"
+
+
+def test_narrowing_takes_dense_rung(wide_segs):
+    """An IN predicate on a group column narrows its dictId range: the
+    composed key space drops below SPARSE_MIN_GROUPS and the dense rung
+    serves outright (the SSB Q3.3/Q3.4 shape)."""
+    sql = ("SELECT a, b, year, sum(v), count(*) FROM hw "
+           "WHERE a IN ('a001', 'a002', 'a003') "
+           "GROUP BY a, b, year ORDER BY a, b, year LIMIT 15000")
+    plan = plan_segment(compile_query(sql), wide_segs[0])
+    assert plan.spec[3] < SPARSE_MIN_GROUPS
+    assert sparse_mode(plan.spec) == 0
+    assert plan.group_bases[0] > 0          # 'a001' is not dictId 0
+    stats = _parity(sql, wide_segs)
+    assert stats.group_by_rung == "dense"
+
+
+def test_narrowing_eq_and_range(wide_segs):
+    """EQ + RANGE predicates narrow their columns; decode must add the
+    bases back so group VALUES stay exact."""
+    sql = ("SELECT a, b, year, sum(v) FROM hw "
+           "WHERE a = 'a077' AND b BETWEEN 'b100' AND 'b120' "
+           "GROUP BY a, b, year ORDER BY a, b, year LIMIT 15000")
+    plan = plan_segment(compile_query(sql), wide_segs[0])
+    assert plan.group_cards[0] == 1          # a narrowed to the single id
+    assert plan.group_cards[1] <= 21         # b narrowed to the range
+    stats = _parity(sql, wide_segs)
+    assert stats.group_by_rung == "dense"
+
+
+def test_narrowing_ignores_or_branches(wide_segs):
+    """Predicates under OR prove nothing about live docs: no narrowing,
+    and results still match."""
+    sql = ("SELECT a, b, year, sum(v) FROM hw "
+           "WHERE a = 'a001' OR b = 'b140' "
+           "GROUP BY a, b, year ORDER BY a, b, year LIMIT 15000")
+    plan = plan_segment(compile_query(sql), wide_segs[0])
+    assert plan.group_cards[0] == 150        # NOT narrowed
+    assert plan.group_cards[1] == 150
+    _parity(sql, wide_segs)
+
+
+def test_group_overflow_still_serves_full_results(wide_segs):
+    """More live groups than the compact cap: hash overflows, sort
+    overflows too, and the host path serves the complete result."""
+    sql = ("SELECT a, b, year, sum(v) FROM hw "
+           "GROUP BY a, b, year ORDER BY a, b, year LIMIT 100000")
+    stats = _parity(sql, wide_segs)
+    assert stats.group_by_rung == "host"
+    assert stats.num_docs_scanned > 0

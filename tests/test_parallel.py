@@ -240,21 +240,25 @@ class TestCompactGroupBy:
         from pinot_tpu.engine.kernels import compact_mode, sparse_mode
         from pinot_tpu.engine.plan import plan_segment
 
+        # the filter rides a NON-group column so dictId narrowing can't
+        # shrink the 2^17 composed key space (an `a IN (...)` filter now
+        # takes the dense rung outright — covered by test_hash_groupby)
         sql = ("SELECT a, b, year, sum(v), count(*) FROM wide "
-               "WHERE a IN ('a001', 'a002', 'a003') "
-               "GROUP BY a, b, year ORDER BY a, b, year LIMIT 5000")
+               "WHERE v < 30 "
+               "GROUP BY a, b, year ORDER BY a, b, year LIMIT 15000")
         ctx = compile_query(sql)
         spec = plan_segment(ctx, wide_segs[0]).spec
         assert compact_mode(spec) > 0
-        # a ~2^17 key space must ride the sort-based sparse-grouping rung
-        # of the cardinality ladder, not a dense scatter
+        # a ~2^17 key space must ride the sparse-grouping rungs of the
+        # cardinality ladder (hash with sort fallback), not a dense scatter
         assert sparse_mode(spec) > 0
         dev = ShardedQueryExecutor()
         host = ServerQueryExecutor(use_device=False)
-        drt, _ = dev.execute(ctx, wide_segs)
+        drt, stats = dev.execute(ctx, wide_segs)
         hrt, _ = host.execute(ctx, wide_segs)
         assert drt.rows == hrt.rows
         assert len(drt.rows) > 100
+        assert stats.group_by_rung in ("hash", "sort")
 
     def test_sparse_doc_sharded_parity(self, wide_segs):
         """Sparse compacts carry DIFFERENT key sets per doc shard; the
@@ -263,8 +267,8 @@ class TestCompactGroupBy:
         from pinot_tpu.parallel import make_combine_mesh
 
         sql = ("SELECT a, b, year, sum(v), count(*), min(v), max(v), "
-               "avg(v) FROM wide WHERE a IN ('a001', 'a002', 'a003') "
-               "GROUP BY a, b, year ORDER BY a, b, year LIMIT 5000")
+               "avg(v) FROM wide WHERE v < 30 "
+               "GROUP BY a, b, year ORDER BY a, b, year LIMIT 15000")
         ctx = compile_query(sql)
         dev = ShardedQueryExecutor(mesh=make_combine_mesh(doc_shards=2))
         host = ServerQueryExecutor(use_device=False)

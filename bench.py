@@ -233,6 +233,21 @@ class _Worker:
     def over(self, need: float = 30.0) -> bool:
         return time.time() + need > self.deadline
 
+    # -- HBM residency accounting (engine/residency.py) ---------------------
+    def _staging_mark(self) -> dict:
+        return self.dev.residency.stats_snapshot()
+
+    def _staging_delta(self, mark: dict) -> dict:
+        """Per-suite staging counters: hit/miss/eviction/spill deltas since
+        ``mark``, plus the current/peak staged bytes."""
+        now = self.dev.residency.stats_snapshot()
+        out = {k: now[k] - mark.get(k, 0)
+               for k in ("hits", "misses", "evictions",
+                         "pinBlockedEvictions", "spills")}
+        out["stagedBytes"] = now["stagedBytes"]
+        out["peakBytes"] = now["peakBytes"]
+        return out
+
     def record(self, suite: str, rec: dict) -> None:
         rec = dict(rec, suite=suite, backend=rec.get("backend", self.backend))
         with open(self.result_file, "a") as f:
@@ -255,7 +270,10 @@ class _Worker:
                 _log(f"{suite}: budget exhausted, stopping worker")
                 break
             try:
-                self.record(suite, fn())
+                mark = self._staging_mark()
+                rec = fn()
+                rec.setdefault("staging", self._staging_delta(mark))
+                self.record(suite, rec)
             except Exception as exc:
                 traceback.print_exc(file=sys.stderr)
                 self.record(suite, {
@@ -305,6 +323,7 @@ class _Worker:
         from pinot_tpu.query import compile_query
         from pinot_tpu.tools import ssb, ssb_baseline
 
+        staging_mark = self._staging_mark()
         segs = self.segments()
         # explicit LIMIT: the engine applies the reference's default
         # group-by LIMIT 10 otherwise, and the baseline computes FULL
@@ -355,7 +374,20 @@ class _Worker:
         n = len(ctxs)
         dev50 = sum(per_q50.values()) / n
         base50 = sum(base_ms.values()) / n
+        staging = self._staging_delta(staging_mark)
+        # the SSB working set must be HBM-resident under the default
+        # budget: a spill means the headline number silently timed the
+        # HOST engine — fail loudly instead of shipping it
+        # (BENCH_ALLOW_SPILL=1 opts out for capped-budget experiments)
+        if staging["spills"] and not os.environ.get("BENCH_ALLOW_SPILL"):
+            raise AssertionError(
+                f"SSB spilled {staging['spills']} queries to the host "
+                f"engine (budget "
+                f"{self.dev.residency.budget_bytes}, peak "
+                f"{staging['peakBytes']} B staged); the device number "
+                f"would be a lie")
         return {
+            "staging": staging,
             "rows": self.rows,
             "sf": round(self.rows / ssb.ROWS_PER_SF, 3),
             "build_s": round(self.build_s, 1),

@@ -309,6 +309,7 @@ class DataTable:
             total_docs=st.get("totalDocs", 0),
             num_groups_limit_reached=st.get("numGroupsLimitReached", False),
             group_by_rung=st.get("groupByRung"),
+            staging=st.get("staging", {}),
             phase_ms=st.get("phaseTimesMs", {}),
             trace=st.get("trace", []),
         )

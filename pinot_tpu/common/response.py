@@ -45,6 +45,10 @@ class BrokerResponse:
                 **{k: round(v, 3) for k, v in self.stats.phase_ms.items()},
             },
         }
+        if self.stats.staging:
+            # HBM residency counters merged across servers (counters sum,
+            # *Bytes keys max — see QueryStats.merge)
+            d["staging"] = self.stats.staging
         if self.result_table is not None:
             d["resultTable"] = self.result_table.to_dict()
         if self.trace_info:

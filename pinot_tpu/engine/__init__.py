@@ -19,12 +19,15 @@ def ensure_x64() -> None:
 
 from pinot_tpu.engine.errors import QueryError, UnsupportedQueryError
 from pinot_tpu.engine.executor import ServerQueryExecutor
+from pinot_tpu.engine.residency import QueryLease, ResidencyManager
 from pinot_tpu.engine.results import DataSchema, QueryStats, ResultTable
 
 __all__ = [
     "QueryError",
     "UnsupportedQueryError",
     "ServerQueryExecutor",
+    "ResidencyManager",
+    "QueryLease",
     "DataSchema",
     "QueryStats",
     "ResultTable",

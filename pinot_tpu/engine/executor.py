@@ -29,7 +29,7 @@ from pinot_tpu.engine.results import (
     reduce_aggregation,
     reduce_group_by,
 )
-from pinot_tpu.engine.staging import StagingCache
+from pinot_tpu.engine.residency import ResidencyManager
 from pinot_tpu.query.context import QueryContext
 from pinot_tpu.query.expressions import Identifier
 from pinot_tpu.segment.immutable import ImmutableSegment
@@ -83,12 +83,22 @@ class ServerQueryExecutor:
 
     def __init__(self, use_device: bool = True,
                  num_groups_limit: int = CommonConstants.DEFAULT_NUM_GROUPS_LIMIT,
-                 use_pallas: Optional[bool] = None):
+                 use_pallas: Optional[bool] = None,
+                 hbm_budget_bytes=None, config=None):
         from pinot_tpu.engine import ensure_x64
         from pinot_tpu.engine.pallas_kernels import PallasKernelCache
+        from pinot_tpu.engine.residency import AUTO
 
         ensure_x64()
-        self.staging = StagingCache()
+        # HBM residency manager: budget/pins/LRU/spill admission for every
+        # device-resident array this executor stages. ``hbm_budget_bytes``:
+        # None = resolve from config key pinot.server.query.hbm.budget.bytes
+        # then backend device memory; <= 0 forces uncapped.
+        self.residency = ResidencyManager(
+            budget_bytes=AUTO if hbm_budget_bytes is None else hbm_budget_bytes,
+            config=config)
+        # legacy alias (pre-residency name); same object
+        self.staging = self.residency
         self.kernels = KernelCache()
         # (sql, segment) -> (segment identity, SegmentPlan): the per-segment
         # analogue of the sharded executor's query cache — repeat queries
@@ -149,53 +159,59 @@ class ServerQueryExecutor:
             raise QueryError(f"no segments for table {ctx.table_name!r}")
         self._validate_columns(ctx, segments[0])
         segments = self._prune(ctx, segments, stats)
+        lease = self._begin_lease(ctx, segments, stats)
+        try:
+            if ctx.distinct:
+                # HAVING is broker-side (it sees the global distinct set);
+                # ORDER BY stays server-side so each server ships its true
+                # top rows — order-by keys are always in the distinct select
+                # list, so a per-server sorted prefix of offset+limit rows
+                # is sufficient
+                if ctx.having is not None:
+                    sub = replace(ctx, order_by=[], having=None,
+                                  limit=self.num_groups_limit, offset=0)
+                else:
+                    sub = replace(ctx, having=None,
+                                  limit=ctx.offset + ctx.limit, offset=0)
+                table = host_engine.execute_distinct(sub, segments, stats)
+                if len(table.rows) >= self.num_groups_limit:
+                    stats.num_groups_limit_reached = True
+                return DataTable.for_distinct(table.schema, table.rows, stats)
 
-        if ctx.distinct:
-            # HAVING is broker-side (it sees the global distinct set); ORDER
-            # BY stays server-side so each server ships its true top rows —
-            # order-by keys are always in the distinct select list, so a
-            # per-server sorted prefix of offset+limit rows is sufficient
-            if ctx.having is not None:
-                sub = replace(ctx, order_by=[], having=None,
-                              limit=self.num_groups_limit, offset=0)
-            else:
-                sub = replace(ctx, having=None,
-                              limit=ctx.offset + ctx.limit, offset=0)
-            table = host_engine.execute_distinct(sub, segments, stats)
-            if len(table.rows) >= self.num_groups_limit:
-                stats.num_groups_limit_reached = True
-            return DataTable.for_distinct(table.schema, table.rows, stats)
+            if ctx.is_selection:
+                if not ctx.order_by:
+                    sub = replace(ctx, limit=ctx.offset + ctx.limit, offset=0)
+                    table = host_engine.execute_selection(sub, segments, stats)
+                    return DataTable.for_selection(table.schema, table.rows,
+                                                   stats)
+                # ordered: append order-by expressions as hidden trailing
+                # columns so the broker can merge-sort without re-reading
+                # segments (ref: SelectionOrderByOperator rows carry
+                # order-by columns)
+                present = {str(e) for e in ctx.select_expressions}
+                hidden = [ob.expr for ob in ctx.order_by
+                          if str(ob.expr) not in present]
+                sub = replace(
+                    ctx,
+                    select_expressions=list(ctx.select_expressions) + hidden,
+                    aliases=list(ctx.aliases) + [None] * len(hidden),
+                    limit=ctx.offset + ctx.limit, offset=0)
+                table = self._selection(sub, segments, stats)
+                return DataTable.for_selection(table.schema, table.rows,
+                                               stats, num_hidden=len(hidden))
 
-        if ctx.is_selection:
-            if not ctx.order_by:
-                sub = replace(ctx, limit=ctx.offset + ctx.limit, offset=0)
-                table = host_engine.execute_selection(sub, segments, stats)
-                return DataTable.for_selection(table.schema, table.rows, stats)
-            # ordered: append order-by expressions as hidden trailing columns
-            # so the broker can merge-sort without re-reading segments
-            # (ref: SelectionOrderByOperator rows carry order-by columns)
-            present = {str(e) for e in ctx.select_expressions}
-            hidden = [ob.expr for ob in ctx.order_by
-                      if str(ob.expr) not in present]
-            sub = replace(
-                ctx,
-                select_expressions=list(ctx.select_expressions) + hidden,
-                aliases=list(ctx.aliases) + [None] * len(hidden),
-                limit=ctx.offset + ctx.limit, offset=0)
-            table = self._selection(sub, segments, stats)
-            return DataTable.for_selection(table.schema, table.rows, stats,
-                                           num_hidden=len(hidden))
-
-        aggs = [resolve_agg(f) for f in ctx.aggregations]
-        if ctx.is_group_by:
-            merged = self._execute_group_by(ctx, aggs, segments, stats)
-            if merged.trim(self.num_groups_limit):
-                stats.num_groups_limit_reached = True
-            return DataTable.for_group_by(merged.groups,
-                                          self._schema_types(segments[0]),
-                                          stats)
-        merged_agg = self._execute_aggregation(ctx, aggs, segments, stats)
-        return DataTable.for_aggregation(merged_agg.states, stats)
+            aggs = [resolve_agg(f) for f in ctx.aggregations]
+            if ctx.is_group_by:
+                merged = self._execute_group_by(ctx, aggs, segments, stats)
+                if merged.trim(self.num_groups_limit):
+                    stats.num_groups_limit_reached = True
+                return DataTable.for_group_by(merged.groups,
+                                              self._schema_types(segments[0]),
+                                              stats)
+            merged_agg = self._execute_aggregation(ctx, aggs, segments, stats)
+            return DataTable.for_aggregation(merged_agg.states, stats)
+        finally:
+            self.residency.end_query(lease, stats)
 
     def execute(self, ctx: QueryContext,
                 segments: List[ImmutableSegment]) -> Tuple[ResultTable, QueryStats]:
@@ -204,22 +220,52 @@ class ServerQueryExecutor:
             raise QueryError(f"no segments for table {ctx.table_name!r}")
         self._validate_columns(ctx, segments[0])
         segments = self._prune(ctx, segments, stats)
+        lease = self._begin_lease(ctx, segments, stats)
+        try:
+            if ctx.distinct:
+                return (host_engine.execute_distinct(ctx, segments, stats),
+                        stats)
+            if ctx.is_selection:
+                return self._selection(ctx, segments, stats), stats
 
-        if ctx.distinct:
-            return host_engine.execute_distinct(ctx, segments, stats), stats
-        if ctx.is_selection:
-            return self._selection(ctx, segments, stats), stats
+            aggs = [resolve_agg(f) for f in ctx.aggregations]
+            if ctx.is_group_by:
+                merged = self._execute_group_by(ctx, aggs, segments, stats)
+                if merged.trim(self.num_groups_limit):
+                    stats.num_groups_limit_reached = True
+                schema_types = self._schema_types(segments[0])
+                return reduce_group_by(ctx, aggs, merged, schema_types), stats
 
-        aggs = [resolve_agg(f) for f in ctx.aggregations]
-        if ctx.is_group_by:
-            merged = self._execute_group_by(ctx, aggs, segments, stats)
-            if merged.trim(self.num_groups_limit):
-                stats.num_groups_limit_reached = True
-            schema_types = self._schema_types(segments[0])
-            return reduce_group_by(ctx, aggs, merged, schema_types), stats
+            merged_agg = self._execute_aggregation(ctx, aggs, segments, stats)
+            return reduce_aggregation(ctx, aggs, merged_agg), stats
+        finally:
+            self.residency.end_query(lease, stats)
 
-        merged_agg = self._execute_aggregation(ctx, aggs, segments, stats)
-        return reduce_aggregation(ctx, aggs, merged_agg), stats
+    def _begin_lease(self, ctx: QueryContext,
+                     segments: List[ImmutableSegment], stats: QueryStats):
+        """Open the residency lease for this query: admission decides
+        device vs host-spill, the lease pins every resident the query
+        stages until ``end_query``. Host-only executors skip the protocol
+        entirely (they stage nothing)."""
+        if not self.use_device:
+            return None
+        lease = self.residency.begin_query(segments,
+                                           ctx.referenced_columns())
+        stats._staging_lease = lease
+        return lease
+
+    @staticmethod
+    def _lease_of(stats: QueryStats):
+        return getattr(stats, "_staging_lease", None)
+
+    def _device_admitted(self, stats: QueryStats) -> bool:
+        """False when admission spilled this query to the host engine."""
+        lease = self._lease_of(stats)
+        return lease is None or lease.device_allowed
+
+    def evict_segment(self, segment_name: str) -> None:
+        """Drop a segment's device arrays (unassignment / reload hook)."""
+        self.residency.evict(segment_name)
 
     def _prune(self, ctx: QueryContext, segments: List[ImmutableSegment],
                stats: QueryStats) -> List[ImmutableSegment]:
@@ -279,6 +325,9 @@ class ServerQueryExecutor:
         from concurrent.futures import ThreadPoolExecutor
 
         locals_ = [QueryStats() for _ in segments]
+        lease = self._lease_of(stats)
+        for st in locals_:  # the pin set must ride into worker threads
+            st._staging_lease = lease
         with ThreadPoolExecutor(workers) as pool:
             parts = list(pool.map(fn, segments, locals_))
         for st in locals_:
@@ -296,7 +345,7 @@ class ServerQueryExecutor:
         st = self._try_star_tree(ctx, aggs, seg, stats)
         if st is not None:
             return done(st, "startree")
-        if self.use_device:
+        if self.use_device and self._device_admitted(stats):
             try:
                 plan = self._plan_for(ctx, seg)
                 return done(self._run_device_scalar(plan, seg, stats),
@@ -311,10 +360,10 @@ class ServerQueryExecutor:
                    stats: QueryStats) -> ResultTable:
         """Selection with the ordered top-k scan on device when eligible
         (engine/selection_device.py); host numpy path otherwise."""
-        if self.use_device and ctx.order_by:
+        if self.use_device and ctx.order_by and self._device_admitted(stats):
             from pinot_tpu.engine.selection_device import device_selection
 
-            table = device_selection(ctx, segments, self.staging,
+            table = device_selection(ctx, segments, self.residency,
                                      self._selection_kernels, stats)
             if table is not None:
                 return table
@@ -402,7 +451,7 @@ class ServerQueryExecutor:
         if st is not None:
             stats.group_by_rung = "startree"
             return done(st, "startree")
-        if self.use_device:
+        if self.use_device and self._device_admitted(stats):
             try:
                 plan = self._plan_for(ctx, seg)
                 return done(self._run_device_grouped(plan, seg, stats),
@@ -462,7 +511,7 @@ class ServerQueryExecutor:
             return None
         if plan.spec in self._pallas_blocked:
             return None
-        staged = self.staging.stage(seg)
+        staged = self.residency.stage(seg, lease=self._lease_of(stats))
         try:
             packed = pallas_kernels.run_segment(plan, staged,
                                                 self.pallas_kernels, interpret)
@@ -488,7 +537,7 @@ class ServerQueryExecutor:
                     stats: QueryStats) -> Dict[str, Any]:
         from pinot_tpu.engine.kernels import unpack_outputs
 
-        staged = self.staging.stage(seg)
+        staged = self.residency.stage(seg, lease=self._lease_of(stats))
         cols = {name: staged.column(name).tree() for name in plan.columns}
         kernel = self.kernels.get(plan.spec)
         params = tuple(plan.params)

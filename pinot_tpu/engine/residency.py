@@ -1,0 +1,548 @@
+"""HBM residency manager: budgeted, pinned, LRU-evicting segment staging.
+
+The subsystem the tiered-storage / multi-table-scale work stands on: a
+production table set cannot fit in HBM, so device staging must degrade
+gracefully instead of OOMing. This module subsumes the old unbounded
+``StagingCache`` and the sharded executor's ad-hoc device-column caches
+behind one byte-accounted, lock-correct manager:
+
+- **Accounting**: every resident (a per-segment :class:`StagedSegment` or a
+  sharded-batch device-column set) reports ``nbytes()``; the manager rolls
+  bytes up per resident and tracks the fleet total + peak.
+- **Budget**: ``pinot.server.query.hbm.budget.bytes`` (spi/config.py layered
+  keys; <= 0 means uncapped). When unset, the budget auto-derives from the
+  backend's reported device memory (``bytes_limit`` fraction) — on hosts
+  whose backend reports nothing (CPU), staging is uncapped.
+- **LRU eviction of UNPINNED residents only**: queries pin the residents
+  they touch for their duration via a :class:`QueryLease` (the same
+  acquire/release hazard discipline as ``TableDataManager.acquire_segments``
+  — ref ``BaseTableDataManager.java:71`` refcounting), so an in-flight query
+  never loses its arrays mid-kernel (the SURVEY §5 race note).
+- **Admission control**: a query whose estimated working set cannot fit even
+  after evicting everything unpinned is routed to the host engine (a
+  *spill*, counted and surfaced) instead of device-OOMing.
+- **Prefetch**: segment add/reload enqueues background staging so the first
+  query pays no H2D (ref: the FetchContext prefetch path,
+  ``InstancePlanMakerImplV2.java:155-170``).
+- **Observability**: global counters + per-query ``QueryStats.staging``
+  deltas, ``ServerMeter`` meters / gauges when bound to a registry, and a
+  bytes-accurate snapshot for ``/debug/memory``.
+"""
+
+from __future__ import annotations
+
+import logging
+import queue
+import threading
+
+from collections import OrderedDict
+from typing import Any, Dict, Iterable, List, Optional
+
+from pinot_tpu.engine.staging import StagedSegment, staged_int_dtype
+from pinot_tpu.spi.config import CommonConstants
+
+log = logging.getLogger(__name__)
+
+# budget sentinel: resolve from config, then backend device memory
+AUTO = object()
+
+_STOP = object()
+
+
+# --------------------------------------------------------------------------
+# working-set estimation (admission control)
+# --------------------------------------------------------------------------
+
+def estimate_segment_bytes(segment, columns: Iterable[str]) -> int:
+    """Metadata-only estimate of the device bytes staging ``columns`` of
+    ``segment`` costs (fwd + dict values + null bitmap; the same layout
+    contract as ``StagedSegment._stage``). Used for admission BEFORE any
+    H2D, so it must not touch column data."""
+    cap = int(getattr(segment, "padded_capacity", 0) or 0)
+    md = getattr(segment, "metadata", None)
+    cols = getattr(md, "columns", {}) if md is not None else {}
+    total = 0
+    for name in columns:
+        cm = cols.get(name) if hasattr(cols, "get") else None
+        if cm is None:
+            continue
+        if cm.single_value:
+            if cm.has_dictionary:
+                total += cap * 4  # fwd dictIds upcast to int32
+            elif cm.data_type.is_integral:
+                total += cap * staged_int_dtype(cm).itemsize
+            else:
+                total += cap * 8  # raw floats stay f64 (staging module note)
+        else:
+            total += cap * 4 * max(cm.max_num_multi_values, 1) + cap * 4
+        if cm.has_dictionary and cm.data_type.is_numeric:
+            total += cm.cardinality * (
+                staged_int_dtype(cm).itemsize if cm.data_type.is_integral
+                else 4)
+        if cm.has_nulls:
+            total += cap
+    return total
+
+
+def resolve_budget_bytes(budget_bytes: Any = AUTO,
+                         config=None) -> Optional[int]:
+    """Budget resolution: explicit arg > layered config key > backend device
+    memory. Returns None for uncapped (explicit <= 0, or nothing known)."""
+    if budget_bytes is not AUTO:
+        if budget_bytes is None:
+            return None
+        b = int(budget_bytes)
+        return b if b > 0 else None
+    from pinot_tpu.spi.config import PinotConfiguration
+
+    cfg = config if config is not None else PinotConfiguration()
+    v = cfg.get(CommonConstants.HBM_BUDGET_BYTES_KEY)
+    if v is not None:
+        b = int(v)
+        return b if b > 0 else None
+    try:
+        import jax
+
+        stats = jax.devices()[0].memory_stats()
+        limit = (stats or {}).get("bytes_limit")
+        if limit:
+            return int(limit * CommonConstants.DEFAULT_HBM_BUDGET_FRACTION)
+    except Exception:  # backend without memory stats / not initialized
+        pass
+    return None
+
+
+# --------------------------------------------------------------------------
+# leases
+# --------------------------------------------------------------------------
+
+class QueryLease:
+    """One query's pin set + staging counters. Created by ``begin_query``,
+    closed by ``end_query``; residents pinned through a lease survive
+    eviction pressure until the lease closes (acquire/release discipline)."""
+
+    __slots__ = ("device_allowed", "spilled", "hits", "misses",
+                 "evictions", "pin_blocked", "_pinned")
+
+    def __init__(self, device_allowed: bool = True):
+        self.device_allowed = device_allowed
+        self.spilled = not device_allowed
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+        self.pin_blocked = 0
+        self._pinned: set = set()
+
+    def staging_dict(self, staged_bytes: int) -> Dict[str, int]:
+        """The ``QueryStats.staging`` payload (merge: counters sum, *Bytes
+        keys max — see QueryStats.merge)."""
+        return {
+            "hits": self.hits,
+            "misses": self.misses,
+            "evictions": self.evictions,
+            "pinBlockedEvictions": self.pin_blocked,
+            "spills": 1 if self.spilled else 0,
+            "stagedBytes": int(staged_bytes),
+        }
+
+
+class _Entry:
+    __slots__ = ("resident", "pins", "nbytes")
+
+    def __init__(self, resident):
+        self.resident = resident
+        self.pins = 0
+        self.nbytes = 0
+
+
+class ResidencyManager:
+    """(name -> resident) LRU with byte budget, pins, spill admission and
+    background prefetch. A *resident* is anything with ``nbytes()`` and
+    ``release()`` — :class:`StagedSegment` for the per-segment path, the
+    sharded executor's batch wrapper for the combine path."""
+
+    def __init__(self, budget_bytes: Any = AUTO, config=None):
+        self._budget_arg = budget_bytes
+        self._config = config
+        self._budget_resolved = False
+        self._budget: Optional[int] = None
+        # RLock: evicting a batch resident re-enters through the executor's
+        # release callback (discard()), and that must not deadlock
+        self._lock = threading.RLock()
+        self._entries: "OrderedDict[str, _Entry]" = OrderedDict()
+        self._staged_bytes = 0
+        self._peak_bytes = 0
+        # global counters (process lifetime; per-query deltas ride leases)
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+        self.pin_blocked = 0
+        self.spills = 0
+        self.prefetched = 0
+        self._metrics = None
+        self._prefetch_q: Optional["queue.Queue"] = None
+        self._prefetch_thread: Optional[threading.Thread] = None
+        self._closed = False
+
+    # -- budget --------------------------------------------------------------
+    @property
+    def budget_bytes(self) -> Optional[int]:
+        """Lazy: resolving the auto default may initialize the jax backend,
+        which must not happen at executor construction."""
+        if not self._budget_resolved:
+            with self._lock:
+                if not self._budget_resolved:
+                    self._budget = resolve_budget_bytes(self._budget_arg,
+                                                        self._config)
+                    self._budget_resolved = True
+        return self._budget
+
+    def set_budget_bytes(self, budget_bytes: Optional[int]) -> None:
+        with self._lock:
+            self._budget = (int(budget_bytes)
+                            if budget_bytes and int(budget_bytes) > 0
+                            else None)
+            self._budget_resolved = True
+            self._enforce_locked()
+
+    # -- staging (the StagingCache surface, now lock-correct) ---------------
+    def stage(self, segment, lease: Optional[QueryLease] = None
+              ) -> StagedSegment:
+        """Resident StagedSegment for ``segment``, created on miss. Atomic
+        get-or-create under the manager lock: concurrent stagers of the same
+        segment share ONE StagedSegment (the old get-then-set built
+        duplicate device arrays and leaked one set until GC). A reloaded
+        segment (same name, new object) invalidates the stale resident —
+        identity check, same guard as before."""
+        name = segment.segment_name
+        with self._lock:
+            e = self._entries.get(name)
+            if e is not None and isinstance(e.resident, StagedSegment) \
+                    and e.resident.segment is segment:
+                self._entries.move_to_end(name)
+                self.hits += 1
+                if lease is not None:
+                    lease.hits += 1
+                self._mark("STAGING_HITS")
+            else:
+                if e is not None:  # identity change: drop stale arrays
+                    del self._entries[name]
+                    e.resident.release()
+                e = _Entry(StagedSegment(segment))
+                self._entries[name] = e
+                self.misses += 1
+                if lease is not None:
+                    lease.misses += 1
+                self._mark("STAGING_MISSES")
+            self._pin_locked(name, e, lease)
+            self._enforce_locked(lease)
+            return e.resident
+
+    def register(self, name: str, make_resident, same=None,
+                 lease: Optional[QueryLease] = None):
+        """Generic get-or-create for non-segment residents (sharded batch
+        device-column sets). ``make_resident()`` builds on miss; ``same(r)``
+        says whether the cached resident is still current."""
+        with self._lock:
+            e = self._entries.get(name)
+            if e is not None and (same is None or same(e.resident)):
+                self._entries.move_to_end(name)
+                self.hits += 1
+                if lease is not None:
+                    lease.hits += 1
+                self._mark("STAGING_HITS")
+            else:
+                if e is not None:
+                    del self._entries[name]
+                    e.resident.release()
+                e = _Entry(make_resident())
+                self._entries[name] = e
+                self.misses += 1
+                if lease is not None:
+                    lease.misses += 1
+                self._mark("STAGING_MISSES")
+            self._pin_locked(name, e, lease)
+            return e.resident
+
+    def _pin_locked(self, name: str, e: _Entry,
+                    lease: Optional[QueryLease]) -> None:
+        if lease is not None and name not in lease._pinned:
+            e.pins += 1
+            lease._pinned.add(name)
+
+    def account(self, name: str,
+                lease: Optional[QueryLease] = None) -> None:
+        """Re-measure one resident (its arrays were staged after admission)
+        and enforce the budget."""
+        with self._lock:
+            self._enforce_locked(lease)
+
+    def evict(self, name: str) -> None:
+        """Explicit eviction (segment unassigned / reloaded). In-flight
+        queries keep their arrays alive through python refs; XLA frees the
+        HBM when the last ref drops."""
+        with self._lock:
+            e = self._entries.pop(name, None)
+            if e is not None:
+                e.resident.release()
+                self.evictions += 1
+                self._mark("STAGING_EVICTIONS")
+                self._refresh_locked()
+
+    def discard(self, name: str) -> None:
+        """Drop an entry WITHOUT calling release (the owner already freed
+        the arrays). Idempotent — also the re-entry point for batch
+        residents whose release callback clears executor caches."""
+        with self._lock:
+            self._entries.pop(name, None)
+
+    def clear(self) -> None:
+        with self._lock:
+            for e in self._entries.values():
+                e.resident.release()
+            self._entries.clear()
+            self._staged_bytes = 0
+
+    # -- query protocol ------------------------------------------------------
+    def begin_query(self, segments: List[Any],
+                    columns: Iterable[str]) -> QueryLease:
+        """Admission: fit the query's estimated working set against what
+        COULD be freed (budget minus other queries' pinned bytes). A query
+        that cannot fit is spilled to the host engine — graceful
+        degradation, never a device OOM."""
+        budget = self.budget_bytes
+        if budget is None:
+            return QueryLease(device_allowed=True)
+        cols = list(columns)
+        with self._lock:
+            self._refresh_locked()
+            names = {getattr(s, "segment_name", None) for s in segments}
+            reusable = 0
+            missing_est = 0
+            for s in segments:
+                e = self._entries.get(s.segment_name)
+                if e is not None and isinstance(e.resident, StagedSegment) \
+                        and e.resident.segment is s:
+                    reusable += e.nbytes
+                else:
+                    missing_est += estimate_segment_bytes(s, cols)
+            other_pinned = sum(e.nbytes for n, e in self._entries.items()
+                               if e.pins > 0 and n not in names)
+            if missing_est + reusable + other_pinned > budget:
+                self.spills += 1
+                self._mark("STAGING_SPILLS")
+                log.info(
+                    "HBM admission: working set ~%d B (+%d B reusable) over "
+                    "budget %d B (%d B pinned elsewhere); spilling query to "
+                    "host engine", missing_est, reusable, budget,
+                    other_pinned)
+                return QueryLease(device_allowed=False)
+        return QueryLease(device_allowed=True)
+
+    def end_query(self, lease: Optional[QueryLease], stats=None) -> None:
+        """Unpin everything the lease held, re-enforce the budget, and
+        surface the per-query staging counters on ``stats.staging``."""
+        if lease is None:
+            return
+        with self._lock:
+            for name in lease._pinned:
+                e = self._entries.get(name)
+                if e is not None and e.pins > 0:
+                    e.pins -= 1
+            lease._pinned.clear()
+            self._enforce_locked(lease)
+            staged = self._staged_bytes
+        if stats is not None:
+            stats.staging = lease.staging_dict(staged)
+
+    # -- eviction engine -----------------------------------------------------
+    def _refresh_locked(self) -> None:
+        total = 0
+        for e in self._entries.values():
+            try:
+                e.nbytes = int(e.resident.nbytes())
+            except Exception:
+                e.nbytes = 0
+            total += e.nbytes
+        self._staged_bytes = total
+        if total > self._peak_bytes:
+            self._peak_bytes = total
+
+    def _enforce_locked(self, lease: Optional[QueryLease] = None) -> None:
+        self._refresh_locked()
+        budget = self.budget_bytes
+        if budget is None:
+            return
+        total = self._staged_bytes
+        for name in list(self._entries):
+            if total <= budget:
+                break
+            e = self._entries[name]
+            if e.pins > 0:
+                # an in-flight query owns these arrays: eviction is blocked
+                # (counted — a high rate means the budget is too small for
+                # the concurrent working set)
+                self.pin_blocked += 1
+                if lease is not None:
+                    lease.pin_blocked += 1
+                self._mark("STAGING_PIN_BLOCKED")
+                continue
+            del self._entries[name]
+            total -= e.nbytes
+            e.resident.release()
+            self.evictions += 1
+            if lease is not None:
+                lease.evictions += 1
+            self._mark("STAGING_EVICTIONS")
+        self._staged_bytes = total
+
+    def enforce(self) -> None:
+        with self._lock:
+            self._enforce_locked()
+
+    # -- prefetch ------------------------------------------------------------
+    def prefetch(self, segment, columns: Optional[List[str]] = None) -> None:
+        """Enqueue background staging (segment add/reload hot path). Mutable
+        (consuming) segments never stage — their arrays grow under the
+        cache's feet. Best-effort: a full budget stops the prefetch instead
+        of evicting serving residents."""
+        if self._closed or getattr(segment, "is_mutable", False):
+            return
+        with self._lock:
+            if self._prefetch_thread is None:
+                self._prefetch_q = queue.Queue()
+                self._prefetch_thread = threading.Thread(
+                    target=self._prefetch_loop, daemon=True,
+                    name="hbm-prefetch")
+                self._prefetch_thread.start()
+        self._prefetch_q.put((segment, columns))
+
+    def _prefetch_loop(self) -> None:
+        while True:
+            item = self._prefetch_q.get()
+            try:
+                if item is _STOP:
+                    return
+                segment, columns = item
+                self._prefetch_one(segment, columns)
+            except Exception:
+                log.exception("prefetch failed")
+            finally:
+                self._prefetch_q.task_done()
+
+    def _prefetch_one(self, segment, columns: Optional[List[str]]) -> None:
+        budget = self.budget_bytes
+        if columns is None:
+            columns = list(segment.metadata.columns.keys())
+        staged = self.stage(segment)
+        for name in columns:
+            if budget is not None:
+                with self._lock:
+                    self._refresh_locked()
+                    if self._staged_bytes >= budget:
+                        return  # best-effort: never evict for a prefetch
+            try:
+                staged.column(name)
+            except Exception:
+                log.debug("prefetch of column %r skipped", name,
+                          exc_info=True)
+        self.prefetched += 1
+        with self._lock:
+            self._refresh_locked()
+
+    def drain_prefetch(self) -> None:
+        """Block until queued prefetches finish (tests / warm-up hooks)."""
+        q = self._prefetch_q
+        if q is not None:
+            q.join()
+
+    def close(self) -> None:
+        self._closed = True
+        if self._prefetch_q is not None:
+            self._prefetch_q.put(_STOP)
+
+    # -- observability -------------------------------------------------------
+    def bind_metrics(self, registry) -> None:
+        """Attach a MetricsRegistry: staged/budget byte gauges + event
+        meters (spi/metrics.py ServerMeter.STAGING_*)."""
+        self._metrics = registry
+        registry.gauge("staging_staged_bytes",
+                       lambda: float(self.staged_bytes()))
+        registry.gauge("staging_peak_bytes",
+                       lambda: float(self._peak_bytes))
+        registry.gauge("staging_budget_bytes",
+                       lambda: float(self.budget_bytes or 0))
+        registry.gauge("staging_resident_segments",
+                       lambda: float(len(self._entries)))
+
+    def _mark(self, name: Optional[str]) -> None:
+        self._mark_n(name, 1)
+
+    def _mark_n(self, name: Optional[str], n: int) -> None:
+        if self._metrics is None or name is None or n <= 0:
+            return
+        from pinot_tpu.spi.metrics import ServerMeter
+
+        metric = getattr(ServerMeter, name, None)
+        if metric is not None:
+            self._metrics.meter(metric).mark(n)
+
+    def staged_bytes(self) -> int:
+        with self._lock:
+            self._refresh_locked()
+            return self._staged_bytes
+
+    @property
+    def peak_bytes(self) -> int:
+        return self._peak_bytes
+
+    def resident_names(self) -> List[str]:
+        with self._lock:
+            return list(self._entries)
+
+    def stats_snapshot(self) -> Dict[str, int]:
+        """Cumulative counters (bench per-suite deltas diff two of these)."""
+        with self._lock:
+            self._refresh_locked()
+            return {
+                "hits": self.hits,
+                "misses": self.misses,
+                "evictions": self.evictions,
+                "pinBlockedEvictions": self.pin_blocked,
+                "spills": self.spills,
+                "prefetched": self.prefetched,
+                "stagedBytes": self._staged_bytes,
+                "peakBytes": self._peak_bytes,
+            }
+
+    def snapshot(self) -> Dict[str, Any]:
+        """Bytes-accurate residency state for ``/debug/memory``."""
+        with self._lock:
+            self._refresh_locked()
+            residents = {}
+            for name, e in self._entries.items():
+                d: Dict[str, Any] = {"bytes": e.nbytes, "pins": e.pins}
+                r = e.resident
+                if isinstance(r, StagedSegment):
+                    d.update(columns=len(r._columns), packed=len(r._packed),
+                             values=len(r._values))
+                else:
+                    d["kind"] = type(r).__name__
+                residents[name] = d
+            return {
+                "budgetBytes": self.budget_bytes,
+                "stagedBytes": self._staged_bytes,
+                "peakBytes": self._peak_bytes,
+                "counters": {
+                    "hits": self.hits, "misses": self.misses,
+                    "evictions": self.evictions,
+                    "pinBlockedEvictions": self.pin_blocked,
+                    "spills": self.spills, "prefetched": self.prefetched,
+                },
+                "stagedSegments": residents,
+            }
+
+
+class StagingCache(ResidencyManager):
+    """Deprecated alias: the pre-residency name, kept for callers that
+    constructed the cache directly (uncapped unless configured)."""

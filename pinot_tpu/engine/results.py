@@ -73,6 +73,11 @@ class QueryStats:
     # 'startree'|'host'; 'mixed' when segments split across rungs) — the
     # bench gates SSB Q3.x on this
     group_by_rung: Optional[str] = None
+    # HBM residency counters for this query (engine/residency.py):
+    # hits/misses/evictions/pinBlockedEvictions/spills sum across
+    # segments/shards/servers at merge; *Bytes keys take the max (each
+    # server reports its own staged total — summing would double-count)
+    staging: Dict[str, int] = field(default_factory=dict)
     # phase -> ms (ref: TimerContext/ServerQueryPhase —
     # ServerQueryExecutorV1Impl.java:122,276,297,303); summed across
     # servers at reduce
@@ -102,6 +107,11 @@ class QueryStats:
                 other.group_by_rung
                 if self.group_by_rung in (None, other.group_by_rung)
                 else "mixed")
+        for k, v in other.staging.items():
+            if k.endswith("Bytes"):
+                self.staging[k] = max(self.staging.get(k, 0), v)
+            else:
+                self.staging[k] = self.staging.get(k, 0) + v
         for phase, ms in other.phase_ms.items():
             self.add_phase_ms(phase, ms)
         self.trace.extend(other.trace)
@@ -119,6 +129,7 @@ class QueryStats:
                              for k, v in self.phase_ms.items()},
             **({"groupByRung": self.group_by_rung}
                if self.group_by_rung else {}),
+            **({"staging": self.staging} if self.staging else {}),
             **({"trace": self.trace} if self.trace else {}),
         }
 

@@ -134,8 +134,9 @@ def device_selection(ctx: QueryContext, segments: List[ImmutableSegment],
         plans.append((seg, order_cols, filter_spec, params, columns))
 
     picked: List[Tuple[ImmutableSegment, np.ndarray]] = []
+    lease = getattr(stats, "_staging_lease", None)
     for seg, order_cols, filter_spec, params, columns in plans:
-        staged = staging.stage(seg)
+        staged = staging.stage(seg, lease=lease)
         cols = {name: staged.column(name).tree() for name in columns}
         keys = [staged.column(c).tree()["fwd"] for c in order_cols]
         k = min(need, seg.padded_capacity)

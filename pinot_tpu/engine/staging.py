@@ -16,6 +16,8 @@ Staged layout per column:
 
 from __future__ import annotations
 
+import threading
+
 from typing import Dict, Optional
 
 import jax.numpy as jnp
@@ -94,7 +96,12 @@ class PackedColumn:
 
 
 class StagedSegment:
-    """Device image of one segment (subset of columns, staged on demand)."""
+    """Device image of one segment (subset of columns, staged on demand).
+
+    Column builds serialize on a per-segment lock: two query threads
+    staging the same column must share ONE set of device arrays — a
+    duplicate build leaks its losing copy until GC (the round-2 residency
+    hazard). Reads stay lock-free (dict get is atomic under the GIL)."""
 
     def __init__(self, segment: ImmutableSegment):
         self.segment = segment
@@ -103,12 +110,17 @@ class StagedSegment:
         self._columns: Dict[str, StagedColumn] = {}
         self._packed: Dict[str, PackedColumn] = {}
         self._values: Dict[str, jnp.ndarray] = {}
+        self._valid_cache = None
+        self._lock = threading.Lock()
 
     def column(self, name: str) -> StagedColumn:
         col = self._columns.get(name)
         if col is None:
-            col = self._stage(name)
-            self._columns[name] = col
+            with self._lock:
+                col = self._columns.get(name)
+                if col is None:
+                    col = self._stage(name)
+                    self._columns[name] = col
         return col
 
     def _stage(self, name: str) -> StagedColumn:
@@ -148,10 +160,13 @@ class StagedSegment:
         the column/segment shape doesn't fit the packed layout."""
         pc = self._packed.get(name)
         if pc is None:
-            pc = self._pack(name)
-            if pc is None:
-                return None
-            self._packed[name] = pc
+            with self._lock:
+                pc = self._packed.get(name)
+                if pc is None:
+                    pc = self._pack(name)
+                    if pc is None:
+                        return None
+                    self._packed[name] = pc
         return pc
 
     def pallas_capacity(self) -> int:
@@ -189,18 +204,21 @@ class StagedSegment:
             if not (cm.single_value and cm.data_type.is_numeric):
                 return None
             col = self.column(name)
-            if cm.has_dictionary:
-                v = col.dictvals[col.fwd]
-            else:
-                v = col.fwd
-            if cm.data_type.is_integral:
-                v = v.astype(staged_int_dtype(cm))
-            else:
-                v = v.astype(jnp.float32)
-            pad = self.pallas_capacity() - v.shape[0]
-            if pad:
-                v = jnp.pad(v, (0, pad))
-            self._values[name] = v
+            with self._lock:
+                v = self._values.get(name)
+                if v is None:
+                    if cm.has_dictionary:
+                        v = col.dictvals[col.fwd]
+                    else:
+                        v = col.fwd
+                    if cm.data_type.is_integral:
+                        v = v.astype(staged_int_dtype(cm))
+                    else:
+                        v = v.astype(jnp.float32)
+                    pad = self.pallas_capacity() - v.shape[0]
+                    if pad:
+                        v = jnp.pad(v, (0, pad))
+                    self._values[name] = v
         return v
 
     def valid_mask(self):
@@ -228,6 +246,23 @@ class StagedSegment:
         self._valid_cache = (ver, arr)
         return arr
 
+    def nbytes(self) -> int:
+        """Device bytes this segment holds resident (HBM accounting for the
+        residency manager). Walks the staged arrays — list() snapshots the
+        dicts against concurrent stagers."""
+        total = 0
+        for col in list(self._columns.values()):
+            for arr in col.tree().values():
+                total += int(getattr(arr, "nbytes", 0))
+        for pc in list(self._packed.values()):
+            total += int(pc.words.nbytes)
+        for v in list(self._values.values()):
+            total += int(v.nbytes)
+        vc = self._valid_cache
+        if vc is not None:
+            total += int(getattr(vc[1], "nbytes", 0))
+        return total
+
     def release(self) -> None:
         """Drop device references (HBM freed when XLA GCs the buffers)."""
         self._columns.clear()
@@ -236,25 +271,13 @@ class StagedSegment:
         self._valid_cache = None
 
 
-class StagingCache:
-    """(segment_name -> StagedSegment) cache; the HBM residency manager
-    (ref: the acquire/release protocol of BaseTableDataManager and the
-    FetchContext prefetch path, InstancePlanMakerImplV2.java:155-170)."""
+# The HBM residency manager subsumed the old unbounded StagingCache
+# (budget + pins + LRU + spill admission live in engine/residency.py);
+# the name stays importable from here for existing callers. Lazy (PEP 562)
+# because residency imports this module for StagedSegment.
+def __getattr__(name: str):
+    if name in ("StagingCache", "ResidencyManager"):
+        from pinot_tpu.engine import residency
 
-    def __init__(self):
-        self._staged: Dict[str, StagedSegment] = {}
-
-    def stage(self, segment: ImmutableSegment) -> StagedSegment:
-        st = self._staged.get(segment.segment_name)
-        if st is None or st.segment is not segment:
-            st = StagedSegment(segment)
-            self._staged[segment.segment_name] = st
-        return st
-
-    def evict(self, segment_name: str) -> None:
-        st = self._staged.pop(segment_name, None)
-        if st is not None:
-            st.release()
-
-    def clear(self) -> None:
-        self._staged.clear()
+        return getattr(residency, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -52,7 +52,9 @@ class ShardedQueryExecutor(ServerQueryExecutor):
         self.sharded_kernels = ShardedKernelCache(self.mesh)
         self._batches: Dict[Tuple[str, ...], SegmentBatch] = {}
         # (batch, column, S) -> device-committed sharded arrays: the batch
-        # analogue of StagingCache (H2D paid once, reused across queries)
+        # analogue of the per-segment staging (H2D paid once, reused across
+        # queries). Byte-accounted + evictable through self.residency as
+        # one _BatchResident per batch.
         self._device_cols: Dict[Tuple[str, str, int], Dict] = {}
         # (sql, batch, S) -> (plan, device params, kernel, cols): repeated
         # queries skip planning AND the per-call H2D parameter uploads (each
@@ -65,6 +67,7 @@ class ShardedQueryExecutor(ServerQueryExecutor):
         self._query_cache_cap = 256
         self._query_cache_lock = threading.Lock()
         self._device_cols_lock = threading.Lock()
+        self._batches_lock = threading.Lock()
         # multi-device combine programs carry collectives (psum/all_gather):
         # two threads interleaving their launches across the same devices
         # deadlock inside the runtime, so launches serialize through this
@@ -91,7 +94,8 @@ class ShardedQueryExecutor(ServerQueryExecutor):
         if self._any_star_tree_fit(ctx, aggs, segments):
             return ServerQueryExecutor._execute_aggregation(
                 self, ctx, aggs, segments, stats)
-        if self.use_device and len(segments) > 1:
+        if self.use_device and len(segments) > 1 \
+                and self._device_admitted(stats):
             try:
                 batch, out, plan = self._run_sharded(ctx, segments, stats)
                 return decode_scalar_result(plan, batch, out)
@@ -105,7 +109,8 @@ class ShardedQueryExecutor(ServerQueryExecutor):
         if self._any_star_tree_fit(ctx, aggs, segments):
             return ServerQueryExecutor._execute_group_by(
                 self, ctx, aggs, segments, stats)
-        if self.use_device and len(segments) > 1:
+        if self.use_device and len(segments) > 1 \
+                and self._device_admitted(stats):
             try:
                 batch, out, plan = self._run_sharded(ctx, segments, stats)
                 return decode_grouped_result(plan, batch, out)
@@ -121,29 +126,62 @@ class ShardedQueryExecutor(ServerQueryExecutor):
             # a bitmap attached AFTER a batch was built must not serve the
             # stale arrays; drop any cached batch ONCE and reject so the
             # per-segment path — which consults the bitmap — serves
-            b = self._batches.pop(key, None)
+            with self._batches_lock:
+                b = self._batches.get(key)
             if b is not None:
                 self._evict_batch(b)
             raise ValueError("upsert-managed segments are not batchable")
-        b = self._batches.get(key)
+        with self._batches_lock:
+            b = self._batches.get(key)
         if b is None or any(cached is not seg for cached, seg
                             in zip(b.segments, segments)):
             # identity check: a reloaded segment keeps its name but must not
-            # serve stale device arrays (same guard as StagingCache)
+            # serve stale device arrays (same guard as the staging path)
             if b is not None:
                 self._evict_batch(b)
             b = SegmentBatch(segments)
-            self._batches[key] = b
+            with self._batches_lock:
+                # a concurrent builder may have won the insert; serve its
+                # batch so both threads share one set of device arrays
+                cur = self._batches.get(key)
+                if cur is not None and all(c is s for c, s in
+                                           zip(cur.segments, segments)):
+                    return cur
+                self._batches[key] = b
         return b
 
     def _evict_batch(self, batch: SegmentBatch) -> None:
+        """Drop EVERYTHING derived from a batch: the batch registration,
+        its sharded device columns, its compiled query-cache entries
+        (their call_fns close over the device arrays — a stale entry would
+        keep serving a reloaded segment's OLD data), and its residency
+        accounting. The old code matched query-cache keys on k[1] — the
+        filter fingerprint slot, never the batch name — so compiled plans
+        (and the arrays their closures pinned) survived eviction."""
         name = batch.metadata.segment_name
+        with self._batches_lock:
+            for k, b in list(self._batches.items()):
+                if b is batch:
+                    del self._batches[k]
         with self._device_cols_lock:
             for k in [k for k in self._device_cols if k[0] == name]:
                 del self._device_cols[k]
         with self._query_cache_lock:
-            for k in [k for k in self._query_cache if k[1] == name]:
+            for k in [k for k in self._query_cache if k[2] == name]:
                 del self._query_cache[k]
+        self.residency.discard(name)
+
+    def evict_segment(self, segment_name: str) -> None:
+        """A segment holds device bytes through BOTH the per-segment staged
+        entry and every cached batch that includes it (batches are keyed by
+        segment-name tuples, so one segment can ride in many). Eviction
+        must clear them all or reload/unassignment leaks stale arrays."""
+        with self._batches_lock:
+            stale = [b for k, b in self._batches.items()
+                     if segment_name in k]
+        for b in stale:
+            self._evict_batch(b)
+        super().evict_segment(segment_name)
 
     def _run_sharded(self, ctx: QueryContext,
                      segments: List[ImmutableSegment],
@@ -151,6 +189,14 @@ class ShardedQueryExecutor(ServerQueryExecutor):
         from pinot_tpu.engine.kernels import unpack_outputs
 
         batch = self.batch_for(segments)
+        # the batch's device arrays are a resident like any staged segment:
+        # byte-accounted, LRU-ordered, and PINNED through this query's lease
+        # so another thread's budget enforcement cannot free arrays a
+        # launched combine program is reading
+        lease = self._lease_of(stats)
+        bkey = batch.metadata.segment_name
+        self.residency.register(bkey, lambda: _BatchResident(self, batch),
+                                same=lambda r: r.batch is batch, lease=lease)
         S = pad_segments(batch.num_segments, self.mesh.shape[SEG_AXIS])
 
         # the filter fingerprint distinguishes same-SQL contexts whose
@@ -222,6 +268,10 @@ class ShardedQueryExecutor(ServerQueryExecutor):
                 segments=batch.num_segments,
                 mesh=f"{self.mesh.shape[SEG_AXIS]}x"
                      f"{self.mesh.shape[DOC_AXIS]}")
+
+        # arrays were staged above: re-measure the resident and enforce the
+        # budget now rather than waiting for end_query
+        self.residency.account(bkey, lease)
 
         stats.num_segments_processed += batch.num_segments
         stats.total_docs += batch.num_docs
@@ -395,8 +445,47 @@ class ShardedQueryExecutor(ServerQueryExecutor):
         return tree
 
     def evict_batches(self) -> None:
-        self._batches.clear()
+        with self._batches_lock:
+            batches = list(self._batches.values())
+            self._batches.clear()
         with self._device_cols_lock:
             self._device_cols.clear()
         with self._query_cache_lock:
             self._query_cache.clear()
+        for b in batches:
+            self.residency.discard(b.metadata.segment_name)
+
+
+class _BatchResident:
+    """Residency adapter for one SegmentBatch's device-column set: nbytes
+    walks the executor's ``_device_cols`` entries for the batch, release
+    drops the batch wholesale (arrays + compiled closures). Lock order is
+    residency lock -> executor cache locks, never the reverse."""
+
+    __slots__ = ("executor", "batch")
+
+    def __init__(self, executor: ShardedQueryExecutor, batch: SegmentBatch):
+        self.executor = executor
+        self.batch = batch
+
+    def nbytes(self) -> int:
+        name = self.batch.metadata.segment_name
+        with self.executor._device_cols_lock:
+            staged = [v for k, v in self.executor._device_cols.items()
+                      if k[0] == name]
+        return sum(_tree_nbytes(v) for v in staged)
+
+    def release(self) -> None:
+        self.executor._evict_batch(self.batch)
+
+
+def _tree_nbytes(obj) -> int:
+    """Device bytes of a staged-column value: dict trees of arrays, the
+    (words, bits) packed tuples, or bare arrays."""
+    if obj is None:
+        return 0
+    if isinstance(obj, dict):
+        return sum(_tree_nbytes(v) for v in obj.values())
+    if isinstance(obj, (tuple, list)):
+        return sum(_tree_nbytes(v) for v in obj)
+    return int(getattr(obj, "nbytes", 0) or 0)

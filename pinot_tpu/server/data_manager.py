@@ -107,12 +107,27 @@ class SegmentDataManager:
 
 
 class TableDataManager:
-    """Ref: BaseTableDataManager.java:71 (offline tables)."""
+    """Ref: BaseTableDataManager.java:71 (offline tables).
 
-    def __init__(self, table_name_with_type: str):
+    ``listener`` (optional) observes the segment lifecycle:
+    ``segment_added(table, segment)`` after registration (the HBM prefetch
+    hook) and ``segment_removed(table, segment_name)`` after unregistration
+    (the HBM eviction hook). Listener failures never break lifecycle."""
+
+    def __init__(self, table_name_with_type: str, listener: Any = None):
         self.table_name = table_name_with_type
+        self.listener = listener
         self._segments: Dict[str, SegmentDataManager] = {}
         self._lock = threading.Lock()
+
+    def _notify(self, method: str, *args) -> None:
+        fn = getattr(self.listener, method, None)
+        if fn is None:
+            return
+        try:
+            fn(self.table_name, *args)
+        except Exception:
+            log.exception("segment lifecycle listener %s failed", method)
 
     # -- lifecycle -----------------------------------------------------------
     def add_segment(self, segment: Any) -> None:
@@ -125,6 +140,7 @@ class TableDataManager:
             self._segments[segment.segment_name] = sdm
         if old is not None:
             old.release()
+        self._notify("segment_added", segment)
 
     def add_segment_from_dir(self, segment_dir: str) -> ImmutableSegment:
         seg = load_segment(segment_dir)
@@ -136,6 +152,7 @@ class TableDataManager:
             sdm = self._segments.pop(segment_name, None)
         if sdm is not None:
             sdm.release()
+            self._notify("segment_removed", segment_name)
 
     def segment_names(self) -> List[str]:
         with self._lock:
@@ -181,8 +198,9 @@ class RealtimeTableDataManager(TableDataManager):
     and carries a valid-doc bitmap (ref: upsert wiring in
     RealtimeTableDataManager)."""
 
-    def __init__(self, table_name_with_type: str, upsert_manager=None):
-        super().__init__(table_name_with_type)
+    def __init__(self, table_name_with_type: str, upsert_manager=None,
+                 listener: Any = None):
+        super().__init__(table_name_with_type, listener=listener)
         self._consumers: Dict[str, RealtimeSegmentDataManager] = {}
         self.upsert_manager = upsert_manager  # TableUpsertMetadataManager
 
@@ -266,17 +284,20 @@ class InstanceDataManager:
     """table -> TableDataManager registry
     (ref: HelixInstanceDataManager.java:74)."""
 
-    def __init__(self):
+    def __init__(self, listener: Any = None):
         self._tables: Dict[str, TableDataManager] = {}
         self._lock = threading.Lock()
+        self.listener = listener  # forwarded to created TableDataManagers
 
     def get_or_create(self, table: str, realtime: bool = False,
                       upsert_manager=None) -> TableDataManager:
         with self._lock:
             tdm = self._tables.get(table)
             if tdm is None:
-                tdm = (RealtimeTableDataManager(table, upsert_manager)
-                       if realtime else TableDataManager(table))
+                tdm = (RealtimeTableDataManager(table, upsert_manager,
+                                                listener=self.listener)
+                       if realtime
+                       else TableDataManager(table, listener=self.listener))
                 self._tables[table] = tdm
             return tdm
 

@@ -66,7 +66,11 @@ class ServerInstance:
         self.executor = executor or ServerQueryExecutor()
         self.scheduler = scheduler or make_scheduler("fcfs")
         self.metrics = MetricsRegistry(role="server")
-        self.data_manager = InstanceDataManager()
+        # segment lifecycle -> HBM residency: adds prefetch, removals evict
+        self.data_manager = InstanceDataManager(listener=self)
+        residency = getattr(self.executor, "residency", None)
+        if residency is not None:
+            residency.bind_metrics(self.metrics)
         self.segment_dir = segment_dir
         self.consumer_tick_s = consumer_tick_s
         self._started = False
@@ -126,7 +130,26 @@ class ServerInstance:
             self._hb_thread.join(timeout=5)
         self.scheduler.shutdown()
         self.data_manager.shutdown()
+        residency = getattr(self.executor, "residency", None)
+        if residency is not None:
+            residency.close()
         self.store.set_instance_alive(self.instance_id, False)
+
+    # -- segment lifecycle -> HBM residency (data-manager listener) ----------
+    def segment_added(self, table: str, segment) -> None:
+        """Prefetch hook: stage new/reloaded immutable segments in the
+        background so the table's first query pays no H2D (residency skips
+        mutable segments and stops at the budget instead of evicting)."""
+        residency = getattr(self.executor, "residency", None)
+        if residency is not None:
+            residency.prefetch(segment)
+
+    def segment_removed(self, table: str, segment_name: str) -> None:
+        """Eviction hook: an unassigned segment's HBM must be reclaimed —
+        refcounts protect in-flight readers, the residency entry must go."""
+        evict = getattr(self.executor, "evict_segment", None)
+        if evict is not None:
+            evict(segment_name)
 
     def _upsert_manager_for(self, table: str):
         """TableUpsertMetadataManager for upsert-enabled realtime tables
@@ -457,24 +480,27 @@ class ServerInstance:
         return {"tableName": table, "segments": sizes,
                 "totalBytes": sum(sizes.values())}
 
+    def evict_staged(self, segment_name: str) -> Dict[str, Any]:
+        """Admin force-eviction of one staged resident (REST
+        ``POST /debug/memory/evict/<name>``); reports what remains."""
+        evict = getattr(self.executor, "evict_segment", None)
+        if evict is not None:
+            evict(segment_name)
+        residency = getattr(self.executor, "residency", None)
+        return {"evicted": segment_name,
+                "stagedBytes": (residency.staged_bytes()
+                                if residency is not None else 0)}
+
     def memory_debug(self) -> Dict[str, Any]:
-        """Staged-device + native mmap accounting
-        (ref: MmapDebugResource)."""
+        """Bytes-accurate HBM residency + native mmap accounting
+        (ref: MmapDebugResource). Per resident: device bytes, pin count,
+        staged column/packed/value array counts; plus the budget, fleet
+        total/peak, and the hit/miss/eviction/spill counters."""
         from pinot_tpu import native
 
-        staged = {}
-        ex = getattr(self, "executor", None)
-        staging = getattr(ex, "staging", None)
-        if staging is not None:
-            # .copy() is one atomic C call under the GIL: safe against
-            # queries staging/evicting concurrently on other threads
-            for name, st in staging._staged.copy().items():
-                staged[name] = {
-                    "columns": len(st._columns),
-                    "packed": len(st._packed),
-                    "values": len(st._values),
-                }
-        return {
-            "stagedSegments": staged,
-            "nativeMmapBuffers": native.mmap_buffer_count(),
-        }
+        out: Dict[str, Any] = {"stagedSegments": {}}
+        residency = getattr(self.executor, "residency", None)
+        if residency is not None:
+            out.update(residency.snapshot())
+        out["nativeMmapBuffers"] = native.mmap_buffer_count()
+        return out

@@ -138,3 +138,9 @@ class CommonConstants:
     # (DocIdSetPlanNode.java:29). On TPU we tile the doc dimension instead;
     # this is the host-side fallback block size.
     MAX_DOC_PER_CALL = 10_000
+    # HBM residency (engine/residency.py): device-staging byte budget.
+    # Unset -> auto from the backend's reported device memory times the
+    # fraction below (uncapped on backends that report nothing, e.g. CPU);
+    # <= 0 -> explicitly uncapped.
+    HBM_BUDGET_BYTES_KEY = "pinot.server.query.hbm.budget.bytes"
+    DEFAULT_HBM_BUDGET_FRACTION = 0.75

@@ -158,6 +158,13 @@ class ServerMeter:
     DOCS_SCANNED = "docs_scanned_total"
     SEGMENTS_PRUNED = "segments_pruned_total"
     QUERY_EXCEPTIONS = "query_exceptions_total"
+    # HBM residency (engine/residency.py; gauges staging_staged_bytes /
+    # staging_peak_bytes / staging_budget_bytes ride the same registry)
+    STAGING_HITS = "staging_hits_total"
+    STAGING_MISSES = "staging_misses_total"
+    STAGING_EVICTIONS = "staging_evictions_total"
+    STAGING_PIN_BLOCKED = "staging_pin_blocked_evictions_total"
+    STAGING_SPILLS = "staging_spills_total"
 
 
 class ServerQueryPhase:

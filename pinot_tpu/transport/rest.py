@@ -516,3 +516,8 @@ class ServerAdminApi(_Api):
                    lambda m, b: (200, s.table_size(m.group(1))))
         self.route("GET", r"/debug/memory",
                    lambda m, b: (200, s.memory_debug()))
+        # ops hook for the HBM budget knob: force-drop one resident's
+        # device arrays (in-flight queries keep theirs via python refs;
+        # the next query re-stages)
+        self.route("POST", r"/debug/memory/evict/([^/]+)",
+                   lambda m, b: (200, s.evict_staged(m.group(1))))

@@ -43,12 +43,7 @@ def test_q1_vs_pandas_oracle(setup, dev_exec, qid):
     assert rt.rows[0][0] == pytest.approx(exp, rel=1e-4)
 
 
-@pytest.mark.parametrize("qid", sorted(ssb.QUERIES))
-def test_device_matches_host(setup, dev_exec, host_exec, qid):
-    cols, segs = setup
-    ctx = compile_query(ssb.QUERIES[qid])
-    got, _ = dev_exec.execute(ctx, segs)
-    want, _ = host_exec.execute(ctx, segs)
+def _assert_rows_match(qid, got, want):
     assert len(got.rows) == len(want.rows), qid
     for gr, wr in zip(got.rows, want.rows):
         for g, w in zip(gr, wr):
@@ -56,6 +51,52 @@ def test_device_matches_host(setup, dev_exec, host_exec, qid):
                 assert g == pytest.approx(w, rel=1e-4), (qid, gr, wr)
             else:
                 assert g == w, (qid, gr, wr)
+
+
+@pytest.mark.parametrize("qid", sorted(ssb.QUERIES))
+def test_device_matches_host(setup, dev_exec, host_exec, qid):
+    cols, segs = setup
+    ctx = compile_query(ssb.QUERIES[qid])
+    got, _ = dev_exec.execute(ctx, segs)
+    want, _ = host_exec.execute(ctx, segs)
+    _assert_rows_match(qid, got, want)
+
+
+def test_capped_hbm_budget_matches_host(setup, host_exec):
+    """The residency acceptance bar: with the HBM budget capped below the
+    SSB working set, every flight still returns host-engine-identical
+    results — wide queries spill to host, narrow ones churn the LRU — and
+    nothing device-OOMs. Under the DEFAULT (uncapped) budget the suite
+    must not spill at all and must serve warm queries 100% from cache."""
+    cols, segs = setup
+    probe = ShardedQueryExecutor()
+    probe.execute(compile_query(ssb.QUERIES["Q1.1"]), segs)
+    one_flight = probe.residency.staged_bytes()
+    assert one_flight > 0
+
+    capped = ShardedQueryExecutor(hbm_budget_bytes=int(one_flight * 1.5))
+    for qid in sorted(ssb.QUERIES):
+        ctx = compile_query(ssb.QUERIES[qid])
+        got, stats = capped.execute(ctx, segs)
+        want, _ = host_exec.execute(ctx, segs)
+        _assert_rows_match(qid, got, want)
+        assert "spills" in stats.staging, qid
+    snap = capped.residency.stats_snapshot()
+    assert snap["spills"] + snap["evictions"] >= 1, \
+        "cap below the working set exercised neither churn nor spill"
+    budget = capped.residency.budget_bytes
+    assert snap["stagedBytes"] <= budget
+
+    # default budget: warm reruns are all hits, never spills
+    warm = ShardedQueryExecutor()
+    qids = sorted(ssb.QUERIES)[:4]
+    for qid in qids:
+        warm.execute(compile_query(ssb.QUERIES[qid]), segs)
+    for qid in qids:
+        _, stats = warm.execute(compile_query(ssb.QUERIES[qid]), segs)
+        assert stats.staging["misses"] == 0, qid
+        assert stats.staging["spills"] == 0, qid
+        assert stats.staging["hits"] >= 1, qid
 
 
 def test_q2_groupby_vs_pandas(setup, dev_exec):

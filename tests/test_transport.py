@@ -298,5 +298,11 @@ def test_server_admin_size_and_memory(cluster, tmp_path):
         assert len(size["segments"]) >= 1
         mem = _http("GET", f"{base}/debug/memory")
         assert "stagedSegments" in mem and "nativeMmapBuffers" in mem
+        # bytes-accurate residency accounting + the ops eviction hook
+        assert "stagedBytes" in mem and "budgetBytes" in mem
+        for seg in mem["stagedSegments"].values():
+            assert seg["bytes"] >= 0
+        out = _http("POST", f"{base}/debug/memory/evict/not_staged")
+        assert out["evicted"] == "not_staged"
     finally:
         api.stop()

@@ -404,9 +404,16 @@ class _Worker:
         }
 
     def bench_qps(self) -> dict:
-        """Closed-loop multi-thread throughput (ref: QueryRunner.java
+        """Closed-loop multi-thread throughput sweep (ref: QueryRunner.java
         multiThreadedQueryRunner: numThreads issuing back-to-back, report
-        QPS + latency percentiles)."""
+        QPS + latency percentiles). Sweeps 1/2/4/8 client threads so the
+        record carries the SCALING story, not one point: ``qps_scaling`` =
+        4-thread QPS / 1-thread QPS, plus per-level launch-coalescing
+        deltas (parallel/launcher.py). A multi-core host where scaling
+        drops below 1.5x means the launch scheduler regressed back to the
+        old fully-serialized combine — fail loudly instead of shipping a
+        flat number (BENCH_ALLOW_FLAT_QPS=1 opts out for 1-2 core hosts
+        or capped experiments)."""
         import concurrent.futures
 
         from pinot_tpu.query import compile_query
@@ -418,35 +425,73 @@ class _Worker:
                 for q in qids]
         for ctx in ctxs:
             self.dev.execute(ctx, segs)   # compile/warm
-        seconds = 8.0
-        threads = 4
-        lat: list = []
+        launcher = getattr(self.dev, "launcher", None)
+        seconds = 5.0
+        levels = {}
         lock = threading.Lock()
-        stop_at = time.perf_counter() + seconds
 
-        def pump(i: int) -> int:
-            done = 0
-            while time.perf_counter() < stop_at:
-                ctx = ctxs[(i + done) % len(ctxs)]
-                t0 = time.perf_counter()
-                self.dev.execute(ctx, segs)
-                dt = (time.perf_counter() - t0) * 1e3
-                with lock:
-                    lat.append(dt)
-                done += 1
-            return done
+        def run_level(threads: int) -> dict:
+            lat: list = []
+            stop_at = time.perf_counter() + seconds
 
-        t0 = time.perf_counter()
-        with concurrent.futures.ThreadPoolExecutor(threads) as pool:
-            total = sum(pool.map(pump, range(threads)))
-        wall = time.perf_counter() - t0
-        arr = np.asarray(lat)
+            def pump(i: int) -> int:
+                done = 0
+                while time.perf_counter() < stop_at:
+                    ctx = ctxs[(i + done) % len(ctxs)]
+                    t0 = time.perf_counter()
+                    self.dev.execute(ctx, segs)
+                    dt = (time.perf_counter() - t0) * 1e3
+                    with lock:
+                        lat.append(dt)
+                    done += 1
+                return done
+
+            mark = launcher.stats_snapshot() if launcher else {}
+            t0 = time.perf_counter()
+            with concurrent.futures.ThreadPoolExecutor(threads) as pool:
+                total = sum(pool.map(pump, range(threads)))
+            wall = time.perf_counter() - t0
+            arr = np.asarray(lat)
+            out = {
+                "qps": round(total / wall, 2),
+                "p50_ms": round(float(np.percentile(arr, 50)), 3),
+                "p95_ms": round(float(np.percentile(arr, 95)), 3),
+                "p99_ms": round(float(np.percentile(arr, 99)), 3),
+            }
+            if launcher:
+                now = launcher.stats_snapshot()
+                out["launch"] = {
+                    k: round(now[k] - mark.get(k, 0), 3)
+                    for k in ("requests", "launches", "coalescedLaunches",
+                              "launchesSaved", "dedupedRequests")}
+                out["launch"]["maxBatchSize"] = now["maxBatchSize"]
+            return out
+
+        for threads in (1, 2, 4, 8):
+            _log(f"qps: sweeping {threads} thread(s)")
+            levels[str(threads)] = run_level(threads)
+
+        qps1 = levels["1"]["qps"]
+        qps4 = levels["4"]["qps"]
+        scaling = round(qps4 / qps1, 3) if qps1 else None
+        multi_core = (os.cpu_count() or 1) >= 4
+        if (multi_core and scaling is not None and scaling < 1.5
+                and not os.environ.get("BENCH_ALLOW_FLAT_QPS")):
+            raise AssertionError(
+                f"QPS scaling regressed: 4-thread {qps4} vs 1-thread "
+                f"{qps1} ({scaling}x < 1.5x on a {os.cpu_count()}-core "
+                f"host) — the launch scheduler is serializing instead of "
+                f"coalescing (levels: {levels})")
+        four = levels["4"]
         return {
-            "queries": list(qids), "threads": threads,
-            "qps": round(total / wall, 2),
-            "p50_ms": round(float(np.percentile(arr, 50)), 3),
-            "p95_ms": round(float(np.percentile(arr, 95)), 3),
-            "p99_ms": round(float(np.percentile(arr, 99)), 3),
+            "queries": list(qids),
+            "threads": 4,
+            "qps": four["qps"],
+            "p50_ms": four["p50_ms"],
+            "p95_ms": four["p95_ms"],
+            "p99_ms": four["p99_ms"],
+            "qps_scaling": scaling,
+            "qps_by_threads": levels,
         }
 
     def bench_micro(self) -> dict:

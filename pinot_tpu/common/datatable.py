@@ -310,6 +310,7 @@ class DataTable:
             num_groups_limit_reached=st.get("numGroupsLimitReached", False),
             group_by_rung=st.get("groupByRung"),
             staging=st.get("staging", {}),
+            launch=st.get("launch", {}),
             phase_ms=st.get("phaseTimesMs", {}),
             trace=st.get("trace", []),
         )

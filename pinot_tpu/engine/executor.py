@@ -90,6 +90,7 @@ class ServerQueryExecutor:
         from pinot_tpu.engine.residency import AUTO
 
         ensure_x64()
+        self.config = config
         # HBM residency manager: budget/pins/LRU/spill admission for every
         # device-resident array this executor stages. ``hbm_budget_bytes``:
         # None = resolve from config key pinot.server.query.hbm.budget.bytes
@@ -126,6 +127,23 @@ class ServerQueryExecutor:
         # unbounded LIMIT variety must not pin kernels forever)
         self._selection_kernels: "OrderedDict" = OrderedDict()
         self.num_groups_limit = num_groups_limit
+        # segment fan-out width: pinot.server.query.worker.threads (the
+        # reference's pqw pool size); default preserves the old hardcoded
+        # min(cpu, 8). The pool itself is persistent and lazily built —
+        # per-query ThreadPoolExecutor spawn/teardown was pure overhead on
+        # the serving path.
+        import os
+
+        from pinot_tpu.spi.config import (
+            CommonConstants as _CC,
+            PinotConfiguration,
+        )
+
+        cfg = config if config is not None else PinotConfiguration()
+        self.worker_threads = max(1, cfg.get_int(
+            _CC.WORKER_THREADS_KEY, min(os.cpu_count() or 1, 8)))
+        self._segment_pool = None
+        self._segment_pool_lock = threading.Lock()
 
     def _pallas_mode(self) -> Optional[bool]:
         """None = disabled; True/False = enabled (interpret or compiled)."""
@@ -311,28 +329,48 @@ class ServerQueryExecutor:
 
     def _map_segments(self, fn, segments: List[ImmutableSegment],
                       stats: QueryStats) -> List[Any]:
-        """Per-segment execution, threaded when it can pay off (ref: the
-        reference's combine runs segment plans on an executor pool,
-        BaseCombineOperator.java:55). The numpy-heavy host families (sketch
-        builds, sorts, percentiles) release the GIL, so segments overlap on
-        multi-core servers; each task gets a private QueryStats merged
-        in-order afterwards (QueryStats mutation is not thread-safe)."""
-        import os
-
-        workers = min(len(segments), os.cpu_count() or 1, 8)
-        if workers <= 1 or len(segments) <= 1:
+        """Per-segment execution on the persistent worker pool (ref: the
+        reference's combine runs segment plans on a sized executor pool,
+        BaseCombineOperator.java:55 + the pqw server pool). The numpy-heavy
+        host families (sketch builds, sorts, percentiles) release the GIL,
+        so segments overlap on multi-core servers; each task gets a private
+        QueryStats merged in-order afterwards (QueryStats mutation is not
+        thread-safe). Sized by pinot.server.query.worker.threads; the pool
+        is shared across concurrent queries, so the thread count is a
+        server-level bound instead of multiplying per in-flight query."""
+        if self.worker_threads <= 1 or len(segments) <= 1:
             return [fn(seg, stats) for seg in segments]
-        from concurrent.futures import ThreadPoolExecutor
-
+        pool = self._worker_pool()
         locals_ = [QueryStats() for _ in segments]
         lease = self._lease_of(stats)
         for st in locals_:  # the pin set must ride into worker threads
             st._staging_lease = lease
-        with ThreadPoolExecutor(workers) as pool:
-            parts = list(pool.map(fn, segments, locals_))
+        parts = pool.map(fn, segments, locals_)
         for st in locals_:
             stats.merge(st)
         return parts
+
+    def _worker_pool(self):
+        """Lazily-built persistent segment-fanout pool (daemon threads;
+        spawn once per executor, not once per query)."""
+        pool = self._segment_pool
+        if pool is None:
+            from pinot_tpu.server.scheduler import WorkerPool
+
+            with self._segment_pool_lock:
+                pool = self._segment_pool
+                if pool is None:
+                    pool = WorkerPool(self.worker_threads, name="pqw")
+                    self._segment_pool = pool
+        return pool
+
+    def close(self) -> None:
+        """Drain the worker pool (server shutdown hook). Safe to reuse the
+        executor afterwards: the pool rebuilds lazily on the next fan-out."""
+        with self._segment_pool_lock:
+            pool, self._segment_pool = self._segment_pool, None
+        if pool is not None:
+            pool.stop()
 
     def _segment_aggregation(self, ctx: QueryContext, aggs: List[AggDef],
                              seg: ImmutableSegment,

@@ -179,6 +179,11 @@ class ResidencyManager:
         self.pin_blocked = 0
         self.spills = 0
         self.prefetched = 0
+        self.borrows = 0
+        # cross-query column dedup: ``column_borrower(segment, name)``
+        # (set by the sharded executor) lets a StagedSegment serve a column
+        # from a resident batch's device copy instead of staging its own
+        self.column_borrower = None
         self._metrics = None
         self._prefetch_q: Optional["queue.Queue"] = None
         self._prefetch_thread: Optional[threading.Thread] = None
@@ -228,7 +233,8 @@ class ResidencyManager:
                 if e is not None:  # identity change: drop stale arrays
                     del self._entries[name]
                     e.resident.release()
-                e = _Entry(StagedSegment(segment))
+                e = _Entry(StagedSegment(segment,
+                                         borrower=self.column_borrower))
                 self._entries[name] = e
                 self.misses += 1
                 if lease is not None:
@@ -288,6 +294,17 @@ class ResidencyManager:
                 self.evictions += 1
                 self._mark("STAGING_EVICTIONS")
                 self._refresh_locked()
+
+    def note_borrow(self, batch_name: str) -> None:
+        """A per-segment staging built a column FROM a resident batch's
+        device copy (cross-query dedup): count it and touch the batch in
+        the LRU — borrowers keep their source warm, the reference-count of
+        the share."""
+        with self._lock:
+            self.borrows += 1
+            if batch_name in self._entries:
+                self._entries.move_to_end(batch_name)
+            self._mark("STAGING_BORROWS")
 
     def discard(self, name: str) -> None:
         """Drop an entry WITHOUT calling release (the owner already freed
@@ -511,6 +528,7 @@ class ResidencyManager:
                 "pinBlockedEvictions": self.pin_blocked,
                 "spills": self.spills,
                 "prefetched": self.prefetched,
+                "borrows": self.borrows,
                 "stagedBytes": self._staged_bytes,
                 "peakBytes": self._peak_bytes,
             }
@@ -538,6 +556,7 @@ class ResidencyManager:
                     "evictions": self.evictions,
                     "pinBlockedEvictions": self.pin_blocked,
                     "spills": self.spills, "prefetched": self.prefetched,
+                    "borrows": self.borrows,
                 },
                 "stagedSegments": residents,
             }

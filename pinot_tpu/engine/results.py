@@ -78,6 +78,12 @@ class QueryStats:
     # segments/shards/servers at merge; *Bytes keys take the max (each
     # server reports its own staged total — summing would double-count)
     staging: Dict[str, int] = field(default_factory=dict)
+    # launch-coalescing counters for this query (parallel/launcher.py):
+    # launches/coalesced/launchesSaved sum across shards/servers at merge;
+    # batchSize (the coalesced batch this query rode) and queueWaitMs
+    # (dispatcher queue wait) take the max — each server reports its own
+    # worst case, summing would misstate both
+    launch: Dict[str, float] = field(default_factory=dict)
     # phase -> ms (ref: TimerContext/ServerQueryPhase —
     # ServerQueryExecutorV1Impl.java:122,276,297,303); summed across
     # servers at reduce
@@ -112,6 +118,11 @@ class QueryStats:
                 self.staging[k] = max(self.staging.get(k, 0), v)
             else:
                 self.staging[k] = self.staging.get(k, 0) + v
+        for k, v in other.launch.items():
+            if k in ("batchSize", "queueWaitMs"):
+                self.launch[k] = max(self.launch.get(k, 0), v)
+            else:
+                self.launch[k] = self.launch.get(k, 0) + v
         for phase, ms in other.phase_ms.items():
             self.add_phase_ms(phase, ms)
         self.trace.extend(other.trace)
@@ -130,6 +141,7 @@ class QueryStats:
             **({"groupByRung": self.group_by_rung}
                if self.group_by_rung else {}),
             **({"staging": self.staging} if self.staging else {}),
+            **({"launch": self.launch} if self.launch else {}),
             **({"trace": self.trace} if self.trace else {}),
         }
 

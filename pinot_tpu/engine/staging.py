@@ -103,7 +103,7 @@ class StagedSegment:
     duplicate build leaks its losing copy until GC (the round-2 residency
     hazard). Reads stay lock-free (dict get is atomic under the GIL)."""
 
-    def __init__(self, segment: ImmutableSegment):
+    def __init__(self, segment: ImmutableSegment, borrower=None):
         self.segment = segment
         self.num_docs = segment.num_docs
         self.capacity = segment.padded_capacity
@@ -112,6 +112,11 @@ class StagedSegment:
         self._values: Dict[str, jnp.ndarray] = {}
         self._valid_cache = None
         self._lock = threading.Lock()
+        # cross-query dedup hook: ``borrower(segment, name)`` may return a
+        # StagedColumn built from a resident sharded batch's device copy of
+        # the same column (no second H2D, dictvals buffer shared) — wired
+        # by the sharded executor through the residency manager
+        self._borrower = borrower
 
     def column(self, name: str) -> StagedColumn:
         col = self._columns.get(name)
@@ -119,7 +124,10 @@ class StagedSegment:
             with self._lock:
                 col = self._columns.get(name)
                 if col is None:
-                    col = self._stage(name)
+                    if self._borrower is not None:
+                        col = self._borrower(self.segment, name)
+                    if col is None:
+                        col = self._stage(name)
                     self._columns[name] = col
         return col
 

@@ -15,10 +15,18 @@ from pinot_tpu.parallel.combine import (
     make_combine_mesh,
 )
 from pinot_tpu.parallel.executor import ShardedQueryExecutor
+from pinot_tpu.parallel.launcher import (
+    LaunchKernel,
+    LaunchScheduler,
+    launcher_for_mesh,
+)
 
 __all__ = [
     "SegmentBatch",
     "ShardedQueryExecutor",
+    "LaunchKernel",
+    "LaunchScheduler",
+    "launcher_for_mesh",
     "make_combine_mesh",
     "build_sharded_kernel",
     "SEG_AXIS",

@@ -56,28 +56,48 @@ class ShardedQueryExecutor(ServerQueryExecutor):
         # queries). Byte-accounted + evictable through self.residency as
         # one _BatchResident per batch.
         self._device_cols: Dict[Tuple[str, str, int], Dict] = {}
-        # (sql, batch, S) -> (plan, device params, kernel, cols): repeated
-        # queries skip planning AND the per-call H2D parameter uploads (each
-        # a tunnel roundtrip on the serving path). LRU-bounded: dashboards
-        # emitting unique literals must not pin device memory forever.
+        # Two-tier query cache. The PARAM tier is keyed on the exact
+        # (sql, filter fp, batch, S) and holds this literal set's plan +
+        # device-committed runtime params (cheap entries; a dashboard
+        # emitting unique literals may churn it affordably). The LAUNCH
+        # tier is keyed on the literal-normalized plan fingerprint — the
+        # plan spec, whose literals ride in params — and holds the
+        # expensive compiled call closures; unique-literal queries HIT
+        # here, reusing the compiled kernel + staged-column bindings
+        # instead of churning them out of one flat LRU. The launch-tier
+        # key doubles as the launcher's coalescing identity.
         import threading
         from collections import OrderedDict
 
-        self._query_cache: "OrderedDict[Tuple, Tuple]" = OrderedDict()
-        self._query_cache_cap = 256
-        self._query_cache_lock = threading.Lock()
+        self._param_cache: "OrderedDict[Tuple, Tuple]" = OrderedDict()
+        self._param_cache_cap = 256
+        self._launch_cache: "OrderedDict[Tuple, object]" = OrderedDict()
+        self._launch_cache_cap = 128
+        self._cache_lock = threading.Lock()
         self._device_cols_lock = threading.Lock()
         self._batches_lock = threading.Lock()
         # multi-device combine programs carry collectives (psum/all_gather):
-        # two threads interleaving their launches across the same devices
-        # deadlock inside the runtime, so launches serialize through this
-        # lock. None on a 1-device mesh (no collectives -> no deadlock; the
-        # serving-path QPS benefit of concurrent launches survives there).
-        self._combine_lock = (threading.Lock()
-                              if self.mesh.devices.size > 1 else None)
+        # interleaved launches from two threads deadlock the runtime. The
+        # old process-global _combine_lock is gone — every launch now flows
+        # through the per-mesh LaunchScheduler, whose single dispatcher
+        # thread totally orders device programs AND coalesces same-kernel
+        # requests into one micro-batched launch (parallel/launcher.py).
+        from pinot_tpu.parallel.launcher import launcher_for_mesh
+        from pinot_tpu.spi.config import CommonConstants, PinotConfiguration
+
+        cfg = self.config if self.config is not None else PinotConfiguration()
+        self._launch_max_batch = max(1, cfg.get_int(
+            CommonConstants.LAUNCH_MAX_BATCH_KEY,
+            CommonConstants.DEFAULT_LAUNCH_MAX_BATCH))
+        self.launcher = launcher_for_mesh(self.mesh)
         # PallasSpec -> jitted sharded fused kernel (literal params stay
         # runtime args, so same-shape queries share the compile)
         self._pallas_sharded: Dict = {}
+        # cross-query column dedup: the per-segment staging path borrows a
+        # resident batch's sharded copy of a column instead of staging a
+        # second device copy (engine/staging.py consults this hook)
+        self.residency.column_borrower = self._borrow_batch_column
+        self._borrows = 0
 
     # -- combine overrides --------------------------------------------------
     def _any_star_tree_fit(self, ctx, aggs, segments) -> bool:
@@ -166,9 +186,11 @@ class ShardedQueryExecutor(ServerQueryExecutor):
         with self._device_cols_lock:
             for k in [k for k in self._device_cols if k[0] == name]:
                 del self._device_cols[k]
-        with self._query_cache_lock:
-            for k in [k for k in self._query_cache if k[2] == name]:
-                del self._query_cache[k]
+        with self._cache_lock:
+            # both tiers carry the batch name at slot [-2]
+            for cache in (self._param_cache, self._launch_cache):
+                for k in [k for k in cache if k[-2] == name]:
+                    del cache[k]
         self.residency.discard(name)
 
     def evict_segment(self, segment_name: str) -> None:
@@ -202,39 +224,41 @@ class ShardedQueryExecutor(ServerQueryExecutor):
         # the filter fingerprint distinguishes same-SQL contexts whose
         # filter was rewritten (hybrid time boundary advancing, IN_SUBQUERY
         # idset refresh) — without it a stale compiled plan would serve
-        qkey = (ctx.sql if ctx.sql is not None else repr(ctx),
+        pkey = (ctx.sql if ctx.sql is not None else repr(ctx),
                 filter_fingerprint(ctx), batch.metadata.segment_name, S)
-        with self._query_cache_lock:
-            cached = self._query_cache.get(qkey)
+        with self._cache_lock:
+            cached = self._param_cache.get(pkey)
             if cached is not None:
-                self._query_cache.move_to_end(qkey)
+                self._param_cache.move_to_end(pkey)
+                plan, launch_key, params = cached
+                kernel = self._launch_cache.get(launch_key)
+                if kernel is not None:
+                    self._launch_cache.move_to_end(launch_key)
         if cached is None:
             plan = plan_segment(ctx, batch)
-            call_fn = self._build_pallas_call(plan, batch, S)
-            is_pallas = call_fn is not None
-            if call_fn is None:
-                call_fn = self._build_jnp_call(plan, batch, S)
-            cached = (plan, call_fn, is_pallas)
-            with self._query_cache_lock:
-                self._query_cache[qkey] = cached
-                if len(self._query_cache) > self._query_cache_cap:
-                    self._query_cache.popitem(last=False)
-        plan, call_fn, is_pallas = cached
+            kernel, params = self._bind_launch(plan, batch, S)
+            self._remember(pkey, plan, kernel, params)
+        elif kernel is None:
+            # launch tier evicted under this param entry: rebind (the plan
+            # is in hand, so this costs a kernel-cache lookup, not a replan)
+            kernel, params = self._bind_launch(plan, batch, S)
+            self._remember(pkey, plan, kernel, params)
         num_docs = self._device_num_docs(batch, S)
 
         trace_on = ctx.trace_enabled
         t0 = time.perf_counter() if trace_on else 0.0
         try:
-            packed = self._launch_combine(call_fn, num_docs)
+            req = self.launcher.submit(kernel, params, num_docs)
+            packed = req.result()
         except (PlanError, ValueError):
             raise
         except Exception:
             # jax.jit compiles lazily: a Mosaic lowering failure on the real
-            # chip surfaces HERE, not in _build_pallas_call. Fall back to
-            # the jnp combine, repair the cache, and block THIS query shape
+            # chip surfaces HERE, not at bind time. Fall back to the jnp
+            # combine, repair both cache tiers, and block THIS query shape
             # only (a process-wide kill switch would cost every other query
             # its fused kernel).
-            if not is_pallas:
+            if not kernel.is_pallas:
                 raise
             import logging
 
@@ -249,23 +273,33 @@ class ShardedQueryExecutor(ServerQueryExecutor):
             for k in list(self._pallas_sharded):
                 if k[1] == plan.spec:
                     self._pallas_sharded.pop(k, None)
-            # evict FIRST: _build_jnp_call may itself raise PlanError
-            # (pallas pads tiles where the jnp path demands divisibility),
-            # and the poisoned pallas entry must not survive that
-            with self._query_cache_lock:
-                self._query_cache.pop(qkey, None)
-            call_fn = self._build_jnp_call(plan, batch, S)
-            with self._query_cache_lock:
-                self._query_cache[qkey] = (plan, call_fn, False)
-            is_pallas = False  # the trace must name the kernel that RAN
-            packed = self._launch_combine(call_fn, num_docs)
+            # evict FIRST: the jnp bind may itself raise PlanError (pallas
+            # pads tiles where the jnp path demands divisibility), and the
+            # poisoned entries must not survive that
+            with self._cache_lock:
+                self._param_cache.pop(pkey, None)
+                self._launch_cache.pop(kernel.key, None)
+            kernel, params = self._bind_jnp(plan, batch, S)
+            self._remember(pkey, plan, kernel, params)
+            req = self.launcher.submit(kernel, params, num_docs)
+            packed = req.result()
+        # coalescing outcome -> per-query stats (merged across shards and
+        # servers; see QueryStats.merge for the sum-vs-max key split)
+        stats.launch = {
+            "launches": 1,
+            "coalesced": 1 if req.batch_size > 1 else 0,
+            "batchSize": req.batch_size,
+            "launchesSaved": req.launches_saved,
+            "queueWaitMs": round(req.queue_wait_ms, 3),
+        }
         # ONE D2H fetch decodes the entire query result
         out = unpack_outputs(packed, plan.spec, num_seg=S)
         if trace_on:
             stats.add_trace(
                 "ShardedCombine", (time.perf_counter() - t0) * 1e3,
-                kernel="pallas" if is_pallas else "jnp",
+                kernel="pallas" if kernel.is_pallas else "jnp",
                 segments=batch.num_segments,
+                batch_size=req.batch_size,
                 mesh=f"{self.mesh.shape[SEG_AXIS]}x"
                      f"{self.mesh.shape[DOC_AXIS]}")
 
@@ -282,25 +316,51 @@ class ShardedQueryExecutor(ServerQueryExecutor):
             stats.group_by_rung = grouped_rung(plan.spec, out)
         return batch, out, plan
 
-    def _launch_combine(self, call_fn, num_docs):
-        """Run one combine program. On a multi-device mesh the launch AND
-        the result wait serialize under _combine_lock: the program's
-        collectives deadlock if another thread's program interleaves its
-        per-device launches (the wait must sit inside the lock — dispatch
-        is async, so releasing early would only move the interleave to the
-        blocked fetch)."""
-        import jax
+    def _remember(self, pkey: Tuple, plan: SegmentPlan, kernel, params
+                  ) -> None:
+        """Insert/refresh both cache tiers (LRU-capped)."""
+        with self._cache_lock:
+            self._param_cache[pkey] = (plan, kernel.key, params)
+            self._param_cache.move_to_end(pkey)
+            if len(self._param_cache) > self._param_cache_cap:
+                self._param_cache.popitem(last=False)
 
-        if self._combine_lock is None:
-            return call_fn(num_docs)
-        with self._combine_lock:
-            packed = call_fn(num_docs)
-            jax.block_until_ready(packed)
-            return packed
+    def _launch_kernel(self, launch_key: Tuple, make_call, is_pallas: bool):
+        """Get-or-create the launch-tier entry: the coalescable
+        LaunchKernel every same-shape query (any literals) shares."""
+        from pinot_tpu.parallel.launcher import LaunchKernel
 
-    def _build_jnp_call(self, plan: SegmentPlan, batch: SegmentBatch,
-                        S: int):
-        """num_docs -> packed output via the jnp masked-vector combine."""
+        with self._cache_lock:
+            kernel = self._launch_cache.get(launch_key)
+            if kernel is not None:
+                self._launch_cache.move_to_end(launch_key)
+                return kernel
+        call = make_call()
+        with self._cache_lock:
+            kernel = self._launch_cache.get(launch_key)
+            if kernel is None:
+                kernel = LaunchKernel(launch_key, call,
+                                      is_pallas=is_pallas,
+                                      max_batch=self._launch_max_batch)
+                self._launch_cache[launch_key] = kernel
+                if len(self._launch_cache) > self._launch_cache_cap:
+                    self._launch_cache.popitem(last=False)
+            return kernel
+
+    def _bind_launch(self, plan: SegmentPlan, batch: SegmentBatch, S: int):
+        """-> (LaunchKernel, device params): fused Pallas when eligible,
+        jnp masked-vector combine otherwise. The kernel is shared across
+        literals (its key is the literal-normalized plan fingerprint);
+        the params are this query's runtime arrays, committed to device
+        once (per-call H2D uploads are tunnel roundtrips the serving path
+        cannot afford)."""
+        bound = self._bind_pallas(plan, batch, S)
+        if bound is not None:
+            return bound
+        return self._bind_jnp(plan, batch, S)
+
+    def _bind_jnp(self, plan: SegmentPlan, batch: SegmentBatch, S: int):
+        """params, num_docs -> packed output via the jnp combine."""
         import jax
 
         from jax.sharding import NamedSharding, PartitionSpec as P
@@ -314,18 +374,22 @@ class ShardedQueryExecutor(ServerQueryExecutor):
                 for name in plan.columns}
         col_layouts = tuple(sorted(
             (name, tuple(sorted(t.keys()))) for name, t in cols.items()))
-        kernel = self.sharded_kernels.get(plan.spec, col_layouts)
-        # params committed to device once per query: per-call H2D uploads
-        # are tunnel roundtrips the serving path cannot afford
+        launch_key = ("jnp", plan.spec, col_layouts,
+                      batch.metadata.segment_name, S)
+
+        def make_call():
+            fn = self.sharded_kernels.get(plan.spec, col_layouts)
+            return lambda params, num_docs: fn(cols, params, num_docs)
+
+        kernel = self._launch_kernel(launch_key, make_call, is_pallas=False)
         params = jax.device_put(
             tuple(plan.params), NamedSharding(self.mesh, P()))
-        return lambda num_docs: kernel(cols, params, num_docs)
+        return kernel, params
 
-    def _build_pallas_call(self, plan: SegmentPlan, batch: SegmentBatch,
-                           S: int):
-        """num_docs -> packed output via the sharded fused Pallas kernel
-        (VERDICT r3 item 2: the flagship kernel serves the combine path),
-        or None when the plan/backing isn't eligible."""
+    def _bind_pallas(self, plan: SegmentPlan, batch: SegmentBatch, S: int):
+        """(LaunchKernel, device params) via the sharded fused Pallas
+        kernel (VERDICT r3 item 2: the flagship kernel serves the combine
+        path), or None when the plan/backing isn't eligible."""
         import logging
 
         from dataclasses import replace
@@ -335,7 +399,6 @@ class ShardedQueryExecutor(ServerQueryExecutor):
         from jax.sharding import NamedSharding, PartitionSpec as P
 
         from pinot_tpu.engine.pallas_kernels import extract_plan
-        from pinot_tpu.engine.staging import PALLAS_TILE
         from pinot_tpu.parallel.combine import build_sharded_pallas_kernel
 
         interpret = self._pallas_mode()
@@ -367,23 +430,31 @@ class ShardedQueryExecutor(ServerQueryExecutor):
                 pp.spec(num_segs=S // n_seg, tiles_per_seg=tiles // n_doc,
                         interpret=bool(interpret)),
                 packed_bits=tuple(bits))
-            # keyed by (spec, plan.spec): the closure bakes plan.spec into
-            # the output layout, and distinct plans CAN collide on spec
-            # alone (num_groups_padded rounds to 128)
-            kkey = (spec, plan.spec)
-            kernel = self._pallas_sharded.get(kkey)
-            if kernel is None:
-                kernel = build_sharded_pallas_kernel(spec, plan.spec,
+            launch_key = ("pallas", spec, plan.spec,
+                          batch.metadata.segment_name, S)
+
+            def make_call():
+                # keyed by (spec, plan.spec): the closure bakes plan.spec
+                # into the output layout, and distinct plans CAN collide on
+                # spec alone (num_groups_padded rounds to 128)
+                kkey = (spec, plan.spec)
+                fn = self._pallas_sharded.get(kkey)
+                if fn is None:
+                    fn = build_sharded_pallas_kernel(spec, plan.spec,
                                                      self.mesh)
-                self._pallas_sharded[kkey] = kernel
+                    self._pallas_sharded[kkey] = fn
+                return lambda params, num_docs: fn(params, packed_cols,
+                                                   value_cols, num_docs)
+
+            kernel = self._launch_kernel(launch_key, make_call,
+                                         is_pallas=True)
             params = jax.device_put(pp.static_params,
                                     NamedSharding(self.mesh, P()))
         except Exception:
             logging.getLogger(__name__).exception(
                 "sharded pallas build failed; using jnp combine")
             return None
-        return lambda num_docs: kernel(params, packed_cols, value_cols,
-                                       num_docs)
+        return kernel, params
 
     def _staged_pallas(self, batch: SegmentBatch, name: str, S: int,
                        kind: str):
@@ -450,10 +521,84 @@ class ShardedQueryExecutor(ServerQueryExecutor):
             self._batches.clear()
         with self._device_cols_lock:
             self._device_cols.clear()
-        with self._query_cache_lock:
-            self._query_cache.clear()
+        with self._cache_lock:
+            self._param_cache.clear()
+            self._launch_cache.clear()
         for b in batches:
             self.residency.discard(b.metadata.segment_name)
+
+    # -- cross-query column dedup (per-segment path borrows batch copies) ----
+    def _borrow_batch_column(self, segment: ImmutableSegment, name: str):
+        """A StagedSegment column served FROM a resident batch's sharded
+        device copy instead of a second host->device staging pass. Only
+        sound when the device bytes coincide: SV column, the batch's
+        padded capacity equals the segment's, and — for dictionary
+        columns — this segment's remap into the unified dictionary is the
+        identity (its value set IS the union), so unified dictIds equal
+        segment dictIds. The unified dictvals array is shared outright
+        (the same device buffer backs both paths: real HBM dedup); the
+        forward row is a device-side slice (no H2D, no host remap).
+        Returns a StagedColumn or None when nothing compatible is
+        resident."""
+        from pinot_tpu.engine.staging import StagedColumn, staged_int_dtype
+
+        with self._batches_lock:
+            batches = [(k, b) for k, b in self._batches.items()
+                       if segment.segment_name in k]
+        for key, batch in batches:
+            try:
+                i = key.index(segment.segment_name)
+            except ValueError:
+                continue
+            if batch.segments[i] is not segment:
+                continue  # reloaded segment: the batch copy is stale
+            if batch.capacity != segment.padded_capacity:
+                continue  # row slice would have the wrong length
+            bname = batch.metadata.segment_name
+            with self._device_cols_lock:
+                tree = next((v for k2, v in self._device_cols.items()
+                             if k2[0] == bname and k2[1] == name), None)
+            if not isinstance(tree, dict) or "fwd" not in tree:
+                continue
+            cm = segment.metadata.columns.get(name)
+            if cm is None or not cm.single_value:
+                continue
+            if cm.has_dictionary:
+                remaps = batch._remaps.get(name)
+                if remaps is None:
+                    continue
+                r = remaps[i]
+                if (len(r) != cm.cardinality
+                        or int(r[-1]) != cm.cardinality - 1
+                        or not np.array_equal(r, np.arange(cm.cardinality,
+                                                           dtype=r.dtype))):
+                    continue  # unified ids differ from segment ids
+                want_dtype = np.dtype(np.int32)
+            elif cm.data_type.is_integral:
+                want_dtype = staged_int_dtype(cm)
+            else:
+                want_dtype = np.dtype(np.float64)
+            fwd = tree["fwd"]
+            if fwd.dtype != want_dtype:
+                continue  # merged stats narrowed differently: not the
+                # same bytes the per-segment contract stages
+            sc = StagedColumn(data_type=cm.data_type,
+                              has_dictionary=cm.has_dictionary)
+            sc.fwd = fwd[i]
+            if cm.has_dictionary and cm.data_type.is_numeric:
+                dv = tree.get("dictvals")
+                if dv is None:
+                    continue
+                sc.dictvals = dv  # SAME device buffer: zero-copy dedup
+            if cm.has_nulls:
+                nb = tree.get("null")
+                if nb is None:
+                    continue
+                sc.null = nb[i]
+            self._borrows += 1
+            self.residency.note_borrow(bname)
+            return sc
+        return None
 
 
 class _BatchResident:

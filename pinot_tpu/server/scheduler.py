@@ -58,6 +58,35 @@ class _DaemonPool:
             self._q.put(None)
 
 
+class WorkerPool:
+    """Persistent segment-fanout pool (the reference's pqw worker threads,
+    ``pinot.server.query.worker.threads``): one per executor, shared by
+    every in-flight query, so segment fan-out stops paying thread
+    spawn/teardown per query AND the thread count is a server-level bound
+    instead of multiplying per concurrent query."""
+
+    def __init__(self, num_workers: int, name: str = "pqw"):
+        self.num_workers = max(1, int(num_workers))
+        self._pool = _DaemonPool(self.num_workers, name)
+
+    def map(self, fn, *iterables) -> list:
+        """Ordered results; the first task exception propagates (matching
+        the old per-query ``ThreadPoolExecutor.map`` semantics)."""
+        import functools
+
+        futs = [self._pool.submit(functools.partial(fn, *args))
+                for args in zip(*iterables)]
+        return [f.result() for f in futs]
+
+    def submit(self, fn, *args) -> Future:
+        import functools
+
+        return self._pool.submit(functools.partial(fn, *args))
+
+    def stop(self) -> None:
+        self._pool.stop()
+
+
 class QueryScheduler:
     """Base: bounded worker pool, graceful drain on shutdown."""
 
@@ -248,8 +277,16 @@ class PriorityScheduler(QueryScheduler):
             self._available.release()
 
 
-def make_scheduler(policy: str = "fcfs", **kw) -> QueryScheduler:
-    """Ref: QuerySchedulerFactory."""
+def make_scheduler(policy: str = "fcfs", config=None, **kw) -> QueryScheduler:
+    """Ref: QuerySchedulerFactory. ``config`` sizes the runner pool from
+    ``pinot.server.query.runner.threads`` (the reference's pqr threads)
+    unless the caller passed ``num_workers`` explicitly."""
+    if config is not None and "num_workers" not in kw:
+        from pinot_tpu.spi.config import CommonConstants
+
+        kw["num_workers"] = max(1, config.get_int(
+            CommonConstants.RUNNER_THREADS_KEY,
+            CommonConstants.DEFAULT_RUNNER_THREADS))
     policy = policy.lower()
     if policy == "fcfs":
         return FcfsScheduler(**kw)

@@ -57,20 +57,26 @@ class ServerInstance:
                  executor: Optional[ServerQueryExecutor] = None,
                  scheduler: Optional[QueryScheduler] = None,
                  segment_dir: str = "/tmp/pinot_tpu_server",
-                 consumer_tick_s: float = 0.02):
+                 consumer_tick_s: float = 0.02,
+                 config=None):
         from pinot_tpu.spi.metrics import MetricsRegistry
 
         self.instance_id = instance_id
         self.store = store
         self.completion_protocol = completion_protocol
-        self.executor = executor or ServerQueryExecutor()
-        self.scheduler = scheduler or make_scheduler("fcfs")
+        self.executor = executor or ServerQueryExecutor(config=config)
+        # runner pool sized by pinot.server.query.runner.threads (pqr)
+        self.scheduler = scheduler or make_scheduler("fcfs", config=config)
         self.metrics = MetricsRegistry(role="server")
         # segment lifecycle -> HBM residency: adds prefetch, removals evict
         self.data_manager = InstanceDataManager(listener=self)
         residency = getattr(self.executor, "residency", None)
         if residency is not None:
             residency.bind_metrics(self.metrics)
+        # launch-coalescing meters/gauges (sharded executors only)
+        launcher = getattr(self.executor, "launcher", None)
+        if launcher is not None:
+            launcher.bind_metrics(self.metrics)
         self.segment_dir = segment_dir
         self.consumer_tick_s = consumer_tick_s
         self._started = False
@@ -130,6 +136,9 @@ class ServerInstance:
             self._hb_thread.join(timeout=5)
         self.scheduler.shutdown()
         self.data_manager.shutdown()
+        close = getattr(self.executor, "close", None)
+        if close is not None:
+            close()
         residency = getattr(self.executor, "residency", None)
         if residency is not None:
             residency.close()
@@ -490,6 +499,17 @@ class ServerInstance:
         return {"evicted": segment_name,
                 "stagedBytes": (residency.staged_bytes()
                                 if residency is not None else 0)}
+
+    def launch_debug(self) -> Dict[str, Any]:
+        """Launch-coalescing state for ``GET /debug/launches``: requests vs
+        device launches, coalesced/deduped/batched counts, queue waits, and
+        the live dispatcher queue depth (empty for host-only executors)."""
+        launcher = getattr(self.executor, "launcher", None)
+        if launcher is None:
+            return {"enabled": False}
+        out: Dict[str, Any] = {"enabled": True}
+        out.update(launcher.snapshot())
+        return out
 
     def memory_debug(self) -> Dict[str, Any]:
         """Bytes-accurate HBM residency + native mmap accounting

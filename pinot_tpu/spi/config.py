@@ -144,3 +144,17 @@ class CommonConstants:
     # <= 0 -> explicitly uncapped.
     HBM_BUDGET_BYTES_KEY = "pinot.server.query.hbm.budget.bytes"
     DEFAULT_HBM_BUDGET_FRACTION = 0.75
+    # Server pool sizing (ref: the pqr/pqw pools,
+    # CommonConstants.Server.*_QUERY_RUNNER_THREADS /
+    # QUERY_WORKER_THREADS): runner threads execute whole queries off the
+    # scheduler queue; worker threads fan segment plans out inside one
+    # query (engine/executor._map_segments). Worker default: min(cpu, 8),
+    # the pre-knob hardcoded fan-out width.
+    RUNNER_THREADS_KEY = "pinot.server.query.runner.threads"
+    DEFAULT_RUNNER_THREADS = 8
+    WORKER_THREADS_KEY = "pinot.server.query.worker.threads"
+    # Launch coalescing (parallel/launcher.py): max requests one vmapped
+    # combine launch may carry. 1 disables batching (dedup + single-thread
+    # dispatch ordering still apply).
+    LAUNCH_MAX_BATCH_KEY = "pinot.server.query.launch.max.batch"
+    DEFAULT_LAUNCH_MAX_BATCH = 8

@@ -165,6 +165,13 @@ class ServerMeter:
     STAGING_EVICTIONS = "staging_evictions_total"
     STAGING_PIN_BLOCKED = "staging_pin_blocked_evictions_total"
     STAGING_SPILLS = "staging_spills_total"
+    STAGING_BORROWS = "staging_borrows_total"
+    # launch coalescing (parallel/launcher.py; gauges launch_queue_depth /
+    # launch_max_batch_size ride the same registry)
+    LAUNCH_REQUESTS = "combine_launch_requests_total"
+    LAUNCHES = "combine_launches_total"
+    LAUNCHES_COALESCED = "combine_launches_coalesced_total"
+    LAUNCHES_SAVED = "combine_launches_saved_total"
 
 
 class ServerQueryPhase:

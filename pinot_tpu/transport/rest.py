@@ -516,6 +516,10 @@ class ServerAdminApi(_Api):
                    lambda m, b: (200, s.table_size(m.group(1))))
         self.route("GET", r"/debug/memory",
                    lambda m, b: (200, s.memory_debug()))
+        # launch-coalescing counters (requests vs device launches, batch
+        # sizes, queue waits) — the QPS-scaling ops view
+        self.route("GET", r"/debug/launches",
+                   lambda m, b: (200, s.launch_debug()))
         # ops hook for the HBM budget knob: force-drop one resident's
         # device arrays (in-flight queries keep theirs via python refs;
         # the next query re-stages)

@@ -270,13 +270,14 @@ def test_evict_segment_clears_every_containing_batch(segs):
     dev.execute(ctx_all, segs)       # batch over all four segments
     dev.execute(ctx_sub, segs[:2])   # a second batch sharing segment 0
     assert len(dev._batches) == 2
-    assert dev._device_cols and dev._query_cache
+    assert dev._device_cols and dev._param_cache and dev._launch_cache
 
     dev.evict_segment(segs[0].segment_name)
     assert not dev._batches, "a batch containing the segment survived"
     assert not dev._device_cols, "sharded device arrays leaked"
-    assert not dev._query_cache, \
+    assert not dev._launch_cache, \
         "compiled query closures (pinning old arrays) leaked"
+    assert not dev._param_cache, "device param arrays leaked"
     assert not dev.residency.resident_names()
 
     # and the path rebuilds cleanly
@@ -285,15 +286,15 @@ def test_evict_segment_clears_every_containing_batch(segs):
 
 
 def test_evict_batch_clears_query_cache_by_batch_name(segs):
-    """Regression for the k[1]-vs-k[2] key bug: query-cache keys are
-    (sql, filter_fp, batch_name, S); the old evictor compared the batch
-    name against the FINGERPRINT slot and never evicted anything."""
+    """Regression for the k[1]-vs-k[2] key bug: both cache tiers carry the
+    batch name at slot [-2]; the old evictor compared the batch name
+    against the FINGERPRINT slot and never evicted anything."""
     dev = ShardedQueryExecutor()
     dev.execute(compile_query(GROUP_SQL), segs)
-    assert dev._query_cache
+    assert dev._param_cache and dev._launch_cache
     batch = dev.batch_for(segs)
     dev._evict_batch(batch)
-    assert not dev._query_cache
+    assert not dev._param_cache and not dev._launch_cache
 
 
 # --------------------------------------------------------------------------

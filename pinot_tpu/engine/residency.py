@@ -169,17 +169,21 @@ class ResidencyManager:
         # RLock: evicting a batch resident re-enters through the executor's
         # release callback (discard()), and that must not deadlock
         self._lock = threading.RLock()
-        self._entries: "OrderedDict[str, _Entry]" = OrderedDict()
-        self._staged_bytes = 0
-        self._peak_bytes = 0
+        self._entries: "OrderedDict[str, _Entry]" = OrderedDict()  # guarded-by: _lock
+        self._staged_bytes = 0  # guarded-by: _lock
+        self._peak_bytes = 0  # guarded-by: _lock
+        # per-name eviction generation: a queued prefetch carries the seq it
+        # was enqueued under and must not resurrect a segment removed while
+        # it waited (the prefetch-vs-removeSegment race)
+        self._retired: Dict[str, int] = {}  # guarded-by: _lock
         # global counters (process lifetime; per-query deltas ride leases)
-        self.hits = 0
-        self.misses = 0
-        self.evictions = 0
-        self.pin_blocked = 0
-        self.spills = 0
-        self.prefetched = 0
-        self.borrows = 0
+        self.hits = 0  # guarded-by: _lock
+        self.misses = 0  # guarded-by: _lock
+        self.evictions = 0  # guarded-by: _lock
+        self.pin_blocked = 0  # guarded-by: _lock
+        self.spills = 0  # guarded-by: _lock
+        self.prefetched = 0  # guarded-by: _lock
+        self.borrows = 0  # guarded-by: _lock
         # cross-query column dedup: ``column_borrower(segment, name)``
         # (set by the sharded executor) lets a StagedSegment serve a column
         # from a resident batch's device copy instead of staging its own
@@ -208,7 +212,8 @@ class ResidencyManager:
                             if budget_bytes and int(budget_bytes) > 0
                             else None)
             self._budget_resolved = True
-            self._enforce_locked()
+            doomed = self._enforce_locked()
+        self._release_all(doomed)
 
     # -- staging (the StagingCache surface, now lock-correct) ---------------
     def stage(self, segment, lease: Optional[QueryLease] = None
@@ -219,36 +224,46 @@ class ResidencyManager:
         duplicate device arrays and leaked one set until GC). A reloaded
         segment (same name, new object) invalidates the stale resident —
         identity check, same guard as before."""
-        name = segment.segment_name
         with self._lock:
-            e = self._entries.get(name)
-            if e is not None and isinstance(e.resident, StagedSegment) \
-                    and e.resident.segment is segment:
-                self._entries.move_to_end(name)
-                self.hits += 1
-                if lease is not None:
-                    lease.hits += 1
-                self._mark("STAGING_HITS")
-            else:
-                if e is not None:  # identity change: drop stale arrays
-                    del self._entries[name]
-                    e.resident.release()
-                e = _Entry(StagedSegment(segment,
-                                         borrower=self.column_borrower))
-                self._entries[name] = e
-                self.misses += 1
-                if lease is not None:
-                    lease.misses += 1
-                self._mark("STAGING_MISSES")
-            self._pin_locked(name, e, lease)
-            self._enforce_locked(lease)
-            return e.resident
+            resident, doomed = self._stage_locked(segment, lease)
+        self._release_all(doomed)
+        return resident
+
+    def _stage_locked(self, segment, lease: Optional[QueryLease]):
+        """Get-or-create under ``_lock`` (caller holds it). Returns
+        ``(resident, doomed)``; the caller releases ``doomed`` after
+        dropping the lock."""
+        name = segment.segment_name
+        doomed: List[Any] = []
+        e = self._entries.get(name)
+        if e is not None and isinstance(e.resident, StagedSegment) \
+                and e.resident.segment is segment:
+            self._entries.move_to_end(name)
+            self.hits += 1
+            if lease is not None:
+                lease.hits += 1
+            self._mark("STAGING_HITS")
+        else:
+            if e is not None:  # identity change: drop stale arrays
+                del self._entries[name]
+                doomed.append(e.resident)
+            e = _Entry(StagedSegment(segment,
+                                     borrower=self.column_borrower))
+            self._entries[name] = e
+            self.misses += 1
+            if lease is not None:
+                lease.misses += 1
+            self._mark("STAGING_MISSES")
+        self._pin_locked(name, e, lease)
+        doomed += self._enforce_locked(lease)
+        return e.resident, doomed
 
     def register(self, name: str, make_resident, same=None,
                  lease: Optional[QueryLease] = None):
         """Generic get-or-create for non-segment residents (sharded batch
         device-column sets). ``make_resident()`` builds on miss; ``same(r)``
         says whether the cached resident is still current."""
+        doomed: List[Any] = []
         with self._lock:
             e = self._entries.get(name)
             if e is not None and (same is None or same(e.resident)):
@@ -260,7 +275,7 @@ class ResidencyManager:
             else:
                 if e is not None:
                     del self._entries[name]
-                    e.resident.release()
+                    doomed.append(e.resident)
                 e = _Entry(make_resident())
                 self._entries[name] = e
                 self.misses += 1
@@ -268,7 +283,9 @@ class ResidencyManager:
                     lease.misses += 1
                 self._mark("STAGING_MISSES")
             self._pin_locked(name, e, lease)
-            return e.resident
+            resident = e.resident
+        self._release_all(doomed)
+        return resident
 
     def _pin_locked(self, name: str, e: _Entry,
                     lease: Optional[QueryLease]) -> None:
@@ -281,19 +298,28 @@ class ResidencyManager:
         """Re-measure one resident (its arrays were staged after admission)
         and enforce the budget."""
         with self._lock:
-            self._enforce_locked(lease)
+            doomed = self._enforce_locked(lease)
+        self._release_all(doomed)
 
     def evict(self, name: str) -> None:
         """Explicit eviction (segment unassigned / reloaded). In-flight
         queries keep their arrays alive through python refs; XLA frees the
-        HBM when the last ref drops."""
+        HBM when the last ref drops. Bumps the retire generation so queued
+        prefetches of the removed segment become no-ops."""
         with self._lock:
+            self._retired[name] = self._retired.get(name, 0) + 1
             e = self._entries.pop(name, None)
             if e is not None:
-                e.resident.release()
                 self.evictions += 1
                 self._mark("STAGING_EVICTIONS")
                 self._refresh_locked()
+        if e is not None:
+            # outside the lock: a resident's release may take its own lock
+            # (StagedSegment serializing against in-flight column builds) or
+            # re-enter the manager (batch residents clearing executor
+            # caches) — lock order is always manager -> resident, held
+            # never-both on the release path
+            e.resident.release()
 
     def note_borrow(self, batch_name: str) -> None:
         """A per-segment staging built a column FROM a resident batch's
@@ -315,10 +341,22 @@ class ResidencyManager:
 
     def clear(self) -> None:
         with self._lock:
-            for e in self._entries.values():
-                e.resident.release()
+            doomed = [e.resident for e in self._entries.values()]
             self._entries.clear()
             self._staged_bytes = 0
+        self._release_all(doomed)
+
+    def _release_all(self, doomed: List[Any]) -> None:
+        """Release evicted residents AFTER the manager lock is dropped:
+        ``release()`` may acquire the resident's own lock, whose holders
+        re-enter the manager (column borrower -> ``note_borrow``) — calling
+        it under ``_lock`` is the A->B/B->A inversion the lint gate exists
+        to catch."""
+        for r in doomed:
+            try:
+                r.release()
+            except Exception:
+                log.exception("resident release failed")
 
     # -- query protocol ------------------------------------------------------
     def begin_query(self, segments: List[Any],
@@ -367,8 +405,9 @@ class ResidencyManager:
                 if e is not None and e.pins > 0:
                     e.pins -= 1
             lease._pinned.clear()
-            self._enforce_locked(lease)
+            doomed = self._enforce_locked(lease)
             staged = self._staged_bytes
+        self._release_all(doomed)
         if stats is not None:
             stats.staging = lease.staging_dict(staged)
 
@@ -385,11 +424,17 @@ class ResidencyManager:
         if total > self._peak_bytes:
             self._peak_bytes = total
 
-    def _enforce_locked(self, lease: Optional[QueryLease] = None) -> None:
+    def _enforce_locked(self, lease: Optional[QueryLease] = None
+                        ) -> List[Any]:
+        """LRU-evict unpinned residents until the budget fits. Returns the
+        evicted residents — the CALLER releases them after dropping
+        ``_lock`` (see ``_release_all``); their bytes are already out of
+        the accounting here."""
         self._refresh_locked()
         budget = self.budget_bytes
         if budget is None:
-            return
+            return []
+        doomed: List[Any] = []
         total = self._staged_bytes
         for name in list(self._entries):
             if total <= budget:
@@ -406,16 +451,18 @@ class ResidencyManager:
                 continue
             del self._entries[name]
             total -= e.nbytes
-            e.resident.release()
+            doomed.append(e.resident)
             self.evictions += 1
             if lease is not None:
                 lease.evictions += 1
             self._mark("STAGING_EVICTIONS")
         self._staged_bytes = total
+        return doomed
 
     def enforce(self) -> None:
         with self._lock:
-            self._enforce_locked()
+            doomed = self._enforce_locked()
+        self._release_all(doomed)
 
     # -- prefetch ------------------------------------------------------------
     def prefetch(self, segment, columns: Optional[List[str]] = None) -> None:
@@ -426,13 +473,16 @@ class ResidencyManager:
         if self._closed or getattr(segment, "is_mutable", False):
             return
         with self._lock:
+            # snapshot the retire generation under the same lock evict()
+            # bumps it: the queued item is valid only for this generation
+            gen = self._retired.get(segment.segment_name, 0)
             if self._prefetch_thread is None:
                 self._prefetch_q = queue.Queue()
                 self._prefetch_thread = threading.Thread(
                     target=self._prefetch_loop, daemon=True,
                     name="hbm-prefetch")
                 self._prefetch_thread.start()
-        self._prefetch_q.put((segment, columns))
+        self._prefetch_q.put((segment, columns, gen))
 
     def _prefetch_loop(self) -> None:
         while True:
@@ -440,32 +490,55 @@ class ResidencyManager:
             try:
                 if item is _STOP:
                     return
-                segment, columns = item
-                self._prefetch_one(segment, columns)
+                segment, columns, gen = item
+                self._prefetch_one(segment, columns, gen)
             except Exception:
                 log.exception("prefetch failed")
             finally:
                 self._prefetch_q.task_done()
 
-    def _prefetch_one(self, segment, columns: Optional[List[str]]) -> None:
+    def _prefetch_one(self, segment, columns: Optional[List[str]],
+                      gen: int) -> None:
         budget = self.budget_bytes
+        name = segment.segment_name
         if columns is None:
             columns = list(segment.metadata.columns.keys())
-        staged = self.stage(segment)
-        for name in columns:
+        with self._lock:
+            # a removeSegment that landed while this item sat in the queue
+            # must win: staging now would resurrect the evicted segment.
+            # Check + stage are one atomic step against evict(); the doomed
+            # list still gets released only after the lock drops.
+            if self._retired.get(name, 0) != gen:
+                return
+            staged, doomed = self._stage_locked(segment, None)
+        self._release_all(doomed)
+        for cname in columns:
             if budget is not None:
                 with self._lock:
                     self._refresh_locked()
                     if self._staged_bytes >= budget:
                         return  # best-effort: never evict for a prefetch
             try:
-                staged.column(name)
+                staged.column(cname)
             except Exception:
-                log.debug("prefetch of column %r skipped", name,
+                log.debug("prefetch of column %r skipped", cname,
                           exc_info=True)
-        self.prefetched += 1
+        orphaned = None
         with self._lock:
-            self._refresh_locked()
+            if self._retired.get(name, 0) != gen:
+                # evicted while columns were staging: the entry is already
+                # gone from _entries (no orphaned resident, no stale bytes
+                # in accounting) — drop our device arrays eagerly instead
+                # of waiting for GC. A re-added segment owns a NEW resident
+                # (stage() identity check), never this one.
+                e = self._entries.get(name)
+                if e is None or e.resident is not staged:
+                    orphaned = staged
+            else:
+                self.prefetched += 1
+                self._refresh_locked()
+        if orphaned is not None:
+            orphaned.release()
 
     def drain_prefetch(self) -> None:
         """Block until queued prefetches finish (tests / warm-up hooks)."""
@@ -483,14 +556,15 @@ class ResidencyManager:
         """Attach a MetricsRegistry: staged/budget byte gauges + event
         meters (spi/metrics.py ServerMeter.STAGING_*)."""
         self._metrics = registry
+        # gauge lambdas run on scrape threads: only locked accessors here
         registry.gauge("staging_staged_bytes",
                        lambda: float(self.staged_bytes()))
         registry.gauge("staging_peak_bytes",
-                       lambda: float(self._peak_bytes))
+                       lambda: float(self.peak_bytes))
         registry.gauge("staging_budget_bytes",
                        lambda: float(self.budget_bytes or 0))
         registry.gauge("staging_resident_segments",
-                       lambda: float(len(self._entries)))
+                       lambda: float(self.resident_count()))
 
     def _mark(self, name: Optional[str]) -> None:
         self._mark_n(name, 1)
@@ -511,7 +585,12 @@ class ResidencyManager:
 
     @property
     def peak_bytes(self) -> int:
-        return self._peak_bytes
+        with self._lock:
+            return self._peak_bytes
+
+    def resident_count(self) -> int:
+        with self._lock:
+            return len(self._entries)
 
     def resident_names(self) -> List[str]:
         with self._lock:

@@ -107,10 +107,12 @@ class StagedSegment:
         self.segment = segment
         self.num_docs = segment.num_docs
         self.capacity = segment.padded_capacity
-        self._columns: Dict[str, StagedColumn] = {}
-        self._packed: Dict[str, PackedColumn] = {}
-        self._values: Dict[str, jnp.ndarray] = {}
-        self._valid_cache = None
+        # writes-only guard: double-checked locking — reads are deliberate
+        # lock-free dict gets (atomic under the GIL), builds serialize
+        self._columns: Dict[str, StagedColumn] = {}  # guarded-by-writes: _lock
+        self._packed: Dict[str, PackedColumn] = {}  # guarded-by-writes: _lock
+        self._values: Dict[str, jnp.ndarray] = {}  # guarded-by-writes: _lock
+        self._valid_cache = None  # guarded-by-writes: _lock
         self._lock = threading.Lock()
         # cross-query dedup hook: ``borrower(segment, name)`` may return a
         # StagedColumn built from a resident sharded batch's device copy of
@@ -251,7 +253,8 @@ class StagedSegment:
         if ver is None:
             return snap
         arr = jnp.asarray(snap)
-        self._valid_cache = (ver, arr)
+        with self._lock:
+            self._valid_cache = (ver, arr)
         return arr
 
     def nbytes(self) -> int:
@@ -272,11 +275,15 @@ class StagedSegment:
         return total
 
     def release(self) -> None:
-        """Drop device references (HBM freed when XLA GCs the buffers)."""
-        self._columns.clear()
-        self._packed.clear()
-        self._values.clear()
-        self._valid_cache = None
+        """Drop device references (HBM freed when XLA GCs the buffers).
+        Locked against in-flight column builds: a build completing after
+        the clear would re-insert into a released segment (its arrays are
+        then invisible to the residency accounting until GC)."""
+        with self._lock:
+            self._columns.clear()
+            self._packed.clear()
+            self._values.clear()
+            self._valid_cache = None
 
 
 # The HBM residency manager subsumed the old unbounded StagingCache

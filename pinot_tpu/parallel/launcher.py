@@ -83,7 +83,7 @@ class LaunchKernel:
         # flips False on the first vmapped failure; the group then runs
         # serially forever (correctness over throughput)
         self.batchable = self.max_batch > 1
-        self._vmapped: Dict[int, Any] = {}
+        self._vmapped: Dict[int, Any] = {}  # guarded-by: _lock
         self._lock = threading.Lock()
 
     def run_one(self, params, num_docs):
@@ -142,24 +142,28 @@ class LaunchScheduler:
 
     def __init__(self, name: str = "combine-launch"):
         self._name = name
-        self._queue: "deque[_LaunchRequest]" = deque()
+        # writes-only guard: queue-depth gauges read len() lock-free
+        # (GIL-atomic), mutation stays on the condition
+        self._queue: "deque[_LaunchRequest]" = deque()  # guarded-by-writes: _cond
         self._cond = threading.Condition()
-        self._thread: Optional[threading.Thread] = None
-        self._closed = False
+        self._thread: Optional[threading.Thread] = None  # guarded-by-writes: _cond
+        self._closed = False  # guarded-by: _cond
         # cumulative counters (process lifetime; bench suites diff
-        # stats_snapshot() marks, /debug/launches serves snapshot())
+        # stats_snapshot() marks, /debug/launches serves snapshot()).
+        # Writes-only guard: gauge lambdas read single counters lock-free;
+        # stats_snapshot() takes the lock for a consistent cut.
         self._stats_lock = threading.Lock()
-        self.requests = 0
-        self.launches = 0
-        self.coalesced_launches = 0
-        self.launches_saved = 0
-        self.deduped_requests = 0
-        self.batched_requests = 0
-        self.failures = 0
-        self.max_batch_size = 0
-        self.queue_wait_ms_total = 0.0
-        self.queue_wait_ms_max = 0.0
-        self._registries: List[Any] = []
+        self.requests = 0  # guarded-by-writes: _stats_lock
+        self.launches = 0  # guarded-by-writes: _stats_lock
+        self.coalesced_launches = 0  # guarded-by-writes: _stats_lock
+        self.launches_saved = 0  # guarded-by-writes: _stats_lock
+        self.deduped_requests = 0  # guarded-by-writes: _stats_lock
+        self.batched_requests = 0  # guarded-by-writes: _stats_lock
+        self.failures = 0  # guarded-by-writes: _stats_lock
+        self.max_batch_size = 0  # guarded-by-writes: _stats_lock
+        self.queue_wait_ms_total = 0.0  # guarded-by-writes: _stats_lock
+        self.queue_wait_ms_max = 0.0  # guarded-by-writes: _stats_lock
+        self._registries: List[Any] = []  # guarded-by-writes: _stats_lock
 
     # -- submission ----------------------------------------------------------
     def submit(self, kernel: LaunchKernel, params, num_docs) -> _LaunchRequest:
@@ -167,7 +171,9 @@ class LaunchScheduler:
         with self._cond:
             if self._closed:
                 raise RuntimeError(f"launch scheduler {self._name} is closed")
-            if self._thread is None:
+            if self._thread is None or not self._thread.is_alive():
+                # also revives a dispatcher a defensive-coded bug killed:
+                # queued waiters must never hang on a dead thread
                 self._thread = threading.Thread(
                     target=self._loop, daemon=True, name=self._name)
                 self._thread.start()
@@ -200,7 +206,18 @@ class LaunchScheduler:
             for req in drained:
                 groups.setdefault(req.kernel.key, []).append(req)
             for reqs in groups.values():
-                self._launch_group(reqs)
+                # a failure escaping _launch_group (import error, a bug in
+                # the grouping itself) must still complete every waiter's
+                # future — the alternative is N client threads hung forever
+                # on a dead dispatcher
+                try:
+                    self._launch_group(reqs)
+                except BaseException as e:  # noqa: BLE001
+                    log.exception("launch group failed outside the "
+                                  "per-request paths")
+                    for r in reqs:
+                        if not r.future.done():
+                            r.future.set_exception(e)
 
     def _launch_group(self, reqs: List[_LaunchRequest]) -> None:
         import jax

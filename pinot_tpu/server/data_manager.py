@@ -74,7 +74,7 @@ class SegmentDataManager:
 
     def __init__(self, segment: Any):
         self.segment = segment
-        self._refcount = 1
+        self._refcount = 1  # guarded-by: _lock
         self._lock = threading.Lock()
 
     @property
@@ -117,7 +117,7 @@ class TableDataManager:
     def __init__(self, table_name_with_type: str, listener: Any = None):
         self.table_name = table_name_with_type
         self.listener = listener
-        self._segments: Dict[str, SegmentDataManager] = {}
+        self._segments: Dict[str, SegmentDataManager] = {}  # guarded-by: _lock
         self._lock = threading.Lock()
 
     def _notify(self, method: str, *args) -> None:
@@ -201,7 +201,9 @@ class RealtimeTableDataManager(TableDataManager):
     def __init__(self, table_name_with_type: str, upsert_manager=None,
                  listener: Any = None):
         super().__init__(table_name_with_type, listener=listener)
-        self._consumers: Dict[str, RealtimeSegmentDataManager] = {}
+        # the base class __init__ created _lock; guarded-by resolves
+        # through the inheritance chain
+        self._consumers: Dict[str, RealtimeSegmentDataManager] = {}  # guarded-by: _lock
         self.upsert_manager = upsert_manager  # TableUpsertMetadataManager
 
     def add_consuming(self, mgr: RealtimeSegmentDataManager) -> None:
@@ -285,7 +287,7 @@ class InstanceDataManager:
     (ref: HelixInstanceDataManager.java:74)."""
 
     def __init__(self, listener: Any = None):
-        self._tables: Dict[str, TableDataManager] = {}
+        self._tables: Dict[str, TableDataManager] = {}  # guarded-by: _lock
         self._lock = threading.Lock()
         self.listener = listener  # forwarded to created TableDataManagers
 

@@ -92,8 +92,8 @@ class QueryScheduler:
 
     def __init__(self, num_workers: int = 8, name: str = "query"):
         self._pool = _DaemonPool(num_workers, name)
-        self._accepting = True
-        self._inflight = 0
+        self._accepting = True  # guarded-by: _lock
+        self._inflight = 0  # guarded-by: _lock
         self._lock = threading.Lock()
         self._drained = threading.Condition(self._lock)
 
@@ -144,7 +144,8 @@ class TokenBucketScheduler(QueryScheduler):
         super().__init__(num_workers, name="tb-query")
         self._rate = tokens_per_second
         self._burst = burst
-        self._buckets: Dict[str, tuple] = {}  # table -> (tokens, last_ts)
+        # table -> (tokens, last_ts)
+        self._buckets: Dict[str, tuple] = {}  # guarded-by: _bucket_lock
         self._bucket_lock = threading.Lock()
 
     def _take_token(self, table: str) -> float:
@@ -184,15 +185,15 @@ class PriorityScheduler(QueryScheduler):
                  table_priorities: Optional[Dict[str, float]] = None):
         # intentionally does NOT call super().__init__: this scheduler owns
         # its queues instead of a shared _DaemonPool queue
-        self._accepting = True
-        self._inflight = 0
+        self._accepting = True  # guarded-by: _lock
+        self._inflight = 0  # guarded-by: _lock
         self._lock = threading.Lock()
         self._drained = threading.Condition(self._lock)
         self._priorities = dict(table_priorities or {})
-        self._queues: Dict[str, "queue.Queue"] = {}
-        self._costs: Dict[str, float] = {}
+        self._queues: Dict[str, "queue.Queue"] = {}  # guarded-by: _lock
+        self._costs: Dict[str, float] = {}  # guarded-by: _lock
         self._available = threading.Semaphore(0)
-        self._stop = False
+        self._stop = False  # guarded-by: _lock
         self._threads = [
             threading.Thread(target=self._work, daemon=True,
                              name=f"prio-query-{i}")
@@ -200,8 +201,10 @@ class PriorityScheduler(QueryScheduler):
         for t in self._threads:
             t.start()
 
-    def _pick_table(self) -> Optional[str]:
-        """Lowest weighted cost wins (the multi-level 'wakeup' choice)."""
+    def _pick_table_locked(self) -> Optional[str]:
+        """Lowest weighted cost wins (the multi-level 'wakeup' choice).
+        Caller holds ``_lock`` (the ``_locked`` suffix is the lint
+        convention for that contract)."""
         best, best_score = None, None
         for table, q in self._queues.items():
             if q.empty():
@@ -219,7 +222,7 @@ class PriorityScheduler(QueryScheduler):
                 if self._stop and all(q.empty()
                                       for q in self._queues.values()):
                     return
-                table = self._pick_table()
+                table = self._pick_table_locked()
                 if table is None:
                     continue
                 fut, fn = self._queues[table].get_nowait()

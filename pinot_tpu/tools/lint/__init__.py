@@ -1,0 +1,35 @@
+"""graftlint: AST-based invariant checker for the pinot_tpu codebase.
+
+Four checker families, each born from a bug an advisor had to find by hand
+(ISSUE 4; the PR-2 ``stage()`` get-then-set race, the ``_evict_batch`` key
+bug, the lazy CRC32C table race):
+
+- ``lock-guard`` / ``lock-order`` (locks.py): ``# guarded-by: <lock>``
+  annotated fields must only be touched under ``with self.<lock>``; the
+  cross-module lock-acquisition graph must be free of A->B / B->A
+  inversions.
+- ``pairing`` (pairing.py): ``begin_query``/``end_query``,
+  ``acquire_segments``/``release_segments`` and refcount
+  ``acquire``/``release`` must pair through a ``finally`` (or context
+  manager) on every path.
+- ``tracer`` (tracer.py): functions reachable from ``jax.jit`` / ``vmap`` /
+  ``shard_map`` / ``pallas_call`` roots must not call host-side
+  nondeterminism (``time.*``, ``threading.*``, ``random.*``, I/O,
+  ``.item()``, global mutation).
+- ``wire`` / ``config`` (wire.py): every ``QueryStats`` field must ride the
+  DataTable wire (``to_dict`` / ``merge`` / ``_stats_from_dict``); every
+  ``pinot.server.*`` / ``pinot.broker.*`` key string must be declared in
+  ``spi/config.py``'s ``CommonConstants``.
+
+Pure stdlib ``ast`` — importing this package must never pull jax or the
+engine (the CLI runs in CI before anything else).
+"""
+
+from pinot_tpu.tools.lint.core import (
+    Finding,
+    LintContext,
+    load_baseline,
+    run_lint,
+)
+
+__all__ = ["Finding", "LintContext", "load_baseline", "run_lint"]

@@ -1,0 +1,54 @@
+"""``python -m pinot_tpu.tools.lint [--baseline FILE] [paths...]``
+
+Runs all four checker families and exits non-zero on any finding not
+covered by the baseline (or an inline ``# lint: ignore[...]``). With no
+paths, lints the whole ``pinot_tpu`` package. Stdlib-only: safe to run
+before the environment can import jax.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+from pinot_tpu.tools.lint.core import DEFAULT_BASELINE, run_lint
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m pinot_tpu.tools.lint",
+        description="AST invariant checker: lock discipline, lease "
+                    "pairing, tracer safety, wire/config consistency.")
+    ap.add_argument("paths", nargs="*",
+                    help="files or directories to lint "
+                         "(default: the pinot_tpu package)")
+    ap.add_argument("--baseline", default=DEFAULT_BASELINE,
+                    help="baseline JSON of accepted finding keys "
+                         "(default: tools/lint/baseline.json)")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="report every finding, ignoring the baseline")
+    ap.add_argument("--keys", action="store_true",
+                    help="print baseline keys instead of messages "
+                         "(for composing baseline entries)")
+    args = ap.parse_args(argv)
+
+    paths = args.paths
+    if not paths:
+        import pinot_tpu
+
+        paths = [os.path.dirname(os.path.abspath(pinot_tpu.__file__))]
+
+    baseline = None if args.no_baseline else args.baseline
+    new, accepted = run_lint(paths, baseline=baseline)
+    for f in new:
+        print(f.key if args.keys else f.render())
+    n_sup = len(accepted)
+    print(f"graftlint: {len(new)} finding(s)"
+          + (f", {n_sup} baselined/suppressed" if n_sup else ""),
+          file=sys.stderr)
+    return 1 if new else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,229 @@
+"""Lint core: module model, finding model, checker registry, baseline.
+
+A *finding* carries a stable ``key`` (``checker:path:symbol`` — no line
+numbers, so unrelated edits don't churn the baseline) plus the file:line
+for humans. Suppression, in priority order:
+
+- inline: a ``# lint: ignore[<checker>] — reason`` comment on the flagged
+  line (use sparingly; the reason is part of the convention);
+- baseline: an entry in the checked-in baseline JSON
+  (``tools/lint/baseline.json``), each with a mandatory ``reason`` —
+  the accepted-violation set, ideally empty.
+"""
+
+from __future__ import annotations
+
+import ast
+import io
+import json
+import os
+import re
+import tokenize
+
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+_IGNORE_RE = re.compile(r"lint:\s*ignore\[([a-z\-,\s]+)\]")
+
+DEFAULT_BASELINE = os.path.join(os.path.dirname(__file__), "baseline.json")
+
+
+@dataclass(frozen=True)
+class Finding:
+    checker: str   # lock-guard | lock-order | pairing | tracer | wire | config
+    path: str      # path as scanned (relative when the scan root is)
+    line: int
+    symbol: str    # stable anchor: Class.field:method, func qualname, key...
+    message: str
+
+    @property
+    def key(self) -> str:
+        return f"{self.checker}:{self.path}:{self.symbol}"
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: [{self.checker}] {self.message}"
+
+
+class Module:
+    """One parsed source file: AST + raw lines + comment map (the AST drops
+    comments, and ``# guarded-by:`` / ``# lint: ignore`` live in them)."""
+
+    def __init__(self, path: str, relpath: str, source: str):
+        self.path = path
+        self.relpath = relpath
+        self.source = source
+        self.tree = ast.parse(source, filename=path)
+        self.lines = source.splitlines()
+        self.comments: Dict[int, str] = {}
+        try:
+            for tok in tokenize.generate_tokens(
+                    io.StringIO(source).readline):
+                if tok.type == tokenize.COMMENT:
+                    self.comments[tok.start[0]] = \
+                        tok.string.lstrip("#").strip()
+        except (tokenize.TokenError, IndentationError):  # partial map is ok
+            pass
+
+    def comment_in_range(self, lo: int, hi: int,
+                         pattern: "re.Pattern") -> Optional["re.Match"]:
+        """First comment line in [lo, hi] matching ``pattern`` (multi-line
+        statements carry their annotation on any of their lines)."""
+        for ln in range(lo, hi + 1):
+            c = self.comments.get(ln)
+            if c:
+                m = pattern.search(c)
+                if m:
+                    return m
+        return None
+
+    def ignored(self, line: int, checker: str) -> bool:
+        m = _IGNORE_RE.search(self.comments.get(line, ""))
+        if m is None:
+            return False
+        names = {s.strip() for s in m.group(1).split(",")}
+        return checker in names or "all" in names
+
+
+class LintContext:
+    """Everything a checker sees: the parsed modules, keyed by relpath."""
+
+    def __init__(self, modules: List[Module]):
+        self.modules = modules
+        self.by_path: Dict[str, Module] = {m.relpath: m for m in modules}
+
+    def module_of(self, relpath: str) -> Optional[Module]:
+        return self.by_path.get(relpath)
+
+
+# -- registry ---------------------------------------------------------------
+
+CheckFn = Callable[[LintContext], List[Finding]]
+_CHECKERS: List[Tuple[str, CheckFn]] = []
+
+
+def register(name: str):
+    def deco(fn: CheckFn) -> CheckFn:
+        _CHECKERS.append((name, fn))
+        return fn
+    return deco
+
+
+def checker_names() -> List[str]:
+    _load_checkers()
+    return [n for n, _ in _CHECKERS]
+
+
+_LOADED = False
+
+
+def _load_checkers() -> None:
+    """Import the checker modules exactly once (registration side effect)."""
+    global _LOADED
+    if _LOADED:
+        return
+    from pinot_tpu.tools.lint import locks, pairing, tracer, wire  # noqa: F401
+    _LOADED = True
+
+
+# -- file collection --------------------------------------------------------
+
+def _collect_files(paths: Sequence[str]) -> List[Tuple[str, str]]:
+    """-> [(abspath, display path)], deterministic order."""
+    out: List[Tuple[str, str]] = []
+    for p in paths:
+        if os.path.isfile(p):
+            out.append((p, os.path.basename(p)))
+            continue
+        for root, dirs, files in os.walk(p):
+            dirs[:] = sorted(d for d in dirs
+                             if d != "__pycache__" and not d.startswith("."))
+            for f in sorted(files):
+                if f.endswith(".py"):
+                    ap = os.path.join(root, f)
+                    out.append((ap, os.path.relpath(ap, os.path.dirname(p))))
+    return out
+
+
+def load_modules(paths: Sequence[str]) -> Tuple[LintContext, List[Finding]]:
+    modules: List[Module] = []
+    findings: List[Finding] = []
+    for ap, rel in _collect_files(paths):
+        try:
+            with open(ap, encoding="utf-8") as f:
+                src = f.read()
+            modules.append(Module(ap, rel, src))
+        except SyntaxError as e:
+            findings.append(Finding(
+                "parse", rel, e.lineno or 0, "syntax",
+                f"cannot parse: {e.msg}"))
+    return LintContext(modules), findings
+
+
+# -- baseline ---------------------------------------------------------------
+
+def load_baseline(path: Optional[str]) -> Dict[str, str]:
+    """-> {finding key: reason}. Missing file = empty baseline."""
+    if not path or not os.path.exists(path):
+        return {}
+    with open(path, encoding="utf-8") as f:
+        data = json.load(f)
+    out: Dict[str, str] = {}
+    for e in data.get("entries", []):
+        out[e["key"]] = e.get("reason", "")
+    return out
+
+
+# -- runner -----------------------------------------------------------------
+
+def run_lint(paths: Sequence[str], baseline: Optional[str] = None
+             ) -> Tuple[List[Finding], List[Finding]]:
+    """Run every registered checker over ``paths``.
+
+    Returns ``(new, accepted)``: findings not covered by the baseline, and
+    findings the baseline (or an inline ignore) covers. Exit policy is the
+    caller's (the CLI exits non-zero iff ``new`` is non-empty).
+    """
+    _load_checkers()
+    ctx, findings = load_modules(paths)
+    for _name, fn in _CHECKERS:
+        findings.extend(fn(ctx))
+    findings.sort(key=lambda f: (f.path, f.line, f.checker, f.symbol))
+
+    accepted_keys = load_baseline(baseline)
+    new: List[Finding] = []
+    accepted: List[Finding] = []
+    for f in findings:
+        mod = ctx.module_of(f.path)
+        if mod is not None and mod.ignored(f.line, f.checker):
+            accepted.append(f)
+        elif f.key in accepted_keys:
+            accepted.append(f)
+        else:
+            new.append(f)
+    return new, accepted
+
+
+# -- shared AST helpers (used by several checkers) --------------------------
+
+def call_name(node: ast.Call) -> Optional[str]:
+    """Last path segment of the called thing: ``a.b.c(...)`` -> 'c'."""
+    f = node.func
+    if isinstance(f, ast.Attribute):
+        return f.attr
+    if isinstance(f, ast.Name):
+        return f.id
+    return None
+
+
+def attr_base_name(node: ast.expr) -> Optional[str]:
+    """Root Name of an attribute chain: ``a.b.c`` -> 'a'; None otherwise."""
+    while isinstance(node, ast.Attribute):
+        node = node.value
+    return node.id if isinstance(node, ast.Name) else None
+
+
+def is_self_attr(node: ast.expr, attr: Optional[str] = None) -> bool:
+    return (isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "self"
+            and (attr is None or node.attr == attr))

@@ -1,0 +1,182 @@
+"""Schema/config consistency: string-level drift catchers.
+
+``wire``: every public field of the ``QueryStats`` dataclass must
+
+- be referenced (``self.<field>``) in ``QueryStats.to_dict`` — the wire
+  serialization both framings share,
+- be referenced (``other.<field>``) in ``QueryStats.merge`` — the
+  cross-segment/shard/server combine,
+- appear as a keyword in ``DataTable._stats_from_dict`` — the decode side,
+
+so "added a stat, forgot the wire" fails lint instead of silently dropping
+the stat at the first broker hop. The launcher's ``LAUNCH_MAX_KEYS``
+(merge-by-max stat keys) must each appear as a literal inside
+``QueryStats.merge`` — the two modules encode the same semantics.
+
+``config``: every ``pinot.server.*`` / ``pinot.broker.*`` string literal
+anywhere in the scanned tree must be a declared constant value in
+``CommonConstants`` (spi/config.py) — undeclared keys are typo'd or
+undocumented knobs.
+
+Both passes no-op when the anchor class isn't in the scanned file set
+(fixture runs), so they stay usable on arbitrary paths.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from typing import Dict, List, Optional, Set, Tuple
+
+from pinot_tpu.tools.lint.core import (
+    Finding,
+    LintContext,
+    Module,
+    register,
+)
+
+CONFIG_KEY_RE = re.compile(r"^pinot\.(server|broker)\.")
+
+
+def _find_class(ctx: LintContext, name: str
+                ) -> Optional[Tuple[Module, ast.ClassDef]]:
+    for mod in ctx.modules:
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.ClassDef) and node.name == name:
+                return (mod, node)
+    return None
+
+
+def _method(cls: ast.ClassDef, name: str) -> Optional[ast.FunctionDef]:
+    for n in cls.body:
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and n.name == name:
+            return n
+    return None
+
+
+def _attr_refs(fn: ast.AST, base: str) -> Set[str]:
+    """Attribute names read off ``base`` (e.g. 'self' or 'other')."""
+    out: Set[str] = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Attribute) \
+                and isinstance(node.value, ast.Name) \
+                and node.value.id == base:
+            out.add(node.attr)
+    return out
+
+
+def _dataclass_fields(cls: ast.ClassDef) -> List[Tuple[str, int]]:
+    out: List[Tuple[str, int]] = []
+    for n in cls.body:
+        if isinstance(n, ast.AnnAssign) and isinstance(n.target, ast.Name) \
+                and not n.target.id.startswith("_"):
+            out.append((n.target.id, n.lineno))
+    return out
+
+
+@register("wire")
+def check_wire(ctx: LintContext) -> List[Finding]:
+    findings: List[Finding] = []
+    hit = _find_class(ctx, "QueryStats")
+    if hit is None:
+        return findings
+    mod, cls = hit
+    fields = _dataclass_fields(cls)
+    to_dict = _method(cls, "to_dict")
+    merge = _method(cls, "merge")
+    ser_refs = _attr_refs(to_dict, "self") if to_dict else set()
+    merge_refs = (_attr_refs(merge, "other") | _attr_refs(merge, "self")) \
+        if merge else set()
+
+    decode_kwargs: Optional[Set[str]] = None
+    decode_loc: Tuple[str, int] = (mod.relpath, cls.lineno)
+    for m2 in ctx.modules:
+        for node in ast.walk(m2.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and node.name == "_stats_from_dict":
+                decode_kwargs = set()
+                for sub in ast.walk(node):
+                    if isinstance(sub, ast.Call):
+                        fname = sub.func.id if isinstance(sub.func, ast.Name)\
+                            else getattr(sub.func, "attr", None)
+                        if fname == "QueryStats":
+                            decode_kwargs |= {k.arg for k in sub.keywords
+                                              if k.arg}
+                decode_loc = (m2.relpath, node.lineno)
+
+    for field, line in fields:
+        if to_dict is not None and field not in ser_refs:
+            findings.append(Finding(
+                "wire", mod.relpath, line, f"QueryStats.{field}:to_dict",
+                f"QueryStats.{field} is not serialized in to_dict() — "
+                f"the stat never reaches the DataTable wire"))
+        if merge is not None and field not in merge_refs:
+            findings.append(Finding(
+                "wire", mod.relpath, line, f"QueryStats.{field}:merge",
+                f"QueryStats.{field} is not combined in merge() — "
+                f"the stat is dropped at segment/shard/server merge"))
+        if decode_kwargs is not None and field not in decode_kwargs:
+            findings.append(Finding(
+                "wire", decode_loc[0], decode_loc[1],
+                f"QueryStats.{field}:_stats_from_dict",
+                f"QueryStats.{field} is not decoded in "
+                f"_stats_from_dict() — the stat is lost on receive"))
+
+    # LAUNCH_MAX_KEYS <-> merge() literal agreement (launcher vs results)
+    if merge is not None:
+        for m2 in ctx.modules:
+            for node in m2.tree.body:
+                if isinstance(node, ast.Assign) \
+                        and any(isinstance(t, ast.Name)
+                                and t.id == "LAUNCH_MAX_KEYS"
+                                for t in node.targets) \
+                        and isinstance(node.value, (ast.Tuple, ast.List)):
+                    keys = [e.value for e in node.value.elts
+                            if isinstance(e, ast.Constant)
+                            and isinstance(e.value, str)]
+                    merge_lits = {n.value for n in ast.walk(merge)
+                                  if isinstance(n, ast.Constant)
+                                  and isinstance(n.value, str)}
+                    for k in keys:
+                        if k not in merge_lits:
+                            findings.append(Finding(
+                                "wire", m2.relpath, node.lineno,
+                                f"LAUNCH_MAX_KEYS.{k}",
+                                f"LAUNCH_MAX_KEYS entry {k!r} is not a "
+                                f"max-merged key in QueryStats.merge() — "
+                                f"launcher and results disagree on merge "
+                                f"semantics"))
+    return findings
+
+
+@register("config")
+def check_config(ctx: LintContext) -> List[Finding]:
+    findings: List[Finding] = []
+    hit = _find_class(ctx, "CommonConstants")
+    if hit is None:
+        return findings
+    _mod, cls = hit
+    declared: Set[str] = set()
+    for n in cls.body:
+        if isinstance(n, ast.Assign) and isinstance(n.value, ast.Constant) \
+                and isinstance(n.value.value, str):
+            declared.add(n.value.value)
+
+    seen: Set[str] = set()
+    for mod in ctx.modules:
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Constant) \
+                    and isinstance(node.value, str) \
+                    and CONFIG_KEY_RE.match(node.value) \
+                    and node.value not in declared:
+                if node.value in seen:
+                    continue
+                seen.add(node.value)
+                findings.append(Finding(
+                    "config", mod.relpath, node.lineno, node.value,
+                    f"config key {node.value!r} is not declared in "
+                    f"CommonConstants (spi/config.py) — undeclared keys "
+                    f"are invisible to operators and prone to typos"))
+    return findings

@@ -23,6 +23,13 @@ jax.config.update("jax_platforms", "cpu")
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "lint: graftlint static-analysis gate (pytest -m lint runs just "
+        "the invariant checkers)")
+
+
 @pytest.fixture(scope="session")
 def eight_devices():
     import jax

@@ -184,6 +184,30 @@ def test_launch_errors_reach_every_rider():
     assert sched.stats_snapshot()["failures"] >= 1
 
 
+def test_dispatcher_crash_completes_waiters_and_recovers(monkeypatch):
+    """A failure escaping _launch_group entirely (an import error, a bug in
+    the grouping) must still complete every waiter's future — the 8-thread
+    hang shape — and the dispatcher must keep serving afterwards."""
+    sched = LaunchScheduler(name="t-crash")
+    orig = LaunchScheduler._launch_group
+    crashed = []
+
+    def flaky(self, reqs):
+        if not crashed:
+            crashed.append(True)
+            raise RuntimeError("synthetic dispatcher bug")
+        return orig(self, reqs)
+
+    monkeypatch.setattr(LaunchScheduler, "_launch_group", flaky)
+    kern = LaunchKernel(("k5",), lambda params, num_docs: params,
+                        max_batch=1)
+    req = sched.submit(kern, ("p1",), 0)
+    with pytest.raises(RuntimeError, match="synthetic dispatcher bug"):
+        req.result(30)
+    # the dispatcher thread survived (or was revived): next launch works
+    assert sched.submit(kern, ("p2",), 0).result(30) == ("p2",)
+
+
 # --------------------------------------------------------------------------
 # the hammer: mixed same-shape / different-shape queries from >= 8 threads
 # --------------------------------------------------------------------------

@@ -354,6 +354,93 @@ def test_prefetch_never_evicts_for_itself(segs):
         rm.close()
 
 
+def test_prefetch_queued_before_remove_cannot_resurrect(segs):
+    """The prefetch-vs-removeSegment race, made deterministic: a prefetch
+    sits in the queue behind a stalled item while the segment is evicted.
+    When the worker finally runs it, the retire-generation check must turn
+    it into a no-op — staging anyway would resurrect a removed segment as
+    an orphaned resident no removeSegment will ever clean up."""
+    from types import SimpleNamespace
+
+    release_worker = threading.Event()
+
+    class _BlockingCols:
+        def keys(self):
+            release_worker.wait(10.0)
+            return []
+
+    blocker = SimpleNamespace(
+        segment_name="__blocker__", is_mutable=False, num_docs=0,
+        padded_capacity=0, metadata=SimpleNamespace(columns=_BlockingCols()))
+
+    rm = ResidencyManager(budget_bytes=0)
+    try:
+        rm.prefetch(blocker)            # worker stalls inside this item
+        rm.prefetch(segs[0])            # queued behind the stall
+        rm.evict(segs[0].segment_name)  # removeSegment lands first
+        release_worker.set()
+        rm.drain_prefetch()
+        assert segs[0].segment_name not in rm.resident_names(), \
+            "queued prefetch resurrected a removed segment"
+        # a re-add AFTER the remove is a fresh generation and must prefetch
+        rm.prefetch(segs[0])
+        rm.drain_prefetch()
+        assert segs[0].segment_name in rm.resident_names()
+    finally:
+        release_worker.set()
+        rm.close()
+
+
+def test_prefetch_vs_remove_thread_hammer(segs):
+    """Background lifecycle-listener staging racing removeSegment eviction:
+    no exceptions, no orphaned resident after the final remove, and byte
+    accounting stays exact (== sum of resident bytes, never negative)."""
+    rm = ResidencyManager(budget_bytes=0)
+    stop = threading.Event()
+    errors = []
+
+    def prefetcher(seg):
+        while not stop.is_set():
+            try:
+                rm.prefetch(seg)
+            except Exception as e:  # pragma: no cover - failure mode
+                errors.append(e)
+                return
+
+    def remover():
+        while not stop.is_set():
+            for s in segs[:2]:
+                try:
+                    rm.evict(s.segment_name)
+                except Exception as e:  # pragma: no cover - failure mode
+                    errors.append(e)
+                    return
+
+    threads = [threading.Thread(target=prefetcher, args=(s,))
+               for s in segs[:2] for _ in range(2)]
+    threads += [threading.Thread(target=remover) for _ in range(2)]
+    try:
+        for t in threads:
+            t.start()
+        stop.wait(1.0)
+    finally:
+        stop.set()
+        for t in threads:
+            t.join()
+    assert not errors
+    rm.drain_prefetch()
+    # the final word is remove: nothing may stay (or come back) resident
+    for s in segs[:2]:
+        rm.evict(s.segment_name)
+    rm.drain_prefetch()
+    for s in segs[:2]:
+        assert s.segment_name not in rm.resident_names()
+    snap = rm.snapshot()
+    by_resident = sum(e["bytes"] for e in snap["stagedSegments"].values())
+    assert snap["stagedBytes"] == by_resident >= 0
+    rm.close()
+
+
 def test_data_manager_lifecycle_hooks(segs, tmp_path):
     from pinot_tpu.server.data_manager import TableDataManager
 

@@ -25,8 +25,8 @@ class HitCounter:
     """Ref: HitCounter.java — ring of per-100ms hit buckets."""
 
     def __init__(self):
-        self._counts = [0] * _BUCKETS
-        self._stamps = [0] * _BUCKETS
+        self._counts = [0] * _BUCKETS  # guarded-by: _lock
+        self._stamps = [0] * _BUCKETS  # guarded-by: _lock
         self._lock = threading.Lock()
 
     def hit(self, now_ms: Optional[int] = None) -> None:
@@ -56,8 +56,10 @@ class QueryQuotaManager:
 
     def __init__(self, store, num_brokers_fn=None):
         self.store = store
-        self._counters: Dict[str, HitCounter] = {}
-        self._quotas: Dict[str, Optional[float]] = {}
+        # lock-free reads are safe (atomic dict ops; a racy miss just
+        # re-creates/re-parses); mutation must serialize
+        self._counters: Dict[str, HitCounter] = {}  # guarded-by-writes: _lock
+        self._quotas: Dict[str, Optional[float]] = {}  # guarded-by-writes: _lock
         self._lock = threading.Lock()
         self._num_brokers_fn = num_brokers_fn or (lambda: 1)
         store.watch("tables/", self._on_table_change)

@@ -168,7 +168,7 @@ class RoutingManager:
         self.store = store
         self.selector = BalancedInstanceSelector()
         self.time_boundary = TimeBoundaryManager(store)
-        self._request_id = 0
+        self._request_id = 0  # guarded-by: _lock
         self._lock = threading.Lock()
         # table -> (selector kind, groups key, selector): rebuilt only when
         # the routing config / instance partitions change (ref:

@@ -61,6 +61,17 @@ class _ParamCursor:
         self.i += 1
         return p
 
+    def finish(self):
+        """Assert full consumption at kernel-build end — the runtime
+        mirror of the lint protocol family, catching dynamically-built
+        specs the static model can't prove. Trace-time only (``i`` is a
+        plain int), so the check costs nothing per launch."""
+        if self.i != len(self.params):
+            raise AssertionError(
+                f"param cursor finished at {self.i} of "
+                f"{len(self.params)} params — pack/unpack drift between "
+                f"plan.py and the kernel consumers")
+
 
 # --------------------------------------------------------------------------
 # filter mask emission
@@ -220,6 +231,7 @@ def build_kernel_body(spec: Tuple, capacity_override: int = 0,
                 "num_matched": mask.sum(dtype=jnp.int32).astype(jnp.int64)}
             for i, aspec in enumerate(agg_specs):
                 out[f"agg{i}"] = _emit_scalar_agg(aspec, cols, pc, mask)
+            pc.finish()
             return out
 
         # ---- group-by path ----
@@ -243,8 +255,10 @@ def build_kernel_body(spec: Tuple, capacity_override: int = 0,
                                       num_groups, sparse_k, capacity,
                                       sparse_rung)
         seg_ids = jnp.where(mask, keys, num_groups)  # overflow bucket
-        return _emit_grouped_all(agg_specs, cols, pc, mask, seg_ids,
-                                 num_groups)
+        out = _emit_grouped_all(agg_specs, cols, pc, mask, seg_ids,
+                                num_groups)
+        pc.finish()
+        return out
 
     return kernel
 
@@ -419,28 +433,36 @@ def _emit_grouped_rung(agg_specs, cols, pc, mask, keys, num_groups, K,
     if rung == "sort":
         out = _emit_grouped_sparse(agg_specs, cols, pc, mask, keys,
                                    num_groups, K)
+        pc.finish()
         out["rung"] = jnp.ones((), dtype=jnp.int32)
         return out
     probe = _hash_probe(mask, keys, K, capacity)
     overflow = probe[0]
     if rung == "hash":
         out = _hash_finish(agg_specs, cols, pc, probe, K)
+        pc.finish()
         out["rung"] = overflow.astype(jnp.int32)
         return out
     # "cond": both branches re-walk the agg params from the same cursor
-    # position with their own cursors (one traced consumption each)
+    # position with their own cursors (one traced consumption each);
+    # the OUTER cursor deliberately stays at ``start`` — each branch
+    # copy asserts full consumption instead
     start = pc.i
 
     def _hash_branch(_):
         pc2 = _ParamCursor(pc.params)
         pc2.i = start
-        return _hash_finish(agg_specs, cols, pc2, probe, K)
+        out = _hash_finish(agg_specs, cols, pc2, probe, K)
+        pc2.finish()
+        return out
 
     def _sort_branch(_):
         pc2 = _ParamCursor(pc.params)
         pc2.i = start
-        return _emit_grouped_sparse(agg_specs, cols, pc2, mask, keys,
-                                    num_groups, K)
+        out = _emit_grouped_sparse(agg_specs, cols, pc2, mask, keys,
+                                   num_groups, K)
+        pc2.finish()
+        return out
 
     out = jax.lax.cond(overflow, _sort_branch, _hash_branch, None)
     out["rung"] = overflow.astype(jnp.int32)

@@ -331,6 +331,10 @@ def extract_plan(plan, provider) -> Optional[PallasPlan]:
                 if is_int and max_abs >= _F32_EXACT:
                     raise _Ineligible("int min/max not f32-exact")
                 aggs.append((base, vexpr, None))
+        # runtime protocol mirror: every eligible plan must have walked
+        # the cursor to the end (an unconsumed tail is pack/unpack drift,
+        # not ineligibility — let the AssertionError propagate)
+        pc.finish()
     except _Ineligible:
         return None
 

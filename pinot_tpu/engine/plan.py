@@ -269,6 +269,12 @@ def plan_segment(ctx: QueryContext, segment: ImmutableSegment) -> SegmentPlan:
 
     spec = (filter_spec, tuple(agg_specs), tuple(group_specs), num_groups,
             segment.padded_capacity)
+    expected = expected_param_count(spec)
+    if len(params) != expected:
+        raise AssertionError(
+            f"param pack/unpack drift: packed {len(params)} params but the "
+            f"spec consumes {expected} — plan.py and the kernel param "
+            f"tables disagree (spec={spec[:3]!r})")
     return SegmentPlan(spec=spec, params=params, columns=columns,
                        group_defs=group_defs, group_cards=group_cards,
                        group_strides=strides, num_groups=num_groups,
@@ -361,6 +367,50 @@ _FILTER_PARAMS = {
     "mv_eq": 1, "mv_neq": 1, "mv_range": 1, "mv_lut": 1,
     "veq": 1, "vneq": 1, "vrange": 2, "vin": 1, "vnotin": 1,
 }
+
+# params consumed per compiled value op (must mirror kernels._emit_value;
+# "fn" is structural — its args carry the params, like and/or/not in the
+# filter tree). "colmv" is absent deliberately: MV values never route
+# through _emit_value (the MV branch reads dense mv + counts, 0 params).
+_VALUE_PARAMS = {"lit": 1, "col": 0, "fn": 0}
+
+
+def _count_value_params(vspec: Optional[Tuple]) -> int:
+    if vspec is None or vspec[0] == "colmv":
+        return 0
+    n = _VALUE_PARAMS[vspec[0]]
+    if vspec[0] == "fn":
+        n += sum(_count_value_params(a) for a in vspec[2])
+    return n
+
+
+def expected_param_count(spec: Tuple) -> int:
+    """Number of runtime params the kernel-side cursor consumes for
+    ``spec`` — the pack-time half of the runtime protocol mirror (the
+    consume-time half is ``_ParamCursor.finish()``). Walks the spec with
+    the same per-op tables the static protocol lint verifies both sides
+    against, so a dynamically-built spec that drifts fails loudly here
+    instead of silently mis-keying results."""
+    filter_spec, agg_specs, group_specs, _num_groups, _cap = spec
+
+    def walk_filter(node: Tuple) -> int:
+        op = node[0]
+        if op in ("and", "or", "not"):
+            return sum(walk_filter(c) for c in node[1])
+        return _FILTER_PARAMS[op]
+
+    n = walk_filter(filter_spec)
+    if group_specs:
+        n += 2  # the strides + bases arrays, in that order
+        for gspec in group_specs:
+            if gspec[0] == "gexpr":
+                n += _count_value_params(gspec[1])
+    for aspec in agg_specs:
+        if aspec[0] == "distinctcounthll":
+            n += 2  # per-dictId (bucket, rank) register LUTs
+        elif aspec[0] != "distinctcount":
+            n += _count_value_params(aspec[2])
+    return n
 
 
 def _conjunctive_dict_ranges(filter_spec: Tuple,

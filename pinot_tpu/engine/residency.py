@@ -283,6 +283,10 @@ class ResidencyManager:
                     lease.misses += 1
                 self._mark("STAGING_MISSES")
             self._pin_locked(name, e, lease)
+            # re-measure + budget-enforce on EVERY outcome, like stage():
+            # without this a miss inserts an unaccounted batch resident and
+            # stagedBytes drifts until the next unrelated refresh
+            doomed += self._enforce_locked(lease)
             resident = e.resident
         self._release_all(doomed)
         return resident
@@ -337,7 +341,8 @@ class ResidencyManager:
         the arrays). Idempotent — also the re-entry point for batch
         residents whose release callback clears executor caches."""
         with self._lock:
-            self._entries.pop(name, None)
+            self._entries.pop(name, None)  # lint: ignore[conservation] — owner already released the arrays (discard contract)
+            self._refresh_locked()
 
     def clear(self) -> None:
         with self._lock:

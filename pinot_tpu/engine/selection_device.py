@@ -84,6 +84,7 @@ def _build_kernel(filter_spec, directions: Tuple[bool, ...], capacity: int,
     def kernel(cols, params, num_docs, keys):
         pc = _ParamCursor(params)
         mask = _emit_filter(filter_spec, cols, pc, capacity)
+        pc.finish()  # selection params are exactly the filter params
         mask = mask & (jnp.arange(capacity, dtype=jnp.int32) < num_docs)
         operands = []
         for key, asc in zip(keys, directions):

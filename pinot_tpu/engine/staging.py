@@ -101,7 +101,13 @@ class StagedSegment:
     Column builds serialize on a per-segment lock: two query threads
     staging the same column must share ONE set of device arrays — a
     duplicate build leaks its losing copy until GC (the round-2 residency
-    hazard). Reads stay lock-free (dict get is atomic under the GIL)."""
+    hazard). Reads stay lock-free (dict get is atomic under the GIL).
+
+    Conservation contract (machine-enforced by the lint ``conservation``
+    family's cache-parity rule): every field this class populates outside
+    ``__init__`` must be counted in ``nbytes()`` AND cleared in
+    ``release()`` — staged bytes invisible to the HBM budget, or device
+    arrays that outlive eviction, are exactly the drift the gate blocks."""
 
     def __init__(self, segment: ImmutableSegment, borrower=None):
         self.segment = segment

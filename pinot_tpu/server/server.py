@@ -82,7 +82,7 @@ class ServerInstance:
         self._started = False
         self._queries_enabled = False
         self._reconcile_lock = threading.RLock()
-        self._upsert_managers: Dict[str, object] = {}
+        self._upsert_managers: Dict[str, object] = {}  # guarded-by: _reconcile_lock
 
     # -- lifecycle (ref: BaseServerStarter.start) ---------------------------
     def start(self, heartbeat_interval_s: float = 0.0) -> None:
@@ -160,7 +160,7 @@ class ServerInstance:
         if evict is not None:
             evict(segment_name)
 
-    def _upsert_manager_for(self, table: str):
+    def _upsert_manager_for_locked(self, table: str):
         """TableUpsertMetadataManager for upsert-enabled realtime tables
         (ref: TableUpsertMetadataManager creation in RealtimeTableDataManager)."""
         if table in self._upsert_managers:
@@ -209,7 +209,7 @@ class ServerInstance:
         realtime = table_type_from_name(table) is TableType.REALTIME
         tdm = self.data_manager.get_or_create(
             table, realtime=realtime,
-            upsert_manager=self._upsert_manager_for(table) if realtime
+            upsert_manager=self._upsert_manager_for_locked(table) if realtime
             else None)
 
         my_segments = {seg: states[self.instance_id]

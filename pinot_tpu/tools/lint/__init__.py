@@ -21,6 +21,23 @@ bug, the lazy CRC32C table race):
   ``pinot.server.*`` / ``pinot.broker.*`` key string must be declared in
   ``spi/config.py``'s ``CommonConstants``.
 
+ISSUE 5 adds the interprocedural dataflow tier (dataflow.py: per-function
+CFG with exception edges, forward abstract interpretation, and a
+path-enumerating dispatch executor with call-graph summaries):
+
+- ``protocol`` (protocol.py): the positional static-param pack/unpack
+  contract between ``engine/plan.py`` and every ``pc.take()`` consumer —
+  per-op counts against ``_FILTER_PARAMS``/``_VALUE_PARAMS``, the
+  (strides, bases) group-epilogue order, ``_bases`` int32-narrowing
+  safety, ``_next_pow2`` drift, and unfinished-cursor tails.
+- ``sync`` (sync.py): device-value taint reaching an implicit
+  host-materialization sink (``np.asarray``, ``.item()``, ``float()`` …)
+  while a lock is held or on the launcher dispatcher thread.
+- ``conservation`` (conservation.py): paired-effect proof that every
+  resident removal releases (exception edges included), every insert
+  re-runs byte accounting, and ``nbytes()``/``release()`` classes count
+  and clear every field they populate.
+
 Pure stdlib ``ast`` — importing this package must never pull jax or the
 engine (the CLI runs in CI before anything else).
 """

@@ -1,25 +1,39 @@
 """``python -m pinot_tpu.tools.lint [--baseline FILE] [paths...]``
 
-Runs all four checker families and exits non-zero on any finding not
-covered by the baseline (or an inline ``# lint: ignore[...]``). With no
-paths, lints the whole ``pinot_tpu`` package. Stdlib-only: safe to run
-before the environment can import jax.
+Runs all checker families — the PR-4 AST tier (lock discipline, lease
+pairing, tracer safety, wire/config consistency) and the dataflow tier
+(kernel param protocol, device-sync taint, HBM accounting conservation) —
+and exits non-zero on any finding not covered by the baseline (or an
+inline ``# lint: ignore[...]``). With no paths, lints the whole
+``pinot_tpu`` package. Stdlib-only: safe to run before the environment
+can import jax.
+
+``--json`` prints one JSON object per finding (key, family, file, line,
+message) for CI / bench-harness annotation; ``--families`` restricts the
+run to a comma-separated subset (see ``--list-families``).
 """
 
 from __future__ import annotations
 
 import argparse
+import json
 import os
 import sys
 
-from pinot_tpu.tools.lint.core import DEFAULT_BASELINE, run_lint
+from pinot_tpu.tools.lint.core import (
+    DEFAULT_BASELINE,
+    checker_names,
+    run_lint,
+)
 
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m pinot_tpu.tools.lint",
-        description="AST invariant checker: lock discipline, lease "
-                    "pairing, tracer safety, wire/config consistency.")
+        description="AST + dataflow invariant checker: lock discipline, "
+                    "lease pairing, tracer safety, wire/config "
+                    "consistency, kernel param protocol, device-sync "
+                    "taint, HBM accounting conservation.")
     ap.add_argument("paths", nargs="*",
                     help="files or directories to lint "
                          "(default: the pinot_tpu package)")
@@ -31,7 +45,30 @@ def main(argv=None) -> int:
     ap.add_argument("--keys", action="store_true",
                     help="print baseline keys instead of messages "
                          "(for composing baseline entries)")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="machine-readable output: one JSON object per "
+                         "finding (key, family, file, line, message)")
+    ap.add_argument("--families", default=None, metavar="F1,F2",
+                    help="run only the named checker families "
+                         "(comma-separated; see --list-families)")
+    ap.add_argument("--list-families", action="store_true",
+                    help="print the registered family names and exit")
     args = ap.parse_args(argv)
+
+    if args.list_families:
+        for name in checker_names():
+            print(name)
+        return 0
+
+    families = None
+    if args.families is not None:
+        families = [s.strip() for s in args.families.split(",") if s.strip()]
+        known = set(checker_names())
+        unknown = [f for f in families if f not in known]
+        if unknown:
+            print(f"unknown families: {', '.join(unknown)} "
+                  f"(known: {', '.join(sorted(known))})", file=sys.stderr)
+            return 2
 
     paths = args.paths
     if not paths:
@@ -40,13 +77,19 @@ def main(argv=None) -> int:
         paths = [os.path.dirname(os.path.abspath(pinot_tpu.__file__))]
 
     baseline = None if args.no_baseline else args.baseline
-    new, accepted = run_lint(paths, baseline=baseline)
+    new, accepted = run_lint(paths, baseline=baseline, families=families)
     for f in new:
-        print(f.key if args.keys else f.render())
+        if args.as_json:
+            print(json.dumps({"key": f.key, "family": f.checker,
+                              "file": f.path, "line": f.line,
+                              "message": f.message}, sort_keys=True))
+        else:
+            print(f.key if args.keys else f.render())
     n_sup = len(accepted)
-    print(f"graftlint: {len(new)} finding(s)"
-          + (f", {n_sup} baselined/suppressed" if n_sup else ""),
-          file=sys.stderr)
+    if not args.as_json:
+        print(f"graftlint: {len(new)} finding(s)"
+              + (f", {n_sup} baselined/suppressed" if n_sup else ""),
+              file=sys.stderr)
     return 1 if new else 0
 
 

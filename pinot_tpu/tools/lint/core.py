@@ -121,7 +121,15 @@ def _load_checkers() -> None:
     global _LOADED
     if _LOADED:
         return
-    from pinot_tpu.tools.lint import locks, pairing, tracer, wire  # noqa: F401
+    from pinot_tpu.tools.lint import (  # noqa: F401
+        conservation,
+        locks,
+        pairing,
+        protocol,
+        sync,
+        tracer,
+        wire,
+    )
     _LOADED = True
 
 
@@ -175,17 +183,29 @@ def load_baseline(path: Optional[str]) -> Dict[str, str]:
 
 # -- runner -----------------------------------------------------------------
 
-def run_lint(paths: Sequence[str], baseline: Optional[str] = None
+def run_lint(paths: Sequence[str], baseline: Optional[str] = None,
+             families: Optional[Sequence[str]] = None
              ) -> Tuple[List[Finding], List[Finding]]:
     """Run every registered checker over ``paths``.
 
-    Returns ``(new, accepted)``: findings not covered by the baseline, and
-    findings the baseline (or an inline ignore) covers. Exit policy is the
-    caller's (the CLI exits non-zero iff ``new`` is non-empty).
+    ``families`` restricts the run to the named checker families
+    (parse errors always report). Returns ``(new, accepted)``: findings
+    not covered by the baseline, and findings the baseline (or an inline
+    ignore) covers. Exit policy is the caller's (the CLI exits non-zero
+    iff ``new`` is non-empty).
     """
     _load_checkers()
+    if families is not None:
+        wanted = set(families)
+        unknown = wanted - {n for n, _ in _CHECKERS}
+        if unknown:
+            raise ValueError(
+                f"unknown lint families {sorted(unknown)}; "
+                f"known: {[n for n, _ in _CHECKERS]}")
     ctx, findings = load_modules(paths)
-    for _name, fn in _CHECKERS:
+    for name, fn in _CHECKERS:
+        if families is not None and name not in families:
+            continue
         findings.extend(fn(ctx))
     findings.sort(key=lambda f: (f.path, f.line, f.checker, f.symbol))
 

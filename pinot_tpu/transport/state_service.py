@@ -73,11 +73,13 @@ class RemoteClusterStateStore(ClusterStateStore):
     def __init__(self, base_url: str, poll_interval_s: float = 0.05,
                  timeout_s: float = 30.0):
         super().__init__(snapshot_path=None)
-        self._base = base_url.rstrip("/")
+        # poller and reconnect race on these; pre-lock snapshot reads are
+        # part of the epoch protocol, so only writes must hold the lock
+        self._base = base_url.rstrip("/")  # guarded-by-writes: _lock
         self._timeout = timeout_s
         self._poll_interval = poll_interval_s
-        self._remote_version = -1
-        self._epoch = 0
+        self._remote_version = -1  # guarded-by-writes: _lock
+        self._epoch = 0  # guarded-by-writes: _lock
         self._stop = threading.Event()
         self._sync_once()  # fail fast if the authority is unreachable
         self._poller = threading.Thread(target=self._poll_loop, daemon=True,

@@ -503,3 +503,58 @@ def test_gexpr_group_by_sharded(setup, host_exec, sql):
     _, segs = setup
     dev = ShardedQueryExecutor()
     assert_rows_close(rows(dev, segs, sql), rows(host_exec, segs, sql))
+
+
+# --------------------------------------------------------------------------
+# param-protocol runtime mirror (PR 5: lint `protocol` family's dynamic half)
+# --------------------------------------------------------------------------
+
+def test_param_cursor_finish_flags_unconsumed_tail():
+    from pinot_tpu.engine.kernels import _ParamCursor
+
+    pc = _ParamCursor([1, 2])
+    pc.take()
+    with pytest.raises(AssertionError, match="pack/unpack drift"):
+        pc.finish()
+    pc.take()
+    pc.finish()  # fully consumed: clean
+
+
+def test_plan_pack_matches_expected_param_count(setup):
+    """Every planned query packs exactly the params its spec consumes —
+    the pack-time half of the protocol mirror (plan_segment asserts this
+    internally; re-check it here so a relaxed assert can't rot)."""
+    from pinot_tpu.engine.plan import expected_param_count, plan_segment
+
+    _, segs = setup
+    queries = [
+        "SELECT count(*) FROM stats",
+        "SELECT sum(salary), max(runs) FROM stats WHERE year > 2000",
+        "SELECT team, sum(runs * 2) FROM stats "
+        "WHERE league != 'AL' GROUP BY team",
+    ]
+    for sql in queries:
+        plan = plan_segment(compile_query(sql), segs[0])
+        assert len(plan.params) == expected_param_count(plan.spec), sql
+
+
+def test_pack_time_drift_check_fires(setup, monkeypatch):
+    """Seed pack/unpack drift (an eq predicate that packs TWO params) and
+    prove plan_segment's length check catches it at plan time instead of
+    letting the kernel silently mis-key."""
+    from pinot_tpu.engine import plan as plan_mod
+
+    _, segs = setup
+    real = plan_mod._compile_predicate
+
+    def drifted(pred, segment, params, columns):
+        spec = real(pred, segment, params, columns)
+        if spec[0] == "eq":
+            params.append(np.int32(0))  # stray param: cursor drift
+        return spec
+
+    monkeypatch.setattr(plan_mod, "_compile_predicate", drifted)
+    with pytest.raises(AssertionError, match="pack/unpack drift"):
+        plan_mod.plan_segment(
+            compile_query("SELECT count(*) FROM stats WHERE team = 'BOS'"),
+            segs[0])

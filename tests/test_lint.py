@@ -6,16 +6,20 @@ Two layers:
   ``pinot_tpu`` package with the checked-in baseline — the machine-enforced
   gate that keeps the PR-1..3 bug classes (field touched outside its
   guarding lock, acquire without a paired release, host effects in traced
-  code, stat added but never wired) from coming back.
+  code, stat added but never wired) from coming back. PR 5 adds the
+  dataflow families: kernel param protocol (``protocol``), device-sync
+  taint (``sync``), and HBM accounting conservation (``conservation``).
 - the fixture tests seed one violation of each invariant into a temp file
   and prove the checker catches it — including a regression fixture in the
-  exact shape of the PR-2 ``stage()`` get-then-set race and an
-  unpaired-lease fixture.
+  exact shape of the PR-2 ``stage()`` get-then-set race, an unpaired-lease
+  fixture, and (for the protocol family) a scratch copy of
+  ``pallas_kernels.py`` with one ``pc.take()`` reordered.
 
 ``pytest -m lint`` runs just this module (fast: stdlib ast only, no jax
 work beyond the conftest import).
 """
 
+import json
 import os
 import textwrap
 
@@ -47,12 +51,19 @@ def _by_checker(findings, checker):
 # --------------------------------------------------------------------------
 
 def test_package_is_clean():
-    """The whole package passes all four checker families against the
+    """The whole package passes every checker family against the
     checked-in (ideally empty) baseline. A finding here means either fix
     the code or — rarely, with justification — baseline it."""
     new, accepted = run_lint([PKG], baseline=DEFAULT_BASELINE)
     assert not new, "graftlint findings:\n" + "\n".join(
         f.render() for f in new)
+
+
+def test_baseline_is_empty():
+    """The dataflow families ship with a truly empty baseline: every true
+    positive they found at landing time was fixed, not accepted."""
+    with open(DEFAULT_BASELINE, encoding="utf-8") as f:
+        assert json.load(f)["entries"] == []
 
 
 def test_cli_exit_codes(tmp_path):
@@ -437,6 +448,350 @@ def test_config_catches_undeclared_key(tmp_path):
         """)
     cf = _by_checker(new, "config")
     assert [f.symbol for f in cf] == ["pinot.server.query.bogus.knob"]
+
+
+# --------------------------------------------------------------------------
+# kernel param protocol (dataflow tier)
+# --------------------------------------------------------------------------
+
+PROTO_TABLE = """\
+    _FILTER_PARAMS = {"eq": 1, "range": 2, "lut": 1}
+
+
+"""
+
+
+def test_protocol_catches_missing_take(tmp_path):
+    """The consumer takes fewer params than the table declares for an op:
+    every later predicate reads the WRONG array — silently wrong results."""
+    new = _lint(tmp_path, PROTO_TABLE + """\
+    def _emit(spec, pc):
+        op = spec[0]
+        if op == "eq":
+            return pc.take()
+        if op == "range":
+            lo = pc.take()  # table says 2: the hi bound is never taken
+            return lo
+        if op == "lut":
+            return pc.take()
+        raise AssertionError(op)
+    """)
+    syms = {f.symbol for f in _by_checker(new, "protocol")}
+    assert "_emit:range" in syms, [f.render() for f in new]
+    assert not any(s.endswith(":eq") or s.endswith(":lut") for s in syms)
+
+
+def test_protocol_catches_extra_take(tmp_path):
+    new = _lint(tmp_path, PROTO_TABLE + """\
+    def _emit(spec, pc):
+        op = spec[0]
+        if op == "eq":
+            return pc.take() + pc.take()  # table says 1
+        if op == "range":
+            lo, hi = pc.take(), pc.take()
+            return lo + hi
+        if op == "lut":
+            return pc.take()
+        raise AssertionError(op)
+    """)
+    syms = {f.symbol for f in _by_checker(new, "protocol")}
+    assert "_emit:eq" in syms
+    assert not any(s.endswith(":range") for s in syms)
+
+
+def test_protocol_raise_declines_an_op(tmp_path):
+    """A consumer that raises for an op declines it (the pallas extractor's
+    ``_Ineligible`` contract) — no finding for ops it never claims."""
+    new = _lint(tmp_path, PROTO_TABLE + """\
+    def _emit(spec, pc):
+        op = spec[0]
+        if op == "eq":
+            return pc.take()
+        raise ValueError(op)  # range/lut: declined, another rung serves
+    """)
+    assert not _by_checker(new, "protocol"), [f.render() for f in new]
+
+
+def test_protocol_catches_reordered_group_takes(tmp_path):
+    """The classic silent-wrong-results drift: the pack side writes
+    (strides, bases) but a consumer takes (bases, strides) — every grouped
+    result mis-keys."""
+    new = _lint(tmp_path, """\
+        def pack(params, strides, group_bases):
+            params.append(strides)
+            params.append(group_bases)
+
+        def consume(pc):
+            bases = pc.take()
+            strides = pc.take()
+            return strides, bases
+        """)
+    hits = [f for f in _by_checker(new, "protocol")
+            if "group-order" in f.symbol]
+    assert hits and "consume" in hits[0].symbol
+
+
+def test_protocol_pack_side_drift(tmp_path):
+    """The pack side appends a different count than the table declares for
+    the op its return tuple carries."""
+    new = _lint(tmp_path, PROTO_TABLE + """\
+    def _compile(pred, params):
+        op = pred[0]
+        if op == "eq":
+            params.append(pred[1])
+            params.append(pred[2])  # one too many: table says 1
+            return ("eq", pred[1])
+        if op == "range":
+            params.append(pred[1])
+            params.append(pred[2])
+            return ("range", pred[1])
+        raise ValueError(op)
+    """)
+    syms = {f.symbol for f in _by_checker(new, "protocol")}
+    assert "_compile:pack:eq" in syms
+    assert not any(s.endswith("pack:range") for s in syms)
+
+
+def test_protocol_flags_reordered_take_in_pallas_scratch(tmp_path):
+    """Acceptance fixture: a scratch copy of the REAL pallas_kernels.py
+    with the strides/bases ``pc.take()`` pair swapped must produce a
+    protocol finding against the real plan.py pack order; the unmodified
+    pair is clean."""
+    eng = os.path.join(PKG, "engine")
+    with open(os.path.join(eng, "plan.py"), encoding="utf-8") as f:
+        plan_src = f.read()
+    with open(os.path.join(eng, "pallas_kernels.py"),
+              encoding="utf-8") as f:
+        pk_src = f.read()
+    s_line = "strides = [int(s) for s in np.asarray(pc.take())]"
+    b_line = "bases = [int(b) for b in np.asarray(pc.take())]"
+    assert s_line in pk_src and b_line in pk_src, \
+        "pallas_kernels group-take lines moved; update the fixture"
+    swapped = (pk_src.replace(s_line, "@@SWAP@@")
+               .replace(b_line, s_line)
+               .replace("@@SWAP@@", b_line))
+    (tmp_path / "plan.py").write_text(plan_src)
+    (tmp_path / "pallas_kernels.py").write_text(swapped)
+    new, _ = run_lint([str(tmp_path)])
+    hits = [f for f in _by_checker(new, "protocol")
+            if "group-order" in f.symbol]
+    assert hits, [f.render() for f in new]
+
+    (tmp_path / "pallas_kernels.py").write_text(pk_src)
+    clean, _ = run_lint([str(tmp_path)])
+    assert not _by_checker(clean, "protocol"), \
+        [f.render() for f in clean]
+
+
+# --------------------------------------------------------------------------
+# device-sync taint (dataflow tier)
+# --------------------------------------------------------------------------
+
+def test_sync_catches_materialization_under_lock(tmp_path):
+    """float() on a device value inside ``with self._lock`` blocks every
+    thread queuing on the lock until the device program finishes — the
+    convoy PR 3 removed the global combine lock to escape."""
+    new = _lint(tmp_path, """\
+        import threading
+
+        import jax.numpy as jnp
+
+
+        class Accum:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.total = 0.0
+
+            def add(self, x):
+                dev = jnp.sum(x)
+                with self._lock:
+                    self.total += float(dev)
+
+            def add_ok(self, x):
+                host = float(jnp.sum(x))  # sync BEFORE taking the lock
+                with self._lock:
+                    self.total += host
+        """)
+    sf = _by_checker(new, "sync")
+    assert any("Accum.add" in f.symbol and "float()" in f.symbol
+               for f in sf), [f.render() for f in new]
+    assert not any("add_ok" in f.symbol for f in sf)
+
+
+def test_sync_catches_dispatcher_thread_materialization(tmp_path):
+    """An implicit D2H on the per-mesh dispatcher thread stalls EVERY
+    sharded launch in the process, not one query."""
+    new = _lint(tmp_path, """\
+        import threading
+
+        import jax.numpy as jnp
+        import numpy as np
+
+
+        class Dispatcher:
+            def start(self):
+                self._t = threading.Thread(target=self._loop, daemon=True)
+                self._t.start()
+
+            def _loop(self):
+                dev = jnp.zeros(4)
+                return np.asarray(dev)  # blocks the dispatcher on device
+        """, name="mini_launcher.py")
+    sf = _by_checker(new, "sync")
+    assert any("_loop" in f.symbol and "asarray" in f.symbol
+               for f in sf), [f.render() for f in new]
+
+
+def test_sync_metadata_reads_never_flag(tmp_path):
+    """.nbytes/.shape/.dtype on a device array are host-side metadata —
+    reading them never syncs, even under a lock."""
+    new = _lint(tmp_path, """\
+        import threading
+
+        import jax.numpy as jnp
+
+
+        class Meter:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.bytes = 0
+
+            def measure(self, x):
+                dev = jnp.sum(x)
+                with self._lock:
+                    self.bytes += int(dev.nbytes)
+        """)
+    assert not _by_checker(new, "sync"), [f.render() for f in new]
+
+
+# --------------------------------------------------------------------------
+# HBM accounting conservation (dataflow tier)
+# --------------------------------------------------------------------------
+
+CONSERVATION_PRELUDE = """\
+    class Manager:
+        def __init__(self):
+            self._entries = {}
+            self._staged_bytes = 0
+
+        def _account(self):
+            self._staged_bytes += 1
+
+        def _release_all(self, doomed):
+            for r in doomed:
+                r.release()
+
+        def get(self, name):
+            e = self._entries.get(name)
+            if e is not None:
+                return e.resident
+            return None
+
+"""
+
+
+def test_conservation_catches_unreleased_pop(tmp_path):
+    new = _lint(tmp_path, CONSERVATION_PRELUDE + """\
+        def evict(self, name):
+            e = self._entries.pop(name, None)
+            if e is not None:
+                self._staged_bytes -= 1  # accounted, but never released
+""")
+    cf = _by_checker(new, "conservation")
+    assert any("evict" in f.symbol and f.symbol.endswith("remove")
+               for f in cf), [f.render() for f in new]
+
+
+def test_conservation_release_on_exception_edge(tmp_path):
+    """A release only on the try fall-through leaks on the handler path —
+    the exception-edged CFG must see it; releasing in a ``finally``
+    satisfies every path."""
+    new = _lint(tmp_path, CONSERVATION_PRELUDE + """\
+        def evict_leaky(self, name):
+            e = self._entries.pop(name, None)
+            if e is None:
+                return
+            try:
+                self._prepare(e)
+            except ValueError:
+                return  # handler path: e.resident leaks until GC
+            e.resident.release()
+
+        def evict_safe(self, name):
+            e = self._entries.pop(name, None)
+            if e is None:
+                return
+            try:
+                self._prepare(e)
+            finally:
+                e.resident.release()
+""")
+    cf = _by_checker(new, "conservation")
+    assert any("evict_leaky" in f.symbol for f in cf), \
+        [f.render() for f in new]
+    assert not any("evict_safe" in f.symbol for f in cf)
+
+
+def test_conservation_catches_unaccounted_insert(tmp_path):
+    new = _lint(tmp_path, CONSERVATION_PRELUDE + """\
+        def put(self, name, r):
+            self._entries[name] = r  # stagedBytes never re-measured
+
+        def put_ok(self, name, r):
+            self._entries[name] = r
+            self._account()
+""")
+    cf = _by_checker(new, "conservation")
+    assert any("put" in f.symbol and f.symbol.endswith("insert")
+               for f in cf), [f.render() for f in new]
+    assert not any("put_ok" in f.symbol for f in cf)
+
+
+def test_conservation_catches_discarded_pop(tmp_path):
+    new = _lint(tmp_path, CONSERVATION_PRELUDE + """\
+        def drop(self, name):
+            self._entries.pop(name, None)
+""")
+    cf = _by_checker(new, "conservation")
+    assert any("drop" in f.symbol and "discard" in f.symbol
+               for f in cf), [f.render() for f in new]
+
+
+# --------------------------------------------------------------------------
+# CLI: --json / --families
+# --------------------------------------------------------------------------
+
+BAD_LOCK_SRC = """\
+    import threading
+
+    class C:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._d = {}  # guarded-by: _lock
+
+        def peek(self):
+            return self._d.get("k")
+    """
+
+
+def test_cli_json_output(tmp_path, capsys):
+    bad = tmp_path / "bad.py"
+    bad.write_text(textwrap.dedent(BAD_LOCK_SRC))
+    rc = lint_main([str(bad), "--json"])
+    out = capsys.readouterr().out
+    rows = [json.loads(line) for line in out.splitlines()]
+    assert rc == 1 and rows
+    assert set(rows[0]) == {"key", "family", "file", "line", "message"}
+    assert rows[0]["family"] == "lock-guard"
+
+
+def test_cli_families_filter(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text(textwrap.dedent(BAD_LOCK_SRC))
+    # the finding is lock-guard: a protocol-only run must not see it
+    assert lint_main([str(bad), "--families", "protocol,sync"]) == 0
+    assert lint_main([str(bad), "--families", "lock-guard"]) == 1
+    assert lint_main([str(bad), "--families", "nonsense"]) == 2
 
 
 # --------------------------------------------------------------------------

@@ -161,6 +161,35 @@ def test_budget_evicts_lru_first(segs):
     assert snap["stagedBytes"] <= int(per_seg * 2.5)
 
 
+def test_register_accounts_and_enforces_on_insert():
+    """Regression (graftlint conservation finding): ``register()`` used to
+    insert a batch resident without re-running byte accounting or budget
+    enforcement — stagedBytes drifted from reality until the next
+    unrelated refresh, and over-budget batch inserts never evicted."""
+    class _Resident:
+        def __init__(self, n):
+            self._n = n
+            self.released = False
+
+        def nbytes(self):
+            return self._n
+
+        def release(self):
+            self.released = True
+
+    rm = ResidencyManager(budget_bytes=1000)
+    a = _Resident(600)
+    rm.register("a", lambda: a)
+    assert rm.staged_bytes() == 600, \
+        "insert must be accounted on the register() call itself"
+    b = _Resident(600)
+    rm.register("b", lambda: b)
+    # over budget: the unpinned LRU entry (a) must evict on the SAME call
+    assert a.released and not b.released
+    assert rm.resident_names() == ["b"]
+    assert rm.staged_bytes() == 600
+
+
 def test_pinned_segments_survive_eviction_pressure(segs):
     rm = ResidencyManager(budget_bytes=0)
     lease = QueryLease()

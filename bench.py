@@ -254,7 +254,9 @@ class _Worker:
             f.write(json.dumps(rec) + "\n")
             f.flush()
             os.fsync(f.fileno())
-        _log(f"recorded {suite}: {rec.get('p50_ms_per_query', '')}")
+        # suites without a per-query p50 (star-tree) log their own scalar
+        _log(f"recorded {suite}: "
+             f"{rec.get('p50_ms_per_query', rec.get('ms', ''))}")
 
     def run(self) -> None:
         for suite, fn in (("ssb", self.bench_ssb),
@@ -336,6 +338,7 @@ class _Worker:
         base_ms = {}
         parity_fail = []
         rungs = {}
+        docs_scanned = {}
         for qid, ctx in ctxs.items():
             _log(f"ssb {qid}: baseline + device compile + parity")
             want = ssb_baseline.run_query(df, qid)
@@ -344,6 +347,7 @@ class _Worker:
             base_ms[qid] = (time.perf_counter() - t0) * 1e3
             got, qstats = self.dev.execute(ctx, segs)   # compiles + warms
             rungs[qid] = qstats.group_by_rung or "scalar"
+            docs_scanned[qid] = qstats.num_docs_scanned
             if not ssb_baseline.rows_match(got.rows, want, rel=1e-6):
                 parity_fail.append(qid)
         if parity_fail:
@@ -358,6 +362,18 @@ class _Worker:
             raise AssertionError(
                 f"group-by rung regression: {regressed} fell back to "
                 f"{[rungs[q] for q in regressed]} (rungs: {rungs})")
+        # with the default lineorder star-tree, Q2.x must serve from the
+        # pre-aggregated node slices on DEVICE — regressing to the scan
+        # (or the host walker) silently re-pays the 3M-doc scan this PR
+        # removed (same loud-failure contract as the Q3.x rung gate)
+        if segs and segs[0].metadata.star_tree_count:
+            off_tree = [q for q in ("Q2.1", "Q2.2", "Q2.3")
+                        if rungs.get(q) != "startree_device"]
+            if off_tree:
+                raise AssertionError(
+                    f"star-tree rung regression: {off_tree} served by "
+                    f"{[rungs[q] for q in off_tree]} instead of "
+                    f"startree_device (rungs: {rungs})")
 
         per_q50, per_q99 = {}, {}
         for qid, ctx in ctxs.items():
@@ -399,6 +415,7 @@ class _Worker:
             "per_query_ms": {q: round(v, 2) for q, v in per_q50.items()},
             "per_query_p99_ms": {q: round(v, 2) for q, v in per_q99.items()},
             "group_by_rung": rungs,
+            "docs_scanned": docs_scanned,
             "pallas_kernels": len(self.dev._pallas_sharded),
             "parity": "ok",
         }
@@ -533,6 +550,7 @@ class _Worker:
                                   [scan_ctx])
         return {"ms": round(st_p50 * 1e3, 3),
                 "scan_ms": round(scan_p50 * 1e3, 3),
+                "group_by_rung": st_stats.group_by_rung,
                 "docs_scanned": st_stats.num_docs_scanned}
 
     def bench_sketches(self) -> dict:

@@ -126,6 +126,11 @@ class ServerQueryExecutor:
         # LRU-capped like the sibling caches (k rides in the key, so
         # unbounded LIMIT variety must not pin kernels forever)
         self._selection_kernels: "OrderedDict" = OrderedDict()
+        # star-tree node-slice kernels (engine/startree_device.py): spec ->
+        # jitted gather+aggregate fn. The spec's capacity is the pow2-padded
+        # selected-record count, so variety is bounded; LRU-capped anyway
+        self._startree_kernels: "OrderedDict" = OrderedDict()  # guarded-by: _startree_kernel_lock
+        self._startree_kernel_lock = threading.Lock()
         self.num_groups_limit = num_groups_limit
         # segment fan-out width: pinot.server.query.worker.threads (the
         # reference's pqw pool size); default preserves the old hardcoded
@@ -382,7 +387,8 @@ class ServerQueryExecutor:
             return done(fast, "metadata")
         st = self._try_star_tree(ctx, aggs, seg, stats)
         if st is not None:
-            return done(st, "startree")
+            result, rung = st
+            return done(result, rung)
         if self.use_device and self._device_admitted(stats):
             try:
                 plan = self._plan_for(ctx, seg)
@@ -417,18 +423,50 @@ class ServerQueryExecutor:
             return None
         return startree_exec.pick_star_tree(ctx, aggs, seg)
 
+    def _startree_kernel(self, spec: Tuple):
+        """spec -> jitted star-tree node-slice kernel (LRU-capped)."""
+        from pinot_tpu.engine.startree_device import build_startree_kernel
+
+        with self._startree_kernel_lock:
+            k = self._startree_kernels.get(spec)
+            if k is not None:
+                self._startree_kernels.move_to_end(spec)
+                return k
+        k = build_startree_kernel(spec)
+        with self._startree_kernel_lock:
+            cur = self._startree_kernels.setdefault(spec, k)
+            self._startree_kernels.move_to_end(spec)
+            if len(self._startree_kernels) > 256:
+                self._startree_kernels.popitem(last=False)
+            return cur
+
     def _try_star_tree(self, ctx: QueryContext, aggs: List[AggDef],
                        seg: ImmutableSegment, stats: QueryStats):
         """Pre-aggregated path when a star-tree fits the query
-        (ref: AggregationGroupByOrderByPlanNode.java:66-87 selection)."""
-        from pinot_tpu.engine import startree_exec
+        (ref: AggregationGroupByOrderByPlanNode.java:66-87 selection).
+        Returns ``(result, rung)`` — rung 'startree_device' when the node
+        arrays served through the device kernels, 'startree' for the host
+        walker — or None (no fit / untranslatable predicate -> scan)."""
+        from pinot_tpu.engine import startree_device, startree_exec
 
         pick = self._star_tree_pick(ctx, aggs, seg)
         if pick is None:
             return None
         tree, preds = pick
-        return startree_exec.execute_star_tree(ctx, aggs, seg, tree, preds,
-                                               stats)
+        matches = startree_exec.resolve_matches(seg, preds)
+        if matches is None:
+            return None  # predicate not dictId-translatable -> scan path
+        if self.use_device and self._device_admitted(stats):
+            try:
+                res = startree_device.execute_star_tree_device(
+                    self, ctx, aggs, seg, tree, matches, stats)
+                if res is not None:
+                    return res, "startree_device"
+            except PlanError:
+                pass  # node plan over device limits -> host walker
+        res = startree_exec.execute_with_matches(ctx, aggs, seg, tree,
+                                                 matches, stats)
+        return None if res is None else (res, "startree")
 
     def _metadata_fast_path(self, ctx: QueryContext, aggs: List[AggDef],
                             seg: ImmutableSegment,
@@ -487,8 +525,9 @@ class ServerQueryExecutor:
 
         st = self._try_star_tree(ctx, aggs, seg, stats)
         if st is not None:
-            stats.group_by_rung = "startree"
-            return done(st, "startree")
+            result, rung = st
+            stats.group_by_rung = rung
+            return done(result, rung)
         if self.use_device and self._device_admitted(stats):
             try:
                 plan = self._plan_for(ctx, seg)

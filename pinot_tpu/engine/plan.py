@@ -128,6 +128,138 @@ class PlanError(UnsupportedQueryError):
     """Query shape the device kernels don't cover -> host fallback."""
 
 
+# --------------------------------------------------------------------------
+# star-tree node plan: the pre-aggregation rung of the device ladder
+# --------------------------------------------------------------------------
+
+# pseudo-column namespace for star-tree node arrays: the kernel spec reads
+# these keys out of the staged node-column tree (engine/staging.py
+# startree_nodes), never a segment forward index
+def startree_dim_key(col: str) -> str:
+    return f"stdim:{col}"
+
+
+def startree_metric_key(fn: str, col: str) -> str:
+    return f"stmetric:{fn}__{col}"
+
+
+@dataclass
+class StarTreePlan:
+    """Executable device plan over one star-tree's node arrays.
+
+    The spec is a regular kernel spec (same ops, same param protocol, same
+    cache) whose capacity is the padded SELECTED-record count — the kernel
+    aggregates a gathered node slice, so the dense/hash group-by rungs and
+    the packed-output machinery apply unchanged. ``agg_map`` records how
+    the rewritten pre-agg leaves reassemble into the ORIGINAL aggregation
+    states (count -> sum of the count column, avg -> sum+count pair)."""
+
+    spec: Tuple
+    params: List[np.ndarray]
+    columns: List[str]            # pseudo node-column keys the kernel reads
+    group_cols: List[str]         # real dimension names (key decode)
+    group_cards: List[int]
+    group_bases: List[int]
+    group_strides: Optional[np.ndarray]
+    num_groups: int
+    agg_map: List[Tuple[str, List[int]]]  # (base, rewritten leaf indexes)
+
+
+def plan_star_tree(ctx, segment, tree, matches: Dict[str, Any],
+                   num_selected: int) -> StarTreePlan:
+    """Star-tree device eligibility + spec build. ``matches`` carries the
+    per-dimension dictId matches ``startree_exec.resolve_matches`` already
+    translated (the fit check in ``pick_star_tree`` has passed). Reuses the
+    PR-1 dictId-narrowing idea: a predicated group dimension's key range
+    shrinks to its match bounds, so selective Q2.x shapes land on the dense
+    rung outright. Raises PlanError when the node slice can't ride the
+    device kernels (the host walker serves instead)."""
+    from pinot_tpu.engine.startree_exec import _pairs_needed
+    from pinot_tpu.segment.startree import match_bounds
+
+    aggs = [resolve_agg(f) for f in ctx.aggregations]
+    params: List[np.ndarray] = []
+    columns: List[str] = []
+
+    group_cols: List[str] = []
+    group_specs: List[Tuple] = []
+    group_cards: List[int] = []
+    group_bases: List[int] = []
+    num_groups = 0
+    if ctx.group_by:
+        for e in ctx.group_by:
+            # pick_star_tree guarantees Identifier group exprs on tree dims
+            col = e.name
+            cm = segment.metadata.column(col)
+            lo, hi = 0, cm.cardinality - 1
+            if col in matches:
+                mlo, mhi = match_bounds(matches[col])
+                lo, hi = max(lo, mlo), min(hi, mhi)
+                if lo > hi:
+                    lo, hi = 0, 0  # unsatisfiable: 1-slot key space
+            group_cols.append(col)
+            group_cards.append(hi - lo + 1)
+            group_bases.append(lo)
+            key = startree_dim_key(col)
+            group_specs.append(("gdict", key))
+            if key not in columns:
+                columns.append(key)
+        total = 1
+        for c in group_cards:
+            total *= c
+            if total > MAX_DEVICE_GROUPS:
+                raise PlanError("star-tree group key space too large "
+                                "-> host walker")
+        num_groups = _next_pow2(total)
+        strides = np.ones(len(group_cards), dtype=np.int32)
+        for i in range(len(group_cards) - 2, -1, -1):
+            strides[i] = strides[i + 1] * group_cards[i + 1]
+        params.append(strides)
+        params.append(np.asarray(group_bases, dtype=np.int64))
+    else:
+        strides = None
+
+    # rewrite aggregations onto the pre-aggregated metric columns: COUNT
+    # becomes SUM over the count column, AVG splits into SUM+COUNT leaves
+    # reassembled at decode (ref: StarTreeGroupByExecutor reading
+    # AggregationFunctionColumnPair columns instead of raw values)
+    agg_specs: List[Tuple] = []
+    agg_map: List[Tuple[str, List[int]]] = []
+
+    def leaf(fn: str, col: str) -> int:
+        key = startree_metric_key(fn, col)
+        acc = "i64" if fn == "count" else "f64"
+        op = "sum" if fn in ("count", "sum") else fn
+        agg_specs.append((op, False, ("col", key, False), acc))
+        if key not in columns:
+            columns.append(key)
+        return len(agg_specs) - 1
+
+    for agg, fn in zip(aggs, ctx.aggregations):
+        pairs = _pairs_needed(agg, fn)
+        if pairs is None:  # pick_star_tree admitted it; stay defensive
+            raise PlanError(f"aggregation {agg.name} has no pre-agg pairs")
+        if agg.base == "avg":
+            (sfn, scol), (cfn, ccol) = pairs
+            agg_map.append(("avg", [leaf(sfn, scol), leaf(cfn, ccol)]))
+        else:
+            (pfn, pcol), = pairs
+            agg_map.append((agg.base, [leaf(pfn, pcol)]))
+
+    capacity = max(128, _next_pow2(max(1, num_selected)))
+    spec = (("true",), tuple(agg_specs), tuple(group_specs), num_groups,
+            capacity)
+    expected = expected_param_count(spec)
+    if len(params) != expected:
+        raise AssertionError(
+            f"star-tree param pack/unpack drift: packed {len(params)} but "
+            f"the spec consumes {expected} (spec={spec[:3]!r})")
+    return StarTreePlan(spec=spec, params=params, columns=columns,
+                        group_cols=group_cols, group_cards=group_cards,
+                        group_bases=group_bases, group_strides=strides,
+                        num_groups=num_groups, agg_map=agg_map)
+
+
 def plan_segment(ctx: QueryContext, segment: ImmutableSegment) -> SegmentPlan:
     if getattr(segment, "is_mutable", False):
         # consuming segments are host-resident (unsorted dictionaries, live

@@ -528,6 +528,20 @@ class ResidencyManager:
             except Exception:
                 log.debug("prefetch of column %r skipped", cname,
                           exc_info=True)
+        # star-tree node arrays ride the same warm-up: the first star-tree
+        # rung query then pays no H2D for the tree either
+        md = getattr(segment, "metadata", None)
+        for ti in range(int(getattr(md, "star_tree_count", 0) or 0)):
+            if budget is not None:
+                with self._lock:
+                    self._refresh_locked()
+                    if self._staged_bytes >= budget:
+                        return
+            try:
+                staged.startree_nodes(ti)
+            except Exception:
+                log.debug("prefetch of star-tree %d skipped", ti,
+                          exc_info=True)
         orphaned = None
         with self._lock:
             if self._retired.get(name, 0) != gen:
@@ -627,7 +641,8 @@ class ResidencyManager:
                 r = e.resident
                 if isinstance(r, StagedSegment):
                     d.update(columns=len(r._columns), packed=len(r._packed),
-                             values=len(r._values))
+                             values=len(r._values),
+                             startrees=len(r._startree))
                 else:
                     d["kind"] = type(r).__name__
                 residents[name] = d

@@ -70,8 +70,8 @@ class QueryStats:
     total_docs: int = 0
     num_groups_limit_reached: bool = False
     # group-by ladder rung that served ('dense'|'compact'|'hash'|'sort'|
-    # 'startree'|'host'; 'mixed' when segments split across rungs) — the
-    # bench gates SSB Q3.x on this
+    # 'startree_device'|'startree'|'host'; 'mixed' when segments split
+    # across rungs) — the bench gates SSB Q2.x/Q3.x on this
     group_by_rung: Optional[str] = None
     # HBM residency counters for this query (engine/residency.py):
     # hits/misses/evictions/pinBlockedEvictions/spills sum across

@@ -118,6 +118,10 @@ class StagedSegment:
         self._columns: Dict[str, StagedColumn] = {}  # guarded-by-writes: _lock
         self._packed: Dict[str, PackedColumn] = {}  # guarded-by-writes: _lock
         self._values: Dict[str, jnp.ndarray] = {}  # guarded-by-writes: _lock
+        # star-tree node arrays: tree index -> {pseudo-column key -> array}
+        # (engine/plan.py startree_dim_key/startree_metric_key namespace) —
+        # resident like any column: counted in nbytes(), dropped in release()
+        self._startree: Dict[int, Dict[str, jnp.ndarray]] = {}  # guarded-by-writes: _lock
         self._valid_cache = None  # guarded-by-writes: _lock
         self._lock = threading.Lock()
         # cross-query dedup hook: ``borrower(segment, name)`` may return a
@@ -237,6 +241,41 @@ class StagedSegment:
                     self._values[name] = v
         return v
 
+    def startree_nodes(self, tree_index: int) -> Dict[str, jnp.ndarray]:
+        """Device image of star-tree ``tree_index``'s node record columns:
+        one int32 [R] array per split dimension (dictIds, STAR = -1) and
+        one value array per pre-agg pair (i64 counts, f64 values). Staged
+        once per resident — the star-tree rung gathers query-selected node
+        slices out of these, so repeat queries pay zero H2D for the tree."""
+        key = int(tree_index)
+        t = self._startree.get(key)
+        if t is None:
+            with self._lock:
+                t = self._startree.get(key)
+                if t is None:
+                    t = self._stage_startree(key)
+                    self._startree[key] = t
+        return t
+
+    def _stage_startree(self, tree_index: int) -> Dict[str, jnp.ndarray]:
+        from pinot_tpu.engine.plan import (
+            startree_dim_key,
+            startree_metric_key,
+        )
+
+        tree = self.segment.star_trees[tree_index]
+        cols: Dict[str, jnp.ndarray] = {}
+        dims = np.asarray(tree.dims)
+        for i, name in enumerate(tree.config.dimensions_split_order):
+            cols[startree_dim_key(name)] = jnp.asarray(
+                np.ascontiguousarray(dims[:, i]).astype(np.int32))
+        for pair, vals in tree.metrics.items():
+            fn, _, col = pair.partition("__")
+            dt = np.int64 if fn == "count" else np.float64
+            cols[startree_metric_key(fn, col)] = jnp.asarray(
+                np.asarray(vals).astype(dt))
+        return cols
+
     def valid_mask(self):
         """Upsert valid-doc snapshot [capacity] for the validdocs kernel
         param, or None when the segment isn't upsert-managed. Versioned
@@ -275,6 +314,9 @@ class StagedSegment:
             total += int(pc.words.nbytes)
         for v in list(self._values.values()):
             total += int(v.nbytes)
+        for t in list(self._startree.values()):
+            for arr in t.values():
+                total += int(getattr(arr, "nbytes", 0))
         vc = self._valid_cache
         if vc is not None:
             total += int(getattr(vc[1], "nbytes", 0))
@@ -289,6 +331,7 @@ class StagedSegment:
             self._columns.clear()
             self._packed.clear()
             self._values.clear()
+            self._startree.clear()
             self._valid_cache = None
 
 

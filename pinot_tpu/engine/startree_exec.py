@@ -24,9 +24,13 @@ from pinot_tpu.query.expressions import (
     Predicate,
     PredicateType,
 )
-from pinot_tpu.segment.startree import STAR, StarTree
+from pinot_tpu.segment.startree import STAR, DictIdRange, StarTree
 
-_MAX_RANGE_IDS = 100_000  # cap on materialized dictId sets for RANGE
+# cap on MATERIALIZED dictId sets: a predicate matching more ids than this
+# never builds a python set. Contiguous runs (every RANGE over a sorted
+# dictionary) decline to a DictIdRange slice check instead; only
+# non-contiguous overflows (NOT_IN over a huge dictionary) bail to the scan
+_MAX_RANGE_IDS = 100_000
 
 
 def _flatten_and(node: Optional[FilterNode]) -> Optional[List[Predicate]]:
@@ -119,9 +123,11 @@ def pick_star_tree(ctx: QueryContext, aggs: List[AggDef],
     return None
 
 
-def _matching_ids(segment, pred: Predicate) -> Optional[Set[int]]:
-    """Predicate -> matching dictId set over the dimension's dictionary
-    (reuses the host predicate evaluators)."""
+def _matching_ids(segment, pred: Predicate):
+    """Predicate -> dictId match over the dimension's dictionary (reuses
+    the host predicate evaluators): a set when small enough to materialize,
+    a :class:`DictIdRange` when the ids are contiguous but over the cap
+    (the RANGE shape), None when neither fits (scan path serves)."""
     from pinot_tpu.engine.host_eval import _matching_dict_ids
 
     ds = segment.data_source(pred.lhs.name)
@@ -129,24 +135,54 @@ def _matching_ids(segment, pred: Predicate) -> Optional[Set[int]]:
         return None
     ids = _matching_dict_ids(ds, pred)
     if len(ids) > _MAX_RANGE_IDS:
+        if int(ids[-1]) - int(ids[0]) + 1 == len(ids):
+            return DictIdRange(int(ids[0]), int(ids[-1]))
         return None
     return set(int(i) for i in ids)
+
+
+def _intersect(a, b):
+    """Meet of two dictId matches (set | DictIdRange)."""
+    if isinstance(a, DictIdRange) and isinstance(b, DictIdRange):
+        return DictIdRange(max(a.lo, b.lo), min(a.hi, b.hi))
+    if isinstance(a, DictIdRange):
+        return {v for v in b if v in a}
+    if isinstance(b, DictIdRange):
+        return {v for v in a if v in b}
+    return a & b
+
+
+def resolve_matches(segment, preds: List[Predicate]) -> Optional[Dict[str, Any]]:
+    """AND-ed predicates -> per-dimension dictId match (set | DictIdRange),
+    or None when a predicate cannot be translated (the caller falls back to
+    the scan path). Shared by the host walker and the device rung."""
+    matches: Dict[str, Any] = {}
+    for p in preds:
+        ids = _matching_ids(segment, p)
+        if ids is None:
+            return None
+        col = p.lhs.name
+        matches[col] = ids if col not in matches \
+            else _intersect(matches[col], ids)
+    return matches
 
 
 def execute_star_tree(ctx: QueryContext, aggs: List[AggDef], segment,
                       tree: StarTree, preds: List[Predicate],
                       stats: Optional[QueryStats] = None):
     """-> AggResult or GroupByResult built from pre-aggregated records."""
-    eq_in: Dict[str, Set[int]] = {}
-    for p in preds:
-        ids = _matching_ids(segment, p)
-        if ids is None:
-            return None
-        col = p.lhs.name
-        eq_in[col] = ids if col not in eq_in else (eq_in[col] & ids)
+    matches = resolve_matches(segment, preds)
+    if matches is None:
+        return None
+    return execute_with_matches(ctx, aggs, segment, tree, matches, stats)
 
+
+def execute_with_matches(ctx: QueryContext, aggs: List[AggDef], segment,
+                         tree: StarTree, matches: Dict[str, Any],
+                         stats: Optional[QueryStats] = None):
+    """Host (numpy) aggregation over the tree-walk-selected records."""
     group_cols = [e.name for e in ctx.group_by]
-    idx = tree.select_records(eq_in, group_cols)
+    idx = tree.select_records(matches, group_cols)
 
     if stats is not None:
         stats.num_segments_processed += 1

@@ -101,9 +101,13 @@ class ShardedQueryExecutor(ServerQueryExecutor):
 
     # -- combine overrides --------------------------------------------------
     def _any_star_tree_fit(self, ctx, aggs, segments) -> bool:
-        """Star-tree-eligible queries take the per-segment path: the
-        pre-aggregated records beat a dense sharded scan (ref: the star-tree
-        plan wins in AggregationGroupByOrderByPlanNode.java:66-87). All
+        """Star-tree-eligible queries take the per-segment path: each
+        segment's node slice rides the DEVICE star-tree rung
+        (engine/startree_device.py) and partials merge through
+        GroupByResult — the pre-aggregated records beat a dense sharded
+        scan (ref: the star-tree plan wins in
+        AggregationGroupByOrderByPlanNode.java:66-87), and the launch
+        dispatcher keeps coalescing the non-fit traffic unchanged. All
         segments of a table share their indexing config, so the first
         segment carrying trees is representative — one fit check, not K."""
         return any(self._star_tree_pick(ctx, aggs, s) is not None

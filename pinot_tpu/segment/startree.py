@@ -29,6 +29,39 @@ STAR = -1
 STARTREE_DIR = "startree{index}"
 META_FILE = "startree_metadata.json"
 
+
+class DictIdRange:
+    """Contiguous inclusive dictId interval [lo, hi] — the cap-safe match
+    representation for RANGE predicates: sorted dictionaries map a value
+    range to one contiguous dictId run, so a predicate matching millions of
+    dictIds is a two-compare slice check instead of a materialized set
+    (the set-based path caps at ``startree_exec._MAX_RANGE_IDS``)."""
+
+    __slots__ = ("lo", "hi")
+
+    def __init__(self, lo: int, hi: int):
+        self.lo = int(lo)
+        self.hi = int(hi)
+
+    def __contains__(self, v) -> bool:
+        return self.lo <= int(v) <= self.hi
+
+    def __len__(self) -> int:
+        return max(0, self.hi - self.lo + 1)
+
+    def __repr__(self) -> str:
+        return f"DictIdRange({self.lo}, {self.hi})"
+
+
+def match_bounds(match) -> Tuple[int, int]:
+    """Inclusive (lo, hi) dictId bounds of a match (set or DictIdRange);
+    (0, -1) for an empty match."""
+    if isinstance(match, DictIdRange):
+        return match.lo, match.hi
+    if not match:
+        return 0, -1
+    return min(match), max(match)
+
 # aggregation pairs supported in tree records (ref:
 # AggregationFunctionColumnPair; COUNT uses the catch-all '*' column)
 _MERGEABLE = {"count", "sum", "min", "max"}
@@ -279,12 +312,14 @@ class StarTree:
 
     # -- query-time traversal (ref: StarTreeFilterOperator.java:87) ----------
     def select_records(self,
-                       eq_in_per_dim: Dict[str, Set[int]],
+                       eq_in_per_dim: Dict[str, Any],
                        group_by_dims: List[str]) -> np.ndarray:
         """Record indices answering the query: for each split dimension —
         with a predicate: descend matching children; grouped: descend all
         non-star children; otherwise: descend the star child (fall back to
-        scanning all children + post-mask when absent)."""
+        scanning all children + post-mask when absent). Predicate matches
+        are dictId sets or contiguous :class:`DictIdRange` slices (both
+        support ``in``; the post-filter branches on the kind)."""
         grouped = set(self._dim_index[d] for d in group_by_dims)
         predicates = {self._dim_index[d]: ids
                       for d, ids in eq_in_per_dim.items()}
@@ -328,8 +363,11 @@ class StarTree:
         mask = np.ones(idx.shape[0], dtype=bool)
         for dim, match in predicates.items():
             col = self.dims[idx, dim]
-            mask &= np.isin(col, np.fromiter(match, dtype=np.int32,
-                                             count=len(match)))
+            if isinstance(match, DictIdRange):
+                mask &= (col >= match.lo) & (col <= match.hi)
+            else:
+                mask &= np.isin(col, np.fromiter(match, dtype=np.int32,
+                                                 count=len(match)))
         for dim in grouped:
             mask &= self.dims[idx, dim] != STAR
         # free dims need no post-filter: each emitted leaf range holds either

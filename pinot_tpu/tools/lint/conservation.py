@@ -536,6 +536,18 @@ def _check_cache_parity(mod: Module, node: ast.ClassDef,
                     base = base.value
                 if isinstance(base, ast.Attribute) and is_self_attr(base):
                     fields.setdefault(base.attr, (mname, n.lineno))
+            # mutating-call population (``self.F.setdefault(k, arrays)``,
+            # ``.update``, ``.append``): the star-tree node-array shape —
+            # a cache filled without a plain subscript assignment must
+            # still obey the nbytes()/release() parity contract
+            if isinstance(n, ast.Call) and isinstance(n.func, ast.Attribute) \
+                    and n.func.attr in ("setdefault", "update", "append") \
+                    and n.args:
+                base = n.func.value
+                while isinstance(base, ast.Subscript):
+                    base = base.value
+                if isinstance(base, ast.Attribute) and is_self_attr(base):
+                    fields.setdefault(base.attr, (mname, n.lineno))
     read_in_nbytes = {n.attr for n in ast.walk(nbytes_fn)
                       if isinstance(n, ast.Attribute) and is_self_attr(n)}
     cleared: Set[str] = set()

@@ -175,13 +175,32 @@ def generate_table(num_segments: int, rows: int,
     return {k: np.concatenate([f[k] for f in frames]) for k in frames[0]}
 
 
+def ssb_indexing_config():
+    """Default lineorder indexing: the star-tree over the Q2.x dimensions
+    (split order descending-ish cardinality under the determinism chain:
+    brand determines category) with the revenue/supplycost/count pre-aggs —
+    the index that turns the Q2.x flights from 3M-doc scans into
+    few-thousand-node slices (ref: enableDefaultStarTree on lineorder in
+    the reference's SSB configs)."""
+    from pinot_tpu.spi.table import IndexingConfig, StarTreeIndexConfig
+
+    return IndexingConfig(star_tree_index_configs=[StarTreeIndexConfig(
+        dimensions_split_order=["d_year", "c_region", "s_region",
+                                "p_category", "p_brand1"],
+        function_column_pairs=["SUM__lo_revenue", "SUM__lo_supplycost",
+                               "COUNT__*"],
+        max_leaf_records=10_000)])
+
+
 def _build_one(i: int, num_segments: int, n: int, seed: int,
                out_dir: str) -> str:
     """Worker: generate + build one segment (process-pool entry point)."""
     from pinot_tpu.segment import SegmentBuilder
 
     frame = generate_segment_frame(i, num_segments, n, seed)
-    SegmentBuilder(ssb_schema(), f"ssb_{i}").build(frame, out_dir)
+    SegmentBuilder(ssb_schema(), f"ssb_{i}",
+                   indexing_config=ssb_indexing_config()).build(frame,
+                                                               out_dir)
     return f"ssb_{i}"
 
 

@@ -28,6 +28,10 @@ def pytest_configure(config):
         "markers",
         "lint: graftlint static-analysis gate (pytest -m lint runs just "
         "the invariant checkers)")
+    config.addinivalue_line(
+        "markers",
+        "startree: star-tree pre-aggregation rung (pytest -m startree "
+        "exercises build/plan/device-exec in isolation; part of tier-1)")
 
 
 @pytest.fixture(scope="session")

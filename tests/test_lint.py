@@ -747,6 +747,39 @@ def test_conservation_catches_unaccounted_insert(tmp_path):
     assert not any("put_ok" in f.symbol for f in cf)
 
 
+def test_conservation_cache_parity_star_tree_nodes(tmp_path):
+    """Star-tree node-array residents obey the same byte-accounting and
+    release obligations as column residents: a node cache populated via
+    ``setdefault`` (no plain subscript assignment anywhere) that nbytes()
+    cannot see and release() never drops must be flagged on both axes."""
+    new = _lint(tmp_path, """\
+        class StagedNodes:
+            def __init__(self):
+                self._columns = {}
+                self._startree = {}
+
+            def column(self, name):
+                col = object()
+                self._columns[name] = col
+                return col
+
+            def startree_nodes(self, i):
+                return self._startree.setdefault(i, {"dim": object()})
+
+            def nbytes(self):
+                return len(self._columns)
+
+            def release(self):
+                self._columns.clear()
+        """)
+    cf = _by_checker(new, "conservation")
+    assert any("_startree" in f.symbol and f.symbol.endswith("nbytes")
+               for f in cf), [f.render() for f in new]
+    assert any("_startree" in f.symbol and f.symbol.endswith("release")
+               for f in cf), [f.render() for f in new]
+    assert not any("_columns" in f.symbol for f in cf)
+
+
 def test_conservation_catches_discarded_pop(tmp_path):
     new = _lint(tmp_path, CONSERVATION_PRELUDE + """\
         def drop(self, name):

@@ -1,6 +1,7 @@
-"""Star-tree index: build/load round-trip and the reference's core parity
+"""Star-tree index: build/load round-trip, the reference's core parity
 strategy — star-tree answers must equal non-star-tree answers on the same
-data (ref: StarTreeClusterIntegrationTest)."""
+data (ref: StarTreeClusterIntegrationTest) — and the DEVICE rung: node
+slices through the group-by kernels, bit-identical to the scan paths."""
 
 import numpy as np
 import pandas as pd
@@ -11,9 +12,17 @@ from pinot_tpu.engine.aggregates import resolve_agg
 from pinot_tpu.engine.startree_exec import pick_star_tree
 from pinot_tpu.query import compile_query
 from pinot_tpu.segment import SegmentBuilder, load_segment
-from pinot_tpu.segment.startree import STAR, StarTree, StarTreeBuilder, StarTreeConfig
+from pinot_tpu.segment.startree import (
+    STAR,
+    DictIdRange,
+    StarTree,
+    StarTreeBuilder,
+    StarTreeConfig,
+)
 from pinot_tpu.spi import DataType, FieldSpec, FieldType, Schema
 from pinot_tpu.spi.table import IndexingConfig, StarTreeIndexConfig
+
+pytestmark = pytest.mark.startree
 
 N = 4000
 
@@ -168,3 +177,320 @@ class TestStarTreeBuilder:
         assert tree.has_pair("count", "*")
         assert tree.has_pair("sum", "revenue")
         assert tree.has_pair("sum", "units")
+
+
+# ==========================================================================
+# the device rung: node slices through the group-by kernels
+# ==========================================================================
+
+SSB_DIMS = ["d_year", "c_region", "s_region", "p_category", "p_brand1"]
+
+
+def ssb_shaped_schema():
+    D, M = FieldType.DIMENSION, FieldType.METRIC
+    return Schema("lineorder_t", [
+        FieldSpec("d_year", DataType.INT, D),
+        FieldSpec("c_region", DataType.STRING, D),
+        FieldSpec("s_region", DataType.STRING, D),
+        FieldSpec("p_category", DataType.STRING, D),
+        FieldSpec("p_brand1", DataType.STRING, D),
+        FieldSpec("lo_quantity", DataType.INT, D),
+        FieldSpec("lo_revenue", DataType.LONG, M),
+        FieldSpec("lo_supplycost", DataType.LONG, M),
+        FieldSpec("tags", DataType.LONG, single_value=False),
+    ])
+
+
+def ssb_shaped_frame(n, seed):
+    rng = np.random.default_rng(seed)
+    regions = np.array(["AFRICA", "AMERICA", "ASIA", "EUROPE"])
+    cat_i = rng.integers(0, 5, n)
+    brand_i = rng.integers(0, 4, n)
+    return {
+        "d_year": rng.integers(1992, 1999, n).astype(np.int64),
+        "c_region": regions[rng.integers(0, 4, n)],
+        "s_region": regions[rng.integers(0, 4, n)],
+        "p_category": np.array([f"C{i}" for i in range(5)])[cat_i],
+        "p_brand1": np.array([f"C{c}B{b}" for c in range(5)
+                              for b in range(4)])[cat_i * 4 + brand_i],
+        "lo_quantity": rng.integers(1, 50, n).astype(np.int64),
+        "lo_revenue": rng.integers(100, 900_000, n).astype(np.int64),
+        "lo_supplycost": rng.integers(50, 60_000, n).astype(np.int64),
+        "tags": [list(rng.integers(0, 9, rng.integers(1, 4)))
+                 for _ in range(n)],
+    }
+
+
+@pytest.fixture(scope="module")
+def ssb_shaped(tmp_path_factory):
+    """Two SSB-shaped segments with the full pre-agg pair set (sum/min/max
+    revenue + sum supplycost + count, so avg/min/max queries are eligible
+    too)."""
+    out = str(tmp_path_factory.mktemp("st_dev"))
+    cfg = IndexingConfig(star_tree_index_configs=[StarTreeIndexConfig(
+        dimensions_split_order=list(SSB_DIMS),
+        function_column_pairs=["COUNT__*", "SUM__lo_revenue",
+                               "SUM__lo_supplycost", "MIN__lo_revenue",
+                               "MAX__lo_revenue"],
+        max_leaf_records=64)])
+    segs = []
+    for i in range(2):
+        b = SegmentBuilder(ssb_shaped_schema(), f"lot_{i}",
+                           indexing_config=cfg)
+        b.build(ssb_shaped_frame(6000, seed=50 + i), out)
+        segs.append(load_segment(f"{out}/lot_{i}"))
+    assert all(s.metadata.star_tree_count == 1 for s in segs)
+    return segs
+
+
+@pytest.fixture(scope="module")
+def device_exec():
+    return ServerQueryExecutor()
+
+
+@pytest.fixture(scope="module")
+def host_exec():
+    return ServerQueryExecutor(use_device=False)
+
+
+def _run3(sql, segs, device_exec, host_exec):
+    """(device rows+stats, device-scan rows, host rows) for one SQL."""
+    got, stats = device_exec.execute(compile_query(sql), segs)
+    scan_ctx = compile_query(sql)
+    scan_ctx.options["useStarTree"] = "false"
+    scan, _ = device_exec.execute(scan_ctx, segs)
+    want, _ = host_exec.execute(compile_query(sql), segs)
+    return got, stats, scan, want
+
+
+def _assert_identical(name, a_rows, b_rows):
+    """BIT-identical: pre-agg sums of integers in f64 are exact, so the
+    star-tree rung owes the scan paths full equality, not approx."""
+    assert len(a_rows) == len(b_rows), (name, len(a_rows), len(b_rows))
+    for ar, br in zip(a_rows, b_rows):
+        assert ar == br, (name, ar, br)
+
+
+class TestStarTreeDeviceRung:
+    AGGS = ["count(*)", "sum(lo_revenue)", "sum(lo_supplycost)",
+            "min(lo_revenue)", "max(lo_revenue)", "avg(lo_revenue)"]
+
+    def test_q2_shape_serves_from_device_nodes(self, ssb_shaped,
+                                               device_exec, host_exec):
+        sql = ("SELECT d_year, p_brand1, sum(lo_revenue) FROM lineorder_t "
+               "WHERE p_category = 'C2' AND s_region = 'AMERICA' "
+               "GROUP BY d_year, p_brand1 ORDER BY d_year, p_brand1 "
+               "LIMIT 10000")
+        got, stats, scan, want = _run3(sql, ssb_shaped, device_exec,
+                                       host_exec)
+        assert stats.group_by_rung == "startree_device"
+        total = sum(s.num_docs for s in ssb_shaped)
+        assert 0 < stats.num_docs_scanned < total / 10
+        _assert_identical("q2-scan", got.rows, scan.rows)
+        _assert_identical("q2-host", got.rows, want.rows)
+
+    def test_parity_fuzz_eligible(self, ssb_shaped, device_exec, host_exec):
+        """Randomized eligible queries: device star-tree rung vs full-scan
+        device path vs host engine, bit-identical, rung recorded."""
+        rng = np.random.default_rng(7)
+        preds_pool = [
+            "c_region = 'ASIA'",
+            "s_region IN ('AMERICA', 'EUROPE')",
+            "p_category = 'C1'",
+            "p_brand1 BETWEEN 'C1B0' AND 'C3B2'",
+            "d_year BETWEEN 1993 AND 1996",
+            "d_year IN (1992, 1995, 1998)",
+        ]
+        for trial in range(20):
+            gdims = list(rng.choice(SSB_DIMS, size=int(rng.integers(1, 4)),
+                                    replace=False))
+            aggs = list(rng.choice(self.AGGS,
+                                   size=int(rng.integers(1, 4)),
+                                   replace=False))
+            preds = list(rng.choice(preds_pool,
+                                    size=int(rng.integers(0, 3)),
+                                    replace=False))
+            sql = (f"SELECT {', '.join(gdims + aggs)} FROM lineorder_t "
+                   + (f"WHERE {' AND '.join(preds)} " if preds else "")
+                   + f"GROUP BY {', '.join(gdims)} "
+                   + f"ORDER BY {', '.join(gdims)} LIMIT 100000")
+            got, stats, scan, want = _run3(sql, ssb_shaped, device_exec,
+                                           host_exec)
+            assert stats.group_by_rung == "startree_device", (trial, sql)
+            _assert_identical(f"fuzz{trial}-scan", got.rows, scan.rows)
+            _assert_identical(f"fuzz{trial}-host", got.rows, want.rows)
+
+    @pytest.mark.parametrize("sql,why", [
+        ("SELECT d_year, sum(lo_revenue) FROM lineorder_t "
+         "WHERE c_region = 'ASIA' OR s_region = 'ASIA' "
+         "GROUP BY d_year ORDER BY d_year", "OR filter"),
+        ("SELECT lo_quantity, sum(lo_revenue) FROM lineorder_t "
+         "WHERE c_region = 'ASIA' GROUP BY lo_quantity "
+         "ORDER BY lo_quantity LIMIT 100", "group-by off the split order"),
+        ("SELECT d_year, summv(tags) FROM lineorder_t GROUP BY d_year "
+         "ORDER BY d_year", "MV aggregation has no pre-agg pair"),
+        ("SELECT d_year, sum(lo_quantity) FROM lineorder_t GROUP BY d_year "
+         "ORDER BY d_year", "aggregation outside the pre-agg set"),
+    ])
+    def test_almost_eligible_falls_to_scan(self, ssb_shaped, device_exec,
+                                           host_exec, sql, why):
+        """Queries one rule short of eligibility must take the scan path —
+        correct rung AND correct answers."""
+        got, stats = device_exec.execute(compile_query(sql), ssb_shaped)
+        assert stats.group_by_rung not in ("startree_device", "startree"), \
+            (why, stats.group_by_rung)
+        want, _ = host_exec.execute(compile_query(sql), ssb_shaped)
+        _assert_identical(why, got.rows, want.rows)
+
+    def test_scalar_aggregation_on_device_nodes(self, ssb_shaped,
+                                                device_exec, host_exec):
+        sql = ("SELECT count(*), sum(lo_revenue), avg(lo_revenue) "
+               "FROM lineorder_t WHERE c_region = 'AMERICA'")
+        got, stats, scan, want = _run3(sql, ssb_shaped, device_exec,
+                                       host_exec)
+        total = sum(s.num_docs for s in ssb_shaped)
+        assert 0 < stats.num_docs_scanned < total / 10
+        _assert_identical("scalar-scan", got.rows, scan.rows)
+        _assert_identical("scalar-host", got.rows, want.rows)
+
+    def test_empty_slice_matches_scan(self, ssb_shaped, device_exec,
+                                      host_exec):
+        sql = ("SELECT d_year, sum(lo_revenue) FROM lineorder_t "
+               "WHERE c_region = 'AMERICA' AND c_region = 'ASIA' "
+               "GROUP BY d_year ORDER BY d_year")
+        got, stats, scan, want = _run3(sql, ssb_shaped, device_exec,
+                                       host_exec)
+        _assert_identical("empty-scan", got.rows, scan.rows)
+        _assert_identical("empty-host", got.rows, want.rows)
+        assert got.rows == []
+
+
+class TestCapSafeRange:
+    def test_range_over_cap_declines_to_slice(self, ssb_shaped, device_exec,
+                                              host_exec, monkeypatch):
+        """A RANGE whose dictId set would exceed _MAX_RANGE_IDS must ride a
+        contiguous DictIdRange slice check — still the star-tree rung, same
+        answers — instead of bailing to the full scan."""
+        from pinot_tpu.engine import startree_exec
+
+        monkeypatch.setattr(startree_exec, "_MAX_RANGE_IDS", 4)
+        sql = ("SELECT d_year, p_brand1, sum(lo_revenue) FROM lineorder_t "
+               "WHERE p_brand1 BETWEEN 'C0B0' AND 'C2B3' "  # 12 dictIds > 4
+               "GROUP BY d_year, p_brand1 ORDER BY d_year, p_brand1 "
+               "LIMIT 100000")
+        got, stats, scan, want = _run3(sql, ssb_shaped, device_exec,
+                                       host_exec)
+        assert stats.group_by_rung == "startree_device"
+        _assert_identical("cap-scan", got.rows, scan.rows)
+        _assert_identical("cap-host", got.rows, want.rows)
+
+    def test_range_at_cap_boundary_stays_set(self, ssb_shaped, device_exec,
+                                             host_exec, monkeypatch):
+        from pinot_tpu.engine import startree_exec
+        from pinot_tpu.query.expressions import Identifier, Predicate, PredicateType
+
+        monkeypatch.setattr(startree_exec, "_MAX_RANGE_IDS", 4)
+        seg = ssb_shaped[0]
+        # exactly at the cap: still a set
+        p = Predicate(PredicateType.RANGE, Identifier("p_brand1"),
+                      lower="C0B0", upper="C0B3",
+                      lower_inclusive=True, upper_inclusive=True)
+        m = startree_exec._matching_ids(seg, p)
+        assert isinstance(m, set) and len(m) == 4
+        # one past the cap: the contiguous slice representation
+        p2 = Predicate(PredicateType.RANGE, Identifier("p_brand1"),
+                       lower="C0B0", upper="C1B0",
+                       lower_inclusive=True, upper_inclusive=True)
+        m2 = startree_exec._matching_ids(seg, p2)
+        assert isinstance(m2, DictIdRange) and len(m2) == 5
+
+    def test_noncontiguous_over_cap_falls_to_scan(self, ssb_shaped,
+                                                  device_exec, host_exec,
+                                                  monkeypatch):
+        from pinot_tpu.engine import startree_exec
+
+        monkeypatch.setattr(startree_exec, "_MAX_RANGE_IDS", 4)
+        # NOT_IN materializes card-1 non-contiguous ids > cap -> scan path
+        sql = ("SELECT d_year, sum(lo_revenue) FROM lineorder_t "
+               "WHERE p_brand1 NOT IN ('C2B1') GROUP BY d_year "
+               "ORDER BY d_year")
+        got, stats = device_exec.execute(compile_query(sql), ssb_shaped)
+        assert stats.group_by_rung not in ("startree_device", "startree")
+        want, _ = host_exec.execute(compile_query(sql), ssb_shaped)
+        _assert_identical("notin", got.rows, want.rows)
+
+    def test_select_records_range_equals_set(self, ssb_shaped):
+        tree = ssb_shaped[0].star_trees[0]
+        as_range = tree.select_records({"p_brand1": DictIdRange(3, 9)},
+                                       ["d_year"])
+        as_set = tree.select_records({"p_brand1": set(range(3, 10))},
+                                     ["d_year"])
+        np.testing.assert_array_equal(np.sort(as_range), np.sort(as_set))
+
+
+class TestNodeArrayResidency:
+    def test_nodes_in_memory_accounting_and_evictable(self, ssb_shaped):
+        """Acceptance: node arrays appear in /debug/memory byte accounting
+        and are evictable under budget pressure."""
+        ex = ServerQueryExecutor()
+        sql = ("SELECT d_year, sum(lo_revenue) FROM lineorder_t "
+               "WHERE p_category = 'C1' GROUP BY d_year ORDER BY d_year")
+        _, stats = ex.execute(compile_query(sql), ssb_shaped)
+        assert stats.group_by_rung == "startree_device"
+
+        snap = ex.residency.snapshot()
+        staged = snap["stagedSegments"]
+        assert staged, "star-tree query staged nothing"
+        assert all(d["startrees"] >= 1 for d in staged.values()), staged
+        assert snap["stagedBytes"] > 0
+        # node bytes are part of the resident's accounting: releasing the
+        # trees must shrink nbytes
+        name = next(iter(staged))
+        resident = ex.residency._entries[name].resident
+        with_nodes = resident.nbytes()
+        node_bytes = sum(int(a.nbytes) for t in resident._startree.values()
+                         for a in t.values())
+        assert node_bytes > 0
+        assert with_nodes >= node_bytes
+
+        # budget pressure: unpinned residents (trees included) evict
+        ex.residency.set_budget_bytes(1)
+        assert ex.residency.resident_count() == 0
+        assert resident._startree == {}
+        # and the rung recovers after eviction (restage on demand)
+        ex.residency.set_budget_bytes(0)  # uncapped
+        _, stats2 = ex.execute(compile_query(sql), ssb_shaped)
+        assert stats2.group_by_rung == "startree_device"
+
+    def test_spilled_query_uses_host_walker(self, ssb_shaped, host_exec):
+        """Admission spill (device not allowed) must still serve star-tree
+        queries — through the host walker, host-identical."""
+        ex = ServerQueryExecutor(hbm_budget_bytes=1)
+        sql = ("SELECT d_year, sum(lo_revenue) FROM lineorder_t "
+               "WHERE p_category = 'C1' GROUP BY d_year ORDER BY d_year")
+        got, stats = ex.execute(compile_query(sql), ssb_shaped)
+        assert stats.group_by_rung == "startree"
+        assert stats.staging.get("spills") == 1
+        want, _ = host_exec.execute(compile_query(sql), ssb_shaped)
+        _assert_identical("spill", got.rows, want.rows)
+
+
+class TestShardedStarTree:
+    def test_sharded_executor_rides_device_rung(self, ssb_shaped,
+                                                host_exec):
+        """The sharded combine routes star-tree-fit queries to the
+        per-segment path: each segment's node slice through the device
+        kernels, partials merged by GroupByResult (the CombineOperator
+        analogue) — coalescing machinery untouched."""
+        from pinot_tpu.parallel import ShardedQueryExecutor
+
+        ex = ShardedQueryExecutor()
+        sql = ("SELECT d_year, p_brand1, sum(lo_revenue), count(*) "
+               "FROM lineorder_t WHERE s_region = 'EUROPE' "
+               "GROUP BY d_year, p_brand1 ORDER BY d_year, p_brand1 "
+               "LIMIT 100000")
+        got, stats = ex.execute(compile_query(sql), ssb_shaped)
+        assert stats.group_by_rung == "startree_device"
+        assert stats.num_segments_processed == len(ssb_shaped)
+        want, _ = host_exec.execute(compile_query(sql), ssb_shaped)
+        _assert_identical("sharded", got.rows, want.rows)

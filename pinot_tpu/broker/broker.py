@@ -25,7 +25,7 @@ from pinot_tpu.broker.routing import RoutingManager
 from pinot_tpu.common.datatable import DataTable
 from pinot_tpu.common.response import BrokerResponse
 from pinot_tpu.controller.state import ClusterStateStore
-from pinot_tpu.engine.errors import QueryError
+from pinot_tpu.engine.errors import QueryError, QueryRejectedError
 from pinot_tpu.engine.results import QueryStats
 from pinot_tpu.query import SqlParseError, compile_query
 from pinot_tpu.query.context import QueryContext
@@ -62,7 +62,8 @@ class BrokerRequestHandler:
     def __init__(self, store: ClusterStateStore,
                  routing: Optional[RoutingManager] = None,
                  scatter_workers: int = 16,
-                 query_timeout_s: float = 30.0):
+                 query_timeout_s: float = 30.0,
+                 coalesce: bool = True):
         from pinot_tpu.spi.metrics import MetricsRegistry
 
         self.store = store
@@ -83,6 +84,22 @@ class BrokerRequestHandler:
             store,
             num_brokers_fn=lambda: max(
                 len(store.instances("BROKER", only_alive=True)), 1))
+        # single admission gate for the front door: the per-table QPS
+        # quota rides it (reason="quota" rejections), and operators can
+        # bound broker concurrency through configure() — the server-side
+        # executor gate bounds execution below.
+        from pinot_tpu.server.admission import AdmissionGate
+
+        self.admission = AdmissionGate(max_concurrent=-1, quota=self.quota,
+                                       name="broker-admission")
+        # single-flight coalescing: concurrent IDENTICAL dashboard queries
+        # (same normalized SQL + principal + cluster-state generation)
+        # share one compile/scatter/gather/reduce, before any fan-out
+        from pinot_tpu.common.singleflight import SingleFlight
+
+        self.coalesce = coalesce
+        self._flights = SingleFlight()
+        self._leading = _threading.local()
 
     # -- transport registry --------------------------------------------------
     def register_server(self, instance_id: str, server) -> None:
@@ -93,6 +110,63 @@ class BrokerRequestHandler:
     # -- entry (ref: handleSQLRequest:203) -----------------------------------
     def handle_sql(self, sql: str, principal=None,
                    access_control=None) -> BrokerResponse:
+        """Front door. Concurrent IDENTICAL queries — same normalized SQL,
+        same principal, same cluster-state generation — single-flight: one
+        leader runs the full compile/authorize/scatter/gather/reduce and
+        every concurrent duplicate receives the same BrokerResponse (the
+        dashboard-fanout case: N browser tabs refreshing one chart cost
+        ONE execution). A store mutation (segment push, table config)
+        bumps the generation, so later arrivals never join a flight whose
+        answer predates the change. Coalescing is skipped for
+        time-dependent SQL (``now()``)."""
+        key = self._flight_key(sql, principal, access_control)
+        led = getattr(self._leading, "keys", None)
+        if led is None:
+            led = self._leading.keys = set()
+        if key is None or key in led:
+            # non-coalescable, or a re-entrant subquery on the leader's own
+            # thread (joining our own flight would deadlock)
+            return self._handle_sql(sql, principal, access_control)
+
+        def lead():
+            led.add(key)
+            try:
+                return self._handle_sql(sql, principal, access_control)
+            finally:
+                led.discard(key)
+
+        resp, coalesced = self._flights.do(key, lead)
+        if coalesced:
+            from pinot_tpu.spi.metrics import BrokerMeter
+
+            self.metrics.meter(BrokerMeter.QUERIES).mark()
+            self.metrics.meter(BrokerMeter.QUERIES_COALESCED).mark()
+        return resp
+
+    def _flight_key(self, sql: str, principal, access_control):
+        """None = don't coalesce. The key carries the cluster-state
+        VERSION as the table generation: any store mutation invalidates
+        joinability (conservatively — a whole-store counter, not per
+        table, trading a few missed coalesces for zero staleness)."""
+        if not self.coalesce or not isinstance(sql, str):
+            return None
+        norm = " ".join(sql.split())
+        if not norm or "now(" in norm.lower():
+            return None  # time-dependent: two calls are NOT identical work
+        pkey = getattr(principal, "name", None) if principal is not None \
+            else None
+        return (norm, pkey,
+                id(access_control) if access_control is not None else None,
+                self.store.version)
+
+    def scheduler_snapshot(self) -> Dict[str, object]:
+        """Broker half of ``/debug/scheduler``: single-flight coalescing
+        counters + the front-door admission gate."""
+        return {"singleFlight": self._flights.snapshot(),
+                "admission": self.admission.snapshot()}
+
+    def _handle_sql(self, sql: str, principal=None,
+                    access_control=None) -> BrokerResponse:
         """``access_control``/``principal`` enable per-table authorization
         on the PARSED query (ref: BaseBrokerRequestHandler.handleRequest
         authorizing on the compiled request, not the raw SQL — a regex over
@@ -171,15 +245,38 @@ class BrokerRequestHandler:
             response.add_exception(QUERY_EXECUTION_ERROR, str(e))
             return finish(response)
 
-        # per-table QPS quota FIRST: a throttled request must not get to
+        # admission FIRST — per-table QPS quota + broker concurrency bound
+        # ride ONE gate: a throttled/rejected request must not get to
         # trigger subquery execution work (ref: queryquota acquire before
-        # routing)
-        for table in physical:
-            if not self.quota.acquire(table):
-                response.add_exception(
-                    TOO_MANY_REQUESTS_ERROR,
-                    f"query quota exceeded for table {table}")
-                return finish(response)
+        # routing). Tickets release in the finally below; rejection is the
+        # typed retriable error, surfaced as a 429-coded exception.
+        tickets: List[object] = []
+        try:
+            for table in physical:
+                t_adm = self.admission.admit(table)
+                tickets.append(t_adm)
+        except QueryRejectedError as e:
+            for t_adm in tickets:
+                self.admission.release(t_adm)
+            self.metrics.meter(BrokerMeter.QUERIES_REJECTED).mark()
+            response.add_exception(
+                TOO_MANY_REQUESTS_ERROR,
+                f"{e} (retriable; queueDepth={e.queue_depth})")
+            return finish(response)
+        try:
+            return self._scatter_reduce(ctx, physical, gapfill_spec,
+                                        response, phase, finish, start,
+                                        principal, access_control)
+        finally:
+            for t_adm in tickets:
+                self.admission.release(t_adm)
+
+    def _scatter_reduce(self, ctx, physical, gapfill_spec, response,
+                        phase, finish, start, principal, access_control
+                        ) -> BrokerResponse:
+        """Post-admission half of the front door: subquery rewrite ->
+        hybrid split -> routing -> scatter/gather -> reduce."""
+        from pinot_tpu.spi.metrics import BrokerMeter, BrokerQueryPhase
 
         try:
             ctx = self._rewrite_subqueries(ctx, principal=principal,

@@ -1,0 +1,84 @@
+"""Single-flight execution: concurrent identical work shares one run.
+
+The request-tier analogue of the launch dispatcher's dedup (PR 3
+coalesces *device launches*; this coalesces whole executions above them):
+the first caller for a key becomes the LEADER and runs the function; every
+caller that arrives while the leader is in flight becomes a FOLLOWER and
+blocks on the leader's future, receiving the same result object (or the
+same exception). The flight table holds only in-flight work — results are
+never cached, so staleness is bounded by one execution and invalidation
+reduces to "don't join a flight whose key embeds an old generation".
+
+Used by:
+
+- ``broker/broker.py``: concurrent identical dashboard queries (same
+  normalized SQL + principal + cluster-state generation) share one
+  scatter/gather/reduce, before any fan-out happens;
+- ``engine/executor.py``: concurrent identical per-segment kernel
+  launches (same cached plan + same staged resident) share one device
+  program + one D2H fetch — the per-segment half of the LaunchKernel
+  coalescing contract.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from concurrent.futures import Future
+from typing import Any, Callable, Dict, Hashable, Optional, Tuple
+
+__all__ = ["SingleFlight"]
+
+
+class SingleFlight:
+    """In-flight dedup table. ``do(key, fn)`` returns ``(result,
+    coalesced)`` — ``coalesced`` True when this caller rode another
+    caller's execution. A ``key`` of None disables coalescing for that
+    call (the caller decided the work isn't shareable)."""
+
+    __slots__ = ("_lock", "_flights", "leaders", "hits")
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._flights: Dict[Hashable, Future] = {}  # guarded-by: _lock
+        # cumulative counters; readers go through snapshot()
+        self.leaders = 0  # guarded-by-writes: _lock
+        self.hits = 0  # guarded-by-writes: _lock
+
+    def do(self, key: Optional[Hashable],
+           fn: Callable[[], Any]) -> Tuple[Any, bool]:
+        if key is None:
+            return fn(), False
+        with self._lock:
+            fut = self._flights.get(key)
+            leader = fut is None
+            if leader:
+                fut = Future()
+                self._flights[key] = fut
+                self.leaders += 1
+            else:
+                self.hits += 1
+        if not leader:
+            return fut.result(), True
+        try:
+            result = fn()
+        except BaseException as e:
+            # drop the flight BEFORE resolving: a caller arriving after
+            # the failure must start fresh, not join a dead flight
+            with self._lock:
+                self._flights.pop(key, None)
+            fut.set_exception(e)
+            raise
+        with self._lock:
+            self._flights.pop(key, None)
+        fut.set_result(result)
+        return result, False
+
+    def inflight(self) -> int:
+        with self._lock:
+            return len(self._flights)
+
+    def snapshot(self) -> Dict[str, int]:
+        with self._lock:
+            return {"leaders": self.leaders, "hits": self.hits,
+                    "inflight": len(self._flights)}

@@ -12,3 +12,20 @@ class QueryError(Exception):
 class UnsupportedQueryError(QueryError):
     def __init__(self, message: str):
         super().__init__(message, code=150)
+
+
+class QueryRejectedError(QueryError):
+    """Admission control rejected the query: the bounded scheduler queue is
+    full, the queue-wait bound expired, or a per-table QPS quota tripped
+    (ref: QueryScheduler returning 503-shaped errors + the queryquota 429).
+    Retriable — the caller saw a load signal, not a broken query — and
+    carries the queue depth observed at rejection so clients can back off
+    proportionally."""
+
+    retriable = True
+
+    def __init__(self, message: str, queue_depth: int = 0,
+                 reason: str = "overload"):
+        super().__init__(message, code=429)
+        self.queue_depth = int(queue_depth)
+        self.reason = reason

@@ -149,6 +149,25 @@ class ServerQueryExecutor:
             _CC.WORKER_THREADS_KEY, min(os.cpu_count() or 1, 8)))
         self._segment_pool = None
         self._segment_pool_lock = threading.Lock()
+        # request-tier admission: bounded concurrency + bounded queue in
+        # front of execution; past the bound queries are REJECTED with a
+        # typed retriable error instead of convoying (server/admission.py).
+        # Lazy import: pinot_tpu.server pulls this module back in.
+        from pinot_tpu.server.admission import AdmissionGate
+
+        self.admission = AdmissionGate.from_config(cfg)
+        # per-segment half of the launch-coalescing contract: concurrent
+        # identical kernel launches (same cached plan + same staged
+        # resident) share one device program + one D2H fetch
+        from pinot_tpu.common.singleflight import SingleFlight
+
+        self._kernel_flight = SingleFlight()
+        # whole-query single-flight for the direct execute() surface (the
+        # embedded / bench path — the broker front door has its own): a
+        # concurrent identical query (same compiled ctx object, same
+        # segment objects) rides the leader's full execution instead of
+        # paying its own serialized device programs
+        self._query_flight = SingleFlight()
 
     def _pallas_mode(self) -> Optional[bool]:
         """None = disabled; True/False = enabled (interpret or compiled)."""
@@ -172,7 +191,17 @@ class ServerQueryExecutor:
         """Instance-level execution returning a mergeable DataTable — the
         scatter/gather server half (ref: InstanceResponseOperator wrapping
         combine output into a serialized DataTable). The broker merges
-        DataTables from all servers and reduces (BrokerReduceService)."""
+        DataTables from all servers and reduces (BrokerReduceService).
+        Admission-gated: past the bounded queue this raises a typed
+        retriable QueryRejectedError BEFORE any lease/pin is taken."""
+        ticket = self.admission.admit(ctx.table_name or "")
+        try:
+            return self._execute_instance_admitted(ctx, segments)
+        finally:
+            self.admission.release(ticket)
+
+    def _execute_instance_admitted(self, ctx: QueryContext,
+                                   segments: List[ImmutableSegment]):
         from dataclasses import replace
 
         from pinot_tpu.common.datatable import DataTable
@@ -238,6 +267,37 @@ class ServerQueryExecutor:
 
     def execute(self, ctx: QueryContext,
                 segments: List[ImmutableSegment]) -> Tuple[ResultTable, QueryStats]:
+        ticket = self.admission.admit(ctx.table_name or "")
+        try:
+            # whole-query single-flight: the identical-dashboard-query
+            # case pays ONE execution; followers share the leader's
+            # (ResultTable, QueryStats) — bit-identical by construction.
+            # Admission stays per caller (a coalesced request is still a
+            # request; its slot releases when the shared flight resolves).
+            out, _ = self._query_flight.do(
+                self._query_flight_key(ctx, segments),
+                lambda: self._execute_admitted(ctx, segments))
+            return out
+        finally:
+            self.admission.release(ticket)
+
+    @staticmethod
+    def _query_flight_key(ctx: QueryContext, segments) -> Optional[Tuple]:
+        """None = not shareable. Keyed on OBJECT identity of the compiled
+        ctx and every segment: a reloaded segment (new object) or a
+        re-compiled ctx never joins a stale flight, and the leader's own
+        references keep the ids stable for the flight's lifetime. Mutable
+        (consuming) and upsert-managed segments are excluded — their
+        contents advance between two otherwise-identical executions."""
+        for s in segments:
+            if getattr(s, "valid_doc_ids", None) is not None \
+                    or getattr(s, "is_mutable", False):
+                return None
+        return (id(ctx), tuple(id(s) for s in segments))
+
+    def _execute_admitted(self, ctx: QueryContext,
+                          segments: List[ImmutableSegment]
+                          ) -> Tuple[ResultTable, QueryStats]:
         stats = QueryStats(num_segments_queried=len(segments))
         if not segments:
             raise QueryError(f"no segments for table {ctx.table_name!r}")
@@ -589,9 +649,22 @@ class ServerQueryExecutor:
         if plan.spec in self._pallas_blocked:
             return None
         staged = self.residency.stage(seg, lease=self._lease_of(stats))
-        try:
+
+        def launch():
             packed = pallas_kernels.run_segment(plan, staged,
-                                                self.pallas_kernels, interpret)
+                                                self.pallas_kernels,
+                                                interpret)
+            return None if packed is None \
+                else unpack_outputs(packed, plan.spec)
+
+        try:
+            # per-segment coalescing contract: concurrent identical queries
+            # (same cached plan object, same staged resident) share ONE
+            # fused-kernel launch + ONE D2H; followers decode the shared
+            # tree. id()-keying is sound because the leader's closure pins
+            # both objects alive for the flight's lifetime.
+            out, _ = self._kernel_flight.do(
+                ("pallas", id(plan), id(staged)), launch)
         except Exception:  # lowering/compile failure -> jnp kernels
             import logging
 
@@ -603,9 +676,8 @@ class ServerQueryExecutor:
             # its fused kernel
             self._pallas_blocked.add(plan.spec)
             return None
-        if packed is None:
+        if out is None:
             return None
-        out = unpack_outputs(packed, plan.spec)
         self._track_kernel_stats(out, seg, stats)
         return out
 
@@ -615,17 +687,28 @@ class ServerQueryExecutor:
         from pinot_tpu.engine.kernels import unpack_outputs
 
         staged = self.residency.stage(seg, lease=self._lease_of(stats))
-        cols = {name: staged.column(name).tree() for name in plan.columns}
-        kernel = self.kernels.get(plan.spec)
-        params = tuple(plan.params)
-        if plan.spec[0][:1] == ("and",) \
-                and plan.spec[0][1][0] == ("validdocs",):
-            # fill the planner's placeholder (staging owns the snapshot
-            # build + version-keyed device cache)
-            params = (staged.valid_mask(),) + params[1:]
-        packed = kernel(cols, params, np.int32(seg.num_docs))
-        # one D2H fetch for the whole output tree (tunnel-latency fix)
-        out = unpack_outputs(packed, plan.spec)
+        has_validdocs = plan.spec[0][:1] == ("and",) \
+            and plan.spec[0][1][0] == ("validdocs",)
+
+        def launch():
+            cols = {name: staged.column(name).tree()
+                    for name in plan.columns}
+            kernel = self.kernels.get(plan.spec)
+            params = tuple(plan.params)
+            if has_validdocs:
+                # fill the planner's placeholder (staging owns the snapshot
+                # build + version-keyed device cache)
+                params = (staged.valid_mask(),) + params[1:]
+            packed = kernel(cols, params, np.int32(seg.num_docs))
+            # one D2H fetch for the whole output tree (tunnel-latency fix)
+            return unpack_outputs(packed, plan.spec)
+
+        # per-segment coalescing: identical concurrent queries (same cached
+        # plan object + same staged resident) share one launch + D2H.
+        # Upsert-managed plans are excluded — their valid mask advances
+        # between calls, so two launches are NOT interchangeable.
+        key = None if has_validdocs else ("seg", id(plan), id(staged))
+        out, _ = self._kernel_flight.do(key, launch)
         self._track_kernel_stats(out, seg, stats)
         return out
 

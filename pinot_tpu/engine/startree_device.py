@@ -165,16 +165,25 @@ def execute_star_tree_device(executor, ctx: QueryContext,
     # evicted out from under the launch
     staged = executor.residency.stage(segment,
                                       lease=executor._lease_of(stats))
-    nodes = staged.startree_nodes(tree_index)
-    cols = {key: {"fwd": nodes[key]} for key in plan.columns}
 
-    capacity = plan.spec[-1]
-    padded = np.zeros(capacity, dtype=np.int32)
-    padded[:n] = idx.astype(np.int32)
-    kernel = executor._startree_kernel(plan.spec)
-    packed = kernel(cols, jnp.asarray(padded), tuple(plan.params),
-                    np.int32(n))
-    out = unpack_outputs(packed, plan.spec)  # may raise PlanError (compact)
+    def launch():
+        nodes = staged.startree_nodes(tree_index)
+        cols = {key: {"fwd": nodes[key]} for key in plan.columns}
+        capacity = plan.spec[-1]
+        padded = np.zeros(capacity, dtype=np.int32)
+        padded[:n] = idx.astype(np.int32)
+        kernel = executor._startree_kernel(plan.spec)
+        packed = kernel(cols, jnp.asarray(padded), tuple(plan.params),
+                        np.int32(n))
+        return unpack_outputs(packed, plan.spec)  # may raise PlanError
+
+    # per-segment coalescing contract (engine/executor._kernel_flight):
+    # concurrent identical dashboard queries — the SAME compiled ctx object
+    # over the same staged tree — share one node-slice launch + D2H. The
+    # walk/plan above stays per-caller (host work, query-private stats).
+    out, _ = executor._kernel_flight.do(
+        ("startree", id(ctx), segment.segment_name, tree_index, id(staged)),
+        launch)
 
     stats.num_segments_processed += 1
     stats.total_docs += segment.num_docs

@@ -90,6 +90,15 @@ class ShardedQueryExecutor(ServerQueryExecutor):
             CommonConstants.LAUNCH_MAX_BATCH_KEY,
             CommonConstants.DEFAULT_LAUNCH_MAX_BATCH))
         self.launcher = launcher_for_mesh(self.mesh)
+        # adaptive micro-batch window knobs ride the shared per-mesh
+        # dispatcher (last executor to configure wins — one serving config
+        # per process in practice)
+        self.launcher.set_window(
+            max_ms=cfg.get_float(CommonConstants.LAUNCH_WINDOW_MS_KEY,
+                                 CommonConstants.DEFAULT_LAUNCH_WINDOW_MS),
+            hot_ms=cfg.get_float(
+                CommonConstants.LAUNCH_WINDOW_HOT_MS_KEY,
+                CommonConstants.DEFAULT_LAUNCH_WINDOW_HOT_MS))
         # PallasSpec -> jitted sharded fused kernel (literal params stay
         # runtime args, so same-shape queries share the compile)
         self._pallas_sharded: Dict = {}

@@ -148,6 +148,16 @@ class LaunchScheduler:
         self._cond = threading.Condition()
         self._thread: Optional[threading.Thread] = None  # guarded-by-writes: _cond
         self._closed = False  # guarded-by: _cond
+        # adaptive micro-batch window: when the arrival-rate EWMA says the
+        # queue is HOT (inter-arrival <= hot threshold), the dispatcher
+        # holds up to window_max_ms for stragglers before grouping — vmap
+        # batches get bigger exactly when traffic would fill them; idle
+        # traffic never waits (window collapses to zero). Writes-only
+        # guards: the dispatcher reads these lock-free between drains.
+        self.window_max_ms = 1.0  # guarded-by-writes: _cond
+        self.window_hot_ms = 2.0  # guarded-by-writes: _cond
+        self._arrival_ewma_ms: Optional[float] = None  # guarded-by-writes: _cond
+        self._last_arrival: Optional[float] = None  # guarded-by: _cond
         # cumulative counters (process lifetime; bench suites diff
         # stats_snapshot() marks, /debug/launches serves snapshot()).
         # Writes-only guard: gauge lambdas read single counters lock-free;
@@ -163,6 +173,9 @@ class LaunchScheduler:
         self.max_batch_size = 0  # guarded-by-writes: _stats_lock
         self.queue_wait_ms_total = 0.0  # guarded-by-writes: _stats_lock
         self.queue_wait_ms_max = 0.0  # guarded-by-writes: _stats_lock
+        self.window_waits = 0  # guarded-by-writes: _stats_lock
+        self.window_gathered = 0  # guarded-by-writes: _stats_lock
+        self.window_last_ms = 0.0  # guarded-by-writes: _stats_lock
         self._registries: List[Any] = []  # guarded-by-writes: _stats_lock
 
     # -- submission ----------------------------------------------------------
@@ -177,9 +190,35 @@ class LaunchScheduler:
                 self._thread = threading.Thread(
                     target=self._loop, daemon=True, name=self._name)
                 self._thread.start()
+            self._note_arrival_locked(req.t_submit)
             self._queue.append(req)
             self._cond.notify()
         return req
+
+    def _note_arrival_locked(self, now: float) -> None:
+        """Arrival-rate EWMA feeding the adaptive window (caller holds
+        ``_cond``). A gap far beyond the hot threshold RESETS the average —
+        the first queries after an idle stretch must not inherit a hot
+        window from yesterday's burst."""
+        if self._last_arrival is not None:
+            dt_ms = (now - self._last_arrival) * 1e3
+            e = self._arrival_ewma_ms
+            if e is None or dt_ms > 8 * max(self.window_hot_ms, 0.001):
+                self._arrival_ewma_ms = dt_ms
+            else:
+                self._arrival_ewma_ms = 0.2 * dt_ms + 0.8 * e
+        self._last_arrival = now
+
+    def set_window(self, max_ms: Optional[float] = None,
+                   hot_ms: Optional[float] = None) -> None:
+        """Configure the adaptive micro-batch window: ``max_ms`` = the
+        straggler hold cap (<= 0 disables), ``hot_ms`` = the inter-arrival
+        EWMA threshold below which traffic counts as hot."""
+        with self._cond:
+            if max_ms is not None:
+                self.window_max_ms = float(max_ms)
+            if hot_ms is not None:
+                self.window_hot_ms = float(hot_ms)
 
     def close(self) -> None:
         """Stop accepting; the dispatcher drains what's queued and exits.
@@ -190,6 +229,19 @@ class LaunchScheduler:
             self._cond.notify()
 
     # -- dispatcher ----------------------------------------------------------
+    def _window_hold_s(self, n_drained: int) -> float:
+        """Adaptive window decision for one drain: hold only when traffic
+        is HOT (EWMA inter-arrival under the hot threshold) and the drain
+        is still small enough that stragglers would grow the vmap group.
+        Idle traffic returns 0.0 — no added latency at low QPS."""
+        w = self.window_max_ms
+        if w <= 0 or n_drained >= 8:
+            return 0.0
+        ewma = self._arrival_ewma_ms
+        if ewma is None or ewma > self.window_hot_ms:
+            return 0.0
+        return w / 1e3
+
     def _loop(self) -> None:
         while True:
             with self._cond:
@@ -199,6 +251,28 @@ class LaunchScheduler:
                     return
                 drained = list(self._queue)
                 self._queue.clear()
+            hold_s = self._window_hold_s(len(drained))
+            if hold_s > 0:
+                # hot queue: hold for stragglers so this drain's vmap
+                # groups get bigger — the micro-batch window
+                deadline = time.perf_counter() + hold_s
+                gathered = 0
+                with self._cond:
+                    while not self._closed:
+                        remaining = deadline - time.perf_counter()
+                        if remaining <= 0:
+                            break
+                        self._cond.wait(remaining)
+                    if self._queue:
+                        gathered = len(self._queue)
+                        drained += list(self._queue)
+                        self._queue.clear()
+                with self._stats_lock:
+                    self.window_waits += 1
+                    self.window_gathered += gathered
+                    self.window_last_ms = hold_s * 1e3
+                self._mark("LAUNCH_WINDOW_WAITS", 1)
+                self._mark("LAUNCH_WINDOW_GATHERED", gathered)
             # group by compiled-kernel identity, preserving the arrival
             # order of the FIRST request of each group (FIFO fairness across
             # shapes; later same-shape arrivals ride the earlier slot)
@@ -354,6 +428,9 @@ class LaunchScheduler:
                 "maxBatchSize": self.max_batch_size,
                 "queueWaitMsTotal": round(self.queue_wait_ms_total, 3),
                 "queueWaitMsMax": round(self.queue_wait_ms_max, 3),
+                "windowWaits": self.window_waits,
+                "windowGathered": self.window_gathered,
+                "windowLastMs": round(self.window_last_ms, 3),
             }
 
     def snapshot(self) -> Dict[str, Any]:
@@ -362,6 +439,10 @@ class LaunchScheduler:
         out["queued"] = len(self._queue)
         out["dispatcherAlive"] = (self._thread is not None
                                   and self._thread.is_alive())
+        out["windowMaxMs"] = self.window_max_ms
+        out["windowHotMs"] = self.window_hot_ms
+        ewma = self._arrival_ewma_ms
+        out["arrivalEwmaMs"] = None if ewma is None else round(ewma, 3)
         return out
 
 

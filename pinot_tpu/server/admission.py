@@ -1,0 +1,252 @@
+"""Admission gate: bounded concurrency + bounded queue above execution.
+
+Re-design of the reference's scheduler-tier admission
+(``QueryScheduler.java`` returning 503-shaped results once its resource
+manager is saturated, plus the broker-side
+``HelixExternalViewBasedQueryQuotaManager`` 429s): concurrent load above
+the bound must degrade to *bounded-latency rejection*, never to a convoy
+where every query's latency is the sum of everyone else's.
+
+One gate instance fronts one executor (or one broker). ``admit`` either:
+
+- passes immediately (a concurrency slot is free),
+- waits — bounded by the queue depth bound AND the wait-time bound — for
+  a slot, or
+- raises a typed, retriable :class:`QueryRejectedError` carrying the
+  queue depth it observed, so clients can back off proportionally.
+
+An optional :class:`~pinot_tpu.broker.quota.QueryQuotaManager` folds the
+per-table QPS quota into the same gate (the broker front door): a quota
+trip is the same typed rejection with ``reason="quota"``. Residency
+leases (``ResidencyManager.begin_query``) open strictly AFTER admission
+and close in the caller's ``finally`` — a rejected query therefore never
+holds pins, and the graftlint pairing family gates the admit/release and
+begin/end pairs on every path.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+
+from typing import Any, Dict, Optional
+
+from pinot_tpu.engine.errors import QueryRejectedError
+
+
+def _auto_concurrent() -> int:
+    return max(8, 2 * (os.cpu_count() or 1))
+
+
+class _Ticket:
+    """One admission; ``release`` through the gate is idempotent."""
+
+    __slots__ = ("released", "gated")
+
+    def __init__(self, gated: bool):
+        self.released = False
+        self.gated = gated
+
+
+class AdmissionGate:
+    """Bounded-slot, bounded-queue admission with typed rejection.
+
+    ``max_concurrent``: executing-query slots (0 = auto from cpu count,
+    < 0 = gate disabled — admits always pass, quota still applies).
+    ``max_queue``: waiters allowed behind the slots (0 = auto, 8x slots;
+    < 0 = no queue, a full gate rejects immediately).
+    ``max_wait_ms``: a waiter past this bound is rejected (the
+    bounded-latency guarantee for the queued path)."""
+
+    def __init__(self, max_concurrent: int = 0, max_queue: int = 0,
+                 max_wait_ms: float = 10_000.0, quota=None,
+                 name: str = "query-admission"):
+        self._name = name
+        self._quota = quota
+        self._cond = threading.Condition()
+        self._slots = 0  # guarded-by-writes: _cond
+        self._max_queue = 0  # guarded-by-writes: _cond
+        self._max_wait_s = 0.0  # guarded-by-writes: _cond
+        self._inflight = 0  # guarded-by-writes: _cond
+        self._waiting = 0  # guarded-by-writes: _cond
+        # cumulative counters (process lifetime; bench suites diff
+        # stats_snapshot() marks). Writes-only guards: gauge lambdas read
+        # single ints lock-free; snapshots take the condition for a
+        # consistent cut.
+        self.admitted = 0  # guarded-by-writes: _cond
+        self.rejected_queue_full = 0  # guarded-by-writes: _cond
+        self.rejected_wait_expired = 0  # guarded-by-writes: _cond
+        self.rejected_quota = 0  # guarded-by-writes: _cond
+        self.max_queue_depth_seen = 0  # guarded-by-writes: _cond
+        self.queue_wait_ms_total = 0.0  # guarded-by-writes: _cond
+        self.queue_wait_ms_max = 0.0  # guarded-by-writes: _cond
+        self._metrics = None
+        self.configure(max_concurrent=max_concurrent, max_queue=max_queue,
+                       max_wait_ms=max_wait_ms)
+
+    @classmethod
+    def from_config(cls, config=None, quota=None,
+                    name: str = "query-admission") -> "AdmissionGate":
+        from pinot_tpu.spi.config import CommonConstants, PinotConfiguration
+
+        cfg = config if config is not None else PinotConfiguration()
+        return cls(
+            max_concurrent=cfg.get_int(
+                CommonConstants.ADMISSION_MAX_CONCURRENT_KEY,
+                CommonConstants.DEFAULT_ADMISSION_MAX_CONCURRENT),
+            max_queue=cfg.get_int(
+                CommonConstants.ADMISSION_MAX_QUEUE_KEY,
+                CommonConstants.DEFAULT_ADMISSION_MAX_QUEUE),
+            max_wait_ms=cfg.get_float(
+                CommonConstants.ADMISSION_MAX_WAIT_MS_KEY,
+                CommonConstants.DEFAULT_ADMISSION_MAX_WAIT_MS),
+            quota=quota, name=name)
+
+    def configure(self, max_concurrent: Optional[int] = None,
+                  max_queue: Optional[int] = None,
+                  max_wait_ms: Optional[float] = None) -> None:
+        """Re-bound the gate at runtime (bench saturation levels, ops
+        tuning). Waiters re-evaluate against the new bounds."""
+        with self._cond:
+            if max_concurrent is not None:
+                mc = int(max_concurrent)
+                self._slots = mc if mc != 0 else _auto_concurrent()
+            if max_queue is not None:
+                mq = int(max_queue)
+                if mq == 0:
+                    self._max_queue = 8 * max(self._slots, 1)
+                else:
+                    self._max_queue = max(mq, 0)
+            if max_wait_ms is not None:
+                self._max_wait_s = max(float(max_wait_ms), 0.0) / 1e3
+            self._cond.notify_all()
+
+    @property
+    def enabled(self) -> bool:
+        return self._slots > 0
+
+    # -- admission -----------------------------------------------------------
+    def admit(self, table: str = "") -> _Ticket:
+        """Admit one query (blocking, bounded) or raise
+        :class:`QueryRejectedError`. The returned ticket MUST be released
+        in a ``finally`` — the graftlint pairing family enforces it."""
+        if self._quota is not None and table \
+                and not self._quota.acquire(table):
+            with self._cond:
+                self.rejected_quota += 1
+                depth = self._waiting
+            self._mark("ADMISSION_REJECTED")
+            raise QueryRejectedError(
+                f"query quota exceeded for table {table}",
+                queue_depth=depth, reason="quota")
+        if self._slots <= 0:  # disabled: count, never queue
+            with self._cond:
+                self.admitted += 1
+            self._mark("ADMISSION_ADMITTED")
+            return _Ticket(gated=False)
+        t0 = time.monotonic()
+        reject: Optional[Any] = None
+        with self._cond:
+            if self._inflight >= self._slots \
+                    and self._waiting >= self._max_queue:
+                self.rejected_queue_full += 1
+                reject = ("queue_full",
+                          f"admission queue full ({self._waiting} waiting, "
+                          f"{self._slots} slots) for {self._name}",
+                          self._waiting)
+            else:
+                deadline = t0 + self._max_wait_s
+                self._waiting += 1
+                if self._waiting > self.max_queue_depth_seen:
+                    self.max_queue_depth_seen = self._waiting
+                try:
+                    while self._inflight >= self._slots:
+                        remaining = deadline - time.monotonic()
+                        if remaining <= 0:
+                            self.rejected_wait_expired += 1
+                            reject = (
+                                "wait_expired",
+                                f"admission wait bound "
+                                f"{self._max_wait_s * 1e3:.0f} ms expired "
+                                f"({self._waiting} waiting) for "
+                                f"{self._name}", self._waiting)
+                            # a release() notify may have landed on THIS
+                            # dying waiter — pass it along or another
+                            # waiter sleeps out its full bound on a slot
+                            # that is actually free
+                            self._cond.notify()
+                            break
+                        self._cond.wait(remaining)
+                finally:
+                    self._waiting -= 1
+                if reject is None:
+                    self._inflight += 1
+                    self.admitted += 1
+                    wait_ms = (time.monotonic() - t0) * 1e3
+                    self.queue_wait_ms_total += wait_ms
+                    if wait_ms > self.queue_wait_ms_max:
+                        self.queue_wait_ms_max = wait_ms
+        if reject is not None:
+            reason, msg, depth = reject
+            self._mark("ADMISSION_REJECTED")
+            raise QueryRejectedError(msg, queue_depth=depth, reason=reason)
+        self._mark("ADMISSION_ADMITTED")
+        return _Ticket(gated=True)
+
+    def release(self, ticket: Optional[_Ticket]) -> None:
+        """Free the ticket's slot (idempotent; None is a no-op so error
+        paths can release unconditionally)."""
+        if ticket is None or ticket.released:
+            return
+        ticket.released = True
+        if not ticket.gated:
+            return
+        with self._cond:
+            if self._inflight > 0:
+                self._inflight -= 1
+            self._cond.notify()
+
+    # -- observability -------------------------------------------------------
+    def bind_metrics(self, registry) -> None:
+        self._metrics = registry
+        # gauge lambdas run on scrape threads: single-int reads are
+        # GIL-atomic under the writes-only guards above
+        registry.gauge("admission_inflight", lambda: float(self._inflight))
+        registry.gauge("admission_queue_depth",
+                       lambda: float(self._waiting))
+
+    def _mark(self, name: str) -> None:
+        if self._metrics is None:
+            return
+        from pinot_tpu.spi.metrics import ServerMeter
+
+        metric = getattr(ServerMeter, name, None)
+        if metric is not None:
+            self._metrics.meter(metric).mark()
+
+    def stats_snapshot(self) -> Dict[str, float]:
+        """Cumulative counters (bench per-level deltas diff two of these)."""
+        with self._cond:
+            return {
+                "admitted": self.admitted,
+                "rejectedQueueFull": self.rejected_queue_full,
+                "rejectedWaitExpired": self.rejected_wait_expired,
+                "rejectedQuota": self.rejected_quota,
+                "rejected": (self.rejected_queue_full
+                             + self.rejected_wait_expired
+                             + self.rejected_quota),
+                "maxQueueDepth": self.max_queue_depth_seen,
+                "queueWaitMsTotal": round(self.queue_wait_ms_total, 3),
+                "queueWaitMsMax": round(self.queue_wait_ms_max, 3),
+            }
+
+    def snapshot(self) -> Dict[str, Any]:
+        """``/debug/scheduler`` body: bounds + live depth + counters."""
+        out: Dict[str, Any] = self.stats_snapshot()
+        with self._cond:
+            out.update(enabled=self._slots > 0, maxConcurrent=self._slots,
+                       maxQueue=self._max_queue,
+                       maxWaitMs=round(self._max_wait_s * 1e3, 3),
+                       inflight=self._inflight, queued=self._waiting)
+        return out

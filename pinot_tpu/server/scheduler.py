@@ -2,8 +2,12 @@
 
 Re-design of ``pinot-core/.../query/scheduler/QueryScheduler.java:56``
 (``processQueryAndSerialize:147``) with the reference's pluggable policies:
-FCFS (``fcfs/``) and token-bucket resource accounting per table
-(``tokenbucket/``, ``MultiLevelPriorityQueue``).
+FCFS (``fcfs/``), token-bucket resource accounting per table
+(``tokenbucket/``), the multi-level priority queue (``priority/``), and —
+the default under concurrency — shortest-expected-work-first
+(:class:`SewfScheduler`): per-query-shape latency EWMAs order the queue so
+cheap dashboard queries stop convoying behind expensive scans, with an
+age-based boost bounding how long an expensive shape can be deferred.
 """
 
 from __future__ import annotations
@@ -53,6 +57,9 @@ class _DaemonPool:
         self._q.put((fut, fn, on_skip))
         return fut
 
+    def qsize(self) -> int:
+        return self._q.qsize()
+
     def stop(self) -> None:
         for _ in self._threads:
             self._q.put(None)
@@ -88,16 +95,20 @@ class WorkerPool:
 
 
 class QueryScheduler:
-    """Base: bounded worker pool, graceful drain on shutdown."""
+    """Base: bounded worker pool, graceful drain on shutdown. ``shape`` on
+    ``submit`` is an optional query-shape key (table + normalized SQL);
+    FCFS/token-bucket policies ignore it, the SEWF policy orders by it."""
 
     def __init__(self, num_workers: int = 8, name: str = "query"):
-        self._pool = _DaemonPool(num_workers, name)
+        self.num_workers = max(1, int(num_workers))
+        self._pool = _DaemonPool(self.num_workers, name)
         self._accepting = True  # guarded-by: _lock
         self._inflight = 0  # guarded-by: _lock
         self._lock = threading.Lock()
         self._drained = threading.Condition(self._lock)
 
-    def submit(self, fn: Callable[[], Any], table: str = "") -> Future:
+    def submit(self, fn: Callable[[], Any], table: str = "",
+               shape: Any = None) -> Future:
         with self._lock:
             if not self._accepting:
                 raise RuntimeError("scheduler is shut down")
@@ -115,6 +126,18 @@ class QueryScheduler:
                 done()
 
         return self._pool.submit(run, on_skip=done)
+
+    def queue_depth(self) -> int:
+        return self._pool.qsize()
+
+    def stats_snapshot(self) -> Dict[str, Any]:
+        """``/debug/scheduler`` body: live policy/queue/in-flight state."""
+        with self._lock:
+            inflight = self._inflight
+        return {"policy": type(self).__name__,
+                "workers": self.num_workers,
+                "inflight": inflight,
+                "queued": self.queue_depth()}
 
     def shutdown(self, timeout_s: float = 30.0) -> None:
         """Disable new queries, drain in-flight ones
@@ -161,16 +184,17 @@ class TokenBucketScheduler(QueryScheduler):
             self._buckets[table] = (0.0, now + wait)
             return wait
 
-    def submit(self, fn: Callable[[], Any], table: str = "") -> Future:
+    def submit(self, fn: Callable[[], Any], table: str = "",
+               shape: Any = None) -> Future:
         wait = self._take_token(table) if table else 0.0
         if wait <= 0:
-            return super().submit(fn, table)
+            return super().submit(fn, table, shape=shape)
 
         def delayed():
             time.sleep(wait)
             return fn()
 
-        return super().submit(delayed, table)
+        return super().submit(delayed, table, shape=shape)
 
 
 class PriorityScheduler(QueryScheduler):
@@ -185,6 +209,7 @@ class PriorityScheduler(QueryScheduler):
                  table_priorities: Optional[Dict[str, float]] = None):
         # intentionally does NOT call super().__init__: this scheduler owns
         # its queues instead of a shared _DaemonPool queue
+        self.num_workers = max(1, int(num_workers))
         self._accepting = True  # guarded-by: _lock
         self._inflight = 0  # guarded-by: _lock
         self._lock = threading.Lock()
@@ -255,7 +280,8 @@ class PriorityScheduler(QueryScheduler):
 
         return done
 
-    def submit(self, fn: Callable[[], Any], table: str = "") -> Future:
+    def submit(self, fn: Callable[[], Any], table: str = "",
+               shape: Any = None) -> Future:
         fut: Future = Future()
         with self._lock:
             if not self._accepting:
@@ -265,6 +291,153 @@ class PriorityScheduler(QueryScheduler):
             self._queues.setdefault(table, queue.Queue()).put((fut, fn))
         self._available.release()
         return fut
+
+    def queue_depth(self) -> int:
+        with self._lock:
+            return sum(q.qsize() for q in self._queues.values())
+
+    def shutdown(self, timeout_s: float = 30.0) -> None:
+        with self._lock:
+            self._accepting = False
+            deadline = time.monotonic() + timeout_s
+            while self._inflight > 0:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    break
+                self._drained.wait(remaining)
+            self._stop = True
+        for _ in self._threads:
+            self._available.release()
+
+
+class SewfScheduler(QueryScheduler):
+    """Shortest-expected-work-first with an age-based anti-starvation
+    boost — the two-level dispatch policy for mixed dashboard traffic.
+
+    Each query shape (table + normalized SQL passed as ``submit(...,
+    shape=)``) keeps a latency EWMA from its own completions. Workers pop
+    the pending entry with the lowest ``expected_ms - age_ms *
+    aging_boost``: cheap shapes overtake expensive scans (a 10 ms Q2.1
+    stops waiting behind a 400 ms Q4.x convoy), while the age term
+    guarantees an expensive query deferred ``expected_diff / aging_boost``
+    milliseconds runs next regardless of what keeps arriving. Unknown
+    shapes score as zero expected work — run soon, then their own EWMA
+    places them."""
+
+    EWMA_ALPHA = 0.25
+
+    def __init__(self, num_workers: int = 8, aging_boost: float = 2.0):
+        # owns its own ordered queue instead of the base _DaemonPool FIFO
+        self.num_workers = max(1, int(num_workers))
+        self.aging_boost = float(aging_boost)
+        self._accepting = True  # guarded-by: _lock
+        self._inflight = 0  # guarded-by: _lock
+        self._lock = threading.Lock()
+        self._drained = threading.Condition(self._lock)
+        # pending entries: (enqueue_ts, shape, fut, fn)
+        self._pending: list = []  # guarded-by: _lock
+        self._ewma_ms: Dict[Any, float] = {}  # guarded-by: _lock
+        self.starvation_boosts = 0  # guarded-by: _lock
+        self._available = threading.Semaphore(0)
+        self._stop = False  # guarded-by: _lock
+        self._threads = [
+            threading.Thread(target=self._work, daemon=True,
+                             name=f"sewf-query-{i}")
+            for i in range(self.num_workers)]
+        for t in self._threads:
+            t.start()
+
+    def _score_locked(self, entry, now: float) -> float:
+        t_enq, shape, _fut, _fn = entry
+        expected = self._ewma_ms.get(shape, 0.0)
+        return expected - (now - t_enq) * 1e3 * self.aging_boost
+
+    def _pick_locked(self):
+        """Pop the lowest-scoring pending entry (caller holds ``_lock``).
+        O(pending) scan — queue depths here are bounded by the admission
+        gate, so a heap's reordering complexity buys nothing."""
+        if not self._pending:
+            return None
+        now = time.monotonic()
+        best_i = 0
+        best_s = None
+        for i, entry in enumerate(self._pending):
+            s = self._score_locked(entry, now)
+            if best_s is None or s < best_s:
+                best_i, best_s = i, s
+        entry = self._pending.pop(best_i)
+        # an entry that won on age rather than expected work is a
+        # starvation-boost event (the anti-starvation half working)
+        if best_i != 0 and self._ewma_ms.get(entry[1], 0.0) \
+                >= max(self._ewma_ms.get(e[1], 0.0)
+                       for e in self._pending + [entry]):
+            self.starvation_boosts += 1
+        return entry
+
+    def _work(self) -> None:
+        while True:
+            self._available.acquire()
+            with self._lock:
+                if self._stop and not self._pending:
+                    return
+                entry = self._pick_locked()
+            if entry is None:
+                continue
+            _t_enq, shape, fut, fn = entry
+            if not fut.set_running_or_notify_cancel():
+                self._done(shape, None)  # cancelled while queued
+                continue
+            t0 = time.perf_counter()
+            try:
+                fut.set_result(fn())
+            except BaseException as e:  # noqa: BLE001 — future carries it
+                fut.set_exception(e)
+            finally:
+                self._done(shape, (time.perf_counter() - t0) * 1e3)
+
+    def _done(self, shape: Any, ms: Optional[float]) -> None:
+        with self._lock:
+            if ms is not None and shape is not None:
+                e = self._ewma_ms.get(shape)
+                self._ewma_ms[shape] = ms if e is None else \
+                    self.EWMA_ALPHA * ms + (1 - self.EWMA_ALPHA) * e
+                if len(self._ewma_ms) > 4096:
+                    # shape churn bound: drop ~half, newest keep their EWMA
+                    for k in list(self._ewma_ms)[:2048]:
+                        del self._ewma_ms[k]
+            self._inflight -= 1
+            self._drained.notify_all()
+
+    def submit(self, fn: Callable[[], Any], table: str = "",
+               shape: Any = None) -> Future:
+        fut: Future = Future()
+        with self._lock:
+            if not self._accepting:
+                raise RuntimeError("scheduler is shut down")
+            self._inflight += 1
+            self._pending.append((time.monotonic(),
+                                  shape if shape is not None else table,
+                                  fut, fn))
+        self._available.release()
+        return fut
+
+    def queue_depth(self) -> int:
+        with self._lock:
+            return len(self._pending)
+
+    def expected_ms(self, shape: Any) -> Optional[float]:
+        with self._lock:
+            return self._ewma_ms.get(shape)
+
+    def stats_snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            return {"policy": type(self).__name__,
+                    "workers": self.num_workers,
+                    "inflight": self._inflight,
+                    "queued": len(self._pending),
+                    "shapesTracked": len(self._ewma_ms),
+                    "starvationBoosts": self.starvation_boosts,
+                    "agingBoost": self.aging_boost}
 
     def shutdown(self, timeout_s: float = 30.0) -> None:
         with self._lock:
@@ -297,4 +470,6 @@ def make_scheduler(policy: str = "fcfs", config=None, **kw) -> QueryScheduler:
         return TokenBucketScheduler(**kw)
     if policy == "priority":
         return PriorityScheduler(**kw)
+    if policy in ("sewf", "shortest", "sjf"):
+        return SewfScheduler(**kw)
     raise ValueError(f"unknown scheduler policy {policy!r}")

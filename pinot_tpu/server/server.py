@@ -65,8 +65,16 @@ class ServerInstance:
         self.store = store
         self.completion_protocol = completion_protocol
         self.executor = executor or ServerQueryExecutor(config=config)
-        # runner pool sized by pinot.server.query.runner.threads (pqr)
-        self.scheduler = scheduler or make_scheduler("fcfs", config=config)
+        # runner pool sized by pinot.server.query.runner.threads (pqr);
+        # policy from pinot.server.query.scheduler.policy — default SEWF
+        # (shortest-expected-work-first with anti-starvation aging)
+        from pinot_tpu.spi.config import CommonConstants
+
+        policy = (config.get_str(CommonConstants.SCHEDULER_POLICY_KEY,
+                                 CommonConstants.DEFAULT_SCHEDULER_POLICY)
+                  if config is not None
+                  else CommonConstants.DEFAULT_SCHEDULER_POLICY)
+        self.scheduler = scheduler or make_scheduler(policy, config=config)
         self.metrics = MetricsRegistry(role="server")
         # segment lifecycle -> HBM residency: adds prefetch, removals evict
         self.data_manager = InstanceDataManager(listener=self)
@@ -77,6 +85,10 @@ class ServerInstance:
         launcher = getattr(self.executor, "launcher", None)
         if launcher is not None:
             launcher.bind_metrics(self.metrics)
+        # admission-gate meters/gauges (server/admission.py)
+        admission = getattr(self.executor, "admission", None)
+        if admission is not None:
+            admission.bind_metrics(self.metrics)
         self.segment_dir = segment_dir
         self.consumer_tick_s = consumer_tick_s
         self._started = False
@@ -368,9 +380,11 @@ class ServerInstance:
             return DataTable.for_exception(
                 f"server {self.instance_id} is shut down")
         submit_t = time.perf_counter()
+        # the shape key feeds the SEWF policy's per-shape latency EWMAs:
+        # same table + same SQL text = same expected work
         future = self.scheduler.submit(
             lambda: self._execute(ctx, table, segment_names, submit_t),
-            table=table)
+            table=table, shape=(table, ctx.sql))
         return future.result()
 
     def _execute(self, ctx: QueryContext, table: str,
@@ -509,6 +523,31 @@ class ServerInstance:
             return {"enabled": False}
         out: Dict[str, Any] = {"enabled": True}
         out.update(launcher.snapshot())
+        return out
+
+    def scheduler_debug(self) -> Dict[str, Any]:
+        """Scheduler-tier state for ``GET /debug/scheduler``: dispatch
+        policy + queue depth, admission bounds/counters, the launch
+        dispatcher's adaptive-window state, and the per-segment kernel
+        single-flight counters — the millions-of-users ops view."""
+        out: Dict[str, Any] = {"scheduler": self.scheduler.stats_snapshot()}
+        admission = getattr(self.executor, "admission", None)
+        if admission is not None:
+            out["admission"] = admission.snapshot()
+        launcher = getattr(self.executor, "launcher", None)
+        if launcher is not None:
+            snap = launcher.snapshot()
+            out["launchWindow"] = {
+                k: snap.get(k) for k in
+                ("windowMaxMs", "windowHotMs", "arrivalEwmaMs",
+                 "windowWaits", "windowGathered", "windowLastMs",
+                 "queued")}
+        flight = getattr(self.executor, "_kernel_flight", None)
+        if flight is not None:
+            out["kernelFlight"] = flight.snapshot()
+        qflight = getattr(self.executor, "_query_flight", None)
+        if qflight is not None:
+            out["queryFlight"] = qflight.snapshot()
         return out
 
     def memory_debug(self) -> Dict[str, Any]:

@@ -158,3 +158,31 @@ class CommonConstants:
     # dispatch ordering still apply).
     LAUNCH_MAX_BATCH_KEY = "pinot.server.query.launch.max.batch"
     DEFAULT_LAUNCH_MAX_BATCH = 8
+    # Adaptive micro-batch window (parallel/launcher.py): when the launch
+    # queue is hot (EWMA inter-arrival <= the hot threshold) the dispatcher
+    # holds up to this long for stragglers so vmap groups get bigger
+    # exactly when it pays; idle traffic pays zero added latency. <= 0
+    # disables the hold.
+    LAUNCH_WINDOW_MS_KEY = "pinot.server.query.launch.window.ms"
+    DEFAULT_LAUNCH_WINDOW_MS = 1.0
+    LAUNCH_WINDOW_HOT_MS_KEY = "pinot.server.query.launch.window.hot.ms"
+    DEFAULT_LAUNCH_WINDOW_HOT_MS = 2.0
+    # Scheduler policy (server/scheduler.py make_scheduler): fcfs |
+    # tokenbucket | priority | sewf (shortest-expected-work-first with an
+    # age-based anti-starvation boost — the default).
+    SCHEDULER_POLICY_KEY = "pinot.server.query.scheduler.policy"
+    DEFAULT_SCHEDULER_POLICY = "sewf"
+    # Admission gate (server/admission.py): bounded concurrency + bounded
+    # queue in front of query execution. 0 = auto-size (concurrent from
+    # cpu count, queue from the concurrency bound); max.concurrent < 0
+    # disables the gate. Past the queue bound — or past the wait bound —
+    # queries are REJECTED with a typed retriable QueryRejectedError, so
+    # overload degrades to bounded-latency rejection instead of convoy
+    # collapse.
+    ADMISSION_MAX_CONCURRENT_KEY = \
+        "pinot.server.query.admission.max.concurrent"
+    DEFAULT_ADMISSION_MAX_CONCURRENT = 0
+    ADMISSION_MAX_QUEUE_KEY = "pinot.server.query.admission.max.queue"
+    DEFAULT_ADMISSION_MAX_QUEUE = 0
+    ADMISSION_MAX_WAIT_MS_KEY = "pinot.server.query.admission.max.wait.ms"
+    DEFAULT_ADMISSION_MAX_WAIT_MS = 10_000.0

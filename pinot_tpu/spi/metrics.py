@@ -144,6 +144,12 @@ class BrokerMeter:
     QUERIES = "queries_total"
     EXCEPTIONS = "query_exceptions_total"
     NO_SERVING_HOST = "no_serving_host_total"
+    # single-flight coalescing (broker/broker.py): followers that shared a
+    # leader's in-flight execution instead of running their own
+    QUERIES_COALESCED = "queries_coalesced_total"
+    # admission gate rejections surfaced as 429s (broker/quota.py +
+    # server/admission.py at the broker front door)
+    QUERIES_REJECTED = "queries_rejected_total"
 
 
 class BrokerQueryPhase:
@@ -172,6 +178,13 @@ class ServerMeter:
     LAUNCHES = "combine_launches_total"
     LAUNCHES_COALESCED = "combine_launches_coalesced_total"
     LAUNCHES_SAVED = "combine_launches_saved_total"
+    # adaptive micro-batch window (parallel/launcher.py): dispatch-loop
+    # holds taken and straggler requests gathered during a held window
+    LAUNCH_WINDOW_WAITS = "launch_window_waits_total"
+    LAUNCH_WINDOW_GATHERED = "launch_window_gathered_total"
+    # admission gate (server/admission.py)
+    ADMISSION_ADMITTED = "admission_admitted_total"
+    ADMISSION_REJECTED = "admission_rejected_total"
 
 
 class ServerQueryPhase:

@@ -7,6 +7,7 @@ the table data managers):
 - ``_begin_lease`` / ``end_query``      (executor wrapper for the above)
 - ``acquire_segments`` / ``release_segments``  (segment refcounts)
 - ``acquire`` / ``release``             (bare refcount style)
+- ``admit`` / ``release``               (admission-gate tickets)
 
 For each function that calls the acquire half:
 
@@ -45,6 +46,9 @@ PAIRS = [
     ("_begin_lease", "end_query"),
     ("acquire_segments", "release_segments"),
     ("acquire", "release"),
+    # admission-gate tickets (server/admission.py): a rejected/errored
+    # query must free its slot on every path or the gate convoys shut
+    ("admit", "release"),
 ]
 BARE_PAIRS = {"acquire"}  # resource = the receiver, not the return value
 

@@ -456,6 +456,10 @@ class BrokerApi(_Api):
         self.route("GET", r"/debug/routing/([^/]+)",
                    lambda m, b: (200, dict(
                        broker.routing.get_routing_table(m.group(1))[0])))
+        # single-flight coalescing + front-door admission counters
+        # (broker half of the scheduler-tier ops view)
+        self.route("GET", r"/debug/scheduler",
+                   lambda m, b: (200, broker.scheduler_snapshot()))
 
     def start(self) -> None:
         super().start()
@@ -520,6 +524,10 @@ class ServerAdminApi(_Api):
         # sizes, queue waits) — the QPS-scaling ops view
         self.route("GET", r"/debug/launches",
                    lambda m, b: (200, s.launch_debug()))
+        # scheduler-tier snapshot: dispatch policy + queue depth, admission
+        # bounds/rejections, adaptive launch window, kernel single-flight
+        self.route("GET", r"/debug/scheduler",
+                   lambda m, b: (200, s.scheduler_debug()))
         # ops hook for the HBM budget knob: force-drop one resident's
         # device arrays (in-flight queries keep theirs via python refs;
         # the next query re-stages)

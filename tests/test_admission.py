@@ -1,0 +1,461 @@
+"""Scheduler-tier tests: single-flight coalescing + admission control.
+
+The millions-of-users tier contracts:
+
+- ``SingleFlight``: N concurrent identical calls -> 1 execution, every
+  caller gets the SAME result object (bit-identical by construction);
+  failures propagate to all; the flight table never caches results.
+- Broker coalescing: concurrent identical SQL shares one execution;
+  a cluster-state mutation (table generation bump) prevents later
+  arrivals from joining a stale in-flight answer.
+- ``AdmissionGate``: past the bounded queue -> immediate typed
+  rejection carrying queue depth; queued waiters are rejected at the
+  wait bound (bounded latency); quota trips are the same typed error.
+- Reject-path hygiene: a rejected query holds NO residency lease — the
+  manager's byte/pin accounting is untouched (the lease opens strictly
+  after admission; graftlint's pairing family guards the pairing).
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from pinot_tpu.broker.broker import (
+    TOO_MANY_REQUESTS_ERROR,
+    BrokerRequestHandler,
+)
+from pinot_tpu.common.singleflight import SingleFlight
+from pinot_tpu.controller.state import ClusterStateStore
+from pinot_tpu.engine import ServerQueryExecutor
+from pinot_tpu.engine.errors import QueryError, QueryRejectedError
+from pinot_tpu.query import compile_query
+from pinot_tpu.segment import SegmentBuilder, load_segment
+from pinot_tpu.server.admission import AdmissionGate
+from pinot_tpu.spi import DataType, FieldSpec, FieldType, Schema
+from pinot_tpu.spi.table import QuotaConfig, TableConfig
+
+RNG = np.random.default_rng(7)
+
+
+# --------------------------------------------------------------------------
+# SingleFlight
+# --------------------------------------------------------------------------
+
+class TestSingleFlight:
+    def test_concurrent_identical_calls_share_one_execution(self):
+        sf = SingleFlight()
+        calls = []
+        entered = threading.Event()
+        go = threading.Event()
+
+        def work():
+            calls.append(1)
+            entered.set()
+            go.wait(10)
+            return {"rows": [1, 2, 3]}
+
+        results = []
+        lock = threading.Lock()
+
+        def run():
+            out, coalesced = sf.do("k", work)
+            with lock:
+                results.append((out, coalesced))
+
+        leader = threading.Thread(target=run)
+        leader.start()
+        assert entered.wait(10)
+        followers = [threading.Thread(target=run) for _ in range(4)]
+        for t in followers:
+            t.start()
+        # every follower must be REGISTERED on the flight before release
+        deadline = time.monotonic() + 10
+        while sf.snapshot()["hits"] < 4 and time.monotonic() < deadline:
+            time.sleep(0.001)
+        assert sf.snapshot()["hits"] == 4
+        go.set()
+        leader.join(10)
+        for t in followers:
+            t.join(10)
+        assert len(calls) == 1, "exactly one execution"
+        outs = [r for r, _ in results]
+        assert all(o is outs[0] for o in outs), \
+            "all callers share the SAME result object (bit-identical)"
+        assert sorted(c for _, c in results) == [False] + [True] * 4
+        assert sf.inflight() == 0
+
+    def test_exception_propagates_to_followers_and_flight_clears(self):
+        sf = SingleFlight()
+        entered = threading.Event()
+        go = threading.Event()
+
+        def boom():
+            entered.set()
+            go.wait(10)
+            raise QueryError("inner failure")
+
+        errs = []
+
+        def run():
+            try:
+                sf.do("k", boom)
+            except QueryError as e:
+                errs.append(str(e))
+
+        leader = threading.Thread(target=run)
+        leader.start()
+        assert entered.wait(10)
+        follower = threading.Thread(target=run)
+        follower.start()
+        deadline = time.monotonic() + 10
+        while sf.snapshot()["hits"] < 1 and time.monotonic() < deadline:
+            time.sleep(0.001)
+        go.set()
+        leader.join(10)
+        follower.join(10)
+        assert errs == ["inner failure"] * 2
+        assert sf.inflight() == 0
+        # a later call starts a FRESH flight (failures are not cached)
+        out, coalesced = sf.do("k", lambda: "ok")
+        assert (out, coalesced) == ("ok", False)
+
+    def test_none_key_never_coalesces(self):
+        sf = SingleFlight()
+        assert sf.do(None, lambda: 1) == (1, False)
+        assert sf.snapshot() == {"leaders": 0, "hits": 0, "inflight": 0}
+
+
+# --------------------------------------------------------------------------
+# broker single-flight
+# --------------------------------------------------------------------------
+
+def _broker():
+    return BrokerRequestHandler(ClusterStateStore())
+
+
+class TestBrokerCoalescing:
+    def test_identical_concurrent_queries_share_one_execution(self):
+        broker = _broker()
+        calls = []
+        entered = threading.Event()
+        go = threading.Event()
+
+        def fake_handle(sql, principal=None, access_control=None):
+            calls.append(sql)
+            entered.set()
+            go.wait(10)
+            return {"sql": sql, "rows": [[42]]}
+
+        broker._handle_sql = fake_handle
+        results = []
+        lock = threading.Lock()
+
+        def run():
+            r = broker.handle_sql("SELECT 1  FROM t")
+            with lock:
+                results.append(r)
+
+        leader = threading.Thread(target=run)
+        leader.start()
+        assert entered.wait(10)
+        # whitespace-normalized duplicates join the same flight
+        followers = [threading.Thread(target=lambda: results.append(
+            broker.handle_sql("SELECT 1 FROM t"))) for _ in range(3)]
+        for t in followers:
+            t.start()
+        deadline = time.monotonic() + 10
+        while broker._flights.snapshot()["hits"] < 3 \
+                and time.monotonic() < deadline:
+            time.sleep(0.001)
+        go.set()
+        leader.join(10)
+        for t in followers:
+            t.join(10)
+        assert len(calls) == 1, "one execution served all four callers"
+        assert all(r is results[0] for r in results), "fanned-out result"
+        snap = broker.scheduler_snapshot()["singleFlight"]
+        assert snap["hits"] == 3 and snap["leaders"] == 1
+
+    def test_generation_bump_prevents_joining_stale_flight(self):
+        broker = _broker()
+        calls = []
+        first_gate = threading.Event()
+        entered = threading.Event()
+
+        def fake_handle(sql, principal=None, access_control=None):
+            calls.append(sql)
+            if len(calls) == 1:
+                entered.set()
+                first_gate.wait(10)
+            return {"n": len(calls)}
+
+        broker._handle_sql = fake_handle
+        leader = threading.Thread(
+            target=lambda: broker.handle_sql("SELECT 1 FROM t"))
+        leader.start()
+        assert entered.wait(10)
+        # a table-config push bumps the cluster-state version: the SAME
+        # SQL arriving now must NOT join the in-flight stale answer
+        broker.store.set("tables/t_OFFLINE", {"changed": True})
+        second = broker.handle_sql("SELECT 1 FROM t")
+        assert second == {"n": 2}, "post-mutation arrival ran fresh"
+        first_gate.set()
+        leader.join(10)
+        assert len(calls) == 2
+
+    def test_principal_and_now_queries_do_not_coalesce(self):
+        broker = _broker()
+        assert broker._flight_key("SELECT now() FROM t", None, None) is None
+        k_a = broker._flight_key("SELECT 1 FROM t",
+                                 type("P", (), {"name": "alice"})(), None)
+        k_b = broker._flight_key("SELECT 1 FROM t",
+                                 type("P", (), {"name": "bob"})(), None)
+        assert k_a != k_b, "different principals never share a flight"
+
+    def test_quota_rejection_is_429_with_queue_depth(self):
+        store = ClusterStateStore()
+        store.add_table_config(TableConfig(
+            "t", quota_config=QuotaConfig(max_queries_per_second=1)))
+        broker = BrokerRequestHandler(store)
+        broker._scatter_reduce = lambda *a, **k: a[3]  # response passthru
+        ok = broker.handle_sql("SELECT count(*) FROM t_OFFLINE")
+        assert not any(e["errorCode"] == TOO_MANY_REQUESTS_ERROR
+                       for e in ok.exceptions)
+        throttled = broker.handle_sql("SELECT count(*) FROM t_OFFLINE "
+                                      "OPTION(x=1)")
+        codes = [e["errorCode"] for e in throttled.exceptions]
+        assert codes == [TOO_MANY_REQUESTS_ERROR]
+        assert "retriable" in throttled.exceptions[0]["message"]
+        assert broker.admission.stats_snapshot()["rejectedQuota"] == 1
+
+
+# --------------------------------------------------------------------------
+# AdmissionGate
+# --------------------------------------------------------------------------
+
+class TestAdmissionGate:
+    def test_queue_full_rejects_immediately_with_typed_error(self):
+        gate = AdmissionGate(max_concurrent=1, max_queue=1,
+                             max_wait_ms=5000)
+        held = gate.admit("t")
+        waiter_err = []
+
+        def waiter():
+            try:
+                t = gate.admit("t")
+                gate.release(t)
+            except QueryRejectedError as e:
+                waiter_err.append(e)
+
+        w = threading.Thread(target=waiter)
+        w.start()
+        deadline = time.monotonic() + 10
+        while gate.snapshot()["queued"] < 1 and time.monotonic() < deadline:
+            time.sleep(0.001)
+        t0 = time.monotonic()
+        with pytest.raises(QueryRejectedError) as ei:
+            gate.admit("t")
+        assert (time.monotonic() - t0) < 1.0, "queue-full reject is instant"
+        assert isinstance(ei.value, QueryError)
+        assert ei.value.retriable is True
+        assert ei.value.reason == "queue_full"
+        assert ei.value.queue_depth == 1
+        assert ei.value.code == 429
+        gate.release(held)
+        w.join(10)
+        assert not waiter_err, "the queued waiter got the freed slot"
+        snap = gate.stats_snapshot()
+        assert snap["rejectedQueueFull"] == 1
+        assert snap["admitted"] == 2
+
+    def test_wait_bound_rejects_queued_waiter(self):
+        gate = AdmissionGate(max_concurrent=1, max_queue=4, max_wait_ms=100)
+        held = gate.admit("t")
+        t0 = time.monotonic()
+        with pytest.raises(QueryRejectedError) as ei:
+            gate.admit("t")
+        waited = time.monotonic() - t0
+        assert 0.05 < waited < 2.0, f"bounded wait, not forever ({waited})"
+        assert ei.value.reason == "wait_expired"
+        gate.release(held)
+        # slot freed: admission works again
+        t = gate.admit("t")
+        gate.release(t)
+        assert gate.stats_snapshot()["rejectedWaitExpired"] == 1
+
+    def test_release_is_idempotent_and_reconfigure_wakes_waiters(self):
+        gate = AdmissionGate(max_concurrent=1, max_queue=4,
+                             max_wait_ms=5000)
+        held = gate.admit("t")
+        gate.release(held)
+        gate.release(held)  # double release must not free a phantom slot
+        a = gate.admit("t")
+        got = []
+
+        def waiter():
+            t = gate.admit("t")
+            got.append(t)
+            gate.release(t)
+
+        w = threading.Thread(target=waiter)
+        w.start()
+        deadline = time.monotonic() + 10
+        while gate.snapshot()["queued"] < 1 and time.monotonic() < deadline:
+            time.sleep(0.001)
+        gate.configure(max_concurrent=2)  # widened: waiter admits now
+        w.join(10)
+        assert got, "configure() wakes and admits the queued waiter"
+        gate.release(a)
+
+    def test_disabled_gate_admits_everything(self):
+        gate = AdmissionGate(max_concurrent=-1, max_queue=0, max_wait_ms=1)
+        tickets = [gate.admit("t") for _ in range(64)]
+        assert gate.stats_snapshot()["admitted"] == 64
+        for t in tickets:
+            gate.release(t)
+
+
+# --------------------------------------------------------------------------
+# executor admission: reject path leaks nothing
+# --------------------------------------------------------------------------
+
+def _schema():
+    return Schema("s", [
+        FieldSpec("k", DataType.STRING),
+        FieldSpec("v", DataType.LONG, FieldType.METRIC),
+    ])
+
+
+@pytest.fixture(scope="module")
+def seg(tmp_path_factory):
+    out = tmp_path_factory.mktemp("adm_segs")
+    b = SegmentBuilder(_schema(), "s_0")
+    b.build({"k": [["a", "b"][i % 2] for i in range(512)],
+             "v": list(range(512))}, str(out))
+    return load_segment(str(out / "s_0"))
+
+
+class TestExecutorAdmission:
+    def test_reject_path_leaks_no_lease_or_bytes(self, seg):
+        ex = ServerQueryExecutor()
+        ctx = compile_query("SELECT sum(v) FROM s")
+        table, stats = ex.execute(ctx, [seg])
+        assert table.rows[0][0] == float(sum(range(512)))
+        before = ex.residency.snapshot()
+        assert all(r["pins"] == 0
+                   for r in before["stagedSegments"].values())
+
+        ex.admission.configure(max_concurrent=1, max_queue=-1,
+                               max_wait_ms=50)
+        blocker = ex.admission.admit("hold")
+        try:
+            with pytest.raises(QueryRejectedError) as ei:
+                ex.execute(ctx, [seg])
+            assert ei.value.retriable
+        finally:
+            ex.admission.release(blocker)
+        after = ex.residency.snapshot()
+        # the reject fired BEFORE any lease: pins untouched, bytes stable
+        assert all(r["pins"] == 0
+                   for r in after["stagedSegments"].values())
+        assert after["stagedBytes"] == before["stagedBytes"]
+        # and the path recovers: same query, same answer
+        table2, _ = ex.execute(ctx, [seg])
+        assert table2.rows == table.rows
+        assert ex.admission.stats_snapshot()["rejectedQueueFull"] >= 1
+
+    def test_query_singleflight_shares_whole_execution(self, seg):
+        """N concurrent identical queries (same compiled ctx object, same
+        segment objects) -> ONE execution, shared result object."""
+        ex = ServerQueryExecutor()
+        ctx = compile_query("SELECT sum(v) FROM s")
+        calls = []
+        entered = threading.Event()
+        go = threading.Event()
+        real = ex._execute_admitted
+
+        def counted(c, segs):
+            calls.append(1)
+            entered.set()
+            go.wait(10)
+            return real(c, segs)
+
+        ex._execute_admitted = counted
+        results = []
+        lock = threading.Lock()
+
+        def run():
+            out = ex.execute(ctx, [seg])
+            with lock:
+                results.append(out)
+
+        leader = threading.Thread(target=run)
+        leader.start()
+        assert entered.wait(10)
+        followers = [threading.Thread(target=run) for _ in range(3)]
+        for t in followers:
+            t.start()
+        deadline = time.monotonic() + 10
+        while ex._query_flight.snapshot()["hits"] < 3 \
+                and time.monotonic() < deadline:
+            time.sleep(0.001)
+        go.set()
+        leader.join(10)
+        for t in followers:
+            t.join(10)
+        assert len(calls) == 1, "one whole-query execution for all four"
+        assert all(r is results[0] for r in results)
+        assert results[0][0].rows[0][0] == float(sum(range(512)))
+        # mutable/upsert segments must never share a flight
+        class FakeMutable:
+            is_mutable = True
+        assert ex._query_flight_key(ctx, [FakeMutable()]) is None
+
+    def test_debug_scheduler_snapshot(self, seg):
+        """/debug/scheduler body: policy + queue depth, admission bounds,
+        launch-window state, kernel single-flight counters."""
+        from pinot_tpu.server.server import ServerInstance
+
+        store = ClusterStateStore()
+        inst = ServerInstance("Server_adm_0", store)
+        d = inst.scheduler_debug()
+        assert d["scheduler"]["policy"] == "SewfScheduler"
+        assert d["scheduler"]["queued"] == 0
+        assert d["admission"]["enabled"] is True
+        assert {"maxConcurrent", "maxQueue", "rejected",
+                "queued"} <= set(d["admission"])
+        assert {"leaders", "hits", "inflight"} == set(d["kernelFlight"])
+        # the REST route serves the same body
+        from pinot_tpu.transport.rest import ServerAdminApi
+
+        api = ServerAdminApi(inst)
+        handler = next(h for m, rx, h, _scope in api._routes
+                       if m == "GET" and rx.pattern == r"/debug/scheduler")
+        status, body = handler(None, None)
+        assert status == 200 and body["scheduler"]["policy"] == \
+            "SewfScheduler"
+
+    def test_concurrent_identical_queries_bit_identical(self, seg):
+        """Kernel single-flight hammer: concurrent identical queries (the
+        dashboard case) must agree bit-for-bit with the serial answer."""
+        ex = ServerQueryExecutor()
+        ctx = compile_query("SELECT k, sum(v), count(*) FROM s "
+                            "GROUP BY k ORDER BY k")
+        want, _ = ex.execute(ctx, [seg])
+        outs = []
+        lock = threading.Lock()
+
+        def run():
+            t, _ = ex.execute(ctx, [seg])
+            with lock:
+                outs.append(t.rows)
+
+        threads = [threading.Thread(target=run) for _ in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(30)
+        assert len(outs) == 8
+        assert all(rows == want.rows for rows in outs)

@@ -413,6 +413,93 @@ def test_launch_max_batch_config_key():
 
 
 # --------------------------------------------------------------------------
+# adaptive micro-batch window (the straggler hold)
+# --------------------------------------------------------------------------
+
+def test_window_gathers_stragglers_into_one_batch():
+    """With a hot arrival EWMA the dispatcher holds the window open, so a
+    straggler submitted ~20 ms behind the first request still rides the
+    SAME vmapped launch — no blocker pinning needed."""
+    import jax.numpy as jnp
+
+    sched = LaunchScheduler(name="t-window")
+    # hot_ms=inf: any PRIMED ewma counts as hot, so the hold is
+    # deterministic; prime with a tight synthetic arrival train
+    sched.set_window(max_ms=250.0, hot_ms=float("inf"))
+    with sched._cond:
+        t = time.perf_counter()
+        for i in range(5):
+            sched._note_arrival_locked(t + i * 0.0005)
+    launches = []
+
+    def call(params, num_docs):
+        launches.append(1)
+        return params * num_docs
+
+    kern = LaunchKernel(("kw",), call, max_batch=8)
+    r1 = sched.submit(kern, jnp.float32(2.0), jnp.int32(3))
+    time.sleep(0.02)  # arrives mid-window: must join r1's drain
+    r2 = sched.submit(kern, jnp.float32(5.0), jnp.int32(3))
+    assert float(np.asarray(r1.result(30))) == 6.0
+    assert float(np.asarray(r2.result(30))) == 15.0
+    assert r1.batch_size == 2 and r2.batch_size == 2, \
+        "the straggler rode the held window into one batch"
+    assert len(launches) == 1
+    snap = sched.stats_snapshot()
+    assert snap["windowWaits"] >= 1
+    assert snap["windowGathered"] >= 1
+    assert sched.snapshot()["windowMaxMs"] == 250.0
+
+
+def test_window_idle_traffic_pays_no_hold():
+    """Cold EWMA (hot_ms=0 means nothing ever counts hot): a lone request
+    must dispatch immediately — no added latency at low QPS."""
+    sched = LaunchScheduler(name="t-window-idle")
+    sched.set_window(max_ms=500.0, hot_ms=0.0)
+
+    def call(params, num_docs):
+        return params
+
+    kern = LaunchKernel(("ki",), call, max_batch=8)
+    t0 = time.perf_counter()
+    r = sched.submit(kern, ("p",), 0)
+    assert r.result(30) == ("p",)
+    assert (time.perf_counter() - t0) < 0.4, \
+        "idle dispatch must not wait out the window"
+    assert sched.stats_snapshot()["windowWaits"] == 0
+
+
+def test_window_arrival_ewma_tracks_and_resets():
+    sched = LaunchScheduler(name="t-ewma")
+    sched.set_window(max_ms=1.0, hot_ms=2.0)
+    with sched._cond:
+        t = 100.0
+        sched._note_arrival_locked(t)
+        for _ in range(10):  # 1 ms apart: hot
+            t += 0.001
+            sched._note_arrival_locked(t)
+        hot = sched._arrival_ewma_ms
+        assert hot is not None and hot < 2.0
+        t += 10.0  # a 10 s gap must RESET, not decay over many arrivals
+        sched._note_arrival_locked(t)
+        assert sched._arrival_ewma_ms > 2.0
+    assert sched._window_hold_s(1) == 0.0
+
+
+def test_window_config_keys():
+    cfg = PinotConfiguration({
+        CommonConstants.LAUNCH_WINDOW_MS_KEY: 3.5,
+        CommonConstants.LAUNCH_WINDOW_HOT_MS_KEY: 9.0})
+    dev = ShardedQueryExecutor(config=cfg)
+    assert dev.launcher.window_max_ms == 3.5
+    assert dev.launcher.window_hot_ms == 9.0
+    # restore the shared per-mesh dispatcher for other tests
+    dev.launcher.set_window(
+        max_ms=CommonConstants.DEFAULT_LAUNCH_WINDOW_MS,
+        hot_ms=CommonConstants.DEFAULT_LAUNCH_WINDOW_HOT_MS)
+
+
+# --------------------------------------------------------------------------
 # cross-query column dedup (batch -> per-segment borrow satellite)
 # --------------------------------------------------------------------------
 

@@ -282,6 +282,46 @@ def test_pairing_catches_missing_and_discarded_release(tmp_path):
     assert len(msgs) == 1 and "discarded" in msgs[0]
 
 
+def test_pairing_catches_unreleased_lease_on_reject(tmp_path):
+    """The admission-reject leak shape (PR 7): a gate that can raise
+    between ``begin_query``/``admit`` and the fall-through releases means
+    every rejection leaks a lease pin AND a gate slot. Both releases live
+    only on the fall-through path — the checker must flag both halves."""
+    new = _lint(tmp_path, """\
+        def rejected_leaks(mgr, gate, segments, run):
+            lease = mgr.begin_query(segments, [])
+            ticket = gate.admit("t")
+            out = run(segments)
+            mgr.end_query(lease)
+            gate.release(ticket)
+            return out
+        """)
+    pf = _by_checker(new, "pairing")
+    assert len(pf) == 2, [f.render() for f in pf]
+    symbols = {f.symbol for f in pf}
+    assert "rejected_leaks:begin_query" in symbols
+    assert "rejected_leaks:admit" in symbols
+    assert all("finally" in f.message for f in pf)
+
+
+def test_pairing_accepts_admission_gate_shape(tmp_path):
+    """The correct executor shape: admit -> try -> lease inside ->
+    releases in finally, rejection before the lease ever opens."""
+    new = _lint(tmp_path, """\
+        def admitted(mgr, gate, segments, run):
+            ticket = gate.admit("t")
+            try:
+                lease = mgr.begin_query(segments, [])
+                try:
+                    return run(segments)
+                finally:
+                    mgr.end_query(lease)
+            finally:
+                gate.release(ticket)
+        """)
+    assert not _by_checker(new, "pairing")
+
+
 def test_pairing_accepts_finally_and_context_manager(tmp_path):
     new = _lint(tmp_path, """\
         def safe(mgr, segments, run):

@@ -12,7 +12,11 @@ import numpy as np
 import pandas as pd
 import pytest
 
-from pinot_tpu.server.scheduler import PriorityScheduler, make_scheduler
+from pinot_tpu.server.scheduler import (
+    PriorityScheduler,
+    SewfScheduler,
+    make_scheduler,
+)
 from pinot_tpu.spi import DataType, FieldSpec, FieldType, Schema
 from pinot_tpu.spi.plugin import PluginManager
 from pinot_tpu.spi.table import TableConfig
@@ -78,6 +82,91 @@ class TestPriorityScheduler:
         first_20 = [t for t, _ in order[:20]]
         assert first_20.count("vip") > 10  # vip dominated the early slots
         s.shutdown(timeout_s=5)
+
+
+class TestSewfScheduler:
+    """Shortest-expected-work-first + the age anti-starvation boost."""
+
+    def test_factory_and_snapshot(self):
+        s = make_scheduler("sewf", num_workers=2)
+        assert isinstance(s, SewfScheduler)
+        snap = s.stats_snapshot()
+        assert snap["policy"] == "SewfScheduler"
+        assert snap["workers"] == 2 and snap["queued"] == 0
+        s.shutdown(timeout_s=2)
+
+    def _seed(self, s, shape, ms, n=3):
+        """Establish a latency EWMA for ``shape`` by running real jobs."""
+        for _ in range(n):
+            s.submit(lambda: time.sleep(ms / 1e3), shape=shape).result(10)
+
+    def test_short_shapes_overtake_long_under_contention(self):
+        s = SewfScheduler(num_workers=1)
+        self._seed(s, "slow", 30.0)
+        self._seed(s, "fast", 1.0)
+        assert s.expected_ms("slow") > s.expected_ms("fast")
+        order = []
+        lock = threading.Lock()
+
+        def job(tag):
+            with lock:
+                order.append(tag)
+
+        gate = threading.Event()
+        blocker = s.submit(lambda: gate.wait(10), shape="blocker")
+        # enqueue while the single worker is parked: two slow, then a fast
+        futs = [s.submit(lambda: job("slow1"), shape="slow"),
+                s.submit(lambda: job("slow2"), shape="slow"),
+                s.submit(lambda: job("fast1"), shape="fast")]
+        gate.set()
+        for f in futs:
+            f.result(10)
+        blocker.result(10)
+        assert order[0] == "fast1", \
+            f"the cheap shape must jump the slow convoy (got {order})"
+        s.shutdown(timeout_s=5)
+
+    def test_age_boost_prevents_starvation(self):
+        s = SewfScheduler(num_workers=1, aging_boost=2.0)
+        self._seed(s, "slow", 30.0)
+        self._seed(s, "fast", 1.0)
+        order = []
+        lock = threading.Lock()
+
+        def job(tag):
+            with lock:
+                order.append(tag)
+
+        gate = threading.Event()
+        blocker = s.submit(lambda: gate.wait(10), shape="blocker")
+        slow = s.submit(lambda: job("slow"), shape="slow")
+        # let the slow entry AGE past its expected-work handicap
+        # (30 ms EWMA / 2.0 boost = 15 ms of age cancels it out)
+        time.sleep(0.05)
+        fast = s.submit(lambda: job("fast"), shape="fast")
+        gate.set()
+        slow.result(10)
+        fast.result(10)
+        blocker.result(10)
+        assert order[0] == "slow", \
+            f"an aged expensive query must not starve (got {order})"
+        s.shutdown(timeout_s=5)
+
+    def test_runs_drains_and_propagates_errors(self):
+        s = SewfScheduler(num_workers=4)
+        futs = [s.submit(lambda i=i: i * 3, shape=f"s{i % 5}")
+                for i in range(40)]
+        assert sorted(f.result(10) for f in futs) == \
+            sorted(i * 3 for i in range(40))
+
+        def boom():
+            raise ValueError("x")
+
+        with pytest.raises(ValueError):
+            s.submit(boom, shape="err").result(10)
+        s.shutdown(timeout_s=5)
+        with pytest.raises(RuntimeError):
+            s.submit(lambda: 1)
 
 
 class TestPluginLoader:

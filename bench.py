@@ -59,7 +59,8 @@ INIT_TIMEOUT_S = 150
 WARMUP = 1
 ITERS = 5
 
-SUITES = ("ssb", "qps", "micro", "startree", "sketches", "cluster")
+SUITES = ("ssb", "qps", "micro", "startree", "sketches", "residency",
+          "cluster")
 
 
 def _log(msg: str) -> None:
@@ -243,9 +244,12 @@ class _Worker:
         now = self.dev.residency.stats_snapshot()
         out = {k: now[k] - mark.get(k, 0)
                for k in ("hits", "misses", "evictions",
-                         "pinBlockedEvictions", "spills")}
+                         "pinBlockedEvictions", "spills", "demotions",
+                         "promotions", "hostDrops", "slicedQueries")}
         out["stagedBytes"] = now["stagedBytes"]
         out["peakBytes"] = now["peakBytes"]
+        out["hostBytes"] = now["hostBytes"]
+        out["hostPeakBytes"] = now["hostPeakBytes"]
         return out
 
     def record(self, suite: str, rec: dict) -> None:
@@ -256,9 +260,12 @@ class _Worker:
             os.fsync(f.fileno())
         # suites without a per-query p50 log their own headline scalar
         # (star-tree: ms; qps: queries/sec — the r05 log had an empty
-        # "recorded qps:" line because neither key existed there)
+        # "recorded qps:" line because neither key existed there;
+        # residency: the sliced-combine p50)
         scalar = rec.get("p50_ms_per_query",
-                         rec.get("ms", rec.get("qps", "")))
+                         rec.get("ms", rec.get(
+                             "qps", rec.get("sliced_p50_ms_per_query",
+                                            ""))))
         _log(f"recorded {suite}: {scalar}")
 
     def run(self) -> None:
@@ -267,6 +274,7 @@ class _Worker:
                           ("micro", self.bench_micro),
                           ("startree", self.bench_startree),
                           ("sketches", self.bench_sketches),
+                          ("residency", self.bench_residency),
                           ("cluster", self.bench_cluster)):
             if suite in self.skip:
                 _log(f"{suite}: already chip-served, skipping")
@@ -660,6 +668,130 @@ class _Worker:
         p50, _ = _time_suite(lambda c: self.dev.execute(c, segs), ctxs,
                              iters=3)
         return {"p50_ms_per_query": round(p50 / len(ctxs) * 1e3, 3)}
+
+    def bench_residency(self) -> dict:
+        """Tiered residency under memory pressure: pin the HBM budget to
+        ~1/4 of the measured working set of three non-star-tree SSB
+        flights and serve them via the budget-sliced sharded combine,
+        against two baselines:
+
+        - **host-spill**: the SAME budget with slicing + the host tier
+          disabled (the pre-tier fit-or-fail behavior) — the over-budget
+          queries fall to the host engine;
+        - **restage vs rebuild**: one segment staged cold (full column
+          build) vs re-staged from a host-tier image (plain H2D).
+
+        Records sliced-vs-spill p50s, restage/rebuild stage latency, and
+        the promoted/demoted/dropped byte counters. Fails LOUDLY if an
+        over-budget query spilled to the host engine while the host tier
+        + slicing could have served it (BENCH_ALLOW_TIER_SPILL=1 escape
+        hatch for hosts whose segments individually exceed the budget)."""
+        from pinot_tpu.parallel import ShardedQueryExecutor
+        from pinot_tpu.query import compile_query
+        from pinot_tpu.spi.config import (
+            CommonConstants,
+            PinotConfiguration,
+        )
+        from pinot_tpu.tools import ssb
+
+        segs = self.segments()
+        qids = ("Q1.1", "Q3.2", "Q4.2")  # scan/group flights off the
+        # star-tree rung: they exercise the sharded combine, not the
+        # per-segment node-slice path
+        ctxs = [compile_query(ssb.QUERIES[q] + " LIMIT 100000")
+                for q in qids]
+
+        # 1) working set of THIS query set, measured uncapped
+        probe = ShardedQueryExecutor()
+        oracle_rows = []
+        for ctx in ctxs:
+            rt, _ = probe.execute(ctx, segs)
+            oracle_rows.append(rt.rows)
+        ws = probe.residency.staged_bytes()
+        probe.residency.clear()
+        probe.close()
+        budget = max(1, ws // 4)
+
+        # 2) sliced-combine serving at budget = ws/4
+        capped = ShardedQueryExecutor(hbm_budget_bytes=budget)
+        parity_fail = []
+        for qid, ctx, want in zip(qids, ctxs, oracle_rows):
+            rt, _ = capped.execute(ctx, segs)
+            if rt.rows != want:
+                parity_fail.append(qid)
+        if parity_fail:
+            raise AssertionError(
+                f"sliced combine diverged from the uncapped oracle: "
+                f"{parity_fail}")
+        sliced_p50, _ = _time_suite(
+            lambda c: capped.execute(c, segs), ctxs, iters=3, warmup=0)
+        snap = capped.residency.stats_snapshot()
+        if snap["spills"] and not os.environ.get("BENCH_ALLOW_TIER_SPILL"):
+            raise AssertionError(
+                f"over-budget queries fell to the host engine "
+                f"({snap['spills']} spills) while the host tier + sliced "
+                f"combine could have served them (budget {budget} B, "
+                f"working set {ws} B)")
+        capped_counters = {
+            k: snap[k] for k in
+            ("demotions", "promotions", "hostDrops", "slicedQueries",
+             "spills", "demotedBytes", "promotedBytes",
+             "hostDroppedBytes", "hostPeakBytes", "estimateScale")}
+        capped.residency.clear()
+        capped.close()
+
+        # 3) host-spill baseline: same budget, tier + slicing disabled
+        cfg = PinotConfiguration(
+            {CommonConstants.HBM_SLICING_ENABLED_KEY: "false",
+             CommonConstants.HOSTRAM_ENABLED_KEY: "false"}, use_env=False)
+        spill = ShardedQueryExecutor(hbm_budget_bytes=budget, config=cfg)
+        spill_p50, _ = _time_suite(
+            lambda c: spill.execute(c, segs), ctxs, iters=3, warmup=0)
+        spill_snap = spill.residency.stats_snapshot()
+        spill.residency.clear()
+        spill.close()
+
+        # 4) restage-from-host vs cold rebuild, one segment
+        from pinot_tpu.engine.residency import ResidencyManager
+
+        cols = [c for c in
+                ("lo_orderdate", "lo_extendedprice", "lo_discount",
+                 "lo_quantity")
+                if c in segs[0].metadata.columns]
+        rm = ResidencyManager(budget_bytes=0)
+        t0 = time.perf_counter()
+        st = rm.stage(segs[0])
+        for c in cols:
+            st.column(c)
+        rebuild_ms = (time.perf_counter() - t0) * 1e3
+        assert rm.demote(segs[0].segment_name)
+        t0 = time.perf_counter()
+        st = rm.stage(segs[0])
+        for c in cols:
+            st.column(c)
+        restage_ms = (time.perf_counter() - t0) * 1e3
+        promoted = rm.stats_snapshot()["promotions"]
+        rm.clear()
+
+        n = len(ctxs)
+        return {
+            "queries": list(qids),
+            "working_set_bytes": ws,
+            "budget_bytes": budget,
+            "over_budget_x": round(ws / budget, 2),
+            "sliced_p50_ms_per_query": round(sliced_p50 / n * 1e3, 3),
+            "host_spill_p50_ms_per_query": round(spill_p50 / n * 1e3, 3),
+            "sliced_vs_spill": round(spill_p50 / sliced_p50, 3)
+            if sliced_p50 else None,
+            "spill_baseline_spills": spill_snap["spills"],
+            "restage_ms": round(restage_ms, 3),
+            "rebuild_ms": round(rebuild_ms, 3),
+            "restage_vs_rebuild": round(rebuild_ms / restage_ms, 3)
+            if restage_ms else None,
+            "restage_promotions": promoted,
+            "tier_counters": capped_counters,
+            "parity": "ok",
+        }
 
     def bench_cluster(self) -> dict:
         """SSB through the FULL distributed path: broker parse -> routing ->

@@ -84,19 +84,24 @@ class ServerQueryExecutor:
     def __init__(self, use_device: bool = True,
                  num_groups_limit: int = CommonConstants.DEFAULT_NUM_GROUPS_LIMIT,
                  use_pallas: Optional[bool] = None,
-                 hbm_budget_bytes=None, config=None):
+                 hbm_budget_bytes=None, host_budget_bytes=None, config=None):
         from pinot_tpu.engine import ensure_x64
         from pinot_tpu.engine.pallas_kernels import PallasKernelCache
         from pinot_tpu.engine.residency import AUTO
 
         ensure_x64()
         self.config = config
-        # HBM residency manager: budget/pins/LRU/spill admission for every
-        # device-resident array this executor stages. ``hbm_budget_bytes``:
-        # None = resolve from config key pinot.server.query.hbm.budget.bytes
-        # then backend device memory; <= 0 forces uncapped.
+        # HBM residency manager: budget/pins/cost-aware eviction with a
+        # host-RAM spill tier + sliced/spill admission for every
+        # device-resident array this executor stages. ``hbm_budget_bytes``
+        # / ``host_budget_bytes``: None = resolve from the config keys
+        # (pinot.server.query.hbm.budget.bytes /
+        # pinot.server.query.hostram.budget.bytes) then the backend device
+        # memory / psutil; <= 0 forces uncapped.
         self.residency = ResidencyManager(
             budget_bytes=AUTO if hbm_budget_bytes is None else hbm_budget_bytes,
+            host_budget_bytes=(AUTO if host_budget_bytes is None
+                               else host_budget_bytes),
             config=config)
         # legacy alias (pre-residency name); same object
         self.staging = self.residency
@@ -327,13 +332,19 @@ class ServerQueryExecutor:
     def _begin_lease(self, ctx: QueryContext,
                      segments: List[ImmutableSegment], stats: QueryStats):
         """Open the residency lease for this query: admission decides
-        device vs host-spill, the lease pins every resident the query
-        stages until ``end_query``. Host-only executors skip the protocol
+        device vs sliced-device vs host-spill, the lease pins every
+        resident the query stages until ``end_query`` (a sliced lease
+        releases pins at slice boundaries instead). Only aggregation /
+        group-by shapes are sliceable — their partials merge with the
+        existing combine merges; selection/distinct keep the old
+        fit-or-spill admission. Host-only executors skip the protocol
         entirely (they stage nothing)."""
         if not self.use_device:
             return None
+        sliceable = not ctx.distinct and not ctx.is_selection
         lease = self.residency.begin_query(segments,
-                                           ctx.referenced_columns())
+                                           ctx.referenced_columns(),
+                                           sliceable=sliceable)
         stats._staging_lease = lease
         return lease
 
@@ -402,12 +413,23 @@ class ServerQueryExecutor:
         QueryStats merged in-order afterwards (QueryStats mutation is not
         thread-safe). Sized by pinot.server.query.worker.threads; the pool
         is shared across concurrent queries, so the thread count is a
-        server-level bound instead of multiplying per in-flight query."""
+        server-level bound instead of multiplying per in-flight query.
+
+        A SLICED lease serializes the fan-out instead: each segment is a
+        budget slice — stage, execute, then unpin + demote-to-host before
+        the next segment stages — so a working set far over the HBM budget
+        still rides the device kernels one segment at a time."""
+        lease = self._lease_of(stats)
+        if lease is not None and lease.sliced:
+            parts = []
+            for seg in segments:
+                parts.append(fn(seg, stats))
+                self.residency.release_slice(lease)
+            return parts
         if self.worker_threads <= 1 or len(segments) <= 1:
             return [fn(seg, stats) for seg in segments]
         pool = self._worker_pool()
         locals_ = [QueryStats() for _ in segments]
-        lease = self._lease_of(stats)
         for st in locals_:  # the pin set must ride into worker threads
             st._staging_lease = lease
         parts = pool.map(fn, segments, locals_)

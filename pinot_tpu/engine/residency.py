@@ -1,4 +1,4 @@
-"""HBM residency manager: budgeted, pinned, LRU-evicting segment staging.
+"""HBM residency manager: budgeted, pinned, tiered, cost-aware staging.
 
 The subsystem the tiered-storage / multi-table-scale work stands on: a
 production table set cannot fit in HBM, so device staging must degrade
@@ -13,20 +13,41 @@ behind one byte-accounted, lock-correct manager:
   keys; <= 0 means uncapped). When unset, the budget auto-derives from the
   backend's reported device memory (``bytes_limit`` fraction) — on hosts
   whose backend reports nothing (CPU), staging is uncapped.
-- **LRU eviction of UNPINNED residents only**: queries pin the residents
+- **Host-RAM spill tier**: eviction DEMOTES a resident's device arrays to
+  host numpy copies instead of dropping them (per the ISCA'23 HBM/ICI cost
+  model a D2H demote + H2D restage is ~10x cheaper than rebuilding device
+  columns from the segment — the TPU analogue of Pinot's PinotDataBuffer
+  mmap/heap tiering). ``stage()`` promotes from the host tier with a plain
+  H2D transfer, skipping dictionary build/encode/pack entirely. Host-tier
+  entries are byte-accounted against their own budget
+  (``pinot.server.query.hostram.budget.bytes``, auto from psutil) and
+  LRU-dropped under pressure.
+- **Restage-cost-aware eviction**: candidates are ranked by
+  ``bytes * staleness / rebuild_cost`` — big, cold, cheap-to-restage
+  residents (host-tier-backed, batch-borrowable) evict first, so the
+  budget preferentially keeps what is slow to get back (star-tree node
+  arrays, full column builds). With equal costs this degrades to exact
+  LRU.
+- **Eviction touches UNPINNED residents only**: queries pin the residents
   they touch for their duration via a :class:`QueryLease` (the same
   acquire/release hazard discipline as ``TableDataManager.acquire_segments``
   — ref ``BaseTableDataManager.java:71`` refcounting), so an in-flight query
   never loses its arrays mid-kernel (the SURVEY §5 race note).
-- **Admission control**: a query whose estimated working set cannot fit even
-  after evicting everything unpinned is routed to the host engine (a
-  *spill*, counted and surfaced) instead of device-OOMing.
+- **Admission control**: a query whose estimated working set cannot fit is
+  granted a SLICED lease when its largest single segment fits (the sharded
+  executor then runs the combine in budget-sized slices — stage k, launch,
+  demote, repeat — and the per-segment path runs serially releasing pins
+  per segment); only a query whose single-segment footprint is itself over
+  budget still spills to the host engine. Admission estimates are
+  validated against measured ``nbytes()`` after staging and a clamped EWMA
+  correction factor feeds back so slicing picks k from real bytes.
 - **Prefetch**: segment add/reload enqueues background staging so the first
   query pays no H2D (ref: the FetchContext prefetch path,
   ``InstancePlanMakerImplV2.java:155-170``).
 - **Observability**: global counters + per-query ``QueryStats.staging``
-  deltas, ``ServerMeter`` meters / gauges when bound to a registry, and a
-  bytes-accurate snapshot for ``/debug/memory``.
+  deltas (now incl. promotions/demotions/hostBytes/slices), ``ServerMeter``
+  meters / gauges when bound to a registry, and a bytes-accurate two-tier
+  snapshot for ``/debug/memory``.
 """
 
 from __future__ import annotations
@@ -36,17 +57,40 @@ import queue
 import threading
 
 from collections import OrderedDict
-from typing import Any, Dict, Iterable, List, Optional
+from typing import Any, Dict, Iterable, List, Optional, Tuple
 
 from pinot_tpu.engine.staging import StagedSegment, staged_int_dtype
 from pinot_tpu.spi.config import CommonConstants
 
 log = logging.getLogger(__name__)
 
-# budget sentinel: resolve from config, then backend device memory
+# budget sentinel: resolve from config, then backend device memory / psutil
 AUTO = object()
 
 _STOP = object()
+
+# Rebuild-cost weights for the eviction ranking (relative units — only the
+# ratios matter). Calibrated to the staging pipeline stages a re-stage
+# skips: a host-tier restage is one H2D; a batch re-adoption re-puts
+# already-stacked host arrays; a borrowable column is a device-side slice;
+# a cold column build pays decode+dict+H2D; star-tree node arrays pay the
+# tree walk on top.
+COST_HOST_RESTAGE = 1.0
+COST_BATCH_RESTAGE = 1.5
+COST_BORROWED_BUILD = 2.0
+COST_COLUMN_BUILD = 4.0
+COST_STARTREE_BUILD = 8.0
+
+# Admission-estimate drift correction: EWMA of measured/estimated staged
+# bytes, clamped so one pathological segment cannot swing admission.
+_EST_ALPHA = 0.2
+_EST_SCALE_MIN = 0.25
+_EST_SCALE_MAX = 4.0
+
+# Greedy slice packing fills at most this fraction of the free budget per
+# slice: estimates are approximate and a slice that lands exactly on the
+# budget line would thrash the evictor mid-launch.
+_SLICE_FILL = 0.85
 
 
 # --------------------------------------------------------------------------
@@ -57,7 +101,8 @@ def estimate_segment_bytes(segment, columns: Iterable[str]) -> int:
     """Metadata-only estimate of the device bytes staging ``columns`` of
     ``segment`` costs (fwd + dict values + null bitmap; the same layout
     contract as ``StagedSegment._stage``). Used for admission BEFORE any
-    H2D, so it must not touch column data."""
+    H2D, so it must not touch column data. Validated post-stage against
+    measured ``nbytes()`` — see ``ResidencyManager.observe_estimate``."""
     cap = int(getattr(segment, "padded_capacity", 0) or 0)
     md = getattr(segment, "metadata", None)
     cols = getattr(md, "columns", {}) if md is not None else {}
@@ -112,6 +157,32 @@ def resolve_budget_bytes(budget_bytes: Any = AUTO,
     return None
 
 
+def resolve_host_budget_bytes(budget_bytes: Any = AUTO,
+                              config=None) -> Optional[int]:
+    """Host-RAM tier budget: explicit arg > layered config key > psutil
+    available memory times the default fraction. None = uncapped (explicit
+    <= 0, or psutil unavailable)."""
+    if budget_bytes is not AUTO:
+        if budget_bytes is None:
+            return None
+        b = int(budget_bytes)
+        return b if b > 0 else None
+    from pinot_tpu.spi.config import PinotConfiguration
+
+    cfg = config if config is not None else PinotConfiguration()
+    v = cfg.get(CommonConstants.HOSTRAM_BUDGET_BYTES_KEY)
+    if v is not None:
+        b = int(v)
+        return b if b > 0 else None
+    try:
+        import psutil
+
+        avail = psutil.virtual_memory().available
+        return int(avail * CommonConstants.DEFAULT_HOSTRAM_BUDGET_FRACTION)
+    except Exception:  # psutil missing / unsupported platform
+        return None
+
+
 # --------------------------------------------------------------------------
 # leases
 # --------------------------------------------------------------------------
@@ -119,21 +190,33 @@ def resolve_budget_bytes(budget_bytes: Any = AUTO,
 class QueryLease:
     """One query's pin set + staging counters. Created by ``begin_query``,
     closed by ``end_query``; residents pinned through a lease survive
-    eviction pressure until the lease closes (acquire/release discipline)."""
+    eviction pressure until the lease closes (acquire/release discipline).
+    A ``sliced`` lease keeps the device path but releases its pins at
+    slice boundaries (``release_slice``) so an over-budget working set
+    streams through the budget instead of spilling to the host engine."""
 
-    __slots__ = ("device_allowed", "spilled", "hits", "misses",
-                 "evictions", "pin_blocked", "_pinned")
+    __slots__ = ("device_allowed", "sliced", "spilled", "hits", "misses",
+                 "evictions", "pin_blocked", "promotions", "demotions",
+                 "slices", "_pinned", "_est")
 
     def __init__(self, device_allowed: bool = True):
         self.device_allowed = device_allowed
+        self.sliced = False
         self.spilled = not device_allowed
         self.hits = 0
         self.misses = 0
         self.evictions = 0
         self.pin_blocked = 0
+        self.promotions = 0
+        self.demotions = 0
+        self.slices = 0
         self._pinned: set = set()
+        # raw (unscaled) admission estimates per missing segment, for the
+        # post-stage drift observation in end_query
+        self._est: Dict[str, int] = {}
 
-    def staging_dict(self, staged_bytes: int) -> Dict[str, int]:
+    def staging_dict(self, staged_bytes: int,
+                     host_bytes: int = 0) -> Dict[str, int]:
         """The ``QueryStats.staging`` payload (merge: counters sum, *Bytes
         keys max — see QueryStats.merge)."""
         return {
@@ -142,36 +225,59 @@ class QueryLease:
             "evictions": self.evictions,
             "pinBlockedEvictions": self.pin_blocked,
             "spills": 1 if self.spilled else 0,
+            "promotions": self.promotions,
+            "demotions": self.demotions,
+            "slices": self.slices,
             "stagedBytes": int(staged_bytes),
+            "hostBytes": int(host_bytes),
         }
 
 
 class _Entry:
-    __slots__ = ("resident", "pins", "nbytes")
+    __slots__ = ("resident", "pins", "nbytes", "touch")
 
     def __init__(self, resident):
         self.resident = resident
         self.pins = 0
         self.nbytes = 0
+        self.touch = 0
 
 
 class ResidencyManager:
-    """(name -> resident) LRU with byte budget, pins, spill admission and
-    background prefetch. A *resident* is anything with ``nbytes()`` and
-    ``release()`` — :class:`StagedSegment` for the per-segment path, the
-    sharded executor's batch wrapper for the combine path."""
+    """(name -> resident) two-tier cache with byte budgets, pins,
+    cost-aware eviction, sliced/spill admission and background prefetch.
+    A *resident* is anything with ``nbytes()`` and ``release()`` —
+    :class:`StagedSegment` for the per-segment path, the sharded
+    executor's batch wrapper for the combine path. A resident that also
+    defines ``demote()`` (returning a host image with ``nbytes()``/
+    ``release()``/``matches()``) moves to the host-RAM tier on eviction
+    instead of dropping."""
 
-    def __init__(self, budget_bytes: Any = AUTO, config=None):
+    def __init__(self, budget_bytes: Any = AUTO, config=None,
+                 host_budget_bytes: Any = AUTO):
         self._budget_arg = budget_bytes
+        self._host_budget_arg = host_budget_bytes
         self._config = config
         self._budget_resolved = False
         self._budget: Optional[int] = None
+        self._host_budget_resolved = False
+        self._host_budget: Optional[int] = None
         # RLock: evicting a batch resident re-enters through the executor's
         # release callback (discard()), and that must not deadlock
         self._lock = threading.RLock()
         self._entries: "OrderedDict[str, _Entry]" = OrderedDict()  # guarded-by: _lock
+        # host-RAM spill tier: name -> _Entry whose resident is a host
+        # image (numpy copies); LRU-dropped under the host budget
+        self._host_entries: "OrderedDict[str, _Entry]" = OrderedDict()  # guarded-by: _lock
         self._staged_bytes = 0  # guarded-by: _lock
         self._peak_bytes = 0  # guarded-by: _lock
+        self._host_bytes = 0  # guarded-by: _lock
+        self._host_peak_bytes = 0  # guarded-by: _lock
+        # monotonically increasing touch sequence for the eviction ranking
+        self._touch_seq = 0  # guarded-by: _lock
+        # admission-estimate drift: EWMA of measured/estimated bytes
+        self._est_scale = 1.0  # guarded-by: _lock
+        self.est_observations = 0  # guarded-by: _lock
         # per-name eviction generation: a queued prefetch carries the seq it
         # was enqueued under and must not resurrect a segment removed while
         # it waited (the prefetch-vs-removeSegment race)
@@ -184,6 +290,21 @@ class ResidencyManager:
         self.spills = 0  # guarded-by: _lock
         self.prefetched = 0  # guarded-by: _lock
         self.borrows = 0  # guarded-by: _lock
+        self.demotions = 0  # guarded-by: _lock
+        self.promotions = 0  # guarded-by: _lock
+        self.host_drops = 0  # guarded-by: _lock
+        self.sliced_queries = 0  # guarded-by: _lock
+        self.demoted_bytes = 0  # guarded-by: _lock
+        self.promoted_bytes = 0  # guarded-by: _lock
+        self.host_dropped_bytes = 0  # guarded-by: _lock
+        # tier feature flags (config only — no jax/psutil touch at init)
+        from pinot_tpu.spi.config import PinotConfiguration
+
+        cfg = config if config is not None else PinotConfiguration()
+        self._host_on = cfg.get_bool(CommonConstants.HOSTRAM_ENABLED_KEY,
+                                     True)
+        self._slicing_on = cfg.get_bool(
+            CommonConstants.HBM_SLICING_ENABLED_KEY, True)
         # cross-query column dedup: ``column_borrower(segment, name)``
         # (set by the sharded executor) lets a StagedSegment serve a column
         # from a resident batch's device copy instead of staging its own
@@ -213,7 +334,43 @@ class ResidencyManager:
                             else None)
             self._budget_resolved = True
             doomed = self._enforce_locked()
-        self._release_all(doomed)
+        self._demote_or_release_all(doomed)
+
+    @property
+    def host_budget_bytes(self) -> Optional[int]:
+        """Host-RAM tier budget (lazy psutil probe); None = uncapped."""
+        if not self._host_budget_resolved:
+            with self._lock:
+                if not self._host_budget_resolved:
+                    self._host_budget = resolve_host_budget_bytes(
+                        self._host_budget_arg, self._config)
+                    self._host_budget_resolved = True
+        return self._host_budget
+
+    def set_host_budget_bytes(self, budget_bytes: Optional[int]) -> None:
+        with self._lock:
+            self._host_budget = (int(budget_bytes)
+                                 if budget_bytes and int(budget_bytes) > 0
+                                 else None)
+            self._host_budget_resolved = True
+            dropped = self._enforce_host_locked()
+        for img in dropped:
+            img.release()
+
+    def set_host_tier_enabled(self, enabled: bool) -> None:
+        """Runtime kill switch (bench spill baseline / ops). Disabling
+        drops nothing retroactively — existing host entries keep serving;
+        new evictions drop instead of demoting."""
+        with self._lock:
+            self._host_on = bool(enabled)
+
+    def host_tier_enabled(self) -> bool:
+        with self._lock:
+            return self._host_on
+
+    def slicing_enabled(self) -> bool:
+        with self._lock:
+            return self._slicing_on
 
     # -- staging (the StagingCache surface, now lock-correct) ---------------
     def stage(self, segment, lease: Optional[QueryLease] = None
@@ -223,32 +380,38 @@ class ResidencyManager:
         segment share ONE StagedSegment (the old get-then-set built
         duplicate device arrays and leaked one set until GC). A reloaded
         segment (same name, new object) invalidates the stale resident —
-        identity check, same guard as before."""
+        identity check, same guard as before. A miss with a matching
+        host-tier image PROMOTES: the new resident restores columns with a
+        plain H2D instead of rebuilding them."""
         with self._lock:
             resident, doomed = self._stage_locked(segment, lease)
-        self._release_all(doomed)
+        self._demote_or_release_all(doomed, lease)
         return resident
 
     def _stage_locked(self, segment, lease: Optional[QueryLease]):
         """Get-or-create under ``_lock`` (caller holds it). Returns
-        ``(resident, doomed)``; the caller releases ``doomed`` after
-        dropping the lock."""
+        ``(resident, doomed)``; the caller demotes/releases ``doomed``
+        after dropping the lock."""
         name = segment.segment_name
         doomed: List[Any] = []
         e = self._entries.get(name)
         if e is not None and isinstance(e.resident, StagedSegment) \
                 and e.resident.segment is segment:
             self._entries.move_to_end(name)
+            e.touch = self._next_touch_locked()
             self.hits += 1
             if lease is not None:
                 lease.hits += 1
             self._mark("STAGING_HITS")
         else:
-            if e is not None:  # identity change: drop stale arrays
+            if e is not None:  # identity change: drop stale arrays outright
                 del self._entries[name]
-                doomed.append(e.resident)
+                doomed.append((None, e.resident))
+            image = self._take_host_locked(name, segment, lease)
             e = _Entry(StagedSegment(segment,
-                                     borrower=self.column_borrower))
+                                     borrower=self.column_borrower,
+                                     host_image=image))
+            e.touch = self._next_touch_locked()
             self._entries[name] = e
             self.misses += 1
             if lease is not None:
@@ -268,6 +431,7 @@ class ResidencyManager:
             e = self._entries.get(name)
             if e is not None and (same is None or same(e.resident)):
                 self._entries.move_to_end(name)
+                e.touch = self._next_touch_locked()
                 self.hits += 1
                 if lease is not None:
                     lease.hits += 1
@@ -275,8 +439,9 @@ class ResidencyManager:
             else:
                 if e is not None:
                     del self._entries[name]
-                    doomed.append(e.resident)
+                    doomed.append((None, e.resident))
                 e = _Entry(make_resident())
+                e.touch = self._next_touch_locked()
                 self._entries[name] = e
                 self.misses += 1
                 if lease is not None:
@@ -288,7 +453,7 @@ class ResidencyManager:
             # stagedBytes drifts until the next unrelated refresh
             doomed += self._enforce_locked(lease)
             resident = e.resident
-        self._release_all(doomed)
+        self._demote_or_release_all(doomed, lease)
         return resident
 
     def _pin_locked(self, name: str, e: _Entry,
@@ -297,16 +462,21 @@ class ResidencyManager:
             e.pins += 1
             lease._pinned.add(name)
 
+    def _next_touch_locked(self) -> int:
+        self._touch_seq += 1
+        return self._touch_seq
+
     def account(self, name: str,
                 lease: Optional[QueryLease] = None) -> None:
         """Re-measure one resident (its arrays were staged after admission)
         and enforce the budget."""
         with self._lock:
             doomed = self._enforce_locked(lease)
-        self._release_all(doomed)
+        self._demote_or_release_all(doomed, lease)
 
     def evict(self, name: str) -> None:
-        """Explicit eviction (segment unassigned / reloaded). In-flight
+        """Explicit eviction (segment unassigned / reloaded) — BOTH tiers,
+        including host-tier batch images containing the segment. In-flight
         queries keep their arrays alive through python refs; XLA frees the
         HBM when the last ref drops. Bumps the retire generation so queued
         prefetches of the removed segment become no-ops."""
@@ -317,6 +487,7 @@ class ResidencyManager:
                 self.evictions += 1
                 self._mark("STAGING_EVICTIONS")
                 self._refresh_locked()
+            dropped = self._drop_host_locked(name)
         if e is not None:
             # outside the lock: a resident's release may take its own lock
             # (StagedSegment serializing against in-flight column builds) or
@@ -324,6 +495,43 @@ class ResidencyManager:
             # caches) — lock order is always manager -> resident, held
             # never-both on the release path
             e.resident.release()
+        for img in dropped:
+            img.release()
+
+    def _drop_host_locked(self, segment_name: str) -> List[Any]:
+        """Remove host-tier entries backed by ``segment_name``: the exact
+        per-segment image plus every batch image whose ``segment_names``
+        contains the segment — a removed/reloaded segment must never be
+        served from a stale host copy. Returns the images; the caller
+        releases them after dropping ``_lock``."""
+        dropped: List[Any] = []
+        for name in list(self._host_entries):
+            he = self._host_entries[name]
+            names = getattr(he.resident, "segment_names", (name,))
+            if name == segment_name or segment_name in names:
+                del self._host_entries[name]
+                self._release_host_locked(he)
+                self.host_drops += 1
+                self.host_dropped_bytes += he.nbytes
+                self._mark("STAGING_HOST_DROPS")
+                dropped.append(he.resident)
+        return dropped
+
+    def demote(self, name: str) -> bool:
+        """Explicit demotion of one UNPINNED resident to the host tier
+        (ops hook: ``POST /debug/memory/demote/<name>``). Returns False
+        when the resident is absent or pinned by an in-flight query."""
+        with self._lock:
+            e = self._entries.get(name)
+            if e is None or e.pins > 0:
+                return False
+            del self._entries[name]
+            doomed = [(name, e.resident)]
+            self.evictions += 1
+            self._mark("STAGING_EVICTIONS")
+            self._refresh_locked()
+        self._demote_or_release_all(doomed)
+        return True
 
     def note_borrow(self, batch_name: str) -> None:
         """A per-segment staging built a column FROM a resident batch's
@@ -332,14 +540,18 @@ class ResidencyManager:
         the share."""
         with self._lock:
             self.borrows += 1
-            if batch_name in self._entries:
+            e = self._entries.get(batch_name)
+            if e is not None:
                 self._entries.move_to_end(batch_name)
+                e.touch = self._next_touch_locked()
             self._mark("STAGING_BORROWS")
 
     def discard(self, name: str) -> None:
-        """Drop an entry WITHOUT calling release (the owner already freed
-        the arrays). Idempotent — also the re-entry point for batch
-        residents whose release callback clears executor caches."""
+        """Drop a DEVICE-tier entry WITHOUT calling release (the owner
+        already freed the arrays). Idempotent — also the re-entry point
+        for batch residents whose release callback clears executor caches.
+        Host-tier images survive: they are owned copies, still valid for
+        promotion."""
         with self._lock:
             self._entries.pop(name, None)  # lint: ignore[conservation] — owner already released the arrays (discard contract)
             self._refresh_locked()
@@ -347,9 +559,12 @@ class ResidencyManager:
     def clear(self) -> None:
         with self._lock:
             doomed = [e.resident for e in self._entries.values()]
+            host_doomed = [e.resident for e in self._host_entries.values()]
             self._entries.clear()
+            self._host_entries.clear()
             self._staged_bytes = 0
-        self._release_all(doomed)
+            self._host_bytes = 0
+        self._release_all(doomed + host_doomed)
 
     def _release_all(self, doomed: List[Any]) -> None:
         """Release evicted residents AFTER the manager lock is dropped:
@@ -363,45 +578,265 @@ class ResidencyManager:
             except Exception:
                 log.exception("resident release failed")
 
+    def _demote_or_release_all(self, doomed: List[Tuple[Optional[str], Any]],
+                               lease: Optional[QueryLease] = None) -> None:
+        """Budget-evicted residents demote to the host-RAM tier instead of
+        dropping; residents that cannot demote (no ``demote()`` hook,
+        identity-invalidated — name None, tier disabled, or image larger
+        than the whole host budget) release as before. Runs AFTER the
+        manager lock is dropped: demotion D2H-syncs device buffers, which
+        must never happen under ``_lock``."""
+        for name, r in doomed:
+            image = None
+            if name is not None and self.host_tier_enabled():
+                demote_fn = getattr(r, "demote", None)
+                if demote_fn is not None:
+                    hb = self.host_budget_bytes
+                    size = 0
+                    if hb is not None:
+                        try:
+                            size = int(r.nbytes())
+                        except Exception:
+                            size = 0
+                    if hb is None or size <= hb:
+                        try:
+                            image = demote_fn()
+                        except Exception:
+                            log.exception("demotion of %r failed; "
+                                          "dropping resident", name)
+                            image = None
+            if image is None:
+                try:
+                    r.release()
+                except Exception:
+                    log.exception("resident release failed")
+                continue
+            with self._lock:
+                self._admit_host_locked(name, image)
+                if lease is not None:
+                    lease.demotions += 1
+
+    # -- host tier -----------------------------------------------------------
+    def _admit_host_locked(self, name: str, image) -> None:
+        """Insert a demoted image into the host tier: replace any stale
+        image under the same name, account the bytes, and LRU-drop over
+        the host budget."""
+        prev = self._host_entries.pop(name, None)
+        if prev is not None:
+            self._release_host_locked(prev)
+            prev.resident.release()
+        e = _Entry(image)
+        try:
+            e.nbytes = int(image.nbytes())
+        except Exception:
+            e.nbytes = 0
+        self._host_entries[name] = e
+        self._host_bytes += e.nbytes
+        if self._host_bytes > self._host_peak_bytes:
+            self._host_peak_bytes = self._host_bytes
+        self.demotions += 1
+        self.demoted_bytes += e.nbytes
+        self._mark("STAGING_DEMOTIONS")
+        dropped = self._enforce_host_locked()
+        for img in dropped:
+            # host images release lock-free (plain numpy container clears;
+            # no resident lock, no manager re-entry)
+            img.release()
+
+    def _release_host_locked(self, e: _Entry) -> None:
+        """Host-tier byte-accounting release: every entry leaving the host
+        dict subtracts its bytes exactly once (the host half of the
+        conservation contract the lint gate enforces)."""
+        self._host_bytes -= e.nbytes
+        if self._host_bytes < 0:
+            self._host_bytes = 0
+
+    def _enforce_host_locked(self) -> List[Any]:
+        """LRU-drop host-tier entries until the host budget fits. Returns
+        the dropped images (callers may release them under or after the
+        lock — host images are lock-free)."""
+        budget = self._host_budget if self._host_budget_resolved \
+            else self.host_budget_bytes
+        dropped: List[Any] = []
+        if budget is None:
+            return dropped
+        while self._host_bytes > budget and self._host_entries:
+            _name, e = self._host_entries.popitem(last=False)
+            self._release_host_locked(e)
+            self.host_drops += 1
+            self.host_dropped_bytes += e.nbytes
+            self._mark("STAGING_HOST_DROPS")
+            dropped.append(e.resident)
+        return dropped
+
+    def _take_host_locked(self, name: str, target,
+                          lease: Optional[QueryLease] = None):
+        """Pop + account the host-tier entry for ``name`` when its image
+        matches ``target`` identity (a segment, or the sharded batch's
+        segment list). Returns the image — the caller adopts its arrays
+        (promotion) — or None. A stale image is dropped on the spot."""
+        he = self._host_entries.pop(name, None)
+        if he is None:
+            return None
+        self._release_host_locked(he)
+        image = he.resident
+        ok = False
+        try:
+            ok = image.matches(target)
+        except Exception:
+            ok = False
+        if not ok:
+            self.host_drops += 1
+            self.host_dropped_bytes += he.nbytes
+            self._mark("STAGING_HOST_DROPS")
+            image.release()
+            return None
+        self.promotions += 1
+        self.promoted_bytes += he.nbytes
+        if lease is not None:
+            lease.promotions += 1
+        self._mark("STAGING_PROMOTIONS")
+        return image
+
+    def promote_host(self, name: str, target=None,
+                     lease: Optional[QueryLease] = None):
+        """Host-tier lookup for non-segment residents (sharded batches):
+        pops + accounts the entry when its identity matches ``target``;
+        the caller adopts the image's host arrays (promotion is then one
+        ``device_put`` per column)."""
+        with self._lock:
+            return self._take_host_locked(name, target, lease)
+
     # -- query protocol ------------------------------------------------------
-    def begin_query(self, segments: List[Any],
-                    columns: Iterable[str]) -> QueryLease:
+    def begin_query(self, segments: List[Any], columns: Iterable[str],
+                    sliceable: bool = False) -> QueryLease:
         """Admission: fit the query's estimated working set against what
-        COULD be freed (budget minus other queries' pinned bytes). A query
-        that cannot fit is spilled to the host engine — graceful
-        degradation, never a device OOM."""
+        COULD be freed (budget minus other queries' pinned bytes).
+
+        Three outcomes instead of the old fit-or-fail two:
+        - fits -> normal device lease;
+        - over budget but every single segment fits (and the caller can
+          slice — aggregations/group-bys) -> SLICED device lease: the
+          executors stream the working set through the budget in slices,
+          demoting between slices;
+        - a single segment alone cannot fit -> host-engine spill
+          (graceful degradation, never a device OOM).
+
+        Estimates are scaled by the measured-vs-estimated drift EWMA."""
         budget = self.budget_bytes
         if budget is None:
             return QueryLease(device_allowed=True)
         cols = list(columns)
         with self._lock:
             self._refresh_locked()
+            scale = min(max(self._est_scale, _EST_SCALE_MIN),
+                        _EST_SCALE_MAX)
             names = {getattr(s, "segment_name", None) for s in segments}
             reusable = 0
             missing_est = 0
+            max_single = 0
+            ests: Dict[str, int] = {}
             for s in segments:
                 e = self._entries.get(s.segment_name)
                 if e is not None and isinstance(e.resident, StagedSegment) \
                         and e.resident.segment is s:
                     reusable += e.nbytes
+                    max_single = max(max_single, e.nbytes)
                 else:
-                    missing_est += estimate_segment_bytes(s, cols)
+                    raw = estimate_segment_bytes(s, cols)
+                    ests[s.segment_name] = raw
+                    est = int(raw * scale)
+                    missing_est += est
+                    max_single = max(max_single, est)
             other_pinned = sum(e.nbytes for n, e in self._entries.items()
                                if e.pins > 0 and n not in names)
-            if missing_est + reusable + other_pinned > budget:
-                self.spills += 1
-                self._mark("STAGING_SPILLS")
+            if missing_est + reusable + other_pinned <= budget:
+                lease = QueryLease(device_allowed=True)
+                lease._est = ests
+                return lease
+            if sliceable and self._slicing_on \
+                    and max_single + other_pinned <= budget:
+                self.sliced_queries += 1
+                self._mark("STAGING_SLICED")
                 log.info(
-                    "HBM admission: working set ~%d B (+%d B reusable) over "
-                    "budget %d B (%d B pinned elsewhere); spilling query to "
-                    "host engine", missing_est, reusable, budget,
-                    other_pinned)
-                return QueryLease(device_allowed=False)
-        return QueryLease(device_allowed=True)
+                    "HBM admission: working set ~%d B over budget %d B "
+                    "(%d B pinned elsewhere) — serving in budget-sized "
+                    "slices on the device path", missing_est + reusable,
+                    budget, other_pinned)
+                lease = QueryLease(device_allowed=True)
+                lease.sliced = True
+                lease._est = ests
+                return lease
+            self.spills += 1
+            self._mark("STAGING_SPILLS")
+            log.info(
+                "HBM admission: working set ~%d B (+%d B reusable) over "
+                "budget %d B (%d B pinned elsewhere) and not sliceable; "
+                "spilling query to host engine", missing_est, reusable,
+                budget, other_pinned)
+            return QueryLease(device_allowed=False)
 
-    def end_query(self, lease: Optional[QueryLease], stats=None) -> None:
-        """Unpin everything the lease held, re-enforce the budget, and
-        surface the per-query staging counters on ``stats.staging``."""
+    def plan_slices(self, segments: List[Any], columns: Iterable[str],
+                    lease: Optional[QueryLease] = None,
+                    pad_to: int = 1) -> Optional[List[List[Any]]]:
+        """Partition ``segments`` into budget-sized slices for the sliced
+        sharded combine (stage k, launch, demote, repeat). ``pad_to`` is
+        the mesh's segment-axis width: a k-segment batch stacks arrays for
+        ceil(k / pad_to) * pad_to segments, so the pad overhead is part of
+        each slice's cost. Estimates ride the drift-corrected scale, so
+        repeat queries pick k from (approximately) real bytes. Returns
+        None when even one padded segment exceeds the free budget — the
+        caller degrades to the per-segment sliced path, whose footprint
+        truly scales one segment at a time."""
+        budget = self.budget_bytes
+        if budget is None:
+            return [list(segments)]
+        if not segments:
+            return [list(segments)]
+        cols = list(columns)
+        known = lease._est if lease is not None else {}
+        with self._lock:
+            self._refresh_locked()
+            scale = min(max(self._est_scale, _EST_SCALE_MIN),
+                        _EST_SCALE_MAX)
+            names = {getattr(s, "segment_name", None) for s in segments}
+            other_pinned = sum(e.nbytes for n, e in self._entries.items()
+                               if e.pins > 0 and n not in names)
+            ests = []
+            for s in segments:
+                raw = known.get(s.segment_name)
+                if raw is None:
+                    raw = estimate_segment_bytes(s, cols)
+                ests.append(max(1, int(raw * scale)))
+        avail = (budget - other_pinned) * _SLICE_FILL
+        mean = sum(ests) / len(ests)
+        if mean * pad_to > avail:
+            # the mesh pad alone blows the budget: no multi-segment batch
+            # can fit, so sharded slicing is pointless here
+            return None
+        slices: List[List[Any]] = []
+        cur: List[Any] = []
+        cur_cost = 0.0
+        for s, est in zip(segments, ests):
+            k = len(cur) + 1
+            padded = -(-k // pad_to) * pad_to
+            cost = cur_cost + est + (padded - k) * mean
+            if cur and cost > avail:
+                slices.append(cur)
+                cur = [s]
+                cur_cost = est
+            else:
+                cur.append(s)
+                cur_cost += est
+        if cur:
+            slices.append(cur)
+        return slices
+
+    def release_slice(self, lease: Optional[QueryLease]) -> None:
+        """Slice boundary for a sliced lease: unpin everything the slice
+        staged and enforce the budget NOW — the evicted residents demote
+        to the host tier, so the next pass over the same data promotes
+        instead of rebuilding."""
         if lease is None:
             return
         with self._lock:
@@ -410,11 +845,60 @@ class ResidencyManager:
                 if e is not None and e.pins > 0:
                     e.pins -= 1
             lease._pinned.clear()
+            lease.slices += 1
+            doomed = self._enforce_locked(lease)
+        self._demote_or_release_all(doomed, lease)
+
+    def end_query(self, lease: Optional[QueryLease], stats=None) -> None:
+        """Unpin everything the lease held, feed the measured-vs-estimated
+        drift observation back into admission, re-enforce the budget, and
+        surface the per-query staging counters on ``stats.staging``."""
+        if lease is None:
+            return
+        with self._lock:
+            self._refresh_locked()
+            for name in lease._pinned:
+                e = self._entries.get(name)
+                if e is not None and e.pins > 0:
+                    e.pins -= 1
+                est = lease._est.get(name, 0)
+                if est > 0 and e is not None \
+                        and isinstance(e.resident, StagedSegment):
+                    self._observe_estimate_locked(est, e.nbytes)
+            lease._pinned.clear()
             doomed = self._enforce_locked(lease)
             staged = self._staged_bytes
-        self._release_all(doomed)
+        self._demote_or_release_all(doomed, lease)
         if stats is not None:
-            stats.staging = lease.staging_dict(staged)
+            # host bytes AFTER the demotions this close triggered — the
+            # per-query tier story must include its own evictees
+            with self._lock:
+                host = self._host_bytes
+            stats.staging = lease.staging_dict(staged, host)
+
+    # -- admission-estimate drift --------------------------------------------
+    def _observe_estimate_locked(self, est: int, measured: int) -> None:
+        if est <= 0 or measured <= 0:
+            return
+        ratio = measured / est
+        ratio = min(max(ratio, _EST_SCALE_MIN), _EST_SCALE_MAX)
+        self._est_scale = ((1.0 - _EST_ALPHA) * self._est_scale
+                           + _EST_ALPHA * ratio)
+        self.est_observations += 1
+
+    def observe_estimate(self, est: int, measured: int) -> None:
+        """Feed one measured-vs-estimated observation into the admission
+        correction EWMA (the post-stage validation path; also the unit
+        test hook for deliberately mis-estimated segments)."""
+        with self._lock:
+            self._observe_estimate_locked(est, measured)
+
+    def estimate_scale(self) -> float:
+        """Current admission correction factor (measured/estimated EWMA,
+        clamped to [0.25, 4])."""
+        with self._lock:
+            return min(max(self._est_scale, _EST_SCALE_MIN),
+                       _EST_SCALE_MAX)
 
     # -- eviction engine -----------------------------------------------------
     def _refresh_locked(self) -> None:
@@ -429,19 +913,55 @@ class ResidencyManager:
         if total > self._peak_bytes:
             self._peak_bytes = total
 
+    def _rebuild_cost_locked(self, name: str, e: _Entry) -> float:
+        """How expensive is getting this resident back after eviction —
+        the cost axis of the eviction ranking. Host-tier-backed residents
+        restage with one H2D; batch residents re-adopt their host stacked
+        arrays; a segment riding inside a resident batch can borrow its
+        columns; a cold StagedSegment pays the full build, star-trees the
+        tree staging on top."""
+        if name in self._host_entries:
+            return COST_HOST_RESTAGE
+        r = e.resident
+        if not isinstance(r, StagedSegment):
+            return COST_BATCH_RESTAGE
+        img = getattr(r, "_host_image", None)
+        if img is not None and not img.empty():
+            # promoted resident with unconsumed host copies: a demotion
+            # recaptures them for free, so restage stays cheap
+            return COST_HOST_RESTAGE
+        if r._startree:
+            return COST_STARTREE_BUILD
+        for other in self._entries:
+            if other != name and other.startswith("batch(") \
+                    and name in other[6:-1].split(","):
+                return COST_BORROWED_BUILD
+        return COST_COLUMN_BUILD
+
     def _enforce_locked(self, lease: Optional[QueryLease] = None
-                        ) -> List[Any]:
-        """LRU-evict unpinned residents until the budget fits. Returns the
-        evicted residents — the CALLER releases them after dropping
-        ``_lock`` (see ``_release_all``); their bytes are already out of
-        the accounting here."""
+                        ) -> List[Tuple[Optional[str], Any]]:
+        """Evict unpinned residents until the budget fits, ranked by
+        ``bytes * staleness / rebuild_cost`` (descending): big, cold,
+        cheap-to-restage residents go first, so the budget preferentially
+        keeps what is slow to get back. With equal bytes and equal costs
+        this is exact LRU. Returns ``(name, resident)`` pairs — the CALLER
+        demotes/releases them after dropping ``_lock`` (see
+        ``_demote_or_release_all``); their bytes are already out of the
+        accounting here."""
         self._refresh_locked()
         budget = self.budget_bytes
         if budget is None:
             return []
-        doomed: List[Any] = []
+        doomed: List[Tuple[Optional[str], Any]] = []
         total = self._staged_bytes
-        for name in list(self._entries):
+        if total <= budget:
+            return doomed
+        seq = self._touch_seq + 1
+        scores: Dict[str, float] = {}
+        for name, e in self._entries.items():
+            scores[name] = (e.nbytes * (seq - e.touch)
+                            / self._rebuild_cost_locked(name, e))
+        for name in sorted(scores, key=scores.get, reverse=True):
             if total <= budget:
                 break
             e = self._entries[name]
@@ -456,7 +976,7 @@ class ResidencyManager:
                 continue
             del self._entries[name]
             total -= e.nbytes
-            doomed.append(e.resident)
+            doomed.append((name, e.resident))
             self.evictions += 1
             if lease is not None:
                 lease.evictions += 1
@@ -467,7 +987,7 @@ class ResidencyManager:
     def enforce(self) -> None:
         with self._lock:
             doomed = self._enforce_locked()
-        self._release_all(doomed)
+        self._demote_or_release_all(doomed)
 
     # -- prefetch ------------------------------------------------------------
     def prefetch(self, segment, columns: Optional[List[str]] = None) -> None:
@@ -516,7 +1036,7 @@ class ResidencyManager:
             if self._retired.get(name, 0) != gen:
                 return
             staged, doomed = self._stage_locked(segment, None)
-        self._release_all(doomed)
+        self._demote_or_release_all(doomed)
         for cname in columns:
             if budget is not None:
                 with self._lock:
@@ -572,8 +1092,8 @@ class ResidencyManager:
 
     # -- observability -------------------------------------------------------
     def bind_metrics(self, registry) -> None:
-        """Attach a MetricsRegistry: staged/budget byte gauges + event
-        meters (spi/metrics.py ServerMeter.STAGING_*)."""
+        """Attach a MetricsRegistry: staged/budget byte gauges for both
+        tiers + event meters (spi/metrics.py ServerMeter.STAGING_*)."""
         self._metrics = registry
         # gauge lambdas run on scrape threads: only locked accessors here
         registry.gauge("staging_staged_bytes",
@@ -584,6 +1104,14 @@ class ResidencyManager:
                        lambda: float(self.budget_bytes or 0))
         registry.gauge("staging_resident_segments",
                        lambda: float(self.resident_count()))
+        registry.gauge("staging_host_bytes",
+                       lambda: float(self.host_bytes()))
+        registry.gauge("staging_host_peak_bytes",
+                       lambda: float(self.host_peak_bytes))
+        registry.gauge("staging_host_budget_bytes",
+                       lambda: float(self.host_budget_bytes or 0))
+        registry.gauge("staging_host_entries",
+                       lambda: float(self.host_entry_count()))
 
     def _mark(self, name: Optional[str]) -> None:
         self._mark_n(name, 1)
@@ -607,15 +1135,40 @@ class ResidencyManager:
         with self._lock:
             return self._peak_bytes
 
+    def host_bytes(self) -> int:
+        with self._lock:
+            return self._host_bytes
+
+    @property
+    def host_peak_bytes(self) -> int:
+        with self._lock:
+            return self._host_peak_bytes
+
     def resident_count(self) -> int:
         with self._lock:
             return len(self._entries)
+
+    def resident_nbytes(self, name: str) -> int:
+        """Measured device bytes of one resident (0 when absent) — the
+        post-stage truth the admission estimates are validated against."""
+        with self._lock:
+            self._refresh_locked()
+            e = self._entries.get(name)
+            return 0 if e is None else e.nbytes
 
     def resident_names(self) -> List[str]:
         with self._lock:
             return list(self._entries)
 
-    def stats_snapshot(self) -> Dict[str, int]:
+    def host_entry_count(self) -> int:
+        with self._lock:
+            return len(self._host_entries)
+
+    def host_entry_names(self) -> List[str]:
+        with self._lock:
+            return list(self._host_entries)
+
+    def stats_snapshot(self) -> Dict[str, Any]:
         """Cumulative counters (bench per-suite deltas diff two of these)."""
         with self._lock:
             self._refresh_locked()
@@ -627,12 +1180,23 @@ class ResidencyManager:
                 "spills": self.spills,
                 "prefetched": self.prefetched,
                 "borrows": self.borrows,
+                "demotions": self.demotions,
+                "promotions": self.promotions,
+                "hostDrops": self.host_drops,
+                "slicedQueries": self.sliced_queries,
                 "stagedBytes": self._staged_bytes,
                 "peakBytes": self._peak_bytes,
+                "hostBytes": self._host_bytes,
+                "hostPeakBytes": self._host_peak_bytes,
+                "demotedBytes": self.demoted_bytes,
+                "promotedBytes": self.promoted_bytes,
+                "hostDroppedBytes": self.host_dropped_bytes,
+                "estimateScale": round(self._est_scale, 4),
+                "estimateObservations": self.est_observations,
             }
 
     def snapshot(self) -> Dict[str, Any]:
-        """Bytes-accurate residency state for ``/debug/memory``."""
+        """Bytes-accurate two-tier residency state for ``/debug/memory``."""
         with self._lock:
             self._refresh_locked()
             residents = {}
@@ -646,6 +1210,9 @@ class ResidencyManager:
                 else:
                     d["kind"] = type(r).__name__
                 residents[name] = d
+            host = {name: {"bytes": e.nbytes,
+                           "kind": type(e.resident).__name__}
+                    for name, e in self._host_entries.items()}
             return {
                 "budgetBytes": self.budget_bytes,
                 "stagedBytes": self._staged_bytes,
@@ -656,8 +1223,23 @@ class ResidencyManager:
                     "pinBlockedEvictions": self.pin_blocked,
                     "spills": self.spills, "prefetched": self.prefetched,
                     "borrows": self.borrows,
+                    "demotions": self.demotions,
+                    "promotions": self.promotions,
+                    "hostDrops": self.host_drops,
+                    "slicedQueries": self.sliced_queries,
                 },
                 "stagedSegments": residents,
+                "hostTier": {
+                    "enabled": self._host_on,
+                    "budgetBytes": self.host_budget_bytes,
+                    "hostBytes": self._host_bytes,
+                    "peakBytes": self._host_peak_bytes,
+                    "demotedBytes": self.demoted_bytes,
+                    "promotedBytes": self.promoted_bytes,
+                    "droppedBytes": self.host_dropped_bytes,
+                    "entries": host,
+                },
+                "estimateScale": round(self._est_scale, 4),
             }
 
 

@@ -74,9 +74,11 @@ class QueryStats:
     # across rungs) — the bench gates SSB Q2.x/Q3.x on this
     group_by_rung: Optional[str] = None
     # HBM residency counters for this query (engine/residency.py):
-    # hits/misses/evictions/pinBlockedEvictions/spills sum across
-    # segments/shards/servers at merge; *Bytes keys take the max (each
-    # server reports its own staged total — summing would double-count)
+    # hits/misses/evictions/pinBlockedEvictions/spills — and the tiered
+    # keys promotions/demotions/slices (budget-slice boundaries the query
+    # crossed) — sum across segments/shards/servers at merge; *Bytes keys
+    # (stagedBytes, hostBytes) take the max (each server reports its own
+    # staged total — summing would double-count)
     staging: Dict[str, int] = field(default_factory=dict)
     # launch-coalescing counters for this query (parallel/launcher.py):
     # launches/coalesced/launchesSaved sum across shards/servers at merge;

@@ -95,6 +95,73 @@ class PackedColumn:
         self.vals_per_word = 32 // bits
 
 
+class SegmentHostImage:
+    """Host-RAM tier image of one demoted :class:`StagedSegment`: numpy
+    copies of every device array, byte-accounted against the residency
+    manager's host budget (``pinot.server.query.hostram.budget.bytes``).
+    Promotion hands the image back to a fresh StagedSegment, which
+    restores each array with a plain H2D ``jnp.asarray`` — no decode, no
+    dictionary build, no bit-packing (the cheap half of the ISCA'23
+    D2H+H2D vs rebuild tradeoff, ~10x cheaper than a cold column build).
+    Containers mirror the StagedSegment caches: ``columns`` holds
+    :class:`StagedColumn` objects whose fields are numpy arrays."""
+
+    __slots__ = ("columns", "packed", "values", "startree",
+                 "segment_names", "_segment_ref", "_nbytes")
+
+    def __init__(self, segment):
+        import weakref
+
+        # weakref: a host image must not keep an unloaded segment (and its
+        # mmapped buffers) alive; identity is re-validated at promotion
+        self._segment_ref = weakref.ref(segment)
+        self.segment_names = (segment.segment_name,)
+        self.columns: Dict[str, StagedColumn] = {}
+        self.packed: Dict[str, tuple] = {}
+        self.values: Dict[str, np.ndarray] = {}
+        self.startree: Dict[int, Dict[str, np.ndarray]] = {}
+        self._nbytes = 0
+
+    def seal(self) -> "SegmentHostImage":
+        """Freeze the byte count after the demoting thread filled the
+        containers (the residency manager accounts this number once, at
+        host-tier admission)."""
+        total = 0
+        for col in self.columns.values():
+            for arr in col.tree().values():
+                total += int(getattr(arr, "nbytes", 0))
+        for words, _bits in self.packed.values():
+            total += int(getattr(words, "nbytes", 0))
+        for v in self.values.values():
+            total += int(getattr(v, "nbytes", 0))
+        for tree in self.startree.values():
+            for arr in tree.values():
+                total += int(getattr(arr, "nbytes", 0))
+        self._nbytes = total
+        return self
+
+    def empty(self) -> bool:
+        return not (self.columns or self.packed or self.values
+                    or self.startree)
+
+    def matches(self, segment) -> bool:
+        """Identity check at promotion: a reloaded segment (same name, new
+        object) must never be served stale host copies."""
+        return segment is not None and self._segment_ref() is segment
+
+    def nbytes(self) -> int:
+        return self._nbytes
+
+    def release(self) -> None:
+        """Drop the host arrays eagerly (big numpy buffers should not wait
+        for GC of stray references)."""
+        self.columns.clear()
+        self.packed.clear()
+        self.values.clear()
+        self.startree.clear()
+        self._nbytes = 0
+
+
 class StagedSegment:
     """Device image of one segment (subset of columns, staged on demand).
 
@@ -109,10 +176,17 @@ class StagedSegment:
     ``release()`` — staged bytes invisible to the HBM budget, or device
     arrays that outlive eviction, are exactly the drift the gate blocks."""
 
-    def __init__(self, segment: ImmutableSegment, borrower=None):
+    def __init__(self, segment: ImmutableSegment, borrower=None,
+                 host_image: Optional[SegmentHostImage] = None):
         self.segment = segment
         self.num_docs = segment.num_docs
         self.capacity = segment.padded_capacity
+        # host-tier promotion source (residency demote/promote protocol):
+        # per-array numpy copies consumed on first access — a restored
+        # array is one H2D jnp.asarray, skipping decode/dictionary/pack
+        # work entirely. Host RAM, so never counted in nbytes(); arrays
+        # leave the image as they promote, and release() drops leftovers.
+        self._host_image = host_image
         # writes-only guard: double-checked locking — reads are deliberate
         # lock-free dict gets (atomic under the GIL), builds serialize
         self._columns: Dict[str, StagedColumn] = {}  # guarded-by-writes: _lock
@@ -139,9 +213,29 @@ class StagedSegment:
                     if self._borrower is not None:
                         col = self._borrower(self.segment, name)
                     if col is None:
+                        col = self._promote_column(name)
+                    if col is None:
                         col = self._stage(name)
                     self._columns[name] = col
         return col
+
+    def _promote_column(self, name: str) -> Optional[StagedColumn]:
+        """Host-tier restore: plain H2D of the demoted numpy arrays (no
+        decode/dictionary/pack work). Consumes the image's copy — promoted
+        bytes are device-owned from here on."""
+        img = self._host_image
+        if img is None:
+            return None
+        hc = img.columns.pop(name, None)
+        if hc is None:
+            return None
+        sc = StagedColumn(data_type=hc.data_type,
+                          has_dictionary=hc.has_dictionary)
+        for k in ("fwd", "dictvals", "mv", "mvcount", "null"):
+            v = getattr(hc, k)
+            if v is not None:
+                setattr(sc, k, jnp.asarray(v))
+        return sc
 
     def _stage(self, name: str) -> StagedColumn:
         ds = self.segment.data_source(name)
@@ -183,11 +277,23 @@ class StagedSegment:
             with self._lock:
                 pc = self._packed.get(name)
                 if pc is None:
-                    pc = self._pack(name)
+                    pc = self._promote_packed(name)
+                    if pc is None:
+                        pc = self._pack(name)
                     if pc is None:
                         return None
                     self._packed[name] = pc
         return pc
+
+    def _promote_packed(self, name: str) -> Optional["PackedColumn"]:
+        img = self._host_image
+        if img is None:
+            return None
+        hp = img.packed.pop(name, None)
+        if hp is None:
+            return None
+        words, bits = hp
+        return PackedColumn(jnp.asarray(words), bits)
 
     def pallas_capacity(self) -> int:
         """Doc capacity padded up to a whole number of Pallas tiles (the
@@ -219,6 +325,17 @@ class StagedSegment:
         in HBM (the metric-column analogue of raw chunk indexes)."""
         v = self._values.get(name)
         if v is None:
+            img = self._host_image
+            if img is not None:
+                with self._lock:
+                    v = self._values.get(name)
+                    if v is None:
+                        hv = img.values.pop(name, None)
+                        if hv is not None:
+                            v = jnp.asarray(hv)
+                            self._values[name] = v
+                if v is not None:
+                    return v
             ds = self.segment.data_source(name)
             cm = ds.metadata
             if not (cm.single_value and cm.data_type.is_numeric):
@@ -253,9 +370,20 @@ class StagedSegment:
             with self._lock:
                 t = self._startree.get(key)
                 if t is None:
-                    t = self._stage_startree(key)
+                    t = self._promote_startree(key)
+                    if t is None:
+                        t = self._stage_startree(key)
                     self._startree[key] = t
         return t
+
+    def _promote_startree(self, key: int):
+        img = self._host_image
+        if img is None:
+            return None
+        ht = img.startree.pop(key, None)
+        if ht is None:
+            return None
+        return {k: jnp.asarray(v) for k, v in ht.items()}
 
     def _stage_startree(self, tree_index: int) -> Dict[str, jnp.ndarray]:
         from pinot_tpu.engine.plan import (
@@ -322,6 +450,50 @@ class StagedSegment:
             total += int(getattr(vc[1], "nbytes", 0))
         return total
 
+    def demote(self) -> Optional[SegmentHostImage]:
+        """D2H snapshot for the residency host-RAM tier, then release the
+        device arrays. Returns the host image (or None when nothing was
+        staged — nothing worth keeping). The device syncs run OUTSIDE the
+        segment lock (the snapshot under the lock is just dict copies):
+        a column build landing after the snapshot is simply not captured
+        and rebuilds cold on the next stage. Unconsumed leftovers of this
+        resident's OWN promotion image are still-valid host copies and
+        carry over, so demote(promote(demote(x))) never decays."""
+        with self._lock:
+            cols = dict(self._columns)
+            packed = dict(self._packed)
+            values = dict(self._values)
+            trees = dict(self._startree)
+            src = self._host_image
+        img = SegmentHostImage(self.segment)
+        for name, col in cols.items():
+            hc = StagedColumn(data_type=col.data_type,
+                              has_dictionary=col.has_dictionary)
+            for k in ("fwd", "dictvals", "mv", "mvcount", "null"):
+                v = getattr(col, k)
+                if v is not None:
+                    setattr(hc, k, np.asarray(v))
+            img.columns[name] = hc
+        for name, pc in packed.items():
+            img.packed[name] = (np.asarray(pc.words), pc.bits)
+        for name, v in values.items():
+            img.values[name] = np.asarray(v)
+        for ti, tree in trees.items():
+            img.startree[ti] = {k: np.asarray(v) for k, v in tree.items()}
+        if src is not None:
+            for name, hc in src.columns.items():
+                img.columns.setdefault(name, hc)
+            for name, hp in src.packed.items():
+                img.packed.setdefault(name, hp)
+            for name, hv in src.values.items():
+                img.values.setdefault(name, hv)
+            for ti, ht in src.startree.items():
+                img.startree.setdefault(ti, ht)
+        self.release()
+        if img.empty():
+            return None
+        return img.seal()
+
     def release(self) -> None:
         """Drop device references (HBM freed when XLA GCs the buffers).
         Locked against in-flight column builds: a build completing after
@@ -333,6 +505,11 @@ class StagedSegment:
             self._values.clear()
             self._startree.clear()
             self._valid_cache = None
+            img = self._host_image
+            if img is not None:
+                # demote() re-homed anything worth keeping before calling
+                # release(); leftover numpy buffers free eagerly
+                img.release()
 
 
 # The HBM residency manager subsumed the old unbounded StagingCache

@@ -109,6 +109,13 @@ class ShardedQueryExecutor(ServerQueryExecutor):
         self._borrows = 0
 
     # -- combine overrides --------------------------------------------------
+    def _sliced_lease(self, stats):
+        """The sliced lease when admission granted budget-sliced execution
+        (working set over the HBM budget, largest segment fits), else
+        None."""
+        lease = self._lease_of(stats)
+        return lease if lease is not None and lease.sliced else None
+
     def _any_star_tree_fit(self, ctx, aggs, segments) -> bool:
         """Star-tree-eligible queries take the per-segment path: each
         segment's node slice rides the DEVICE star-tree rung
@@ -127,6 +134,9 @@ class ShardedQueryExecutor(ServerQueryExecutor):
         if self._any_star_tree_fit(ctx, aggs, segments):
             return ServerQueryExecutor._execute_aggregation(
                 self, ctx, aggs, segments, stats)
+        if self.use_device and self._sliced_lease(stats) is not None:
+            return self._execute_sliced(ctx, aggs, segments, stats,
+                                        grouped=False)
         if self.use_device and len(segments) > 1 \
                 and self._device_admitted(stats):
             try:
@@ -142,6 +152,9 @@ class ShardedQueryExecutor(ServerQueryExecutor):
         if self._any_star_tree_fit(ctx, aggs, segments):
             return ServerQueryExecutor._execute_group_by(
                 self, ctx, aggs, segments, stats)
+        if self.use_device and self._sliced_lease(stats) is not None:
+            return self._execute_sliced(ctx, aggs, segments, stats,
+                                        grouped=True)
         if self.use_device and len(segments) > 1 \
                 and self._device_admitted(stats):
             try:
@@ -151,8 +164,52 @@ class ShardedQueryExecutor(ServerQueryExecutor):
                 pass
         return super()._execute_group_by(ctx, aggs, segments, stats)
 
+    def _execute_sliced(self, ctx, aggs, segments, stats, grouped: bool):
+        """Budget-sliced sharded combine: a working set over the HBM
+        budget streams through it in budget-sized slices — stage k
+        segments, launch through the existing dispatcher (slices are just
+        more launches to coalesce), merge partials with the existing
+        AggResult/GroupByResult merges, unpin + demote-to-host, repeat —
+        so a table 10x over HBM still rides the device kernels instead of
+        spilling to the host engine. Slice sizing comes from
+        ``plan_slices`` (drift-corrected estimates, mesh seg-axis pad
+        included); when even one padded slice cannot fit, the per-segment
+        sliced path (base class, serial stage/execute/demote) serves."""
+        lease = self._lease_of(stats)
+        slices = self.residency.plan_slices(
+            segments, ctx.referenced_columns(), lease,
+            pad_to=self.mesh.shape[SEG_AXIS])
+        base = (ServerQueryExecutor._execute_group_by if grouped
+                else ServerQueryExecutor._execute_aggregation)
+        if slices is None:
+            return base(self, ctx, aggs, segments, stats)
+        merged = GroupByResult() if grouped else None
+        for chunk in slices:
+            part = None
+            if len(chunk) > 1:
+                try:
+                    batch, out, plan = self._run_sharded(ctx, chunk, stats)
+                    part = (decode_grouped_result(plan, batch, out)
+                            if grouped
+                            else decode_scalar_result(plan, batch, out))
+                except (PlanError, ValueError):
+                    part = None  # per-segment path serves this slice
+            if part is None:
+                part = base(self, ctx, aggs, chunk, stats)
+            if grouped:
+                merged.merge(part, aggs)
+            elif merged is None:
+                merged = part
+            else:
+                merged.merge(part, aggs)
+            # slice boundary: unpin + demote so the next slice fits; a
+            # repeat pass over the same data promotes from the host tier
+            self.residency.release_slice(lease)
+        return merged
+
     # -- sharded execution ---------------------------------------------------
-    def batch_for(self, segments: List[ImmutableSegment]) -> SegmentBatch:
+    def batch_for(self, segments: List[ImmutableSegment],
+                  lease=None) -> SegmentBatch:
         key = tuple(s.segment_name for s in segments)
         if any(getattr(s, "valid_doc_ids", None) is not None
                for s in segments):
@@ -172,7 +229,12 @@ class ShardedQueryExecutor(ServerQueryExecutor):
             # serve stale device arrays (same guard as the staging path)
             if b is not None:
                 self._evict_batch(b)
-            b = SegmentBatch(segments)
+            # host-tier promotion first: a demoted batch's SegmentBatch
+            # (host stacked arrays + unified dictionaries intact) re-stages
+            # with plain device_puts, skipping dictionary unification
+            b = self._adopt_host_batch(key, segments, lease)
+            if b is None:
+                b = SegmentBatch(segments)
             with self._batches_lock:
                 # a concurrent builder may have won the insert; serve its
                 # batch so both threads share one set of device arrays
@@ -182,6 +244,21 @@ class ShardedQueryExecutor(ServerQueryExecutor):
                     return cur
                 self._batches[key] = b
         return b
+
+    def _adopt_host_batch(self, key: Tuple[str, ...],
+                          segments: List[ImmutableSegment],
+                          lease=None) -> Optional[SegmentBatch]:
+        """Promote a demoted batch from the residency host tier: the image
+        carries the old SegmentBatch object, whose host-side stacked
+        arrays and unified dictionaries survived demotion — re-staging is
+        one H2D ``device_put`` per column instead of a re-unification."""
+        name = "batch(" + ",".join(key) + ")"
+        image = self.residency.promote_host(name, segments, lease)
+        if image is None:
+            return None
+        batch = image.batch
+        image.release()
+        return batch
 
     def _evict_batch(self, batch: SegmentBatch) -> None:
         """Drop EVERYTHING derived from a batch: the batch registration,
@@ -223,12 +300,12 @@ class ShardedQueryExecutor(ServerQueryExecutor):
                      stats: QueryStats):
         from pinot_tpu.engine.kernels import unpack_outputs
 
-        batch = self.batch_for(segments)
+        lease = self._lease_of(stats)
+        batch = self.batch_for(segments, lease)
         # the batch's device arrays are a resident like any staged segment:
         # byte-accounted, LRU-ordered, and PINNED through this query's lease
         # so another thread's budget enforcement cannot free arrays a
         # launched combine program is reading
-        lease = self._lease_of(stats)
         bkey = batch.metadata.segment_name
         self.residency.register(bkey, lambda: _BatchResident(self, batch),
                                 same=lambda r: r.batch is batch, lease=lease)
@@ -297,14 +374,24 @@ class ShardedQueryExecutor(ServerQueryExecutor):
             req = self.launcher.submit(kernel, params, num_docs)
             packed = req.result()
         # coalescing outcome -> per-query stats (merged across shards and
-        # servers; see QueryStats.merge for the sum-vs-max key split)
-        stats.launch = {
+        # servers; see QueryStats.merge for the sum-vs-max key split).
+        # Accumulate instead of overwrite: a sliced combine calls this once
+        # per slice and the query's launch story is the sum
+        cur = {
             "launches": 1,
             "coalesced": 1 if req.batch_size > 1 else 0,
             "batchSize": req.batch_size,
             "launchesSaved": req.launches_saved,
             "queueWaitMs": round(req.queue_wait_ms, 3),
         }
+        if stats.launch:
+            for k, v in cur.items():
+                if k in ("batchSize", "queueWaitMs"):
+                    stats.launch[k] = max(stats.launch.get(k, 0), v)
+                else:
+                    stats.launch[k] = stats.launch.get(k, 0) + v
+        else:
+            stats.launch = cur
         # ONE D2H fetch decodes the entire query result
         out = unpack_outputs(packed, plan.spec, num_seg=S)
         if trace_on:
@@ -319,6 +406,15 @@ class ShardedQueryExecutor(ServerQueryExecutor):
         # arrays were staged above: re-measure the resident and enforce the
         # budget now rather than waiting for end_query
         self.residency.account(bkey, lease)
+        # estimate-drift feedback for the batch path: the admission/slice
+        # estimates were per-segment sums; the measured batch bytes (incl.
+        # the mesh seg-axis pad) are the truth slicing should pick k from
+        # on the next pass
+        if lease is not None and lease._est:
+            est = sum(lease._est.get(s.segment_name, 0) for s in segments)
+            measured = self.residency.resident_nbytes(bkey)
+            if est > 0 and measured > 0:
+                self.residency.observe_estimate(est, measured)
 
         stats.num_segments_processed += batch.num_segments
         stats.total_docs += batch.num_docs
@@ -326,7 +422,9 @@ class ShardedQueryExecutor(ServerQueryExecutor):
         stats.num_docs_scanned += int(seg_matched.sum())
         stats.num_segments_matched += int((seg_matched > 0).sum())
         if plan.spec[2]:  # grouped: record the ladder rung that served
-            stats.group_by_rung = grouped_rung(plan.spec, out)
+            rung = grouped_rung(plan.spec, out)
+            stats.group_by_rung = (rung if stats.group_by_rung
+                                   in (None, rung) else "mixed")
         return batch, out, plan
 
     def _remember(self, pkey: Tuple, plan: SegmentPlan, kernel, params
@@ -614,6 +712,43 @@ class ShardedQueryExecutor(ServerQueryExecutor):
         return None
 
 
+class _BatchHostImage:
+    """Host-RAM tier image of a demoted sharded batch: the SegmentBatch
+    object itself IS the host copy — its ``_stacked`` numpy trees and
+    unified dictionaries are exactly what ``device_stage_column`` re-puts,
+    so promotion (``batch_for`` -> ``_adopt_host_batch``) skips dictionary
+    unification / remapping / stacking and pays only H2D. The residency
+    manager byte-accounts the retained host arrays against the host
+    budget; ``segment_names`` lets ``evict()`` drop every image containing
+    a removed/reloaded segment."""
+
+    __slots__ = ("batch", "segment_names")
+
+    def __init__(self, batch: SegmentBatch):
+        self.batch = batch
+        self.segment_names = tuple(s.segment_name for s in batch.segments)
+
+    def matches(self, segments) -> bool:
+        b = self.batch
+        return (b is not None and segments is not None
+                and len(b.segments) == len(segments)
+                and all(c is s for c, s in zip(b.segments, segments)))
+
+    def nbytes(self) -> int:
+        b = self.batch
+        if b is None:
+            return 0
+        total = 0
+        for tree in b._stacked.values():
+            for k, v in tree.items():
+                if k != "__S":
+                    total += int(getattr(v, "nbytes", 0) or 0)
+        return total
+
+    def release(self) -> None:
+        self.batch = None
+
+
 class _BatchResident:
     """Residency adapter for one SegmentBatch's device-column set: nbytes
     walks the executor's ``_device_cols`` entries for the batch, release
@@ -635,6 +770,16 @@ class _BatchResident:
 
     def release(self) -> None:
         self.executor._evict_batch(self.batch)
+
+    def demote(self) -> Optional[_BatchHostImage]:
+        """Demotion to the host-RAM tier: the batch's stacked numpy trees
+        (host-resident build byproducts) become the image; the device
+        arrays AND the compiled closures that pin them drop through the
+        normal batch eviction. Returns None when nothing was stacked —
+        nothing worth keeping, plain release semantics apply."""
+        image = _BatchHostImage(self.batch)
+        self.executor._evict_batch(self.batch)
+        return image if image.nbytes() > 0 else None
 
 
 def _tree_nbytes(obj) -> int:

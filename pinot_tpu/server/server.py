@@ -514,6 +514,20 @@ class ServerInstance:
                 "stagedBytes": (residency.staged_bytes()
                                 if residency is not None else 0)}
 
+    def demote_staged(self, name: str) -> Dict[str, Any]:
+        """Admin force-demotion of one resident to the host-RAM tier
+        (REST ``POST /debug/memory/demote/<name>``): its device arrays
+        D2H-snapshot into the host tier and the next query promotes them
+        with a plain H2D instead of rebuilding. Refused (demoted=False)
+        when the resident is pinned by an in-flight query."""
+        residency = getattr(self.executor, "residency", None)
+        if residency is None:
+            return {"demoted": False, "reason": "no residency manager"}
+        ok = residency.demote(name)
+        return {"demoted": bool(ok), "name": name,
+                "stagedBytes": residency.staged_bytes(),
+                "hostBytes": residency.host_bytes()}
+
     def launch_debug(self) -> Dict[str, Any]:
         """Launch-coalescing state for ``GET /debug/launches``: requests vs
         device launches, coalesced/deduped/batched counts, queue waits, and

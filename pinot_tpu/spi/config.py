@@ -144,6 +144,23 @@ class CommonConstants:
     # <= 0 -> explicitly uncapped.
     HBM_BUDGET_BYTES_KEY = "pinot.server.query.hbm.budget.bytes"
     DEFAULT_HBM_BUDGET_FRACTION = 0.75
+    # Host-RAM spill tier (engine/residency.py): eviction demotes device
+    # arrays to pinned host numpy copies instead of dropping them, so a
+    # re-stage is one H2D transfer instead of a full column rebuild (the
+    # ISCA'23 D2H+H2D vs rebuild cost model — ~10x cheaper). Budget key
+    # unset -> auto from psutil available RAM times the fraction below
+    # (uncapped when psutil is missing); <= 0 -> explicitly uncapped.
+    # The enabled key turns the tier off wholesale (eviction drops, the
+    # pre-tier behavior) — the bench uses it for the spill baseline.
+    HOSTRAM_BUDGET_BYTES_KEY = "pinot.server.query.hostram.budget.bytes"
+    HOSTRAM_ENABLED_KEY = "pinot.server.query.hostram.enabled"
+    DEFAULT_HOSTRAM_BUDGET_FRACTION = 0.5
+    # Budget-sliced sharded combine (parallel/executor.py): a query whose
+    # working set exceeds the HBM budget — but whose largest single
+    # segment fits — runs the combine in budget-sized slices (stage k
+    # segments, launch, demote-to-host, repeat) instead of spilling to
+    # the host engine. Disable to restore spill-on-over-budget.
+    HBM_SLICING_ENABLED_KEY = "pinot.server.query.hbm.slicing.enabled"
     # Server pool sizing (ref: the pqr/pqw pools,
     # CommonConstants.Server.*_QUERY_RUNNER_THREADS /
     # QUERY_WORKER_THREADS): runner threads execute whole queries off the

@@ -172,6 +172,16 @@ class ServerMeter:
     STAGING_PIN_BLOCKED = "staging_pin_blocked_evictions_total"
     STAGING_SPILLS = "staging_spills_total"
     STAGING_BORROWS = "staging_borrows_total"
+    # host-RAM spill tier (engine/residency.py; gauges staging_host_bytes /
+    # staging_host_peak_bytes / staging_host_budget_bytes ride the same
+    # registry): demotions move device arrays to host numpy, promotions
+    # re-stage them with a plain H2D, host drops are the tier's own LRU
+    # evictions, sliced = over-budget queries served via the budget-sliced
+    # sharded combine instead of a host-engine spill
+    STAGING_DEMOTIONS = "staging_demotions_total"
+    STAGING_PROMOTIONS = "staging_promotions_total"
+    STAGING_HOST_DROPS = "staging_host_drops_total"
+    STAGING_SLICED = "staging_sliced_queries_total"
     # launch coalescing (parallel/launcher.py; gauges launch_queue_depth /
     # launch_max_batch_size ride the same registry)
     LAUNCH_REQUESTS = "combine_launch_requests_total"

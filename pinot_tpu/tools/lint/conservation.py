@@ -26,6 +26,16 @@ caught:
   on every fall-through path, a method that (transitively) writes a
   ``*bytes*`` counter field. Exception paths are exempt — the query is
   dying and the next refresh re-measures.
+- **host-tier removal -> accounting** (entries fields whose name contains
+  ``host`` — the host-RAM spill tier): unlike the device tier, whose
+  ``stagedBytes`` is re-derived by walking residents on every refresh,
+  host-tier bytes are a running counter adjusted at each transition —
+  so every demotion that inserts must account host bytes (the insert
+  rule above) AND every promotion/drop that removes must reach a
+  ``*bytes*`` write on all paths *including exception edges* (a pop
+  whose accounting lives only on the try fall-through drifts the host
+  budget forever). This is a second obligation on top of remove ->
+  release.
 - **cache-field parity** (classes defining both ``nbytes()`` and
   ``release()``): every field such a class populates outside ``__init__``
   must be read by ``nbytes()`` AND cleared by ``release()`` — a staged
@@ -302,7 +312,7 @@ class _MethodAnalysis:
                 continue
             if oid[0] in ("remove", "call") and hs & released:
                 out[oid] = (False, hs)
-            elif oid[0] == "insert" and accounted:
+            elif oid[0] in ("insert", "hostacct") and accounted:
                 out[oid] = (False, hs)
         if isinstance(st, ast.Return) and st.value is not None:
             for oid, (p, hs) in list(out.items()):
@@ -333,6 +343,21 @@ class _MethodAnalysis:
         else:
             summ.add("whole")
 
+    def _host_obligation(self, f: str, node: ast.AST, out: _State,
+                         what: str, holders: FrozenSet[str]) -> None:
+        """Host-tier removal -> accounting: entries fields named ``*host*``
+        keep a running byte counter, so every removal must reach a
+        ``*bytes*`` write (exception edges included — see exc_filter,
+        which exempts only inserts). ``holders`` carries the popped
+        entry's variables so ``is None`` guards prune the
+        nothing-was-removed branch, same as the remove rule."""
+        if "host" not in f.lower():
+            return
+        oid = ("hostacct", node.lineno, node.col_offset)
+        out.setdefault(oid, (True, holders))
+        self.obligation_lines[oid] = (
+            f"host-tier {what} on self.{f}")
+
     def _new_obligations(self, st: ast.stmt, out: _State) -> None:
         for f in self.model.entries_fields:
             for n in stmt_scan(st):
@@ -350,6 +375,7 @@ class _MethodAnalysis:
                             pop.lineno,
                             f"self.{f}.pop() result is discarded — the "
                             f"removed resident can never be released"))
+                    self._host_obligation(f, pop, out, "pop", holders)
                 clr = _self_field_call(n, f, "clear")
                 if clr is not None:
                     if self.captured:
@@ -363,6 +389,8 @@ class _MethodAnalysis:
                             clr.lineno,
                             f"self.{f}.clear() drops every resident "
                             f"without capturing them for release"))
+                    self._host_obligation(f, clr, out, "clear",
+                                          frozenset(self.captured))
             if isinstance(st, ast.Delete):
                 for t in st.targets:
                     if isinstance(t, ast.Subscript) \
@@ -372,6 +400,8 @@ class _MethodAnalysis:
                             oid, (True, frozenset(self.entry_vars)))
                         self.obligation_lines[oid] = (
                             f"resident deleted from self.{f}")
+                        self._host_obligation(f, st, out, "delete",
+                                              frozenset(self.entry_vars))
             if isinstance(st, ast.Assign) and self.model.accounting:
                 for t in st.targets:
                     if isinstance(t, ast.Subscript) \
@@ -500,6 +530,14 @@ def _check_manager(mod: Module, node: ast.ClassDef,
                     f"{what} in {mname}() without re-running byte "
                     f"accounting on every fall-through path — "
                     f"stagedBytes drifts from the budget"))
+            elif kind == "hostacct":
+                findings.append(Finding(
+                    "conservation", mod.relpath, line,
+                    f"{model.name}.{mname}:hostacct",
+                    f"{what} in {mname}() never reaches a byte-counter "
+                    f"write on some path (exception edges included) — "
+                    f"the host-tier running byte total drifts from "
+                    f"reality"))
             else:
                 findings.append(Finding(
                     "conservation", mod.relpath, line,

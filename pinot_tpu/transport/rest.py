@@ -533,3 +533,8 @@ class ServerAdminApi(_Api):
         # the next query re-stages)
         self.route("POST", r"/debug/memory/evict/([^/]+)",
                    lambda m, b: (200, s.evict_staged(m.group(1))))
+        # tiered-residency sibling: force-demote one resident to the
+        # host-RAM tier (next query promotes with a plain H2D instead of
+        # a rebuild); /debug/memory reports both tiers' byte accounting
+        self.route("POST", r"/debug/memory/demote/([^/]+)",
+                   lambda m, b: (200, s.demote_staged(m.group(1))))

@@ -32,6 +32,11 @@ def pytest_configure(config):
         "markers",
         "startree: star-tree pre-aggregation rung (pytest -m startree "
         "exercises build/plan/device-exec in isolation; part of tier-1)")
+    config.addinivalue_line(
+        "markers",
+        "residency_tier: tiered residency (host-RAM spill tier, "
+        "restage-cost-aware eviction, budget-sliced sharded combine; "
+        "pytest -m residency_tier runs it in isolation; part of tier-1)")
 
 
 @pytest.fixture(scope="session")

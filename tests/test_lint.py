@@ -830,6 +830,103 @@ def test_conservation_catches_discarded_pop(tmp_path):
                for f in cf), [f.render() for f in new]
 
 
+HOST_TIER_PRELUDE = """\
+    class TieredManager:
+        def __init__(self):
+            self._entries = {}
+            self._host_entries = {}
+            self._staged_bytes = 0
+            self._host_bytes = 0
+
+        def _release_all(self, doomed):
+            for r in doomed:
+                r.release()
+
+        def _release_host(self, e):
+            self._host_bytes -= e.nbytes
+
+        def get(self, name):
+            e = self._entries.get(name)
+            if e is not None:
+                return e.resident
+            return None
+
+        def get_host(self, name):
+            e = self._host_entries.get(name)
+            if e is not None:
+                return e.resident
+            return None
+
+"""
+
+
+def test_conservation_host_tier_demote_without_account(tmp_path):
+    """The host-tier half of the byte-accounting conservation family: a
+    demotion that inserts the image into the host dict WITHOUT adjusting
+    host bytes lets the running total drift from reality — the insert
+    rule must extend to the host tier unchanged."""
+    new = _lint(tmp_path, HOST_TIER_PRELUDE + """\
+        def demote_bad(self, name, image):
+            e = self._entries.pop(name, None)
+            if e is not None:
+                self._release_all([e.resident])
+                self._host_entries[name] = image  # bytes never accounted
+
+        def demote_ok(self, name, image):
+            e = self._entries.pop(name, None)
+            if e is not None:
+                self._release_all([e.resident])
+                self._host_entries[name] = image
+                self._host_bytes += image.nbytes
+""")
+    cf = _by_checker(new, "conservation")
+    assert any("demote_bad" in f.symbol and f.symbol.endswith("insert")
+               for f in cf), [f.render() for f in new]
+    assert not any("demote_ok" in f.symbol for f in cf)
+
+
+def test_conservation_host_tier_pop_must_account(tmp_path):
+    """Host-tier removal -> accounting (the new ``hostacct`` obligation):
+    the host total is a RUNNING counter, so a promotion that pops an
+    image and even releases it — but never subtracts its bytes — drifts
+    the host budget forever. Accounting only on the try fall-through
+    leaks on the handler path (exception edges included)."""
+    new = _lint(tmp_path, HOST_TIER_PRELUDE + """\
+        def promote_bad(self, name):
+            he = self._host_entries.pop(name, None)
+            if he is None:
+                return None
+            self._release_all([he.resident])  # released, NOT accounted
+            return he.resident
+
+        def promote_exc_leak(self, name):
+            he = self._host_entries.pop(name, None)
+            if he is None:
+                return None
+            try:
+                self._validate(he)
+            except ValueError:
+                self._release_all([he.resident])
+                return None  # handler path skips the accounting
+            self._release_host(he)
+            return he.resident
+
+        def promote_ok(self, name):
+            he = self._host_entries.pop(name, None)
+            if he is None:
+                return None
+            self._release_host(he)
+            return he.resident
+""")
+    cf = _by_checker(new, "conservation")
+    assert any("promote_bad" in f.symbol and "hostacct" in f.symbol
+               for f in cf), [f.render() for f in new]
+    assert any("promote_exc_leak" in f.symbol and "hostacct" in f.symbol
+               for f in cf), [f.render() for f in new]
+    assert not any("promote_ok" in f.symbol and "hostacct" in f.symbol
+                   for f in cf), [f.render() for f in new]
+
+
 # --------------------------------------------------------------------------
 # CLI: --json / --families
 # --------------------------------------------------------------------------

@@ -304,5 +304,18 @@ def test_server_admin_size_and_memory(cluster, tmp_path):
             assert seg["bytes"] >= 0
         out = _http("POST", f"{base}/debug/memory/evict/not_staged")
         assert out["evicted"] == "not_staged"
+        # tiered residency: both tiers reported + the ops demotion hook
+        tier = mem["hostTier"]
+        assert "hostBytes" in tier and "entries" in tier
+        staged = [n for n in mem["stagedSegments"]]
+        if staged:
+            out = _http("POST", f"{base}/debug/memory/demote/{staged[0]}")
+            assert out["demoted"] in (True, False)  # False iff pinned
+            if out["demoted"]:
+                mem2 = _http("GET", f"{base}/debug/memory")
+                assert staged[0] in mem2["hostTier"]["entries"]
+                assert mem2["hostTier"]["hostBytes"] > 0
+        out = _http("POST", f"{base}/debug/memory/demote/not_staged")
+        assert out["demoted"] is False
     finally:
         api.stop()

@@ -252,6 +252,20 @@ class _Worker:
         out["hostPeakBytes"] = now["hostPeakBytes"]
         return out
 
+    # -- path-decision ledger (common/tracing.py) ---------------------------
+    def _decision_mark(self) -> dict:
+        from pinot_tpu.common.tracing import LEDGER
+
+        return LEDGER.snapshot()
+
+    def _decision_delta(self, mark: dict) -> dict:
+        """Per-suite decline-reason histogram: every point where execution
+        declined a faster rung during this suite, keyed
+        "point:declined->chosen:reason"."""
+        from pinot_tpu.common.tracing import LEDGER
+
+        return LEDGER.delta(mark)
+
     def record(self, suite: str, rec: dict) -> None:
         rec = dict(rec, suite=suite, backend=rec.get("backend", self.backend))
         with open(self.result_file, "a") as f:
@@ -284,8 +298,13 @@ class _Worker:
                 break
             try:
                 mark = self._staging_mark()
+                dmark = self._decision_mark()
                 rec = fn()
                 rec.setdefault("staging", self._staging_delta(mark))
+                # every suite records its decline-reason histogram: the
+                # BENCH JSON must EXPLAIN every non-device fallback, not
+                # just count it (the "why is pallas_kernels 0" evidence)
+                rec.setdefault("decisions", self._decision_delta(dmark))
                 self.record(suite, rec)
             except Exception as exc:
                 traceback.print_exc(file=sys.stderr)
@@ -337,6 +356,7 @@ class _Worker:
         from pinot_tpu.tools import ssb, ssb_baseline
 
         staging_mark = self._staging_mark()
+        decision_mark = self._decision_mark()
         segs = self.segments()
         # explicit LIMIT: the engine applies the reference's default
         # group-by LIMIT 10 otherwise, and the baseline computes FULL
@@ -413,7 +433,23 @@ class _Worker:
                 f"{self.dev.residency.budget_bytes}, peak "
                 f"{staging['peakBytes']} B staged); the device number "
                 f"would be a lie")
+        # every pallas decline during the SSB suite must carry a
+        # CLASSIFIED reason code: an "unknown" means a decline path the
+        # ledger cannot explain, and the next TPU-fight PR would be
+        # aiming blind — fail loudly instead of shipping it
+        from pinot_tpu.common.tracing import parse_decision_key
+
+        decisions = self._decision_delta(decision_mark)
+        unknown = [k for k in decisions
+                   if parse_decision_key(k)[0] == "pallas"
+                   and parse_decision_key(k)[3] == "unknown"]
+        if unknown:
+            raise AssertionError(
+                f"SSB pallas declines with unclassified reason codes: "
+                f"{unknown} — every decline must be classified "
+                f"(decisions: {decisions})")
         return {
+            "decisions": decisions,
             "staging": staging,
             "rows": self.rows,
             "sf": round(self.rows / ssb.ROWS_PER_SF, 3),

@@ -263,19 +263,24 @@ class BrokerRequestHandler:
                 TOO_MANY_REQUESTS_ERROR,
                 f"{e} (retriable; queueDepth={e.queue_depth})")
             return finish(response)
+        admit_wait_ms = sum(getattr(t_adm, "wait_ms", 0.0)
+                            for t_adm in tickets)
         try:
             return self._scatter_reduce(ctx, physical, gapfill_spec,
                                         response, phase, finish, start,
-                                        principal, access_control)
+                                        principal, access_control,
+                                        admit_wait_ms=admit_wait_ms)
         finally:
             for t_adm in tickets:
                 self.admission.release(t_adm)
 
     def _scatter_reduce(self, ctx, physical, gapfill_spec, response,
-                        phase, finish, start, principal, access_control
-                        ) -> BrokerResponse:
+                        phase, finish, start, principal, access_control,
+                        admit_wait_ms: float = 0.0) -> BrokerResponse:
         """Post-admission half of the front door: subquery rewrite ->
-        hybrid split -> routing -> scatter/gather -> reduce."""
+        hybrid split -> routing -> scatter/gather -> reduce.
+        ``admit_wait_ms`` is the front-door admission-gate queue wait —
+        the broker-level queue span in the trace tree."""
         from pinot_tpu.spi.metrics import BrokerMeter, BrokerQueryPhase
 
         try:
@@ -334,17 +339,31 @@ class BrokerRequestHandler:
                 table = apply_gapfill(ctx, table, gapfill_spec)
             response.result_table = table
             response.stats = stats
-            if stats.trace:
-                # ref: trace JSON attached to response metadata
-                # (ServerQueryExecutorV1Impl.java:221-226)
-                response.trace_info = {"entries": stats.trace}
+            traced_stats = stats if (stats.trace or stats.spans) else None
             for msg in server_errors:
                 # partial result: the table stands, but the caller sees it
                 response.add_exception(SERVER_NOT_RESPONDING_ERROR, msg)
         except QueryError as e:
+            traced_stats = None
             response.add_exception(QUERY_EXECUTION_ERROR, str(e))
         phase(BrokerQueryPhase.REDUCE, t)
         response.time_used_ms = (time.perf_counter() - start) * 1e3
+        if traced_stats is not None:
+            # ref: trace JSON attached to response metadata
+            # (ServerQueryExecutorV1Impl.java:221-226). The legacy flat
+            # "entries" view is preserved (emitted from the span tree at
+            # each span close); "spans" is the broker root with the
+            # measured broker phases as children and every server's tree
+            # — instance-tagged at gather, see _tag_trace — re-parented
+            # under ScatterGather. Assembled AFTER the REDUCE phase timer
+            # so the root's children account the full broker wall time.
+            from pinot_tpu.common.tracing import build_broker_root
+
+            root = build_broker_root(
+                response.phase_times_ms, traced_stats.spans,
+                response.time_used_ms, admission_wait_ms=admit_wait_ms)
+            response.trace_info = {"entries": traced_stats.trace,
+                                   "spans": [root]}
         return finish(response)
 
     # -- table resolution + hybrid split -------------------------------------
@@ -584,7 +603,11 @@ def _and(a: Optional[FilterNode], b: FilterNode) -> FilterNode:
 
 
 def _tag_trace(dt: DataTable, instance_id: str) -> None:
-    """Attribute trace entries to their server BEFORE the reduce flattens
-    them (the reference keys traceInfo per server)."""
+    """Attribute trace entries AND span-tree roots to their server BEFORE
+    the reduce merges/re-parents them (the reference keys traceInfo per
+    instance) — after the broker root adopts every server's trees, the
+    per-server origin is only recoverable from these tags."""
     for e in dt.stats.trace:
         e.setdefault("instance", instance_id)
+    for root in dt.stats.spans:
+        root.setdefault("instance", instance_id)

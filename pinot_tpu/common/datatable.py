@@ -313,6 +313,8 @@ class DataTable:
             launch=st.get("launch", {}),
             phase_ms=st.get("phaseTimesMs", {}),
             trace=st.get("trace", []),
+            spans=st.get("spans", []),
+            decisions=st.get("decisions", {}),
         )
 
     @classmethod

@@ -49,6 +49,11 @@ class BrokerResponse:
             # HBM residency counters merged across servers (counters sum,
             # *Bytes keys max — see QueryStats.merge)
             d["staging"] = self.stats.staging
+        if self.stats.decisions:
+            # path-decision ledger (common/tracing.py): every decline of
+            # a faster rung this query took, keyed
+            # "point:declined->chosen:reason", summed across servers
+            d["decisions"] = self.stats.decisions
         if self.result_table is not None:
             d["resultTable"] = self.result_table.to_dict()
         if self.trace_info:

@@ -1,0 +1,565 @@
+"""Query lifecycle tracing: span trees + the path-decision ledger.
+
+Re-design of the reference's request-scoped tracing
+(``TraceContext.java:46`` — per-operator trace trees attached to traced
+requests — plus the ``ServerQueryPhase``/``BrokerQueryPhase`` timer
+pyramid and the broker slow-query log), with one addition the reference
+never had: a **decision ledger** that records WHY execution declined a
+faster path.
+
+Two data products ride together:
+
+- **Span trees** (:class:`Span` / :class:`SpanRecorder`): a hierarchical
+  record of the full query lifecycle — broker parse/route/scatter ->
+  server admission queue -> scheduler queue -> residency lease ->
+  launch-dispatcher queue + vmap batch -> per-segment kernel + D2H ->
+  sharded combine -> broker reduce. Every span carries wall ms, an
+  explicit queue-vs-work split (``queueMs``/``workMs``) where a queue
+  exists, and structured attributes. Server trees ship on the DataTable
+  wire (``QueryStats.spans``) and are re-parented under the broker root
+  at reduce; the legacy flat ``traceInfo["entries"]`` view is EMITTED
+  FROM the tree (each span close appends one flat entry), so pre-span
+  consumers keep working.
+- **The decision ledger**: every point where execution declines a faster
+  rung emits a machine-readable ``(decision_point, chosen, declined,
+  reason_code)`` record — pallas eligibility, star-tree fit, residency
+  spill/slice, backend selection, host-engine fallbacks. Records
+  aggregate into ``QueryStats.decisions`` (summed at merge) and into the
+  process-level :data:`LEDGER` histogram surfaced on ``/metrics`` — the
+  forensics the "why did pallas never fire" question needs.
+
+Cost model: spans are recorded only when a recorder is attached to the
+stats (``trace=true``, the ``pinot.server.query.trace.sample`` rate, or
+a configured slow-query threshold); the off path pays one ``getattr``
+per site. Reason-code counters are always on — they fire only at decline
+points, which are off the resident fast path.
+"""
+
+from __future__ import annotations
+
+import re
+import threading
+import time
+
+from contextlib import contextmanager
+from typing import Any, Dict, List, Optional, Tuple
+
+# span-dict keys the serializer owns; attributes must not collide
+_RESERVED = ("name", "ms", "queueMs", "workMs", "children")
+
+
+class Span:
+    """One open span. Closed spans become plain dicts (wire-ready)."""
+
+    __slots__ = ("name", "t0", "wall_ms", "queue_ms", "attrs", "children")
+
+    def __init__(self, name: str, attrs: Optional[Dict[str, Any]] = None):
+        self.name = name
+        self.t0 = time.perf_counter()
+        self.wall_ms = 0.0
+        self.queue_ms: Optional[float] = None
+        self.attrs: Dict[str, Any] = dict(attrs) if attrs else {}
+        self.children: List[Dict[str, Any]] = []
+
+    def to_dict(self) -> Dict[str, Any]:
+        d: Dict[str, Any] = {"name": self.name, "ms": round(self.wall_ms, 3)}
+        if self.queue_ms is not None:
+            # the explicit queue-vs-work split: queueMs is time spent
+            # WAITING at this level, workMs the remainder
+            d["queueMs"] = round(self.queue_ms, 3)
+            d["workMs"] = round(max(self.wall_ms - self.queue_ms, 0.0), 3)
+        for k, v in self.attrs.items():
+            if k not in _RESERVED:
+                d[k] = v
+        if self.children:
+            d["children"] = self.children
+        return d
+
+
+class SpanRecorder:
+    """Per-query span collector. One per :class:`QueryStats`;
+    thread-confined — segment fan-out workers record into their private
+    stats' recorders, and ``QueryStats.merge`` re-parents their finished
+    spans under the caller's currently-open span.
+
+    ``sink`` is the completed-top-level-span list (normally the stats'
+    own ``spans`` field, so finished trees land directly on the wire
+    payload); ``legacy`` is the flat entry list (``QueryStats.trace``) —
+    every span close appends one ``{"operator", "ms", ...attrs}`` entry,
+    preserving the pre-span-tree ``traceInfo["entries"]`` contract."""
+
+    __slots__ = ("spans", "_stack", "_legacy")
+
+    def __init__(self, sink: Optional[List[Dict[str, Any]]] = None,
+                 legacy: Optional[List[Dict[str, Any]]] = None):
+        self.spans: List[Dict[str, Any]] = sink if sink is not None else []
+        self._stack: List[Span] = []
+        self._legacy = legacy
+
+    # -- open/close ----------------------------------------------------------
+    def span_begin(self, name: str, **attrs: Any) -> Span:
+        """Open a child of the current span (or a new root). MUST reach
+        ``span_end`` on every path, exception edges included — the
+        graftlint ``spanpair`` obligation gates manual pairs; prefer the
+        ``span()`` context manager."""
+        sp = Span(name, attrs)
+        self._stack.append(sp)
+        return sp
+
+    def span_end(self, span: Span, queue_ms: Optional[float] = None,
+                 **attrs: Any) -> Optional[Dict[str, Any]]:
+        """Close ``span`` (idempotent: a second close is a no-op). A
+        still-open child left behind by an error path is swept closed
+        into ``span`` first, so exception edges can never leave a
+        dangling open span below a closed parent."""
+        if span not in self._stack:
+            return None
+        while self._stack[-1] is not span:
+            self.span_end(self._stack[-1])
+        self._stack.pop()
+        span.wall_ms = (time.perf_counter() - span.t0) * 1e3
+        if queue_ms is not None:
+            span.queue_ms = queue_ms
+        if attrs:
+            span.attrs.update(attrs)
+        d = span.to_dict()
+        target = self._stack[-1].children if self._stack else self.spans
+        target.append(d)
+        if self._legacy is not None:
+            self._legacy.append({"operator": span.name,
+                                 "ms": round(span.wall_ms, 3), **span.attrs})
+        return d
+
+    @contextmanager
+    def span(self, name: str, **attrs: Any):
+        sp = self.span_begin(name, **attrs)
+        try:
+            yield sp
+        finally:
+            self.span_end(sp)
+
+    def close_all(self) -> None:
+        """Close every open span, outermost last (query teardown /
+        exception edge)."""
+        if self._stack:
+            self.span_end(self._stack[0])
+
+    @property
+    def open_depth(self) -> int:
+        return len(self._stack)
+
+    # -- pre-measured / adopted spans ---------------------------------------
+    def add_completed(self, name: str, wall_ms: float,
+                      queue_ms: Optional[float] = None,
+                      **attrs: Any) -> Dict[str, Any]:
+        """Attach an already-measured span (e.g. a queue wait that ended
+        before the recorder existed) as a child of the current span."""
+        sp = Span(name, attrs)
+        sp.wall_ms = wall_ms
+        sp.queue_ms = queue_ms
+        d = sp.to_dict()
+        target = self._stack[-1].children if self._stack else self.spans
+        target.append(d)
+        if self._legacy is not None:
+            self._legacy.append({"operator": name, "ms": round(wall_ms, 3),
+                                 **attrs})
+        return d
+
+    def adopt(self, span_dicts: List[Dict[str, Any]]) -> None:
+        """Re-parent completed span dicts (a worker stats' trees, a
+        server's wire trees) under the currently-open span."""
+        target = self._stack[-1].children if self._stack else self.spans
+        target.extend(span_dicts)
+
+
+# --------------------------------------------------------------------------
+# QueryStats attachment (the stats object stays a plain dataclass; the
+# recorder rides as a private attribute so untraced queries allocate nothing)
+# --------------------------------------------------------------------------
+
+def stats_tracer(stats: Any) -> Optional[SpanRecorder]:
+    """The stats' recorder, or None (untraced: zero-allocation path)."""
+    return getattr(stats, "_recorder", None)
+
+
+def start_trace(stats: Any) -> SpanRecorder:
+    """Attach a recorder to ``stats`` (idempotent). Completed roots land
+    in ``stats.spans`` (the wire field); flat entries in ``stats.trace``."""
+    rec = getattr(stats, "_recorder", None)
+    if rec is None:
+        rec = SpanRecorder(sink=stats.spans, legacy=stats.trace)
+        stats._recorder = rec
+    return rec
+
+
+class _NullSpanCm:
+    __slots__ = ()
+
+    def __enter__(self):
+        return None
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NULL_SPAN = _NullSpanCm()
+
+
+def maybe_span(stats: Any, name: str, **attrs: Any):
+    """Context manager that records a span when ``stats`` is traced and
+    is a shared no-op singleton otherwise (the off-path cost is one
+    ``getattr``)."""
+    rec = getattr(stats, "_recorder", None)
+    if rec is None:
+        return _NULL_SPAN
+    return rec.span(name, **attrs)
+
+
+def attach_root_child(stats: Any, name: str, wall_ms: float,
+                      queue_ms: Optional[float] = None, front: bool = False,
+                      **attrs: Any) -> None:
+    """Retroactively attach a pre-measured child to the stats' FINISHED
+    root span (the scheduler-queue wait is measured by the server tier
+    after the executor already closed the tree). The root's wall time
+    grows to keep the tree self-consistent (children must account inside
+    the root)."""
+    if not stats.spans:
+        return
+    root = stats.spans[0]
+    sp = Span(name, attrs)
+    sp.wall_ms = wall_ms
+    sp.queue_ms = queue_ms
+    child = sp.to_dict()
+    kids = root.setdefault("children", [])
+    if front:
+        kids.insert(0, child)
+    else:
+        kids.append(child)
+    root["ms"] = round(root.get("ms", 0.0) + wall_ms, 3)
+    stats.trace.append({"operator": name, "ms": round(wall_ms, 3), **attrs})
+
+
+def flatten_spans(span_dicts: List[Dict[str, Any]]
+                  ) -> List[Dict[str, Any]]:
+    """Span trees -> legacy flat entries (pre-order), for consumers that
+    want the old shape derived from the tree rather than the emitted
+    legacy list."""
+    out: List[Dict[str, Any]] = []
+
+    def walk(d: Dict[str, Any]) -> None:
+        e = {"operator": d["name"], "ms": d["ms"]}
+        for k, v in d.items():
+            if k not in ("name", "ms", "children"):
+                e[k] = v
+        out.append(e)
+        for c in d.get("children", ()):
+            walk(c)
+
+    for d in span_dicts:
+        walk(d)
+    return out
+
+
+def build_broker_root(phase_ms: Dict[str, float],
+                      server_spans: List[Dict[str, Any]],
+                      total_ms: float,
+                      admission_wait_ms: float = 0.0) -> Dict[str, Any]:
+    """Assemble the broker root span from the measured broker phases
+    (COMPILATION/ROUTING/SCATTER_GATHER/REDUCE), re-parenting the
+    per-server trees under the ScatterGather child — the reduce-side half
+    of the reference's per-server ``traceInfo`` keying."""
+    children: List[Dict[str, Any]] = []
+    if admission_wait_ms > 0:
+        children.append({"name": "Admission",
+                         "ms": round(admission_wait_ms, 3),
+                         "queueMs": round(admission_wait_ms, 3),
+                         "workMs": 0.0})
+    for phase, name in (("COMPILATION", "Compile"), ("ROUTING", "Routing")):
+        if phase in phase_ms:
+            children.append({"name": name,
+                             "ms": round(phase_ms[phase], 3)})
+    sg: Dict[str, Any] = {
+        "name": "ScatterGather",
+        "ms": round(phase_ms.get("SCATTER_GATHER", 0.0), 3)}
+    if server_spans:
+        sg["children"] = list(server_spans)
+    children.append(sg)
+    if "REDUCE" in phase_ms:
+        children.append({"name": "Reduce",
+                         "ms": round(phase_ms["REDUCE"], 3)})
+    return {"name": "BrokerQuery", "ms": round(total_ms, 3),
+            "children": children}
+
+
+# --------------------------------------------------------------------------
+# path-decision ledger
+# --------------------------------------------------------------------------
+
+# Ordered (substring, reason_code) classification of decline messages.
+# More specific substrings FIRST. Every PlanError / pallas ineligibility
+# message in the engine maps to a stable code here; the normalizing
+# fallback below keeps even unlisted messages classified (never
+# "unknown" for a non-empty message) — the bench loud-fails on "unknown".
+_DECLINE_RULES: Tuple[Tuple[str, str], ...] = (
+    ("mutable segment", "mutable_segment"),
+    ("star-tree group key space", "startree_group_space_over_limit"),
+    ("no pre-agg pairs", "startree_no_preagg_pair"),
+    ("star-tree param", "startree_param_drift"),
+    ("group key space", "group_space_over_limit"),
+    ("not device-supported", "agg_not_device_supported"),
+    ("DISTINCTCOUNTHLL argument", "hll_arg_not_column"),
+    ("DISTINCTCOUNTHLL needs", "hll_needs_sv_dict"),
+    ("HLL register space", "hll_register_space_over_limit"),
+    ("DISTINCTCOUNT argument", "distinctcount_arg_not_column"),
+    ("DISTINCTCOUNT on raw", "distinctcount_raw_column"),
+    ("DISTINCTCOUNT on MV", "distinctcount_mv_column"),
+    ("DISTINCTCOUNT cardinality", "distinctcount_cardinality_over_limit"),
+    ("MV aggregation argument", "mv_agg_arg_not_column"),
+    ("needs a numeric MV column", "mv_agg_not_numeric"),
+    ("group-by on virtual column", "group_virtual_column"),
+    ("group-by on MV column", "group_mv_column"),
+    ("raw int group-by span", "group_raw_span_over_limit"),
+    ("group-by on raw float", "group_raw_float_column"),
+    ("group-by expression span", "group_expression_span_over_limit"),
+    ("group-by expression", "group_expression_unbounded"),
+    ("expression predicate", "expression_predicate"),
+    ("virtual column predicate", "virtual_column_predicate"),
+    ("JSON_MATCH on MV", "json_match_mv_column"),
+    ("on raw column -> host", "raw_predicate_unsupported"),
+    ("raw MV column predicate", "raw_mv_predicate"),
+    ("predicate", "predicate_unsupported"),
+    ("non-numeric literal", "value_literal_non_numeric"),
+    ("virtual column in value", "value_virtual_column"),
+    ("in value expression", "value_column_not_numeric_sv"),
+    ("transform", "transform_unsupported"),
+    ("cannot compile value", "value_expression_uncompilable"),
+    ("live groups exceed the compact cap", "compact_cap_overflow"),
+    ("doc axis", "capacity_mesh_mismatch"),
+    # pallas eligibility (engine/pallas_kernels.py _Ineligible messages)
+    ("unpackable column", "pallas_unpackable_column"),
+    ("lut with too many runs", "pallas_lut_too_many_runs"),
+    ("raw group key", "pallas_raw_group_key"),
+    ("non-numeric/MV agg value column", "pallas_value_not_numeric_sv"),
+    ("no stats for int value bound", "pallas_no_int_stats"),
+    ("i64-staged value column", "pallas_i64_value_column"),
+    ("missing agg value", "pallas_missing_agg_value"),
+    ("int expr bound exceeds i32", "pallas_expression_bound_over_i32"),
+    ("agg value", "pallas_agg_value_op_unsupported"),
+    ("mv aggregation", "pallas_mv_aggregation"),
+    ("int min/max not f32-exact", "pallas_minmax_not_f32_exact"),
+)
+
+_SANITIZE = re.compile(r"[^a-z0-9]+")
+_DIGITS = re.compile(r"\d+")
+
+
+def classify_decline(message: str) -> str:
+    """Decline message -> stable snake_case reason code. The table covers
+    every engine decline message; the fallback strips runtime-variable
+    digits and normalizes, so new messages stay machine-readable (and
+    non-``unknown``) until classified properly."""
+    for needle, code in _DECLINE_RULES:
+        if needle in message:
+            return code
+    code = _SANITIZE.sub("_", _DIGITS.sub("", message).lower()).strip("_")
+    return code[:64] if code else "unknown"
+
+
+class DecisionLedger:
+    """Always-on histogram of path-decision records, keyed on the full
+    ``(decision_point, chosen, declined, reason_code)`` tuple. One
+    process-level instance (:data:`LEDGER`) backs ``/metrics`` and the
+    bench per-suite deltas; tests may instantiate private ledgers."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counts: Dict[Tuple[str, str, str, str], int] = {}  # guarded-by: _lock
+        self._registries: List[Any] = []  # guarded-by-writes: _lock
+
+    def record(self, point: str, chosen: str, declined: str,
+               reason: str) -> None:
+        key = (point, chosen, declined, reason)
+        with self._lock:
+            self._counts[key] = self._counts.get(key, 0) + 1
+            regs = list(self._registries)
+        if regs:
+            from pinot_tpu.spi.metrics import decision_meter_name
+
+            name = decision_meter_name(point, reason)
+            for reg in regs:
+                reg.meter(name).mark()
+
+    def bind_metrics(self, registry: Any) -> None:
+        """Surface the histogram on a MetricsRegistry: each (point,
+        reason) pair becomes a ``decision_declined_total_*`` counter on
+        ``/metrics``."""
+        with self._lock:
+            if registry not in self._registries:
+                self._registries.append(registry)
+            existing = dict(self._counts)
+        if existing:
+            from pinot_tpu.spi.metrics import decision_meter_name
+
+            for (point, _c, _d, reason), n in existing.items():
+                registry.meter(decision_meter_name(point, reason)).mark(n)
+
+    def snapshot(self) -> Dict[str, int]:
+        """``"point:declined->chosen:reason" -> count`` (the same key
+        shape ``QueryStats.decisions`` uses)."""
+        with self._lock:
+            return {decision_key(p, c, d, r): n
+                    for (p, c, d, r), n in self._counts.items()}
+
+    def reason_histogram(self) -> Dict[str, int]:
+        """reason_code -> count across all decision points."""
+        out: Dict[str, int] = {}
+        with self._lock:
+            for (_p, _c, _d, r), n in self._counts.items():
+                out[r] = out.get(r, 0) + n
+        return out
+
+    def delta(self, mark: Dict[str, int]) -> Dict[str, int]:
+        """Per-suite histogram since ``mark`` (a prior ``snapshot()``)."""
+        now = self.snapshot()
+        return {k: v - mark.get(k, 0) for k, v in now.items()
+                if v - mark.get(k, 0)}
+
+
+def decision_key(point: str, chosen: str, declined: str,
+                 reason: str) -> str:
+    return f"{point}:{declined}->{chosen}:{reason}"
+
+
+def parse_decision_key(key: str) -> Tuple[str, str, str, str]:
+    """Inverse of :func:`decision_key` -> (point, chosen, declined,
+    reason)."""
+    point, rest = key.split(":", 1)
+    path, reason = rest.rsplit(":", 1)
+    declined, chosen = path.split("->", 1)
+    return point, chosen, declined, reason
+
+
+LEDGER = DecisionLedger()
+
+
+def record_decision(stats: Any, point: str, chosen: str, declined: str,
+                    reason: str) -> None:
+    """One ledger record: execution declined ``declined`` in favor of
+    ``chosen`` at ``point`` because ``reason``. Lands in the per-query
+    ``QueryStats.decisions`` dict (summed across segments/shards/servers
+    at merge) AND the process :data:`LEDGER` histogram — both always on;
+    a decline is never silent."""
+    if stats is not None:
+        key = decision_key(point, chosen, declined, reason)
+        stats.decisions[key] = stats.decisions.get(key, 0) + 1
+    LEDGER.record(point, chosen, declined, reason)
+
+
+# --------------------------------------------------------------------------
+# query registry: /debug/queries + slow-query log
+# --------------------------------------------------------------------------
+
+class QueryRegistry:
+    """Backing store for ``/debug/queries``: the currently-running query
+    set, a ring buffer of the last N completed, and a slow-query log
+    (``pinot.server.query.slow.threshold.ms``) that retains the full
+    span tree for over-threshold queries — the executor force-records
+    spans for every query while the threshold is configured, and ships
+    them on the wire only when the query was actually traced/sampled, so
+    a slow query's forensics survive even when sampling missed it."""
+
+    def __init__(self, ring_size: int = 128, slow_log_size: int = 32,
+                 slow_threshold_ms: float = 0.0):
+        self.ring_size = max(1, int(ring_size))
+        self.slow_log_size = max(1, int(slow_log_size))
+        self.slow_threshold_ms = float(slow_threshold_ms)
+        self._lock = threading.Lock()
+        self._seq = 0  # guarded-by: _lock
+        self._running: Dict[int, Dict[str, Any]] = {}  # guarded-by: _lock
+        self._completed: List[Dict[str, Any]] = []  # guarded-by: _lock
+        self._slow: List[Dict[str, Any]] = []  # guarded-by: _lock
+        self.slow_queries = 0  # guarded-by: _lock
+
+    @property
+    def force_trace(self) -> bool:
+        """True when every query must record spans so the slow log can
+        retain trees sampling missed."""
+        return self.slow_threshold_ms > 0
+
+    def begin(self, ctx: Any, stats: Any = None) -> Dict[str, Any]:
+        token: Dict[str, Any] = {
+            "sql": getattr(ctx, "sql", None),
+            "table": getattr(ctx, "table_name", None),
+            "requestId": getattr(ctx, "request_id", None),
+            "phase": "executing",
+            "t0": time.perf_counter(),
+            "stats": stats,
+        }
+        with self._lock:
+            self._seq += 1
+            token["id"] = self._seq
+            self._running[token["id"]] = token
+        return token
+
+    def phase(self, token: Dict[str, Any], phase: str) -> None:
+        token["phase"] = phase
+
+    def end(self, token: Dict[str, Any], error: Any = None) -> float:
+        elapsed_ms = (time.perf_counter() - token["t0"]) * 1e3
+        stats = token.get("stats")
+        entry: Dict[str, Any] = {
+            "id": token["id"],
+            "sql": token["sql"],
+            "table": token["table"],
+            "elapsedMs": round(elapsed_ms, 3),
+        }
+        if token.get("requestId"):
+            entry["requestId"] = token["requestId"]
+        if error is not None:
+            entry["error"] = f"{type(error).__name__}: {error}"[:200]
+        if stats is not None and stats.decisions:
+            entry["decisions"] = dict(stats.decisions)
+        slow = self.slow_threshold_ms > 0 \
+            and elapsed_ms >= self.slow_threshold_ms
+        if slow and stats is not None and stats.spans:
+            # copy the LIST (dicts shared): the executor may clear the
+            # stats' wire field when the query wasn't actually traced
+            entry["spans"] = list(stats.spans)
+        with self._lock:
+            self._running.pop(token["id"], None)
+            self._completed.append(entry)
+            if len(self._completed) > self.ring_size:
+                del self._completed[0]
+            if slow:
+                self.slow_queries += 1
+                self._slow.append(entry)
+                if len(self._slow) > self.slow_log_size:
+                    del self._slow[0]
+        return elapsed_ms
+
+    def snapshot(self) -> Dict[str, Any]:
+        """``/debug/queries`` body."""
+        now = time.perf_counter()
+        with self._lock:
+            running = list(self._running.values())
+            completed = list(self._completed)
+            slow = list(self._slow)
+            slow_n = self.slow_queries
+        run_out = []
+        for t in running:
+            lease = getattr(t.get("stats"), "_staging_lease", None)
+            run_out.append({
+                "id": t["id"],
+                "sql": t["sql"],
+                "table": t["table"],
+                "phase": t["phase"],
+                "elapsedMs": round((now - t["t0"]) * 1e3, 3),
+                "pinsHeld": len(lease._pinned) if lease is not None else 0,
+            })
+        return {
+            "running": run_out,
+            "completed": completed,
+            "slow": slow,
+            "slowThresholdMs": self.slow_threshold_ms,
+            "slowQueries": slow_n,
+        }

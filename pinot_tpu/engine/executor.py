@@ -10,8 +10,6 @@ merges per-segment partials and reduces to a ResultTable.
 
 from __future__ import annotations
 
-import time
-
 from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
@@ -28,6 +26,13 @@ from pinot_tpu.engine.results import (
     ResultTable,
     reduce_aggregation,
     reduce_group_by,
+)
+from pinot_tpu.common.tracing import (
+    QueryRegistry,
+    maybe_span,
+    record_decision,
+    start_trace,
+    stats_tracer,
 )
 from pinot_tpu.engine.residency import ResidencyManager
 from pinot_tpu.query.context import QueryContext
@@ -63,16 +68,21 @@ def filter_fingerprint(ctx: QueryContext) -> str:
 
 
 def _segment_tracer(ctx: QueryContext, stats: QueryStats, op: str, seg):
-    """``done(result, path)`` pass-through that records a per-segment trace
-    entry when the request carries trace=true (ref: TraceContext.java:46 —
-    operator timings attach to the request's trace tree)."""
-    if not ctx.trace_enabled:
+    """``done(result, path)`` pass-through that records a per-segment SPAN
+    when the query is traced (ref: TraceContext.java:46 — operator timings
+    attach to the request's trace tree); the legacy flat entry is emitted
+    from the span at close. Untraced queries get the zero-allocation
+    pass-through."""
+    rec = stats_tracer(stats)
+    if rec is None:
         return lambda result, path: result
-    t0 = time.perf_counter()
+    sp = rec.span_begin(op, segment=seg.segment_name)
 
     def done(result, path):
-        stats.add_trace(op, (time.perf_counter() - t0) * 1e3,
-                        segment=seg.segment_name, path=path)
+        # the closure owns the span close (graftlint spanpair contract);
+        # an error path that skips done() is swept closed when the parent
+        # (or the root, at query teardown) ends
+        rec.span_end(sp, path=path)
         return result
 
     return done
@@ -161,6 +171,24 @@ class ServerQueryExecutor:
         from pinot_tpu.server.admission import AdmissionGate
 
         self.admission = AdmissionGate.from_config(cfg)
+        # query lifecycle tracing (common/tracing.py): spans are recorded
+        # when the request asks (trace=true), the sample rate hits, or a
+        # slow-query threshold is configured (the registry then retains
+        # over-threshold trees sampling missed). The registry also backs
+        # /debug/queries (running set + completed ring).
+        self.trace_sample = cfg.get_float(
+            _CC.TRACE_SAMPLE_KEY, _CC.DEFAULT_TRACE_SAMPLE)
+        self.queries = QueryRegistry(slow_threshold_ms=cfg.get_float(
+            _CC.SLOW_THRESHOLD_MS_KEY, _CC.DEFAULT_SLOW_THRESHOLD_MS))
+        # backend selection is itself a path decision: a CPU default
+        # backend is why no pallas kernel can compile — record it ONCE so
+        # the ledger explains the whole pallas story, not just per-plan
+        # declines
+        import jax as _jax
+
+        if _jax.default_backend() == "cpu":
+            record_decision(None, "backend", "cpu", "tpu",
+                            "cpu_default_backend")
         # per-segment half of the launch-coalescing contract: concurrent
         # identical kernel launches (same cached plan + same staged
         # resident) share one device program + one D2H fetch
@@ -201,17 +229,73 @@ class ServerQueryExecutor:
         retriable QueryRejectedError BEFORE any lease/pin is taken."""
         ticket = self.admission.admit(ctx.table_name or "")
         try:
-            return self._execute_instance_admitted(ctx, segments)
+            return self._execute_instance_admitted(
+                ctx, segments, admit_wait_ms=ticket.wait_ms)
         finally:
             self.admission.release(ticket)
 
+    # -- tracing bookends ----------------------------------------------------
+    def _open_query(self, ctx: QueryContext, segments,
+                    admit_wait_ms: float = 0.0):
+        """Create the query's stats + registry token and, when the query
+        is traced (trace=true / sample hit / slow-log force), its span
+        recorder and root span. The admission-gate queue wait — measured
+        before stats existed — lands as the first child with full queue
+        attribution."""
+        stats = QueryStats(num_segments_queried=len(segments))
+        requested = ctx.trace_enabled
+        if not requested and self.trace_sample > 0:
+            import random
+
+            requested = random.random() < self.trace_sample
+        if requested or self.queries.force_trace:
+            rec = start_trace(stats)
+            stats._trace_requested = requested
+            root = rec.span_begin("ServerQuery", table=ctx.table_name)
+            stats._root_span = root  # closed by _close_query's close_all
+            rec.add_completed("Admission", wall_ms=admit_wait_ms,
+                              queue_ms=admit_wait_ms)
+        token = self.queries.begin(ctx, stats)
+        stats._registry_token = token  # phase updates from inner layers
+        return stats, token
+
+    def _close_query(self, stats: QueryStats, token, error=None) -> None:
+        """Query teardown: close every open span (exception edges leave
+        the tree closed, never dangling), finish the registry entry (the
+        slow log snapshots over-threshold trees here), and — when the
+        recording was slow-log-forced rather than requested — strip the
+        spans/entries off the wire payload."""
+        rec = stats_tracer(stats)
+        if rec is not None:
+            rec.close_all()
+        self.queries.end(token, error=error)
+        if rec is not None and not getattr(stats, "_trace_requested", False):
+            # forced recording: the slow log copied what it needed; the
+            # response must look exactly like an untraced one
+            stats.spans.clear()
+            stats.trace.clear()
+            stats._recorder = None
+
     def _execute_instance_admitted(self, ctx: QueryContext,
-                                   segments: List[ImmutableSegment]):
+                                   segments: List[ImmutableSegment],
+                                   admit_wait_ms: float = 0.0):
+        stats, token = self._open_query(ctx, segments, admit_wait_ms)
+        error = None
+        try:
+            return self._execute_instance_traced(ctx, segments, stats)
+        except BaseException as e:
+            error = e
+            raise
+        finally:
+            self._close_query(stats, token, error=error)
+
+    def _execute_instance_traced(self, ctx: QueryContext,
+                                 segments: List[ImmutableSegment],
+                                 stats: QueryStats):
         from dataclasses import replace
 
         from pinot_tpu.common.datatable import DataTable
 
-        stats = QueryStats(num_segments_queried=len(segments))
         if not segments:
             raise QueryError(f"no segments for table {ctx.table_name!r}")
         self._validate_columns(ctx, segments[0])
@@ -230,6 +314,8 @@ class ServerQueryExecutor:
                 else:
                     sub = replace(ctx, having=None,
                                   limit=ctx.offset + ctx.limit, offset=0)
+                record_decision(stats, "plan", "host_engine",
+                                "device_kernel", "distinct_host_only")
                 table = host_engine.execute_distinct(sub, segments, stats)
                 if len(table.rows) >= self.num_groups_limit:
                     stats.num_groups_limit_reached = True
@@ -281,7 +367,8 @@ class ServerQueryExecutor:
             # request; its slot releases when the shared flight resolves).
             out, _ = self._query_flight.do(
                 self._query_flight_key(ctx, segments),
-                lambda: self._execute_admitted(ctx, segments))
+                lambda: self._execute_admitted(
+                    ctx, segments, admit_wait_ms=ticket.wait_ms))
             return out
         finally:
             self.admission.release(ticket)
@@ -301,9 +388,23 @@ class ServerQueryExecutor:
         return (id(ctx), tuple(id(s) for s in segments))
 
     def _execute_admitted(self, ctx: QueryContext,
-                          segments: List[ImmutableSegment]
+                          segments: List[ImmutableSegment],
+                          admit_wait_ms: float = 0.0
                           ) -> Tuple[ResultTable, QueryStats]:
-        stats = QueryStats(num_segments_queried=len(segments))
+        stats, token = self._open_query(ctx, segments, admit_wait_ms)
+        error = None
+        try:
+            return self._execute_traced(ctx, segments, stats)
+        except BaseException as e:
+            error = e
+            raise
+        finally:
+            self._close_query(stats, token, error=error)
+
+    def _execute_traced(self, ctx: QueryContext,
+                        segments: List[ImmutableSegment],
+                        stats: QueryStats
+                        ) -> Tuple[ResultTable, QueryStats]:
         if not segments:
             raise QueryError(f"no segments for table {ctx.table_name!r}")
         self._validate_columns(ctx, segments[0])
@@ -311,6 +412,8 @@ class ServerQueryExecutor:
         lease = self._begin_lease(ctx, segments, stats)
         try:
             if ctx.distinct:
+                record_decision(stats, "plan", "host_engine",
+                                "device_kernel", "distinct_host_only")
                 return (host_engine.execute_distinct(ctx, segments, stats),
                         stats)
             if ctx.is_selection:
@@ -339,12 +442,27 @@ class ServerQueryExecutor:
         existing combine merges; selection/distinct keep the old
         fit-or-spill admission. Host-only executors skip the protocol
         entirely (they stage nothing)."""
+        token = getattr(stats, "_registry_token", None)
+        if token is not None:
+            token["phase"] = "staging"
         if not self.use_device:
+            record_decision(stats, "backend", "host_engine", "device",
+                            "device_disabled")
             return None
         sliceable = not ctx.distinct and not ctx.is_selection
-        lease = self.residency.begin_query(segments,
-                                           ctx.referenced_columns(),
-                                           sliceable=sliceable)
+        with maybe_span(stats, "Lease", segments=len(segments)) as sp:
+            lease = self.residency.begin_query(segments,
+                                               ctx.referenced_columns(),
+                                               sliceable=sliceable)
+            if sp is not None:
+                sp.attrs.update(sliced=lease.sliced, spilled=lease.spilled,
+                                reason=lease.admit_reason)
+        if not lease.device_allowed:
+            record_decision(stats, "residency", "host_engine", "device",
+                            lease.admit_reason)
+        elif lease.sliced:
+            record_decision(stats, "residency", "sliced_device",
+                            "resident_device", lease.admit_reason)
         stats._staging_lease = lease
         return lease
 
@@ -419,6 +537,9 @@ class ServerQueryExecutor:
         budget slice — stage, execute, then unpin + demote-to-host before
         the next segment stages — so a working set far over the HBM budget
         still rides the device kernels one segment at a time."""
+        token = getattr(stats, "_registry_token", None)
+        if token is not None:
+            token["phase"] = "executing"
         lease = self._lease_of(stats)
         if lease is not None and lease.sliced:
             parts = []
@@ -429,11 +550,20 @@ class ServerQueryExecutor:
         if self.worker_threads <= 1 or len(segments) <= 1:
             return [fn(seg, stats) for seg in segments]
         pool = self._worker_pool()
+        traced = stats_tracer(stats) is not None
         locals_ = [QueryStats() for _ in segments]
         for st in locals_:  # the pin set must ride into worker threads
             st._staging_lease = lease
+            if traced:
+                # recorders are thread-confined: each worker records into
+                # its private stats; merge() below re-parents the
+                # finished spans under the caller's open span
+                start_trace(st)
         parts = pool.map(fn, segments, locals_)
         for st in locals_:
+            rec = stats_tracer(st)
+            if rec is not None:
+                rec.close_all()
             stats.merge(st)
         return parts
 
@@ -476,10 +606,12 @@ class ServerQueryExecutor:
                 plan = self._plan_for(ctx, seg)
                 return done(self._run_device_scalar(plan, seg, stats),
                             "device")
-            except PlanError:
-                pass
-        return done(host_engine.host_aggregate_segment(ctx, aggs, seg,
-                                                       stats), "host")
+            except PlanError as e:
+                record_decision(stats, "plan", "host_engine",
+                                "device_kernel", e.reason_code)
+        with maybe_span(stats, "HostScan", segment=seg.segment_name):
+            return done(host_engine.host_aggregate_segment(ctx, aggs, seg,
+                                                           stats), "host")
 
     def _selection(self, ctx: QueryContext,
                    segments: List[ImmutableSegment],
@@ -493,17 +625,23 @@ class ServerQueryExecutor:
                                      self._selection_kernels, stats)
             if table is not None:
                 return table
-        return host_engine.execute_selection(ctx, segments, stats)
+            record_decision(stats, "selection", "host_engine",
+                            "device_topk", "selection_not_device_eligible")
+        with maybe_span(stats, "HostSelection"):
+            return host_engine.execute_selection(ctx, segments, stats)
 
     def _star_tree_pick(self, ctx: QueryContext, aggs: List[AggDef],
-                        seg: ImmutableSegment):
+                        seg: ImmutableSegment, on_decline=None):
         """(tree, predicates) when a star-tree fits and the option allows
-        it, else None — the single gate for both executors."""
+        it, else None — the single gate for both executors.
+        ``on_decline`` receives the reason code when trees exist but none
+        fits (the decision ledger's hook)."""
         from pinot_tpu.engine import startree_exec
 
         if ctx.options.get("useStarTree", "true").lower() == "false":
-            return None
-        return startree_exec.pick_star_tree(ctx, aggs, seg)
+            return None  # operator opt-out, not a decline
+        return startree_exec.pick_star_tree(ctx, aggs, seg,
+                                            on_decline=on_decline)
 
     def _startree_kernel(self, spec: Tuple):
         """spec -> jitted star-tree node-slice kernel (LRU-capped)."""
@@ -531,11 +669,15 @@ class ServerQueryExecutor:
         walker — or None (no fit / untranslatable predicate -> scan)."""
         from pinot_tpu.engine import startree_device, startree_exec
 
-        pick = self._star_tree_pick(ctx, aggs, seg)
+        def declined(reason: str) -> None:
+            record_decision(stats, "startree", "scan", "startree", reason)
+
+        pick = self._star_tree_pick(ctx, aggs, seg, on_decline=declined)
         if pick is None:
             return None
         tree, preds = pick
-        matches = startree_exec.resolve_matches(seg, preds)
+        matches = startree_exec.resolve_matches(seg, preds,
+                                                on_decline=declined)
         if matches is None:
             return None  # predicate not dictId-translatable -> scan path
         if self.use_device and self._device_admitted(stats):
@@ -544,8 +686,10 @@ class ServerQueryExecutor:
                     self, ctx, aggs, seg, tree, matches, stats)
                 if res is not None:
                     return res, "startree_device"
-            except PlanError:
-                pass  # node plan over device limits -> host walker
+            except PlanError as e:
+                # node plan over device limits -> host walker
+                record_decision(stats, "startree", "startree_host",
+                                "startree_device", e.reason_code)
         res = startree_exec.execute_with_matches(ctx, aggs, seg, tree,
                                                  matches, stats)
         return None if res is None else (res, "startree")
@@ -615,11 +759,13 @@ class ServerQueryExecutor:
                 plan = self._plan_for(ctx, seg)
                 return done(self._run_device_grouped(plan, seg, stats),
                             "device")
-            except PlanError:
-                pass
+            except PlanError as e:
+                record_decision(stats, "plan", "host_engine",
+                                "device_kernel", e.reason_code)
         stats.group_by_rung = "host"
-        return done(host_engine.host_group_by_segment(ctx, aggs, seg,
-                                                      stats), "host")
+        with maybe_span(stats, "HostScan", segment=seg.segment_name):
+            return done(host_engine.host_group_by_segment(ctx, aggs, seg,
+                                                          stats), "host")
 
     def _plan_for(self, ctx: QueryContext, seg: ImmutableSegment):
         """plan_segment with an LRU keyed on (sql, segment); a reloaded
@@ -667,15 +813,25 @@ class ServerQueryExecutor:
 
         interpret = self._pallas_mode()
         if interpret is None:
+            record_decision(stats, "pallas", "jnp_kernel", "pallas_kernel",
+                            "pallas_disabled_on_backend")
             return None
         if plan.spec in self._pallas_blocked:
+            record_decision(stats, "pallas", "jnp_kernel", "pallas_kernel",
+                            "pallas_shape_blocked")
             return None
-        staged = self.residency.stage(seg, lease=self._lease_of(stats))
+        with maybe_span(stats, "Stage", segment=seg.segment_name):
+            staged = self.residency.stage(seg, lease=self._lease_of(stats))
+
+        def declined(reason: str) -> None:
+            record_decision(stats, "pallas", "jnp_kernel", "pallas_kernel",
+                            reason)
 
         def launch():
             packed = pallas_kernels.run_segment(plan, staged,
                                                 self.pallas_kernels,
-                                                interpret)
+                                                interpret,
+                                                on_decline=declined)
             return None if packed is None \
                 else unpack_outputs(packed, plan.spec)
 
@@ -685,8 +841,12 @@ class ServerQueryExecutor:
             # fused-kernel launch + ONE D2H; followers decode the shared
             # tree. id()-keying is sound because the leader's closure pins
             # both objects alive for the flight's lifetime.
-            out, _ = self._kernel_flight.do(
-                ("pallas", id(plan), id(staged)), launch)
+            with maybe_span(stats, "Kernel", kernel="pallas",
+                            segment=seg.segment_name) as sp:
+                out, _ = self._kernel_flight.do(
+                    ("pallas", id(plan), id(staged)), launch)
+                if sp is not None:
+                    sp.attrs["served"] = out is not None
         except Exception:  # lowering/compile failure -> jnp kernels
             import logging
 
@@ -697,6 +857,7 @@ class ServerQueryExecutor:
             # Mosaic-unlowerable shape must not cost every other query
             # its fused kernel
             self._pallas_blocked.add(plan.spec)
+            declined("pallas_exec_failed")
             return None
         if out is None:
             return None
@@ -708,7 +869,8 @@ class ServerQueryExecutor:
                     stats: QueryStats) -> Dict[str, Any]:
         from pinot_tpu.engine.kernels import unpack_outputs
 
-        staged = self.residency.stage(seg, lease=self._lease_of(stats))
+        with maybe_span(stats, "Stage", segment=seg.segment_name):
+            staged = self.residency.stage(seg, lease=self._lease_of(stats))
         has_validdocs = plan.spec[0][:1] == ("and",) \
             and plan.spec[0][1][0] == ("validdocs",)
 
@@ -730,7 +892,9 @@ class ServerQueryExecutor:
         # Upsert-managed plans are excluded — their valid mask advances
         # between calls, so two launches are NOT interchangeable.
         key = None if has_validdocs else ("seg", id(plan), id(staged))
-        out, _ = self._kernel_flight.do(key, launch)
+        with maybe_span(stats, "Kernel", kernel="jnp",
+                        segment=seg.segment_name):
+            out, _ = self._kernel_flight.do(key, launch)
         self._track_kernel_stats(out, seg, stats)
         return out
 

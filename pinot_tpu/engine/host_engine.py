@@ -178,6 +178,14 @@ def _track(stats: Optional[QueryStats], seg: ImmutableSegment,
 def execute_distinct(ctx: QueryContext, segments: List[ImmutableSegment],
                      stats: Optional[QueryStats] = None) -> ResultTable:
     """Ref: DistinctOperator + DistinctDataTableReducer."""
+    from pinot_tpu.common.tracing import maybe_span
+
+    with maybe_span(stats, "HostDistinct", segments=len(segments)):
+        return _execute_distinct(ctx, segments, stats)
+
+
+def _execute_distinct(ctx: QueryContext, segments: List[ImmutableSegment],
+                      stats: Optional[QueryStats] = None) -> ResultTable:
     schema = segments[0].metadata.schema
     select = _expand_select(ctx, schema)
     names = _select_names(ctx, select)

@@ -169,20 +169,30 @@ def _limbs_for(max_abs: int) -> int:
     return max(1, -(-max(max_abs.bit_length(), 1) // _LIMB_BITS))
 
 
-def extract_plan(plan, provider) -> Optional[PallasPlan]:
+def extract_plan(plan, provider, on_decline=None) -> Optional[PallasPlan]:
     """SegmentPlan -> PallasPlan, or None when the query shape isn't covered
     by the fused kernel. ``provider`` supplies column metadata (an
-    ImmutableSegment or a SegmentBatch with unified stats)."""
+    ImmutableSegment or a SegmentBatch with unified stats). ``on_decline``
+    (if given) receives the machine-readable reason code whenever None is
+    returned — the path-decision ledger's hook; every ineligibility is
+    classified, never ``unknown``."""
     from pinot_tpu.engine.kernels import _ParamCursor
     from pinot_tpu.engine.staging import staged_int_dtype
 
+    def decline(reason: str) -> None:
+        if on_decline is not None:
+            on_decline(reason)
+
     filter_spec, agg_specs, group_specs, num_groups, _ = plan.spec
     if group_specs and num_groups > MAX_PALLAS_GROUPS:
+        decline("pallas_too_many_groups")
         return None
     if any(a[0] in ("distinctcount", "distinctcounthll")
            for a in agg_specs):
+        decline("pallas_distinct_agg")
         return None  # 3-tuple specs (col, card/log2m) — jnp path serves
     if provider.metadata.num_docs > _I32_MAX:
+        decline("pallas_docs_over_i32")
         return None  # count/carry-chain bounds assume i32 doc counts
 
     try:
@@ -335,7 +345,16 @@ def extract_plan(plan, provider) -> Optional[PallasPlan]:
         # the cursor to the end (an unconsumed tail is pack/unpack drift,
         # not ineligibility — let the AssertionError propagate)
         pc.finish()
-    except _Ineligible:
+    except _Ineligible as e:
+        from pinot_tpu.common.tracing import classify_decline
+
+        reason = classify_decline(str(e))
+        if not reason.startswith("pallas_"):
+            # messages raised with bare op names (filter/agg ops outside
+            # the covered set) classify through the generic fallback;
+            # namespace them so the histogram reads per decision point
+            reason = f"pallas_{reason}"
+        decline(reason)
         return None
 
     params = np.asarray([v for lo, hi in intervals for v in (lo, hi)],
@@ -718,13 +737,18 @@ def assemble_outputs(plan_spec: Tuple, spec: PallasSpec, out_f, out_i, out_mm,
 # --------------------------------------------------------------------------
 
 def run_segment(plan, staged: StagedSegment, cache: PallasKernelCache,
-                interpret: bool):
+                interpret: bool, on_decline=None):
     """Run the fused kernel over one staged segment; returns the PACKED f64
     output vector (kernels.pack_outputs layout, single D2H fetch) or None
-    when the plan/staging isn't eligible."""
+    when the plan/staging isn't eligible (``on_decline`` receives the
+    reason code, same contract as ``extract_plan``)."""
     from pinot_tpu.engine.kernels import pack_outputs
 
-    pp = extract_plan(plan, staged.segment)
+    def decline(reason: str) -> None:
+        if on_decline is not None:
+            on_decline(reason)
+
+    pp = extract_plan(plan, staged.segment, on_decline=on_decline)
     if pp is None:
         return None
 
@@ -733,6 +757,7 @@ def run_segment(plan, staged: StagedSegment, cache: PallasKernelCache,
     for nm in pp.packed_names:
         pc = staged.packed_column(nm)
         if pc is None:
+            decline("pallas_column_not_packable")
             return None
         bits.append(pc.bits)
         W = PALLAS_TILE // pc.vals_per_word
@@ -741,6 +766,7 @@ def run_segment(plan, staged: StagedSegment, cache: PallasKernelCache,
     for nm in pp.value_names:
         v = staged.value_column(nm)
         if v is None or v.dtype not in (jnp.float32, jnp.int32):
+            decline("pallas_value_layout_unsupported")
             return None
         value_cols.append(v.reshape(1, -1, PALLAS_TILE // 128, 128))
 

@@ -125,7 +125,25 @@ class SegmentPlan:
 
 
 class PlanError(UnsupportedQueryError):
-    """Query shape the device kernels don't cover -> host fallback."""
+    """Query shape the device kernels don't cover -> host fallback.
+
+    Every PlanError carries a machine-readable ``reason_code`` for the
+    path-decision ledger (common/tracing.py): pass ``reason=`` at the
+    raise site or rely on the message classifier — either way a decline
+    is never ``unknown`` (the bench gates on that)."""
+
+    def __init__(self, message: str, reason: Optional[str] = None):
+        super().__init__(message)
+        self._reason = reason
+
+    @property
+    def reason_code(self) -> str:
+        if self._reason is not None:
+            return self._reason
+        from pinot_tpu.common.tracing import classify_decline
+
+        self._reason = classify_decline(str(self))
+        return self._reason
 
 
 # --------------------------------------------------------------------------

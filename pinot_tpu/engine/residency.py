@@ -197,12 +197,17 @@ class QueryLease:
 
     __slots__ = ("device_allowed", "sliced", "spilled", "hits", "misses",
                  "evictions", "pin_blocked", "promotions", "demotions",
-                 "slices", "_pinned", "_est")
+                 "slices", "admit_reason", "_pinned", "_est")
 
     def __init__(self, device_allowed: bool = True):
         self.device_allowed = device_allowed
         self.sliced = False
         self.spilled = not device_allowed
+        # machine-readable admission outcome for the path-decision ledger
+        # ("fits" | "working_set_over_budget_sliceable" |
+        #  "single_segment_over_budget" |
+        #  "working_set_over_budget_not_sliceable")
+        self.admit_reason = "fits"
         self.hits = 0
         self.misses = 0
         self.evictions = 0
@@ -765,6 +770,7 @@ class ResidencyManager:
                     budget, other_pinned)
                 lease = QueryLease(device_allowed=True)
                 lease.sliced = True
+                lease.admit_reason = "working_set_over_budget_sliceable"
                 lease._est = ests
                 return lease
             self.spills += 1
@@ -774,7 +780,12 @@ class ResidencyManager:
                 "budget %d B (%d B pinned elsewhere) and not sliceable; "
                 "spilling query to host engine", missing_est, reusable,
                 budget, other_pinned)
-            return QueryLease(device_allowed=False)
+            lease = QueryLease(device_allowed=False)
+            lease.admit_reason = (
+                "single_segment_over_budget"
+                if max_single + other_pinned > budget
+                else "working_set_over_budget_not_sliceable")
+            return lease
 
     def plan_slices(self, segments: List[Any], columns: Iterable[str],
                     lease: Optional[QueryLease] = None,

@@ -90,10 +90,24 @@ class QueryStats:
     # ServerQueryExecutorV1Impl.java:122,276,297,303); summed across
     # servers at reduce
     phase_ms: Dict[str, float] = field(default_factory=dict)
-    # request-scoped trace entries, populated only when the query sets
-    # trace=true (ref: TraceContext.java:46 — operator-level timings
-    # attached to the response metadata)
+    # request-scoped trace entries, populated only when the query is
+    # traced (trace=true / sample / slow-log force) — the legacy FLAT
+    # view, emitted from the span tree at each span close
+    # (ref: TraceContext.java:46 — operator-level timings attached to
+    # the response metadata)
     trace: List[Dict[str, Any]] = field(default_factory=list)
+    # hierarchical span trees (common/tracing.py SpanRecorder): completed
+    # root spans land here directly (the recorder's sink IS this list).
+    # Serialized on the DataTable wire; the broker re-parents each
+    # server's roots under its own root at reduce. Concat at merge —
+    # unless the merging stats has an OPEN span, in which case the merged
+    # trees nest under it (segment fan-out workers -> caller's combine)
+    spans: List[Dict[str, Any]] = field(default_factory=list)
+    # path-decision ledger (common/tracing.py record_decision): every
+    # decline of a faster rung, keyed "point:declined->chosen:reason",
+    # counts summed across segments/shards/servers at merge. Always on —
+    # declines are off the resident fast path, so the cost is nil
+    decisions: Dict[str, int] = field(default_factory=dict)
 
     def add_phase_ms(self, phase: str, ms: float) -> None:
         self.phase_ms[phase] = self.phase_ms.get(phase, 0.0) + ms
@@ -128,6 +142,17 @@ class QueryStats:
         for phase, ms in other.phase_ms.items():
             self.add_phase_ms(phase, ms)
         self.trace.extend(other.trace)
+        if other.spans:
+            rec = getattr(self, "_recorder", None)
+            if rec is not None:
+                # a live recorder with an open span adopts the merged
+                # trees as children (worker-thread partials nest under
+                # the caller's combine); otherwise they concat top-level
+                rec.adopt(other.spans)
+            else:
+                self.spans.extend(other.spans)
+        for k, v in other.decisions.items():
+            self.decisions[k] = self.decisions.get(k, 0) + v
 
     def to_dict(self) -> Dict[str, Any]:
         return {
@@ -145,6 +170,8 @@ class QueryStats:
             **({"staging": self.staging} if self.staging else {}),
             **({"launch": self.launch} if self.launch else {}),
             **({"trace": self.trace} if self.trace else {}),
+            **({"spans": self.spans} if self.spans else {}),
+            **({"decisions": self.decisions} if self.decisions else {}),
         }
 
 

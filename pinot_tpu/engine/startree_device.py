@@ -181,9 +181,14 @@ def execute_star_tree_device(executor, ctx: QueryContext,
     # concurrent identical dashboard queries — the SAME compiled ctx object
     # over the same staged tree — share one node-slice launch + D2H. The
     # walk/plan above stays per-caller (host work, query-private stats).
-    out, _ = executor._kernel_flight.do(
-        ("startree", id(ctx), segment.segment_name, tree_index, id(staged)),
-        launch)
+    from pinot_tpu.common.tracing import maybe_span
+
+    with maybe_span(stats, "Kernel", kernel="startree_device",
+                    segment=segment.segment_name, records=n):
+        out, _ = executor._kernel_flight.do(
+            ("startree", id(ctx), segment.segment_name, tree_index,
+             id(staged)),
+            launch)
 
     stats.num_segments_processed += 1
     stats.total_docs += segment.num_docs

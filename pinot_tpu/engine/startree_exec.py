@@ -76,35 +76,57 @@ def _pairs_needed(agg: AggDef, fn: Function) -> Optional[List[Tuple[str, str]]]:
 
 
 def pick_star_tree(ctx: QueryContext, aggs: List[AggDef],
-                   segment) -> Optional[Tuple[StarTree, List[Predicate]]]:
+                   segment, on_decline=None
+                   ) -> Optional[Tuple[StarTree, List[Predicate]]]:
     """Ref: StarTreeUtils.isFitForStarTree — first tree satisfying the
-    query, or None."""
+    query, or None. ``on_decline`` (if given) receives a machine-readable
+    reason code when the segment HAS trees but none fits — the
+    path-decision ledger's hook (a segment without trees is not a
+    decline). The reported reason is the first tree's, the configured
+    primary."""
+
+    def decline(reason: str):
+        if on_decline is not None:
+            on_decline(reason)
+        return None
+
     trees = getattr(segment, "star_trees", None)
     if not trees or not ctx.is_aggregation:
         return None
     if getattr(segment, "valid_doc_ids", None) is not None:
-        return None  # pre-agg records ignore upsert invalidation
+        # pre-agg records ignore upsert invalidation
+        return decline("startree_upsert_valid_docs")
     preds = _flatten_and(ctx.filter)
     if preds is None:
-        return None
+        return decline("startree_filter_or_not_shape")
     group_cols: List[str] = []
     for e in ctx.group_by:
         if not isinstance(e, Identifier):
-            return None
+            return decline("startree_group_expression")
         group_cols.append(e.name)
+
+    reason: Optional[str] = None
+
+    def note(r: str) -> None:
+        nonlocal reason
+        if reason is None:
+            reason = r
 
     for tree in trees:
         dims = set(tree.config.dimensions_split_order)
         if any(c not in dims for c in group_cols):
+            note("startree_group_off_split_order")
             continue
         ok = True
         for p in preds:
             if not isinstance(p.lhs, Identifier) or p.lhs.name not in dims:
+                note("startree_filter_non_dimension")
                 ok = False
                 break
             if p.type not in (PredicateType.EQ, PredicateType.IN,
                               PredicateType.NOT_EQ, PredicateType.NOT_IN,
                               PredicateType.RANGE):
+                note("startree_predicate_type_unsupported")
                 ok = False
                 break
         if not ok:
@@ -113,6 +135,9 @@ def pick_star_tree(ctx: QueryContext, aggs: List[AggDef],
         for agg, fn in zip(aggs, ctx.aggregations):
             ps = _pairs_needed(agg, fn)
             if ps is None:
+                # expression aggs (sum(a*b)) have no pre-agg pair — the
+                # Q1.x shape the ROADMAP names as the coverage gap
+                note("startree_expression_agg_no_pair")
                 needed = None
                 break
             needed.extend(ps)
@@ -120,24 +145,28 @@ def pick_star_tree(ctx: QueryContext, aggs: List[AggDef],
             continue
         if all(tree.has_pair(f, c) for f, c in needed):
             return tree, preds
-    return None
+        note("startree_missing_function_pair")
+    return decline(reason or "startree_no_fitting_tree")
 
 
 def _matching_ids(segment, pred: Predicate):
     """Predicate -> dictId match over the dimension's dictionary (reuses
     the host predicate evaluators): a set when small enough to materialize,
     a :class:`DictIdRange` when the ids are contiguous but over the cap
-    (the RANGE shape), None when neither fits (scan path serves)."""
+    (the RANGE shape), a reason STRING when neither fits (scan path
+    serves; the string feeds the decision ledger)."""
     from pinot_tpu.engine.host_eval import _matching_dict_ids
 
     ds = segment.data_source(pred.lhs.name)
     if ds.dictionary is None:
-        return None
+        return "startree_raw_dimension"
     ids = _matching_dict_ids(ds, pred)
     if len(ids) > _MAX_RANGE_IDS:
         if int(ids[-1]) - int(ids[0]) + 1 == len(ids):
             return DictIdRange(int(ids[0]), int(ids[-1]))
-        return None
+        # non-contiguous overflow (NOT_IN over a huge dictionary): the
+        # RANGE shape declines to a slice check, this cannot
+        return "startree_dictid_overflow_noncontiguous"
     return set(int(i) for i in ids)
 
 
@@ -152,14 +181,18 @@ def _intersect(a, b):
     return a & b
 
 
-def resolve_matches(segment, preds: List[Predicate]) -> Optional[Dict[str, Any]]:
+def resolve_matches(segment, preds: List[Predicate], on_decline=None
+                    ) -> Optional[Dict[str, Any]]:
     """AND-ed predicates -> per-dimension dictId match (set | DictIdRange),
     or None when a predicate cannot be translated (the caller falls back to
-    the scan path). Shared by the host walker and the device rung."""
+    the scan path; ``on_decline`` receives the reason code). Shared by the
+    host walker and the device rung."""
     matches: Dict[str, Any] = {}
     for p in preds:
         ids = _matching_ids(segment, p)
-        if ids is None:
+        if isinstance(ids, str):
+            if on_decline is not None:
+                on_decline(ids)
             return None
         col = p.lhs.name
         matches[col] = ids if col not in matches \

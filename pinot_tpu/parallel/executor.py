@@ -10,8 +10,6 @@ reference's plan-node selection (ref: InstancePlanMakerImplV2.java:227).
 
 from __future__ import annotations
 
-import time
-
 from typing import Dict, List, Optional, Tuple
 
 import jax.numpy as jnp
@@ -19,6 +17,11 @@ import numpy as np
 
 from jax.sharding import Mesh
 
+from pinot_tpu.common.tracing import (
+    maybe_span,
+    record_decision,
+    stats_tracer,
+)
 from pinot_tpu.engine.executor import (
     ServerQueryExecutor,
     decode_grouped_result,
@@ -142,10 +145,14 @@ class ShardedQueryExecutor(ServerQueryExecutor):
             try:
                 batch, out, plan = self._run_sharded(ctx, segments, stats)
                 return decode_scalar_result(plan, batch, out)
-            except (PlanError, ValueError):
+            except (PlanError, ValueError) as e:
                 # ValueError: segments not batchable (mixed layouts/schemas,
                 # batch.py) — the per-segment path still serves them
-                pass
+                record_decision(
+                    stats, "sharded_combine", "per_segment",
+                    "sharded_combine",
+                    e.reason_code if isinstance(e, PlanError)
+                    else "segments_not_batchable")
         return super()._execute_aggregation(ctx, aggs, segments, stats)
 
     def _execute_group_by(self, ctx, aggs, segments, stats):
@@ -160,8 +167,12 @@ class ShardedQueryExecutor(ServerQueryExecutor):
             try:
                 batch, out, plan = self._run_sharded(ctx, segments, stats)
                 return decode_grouped_result(plan, batch, out)
-            except (PlanError, ValueError):
-                pass
+            except (PlanError, ValueError) as e:
+                record_decision(
+                    stats, "sharded_combine", "per_segment",
+                    "sharded_combine",
+                    e.reason_code if isinstance(e, PlanError)
+                    else "segments_not_batchable")
         return super()._execute_group_by(ctx, aggs, segments, stats)
 
     def _execute_sliced(self, ctx, aggs, segments, stats, grouped: bool):
@@ -182,29 +193,35 @@ class ShardedQueryExecutor(ServerQueryExecutor):
         base = (ServerQueryExecutor._execute_group_by if grouped
                 else ServerQueryExecutor._execute_aggregation)
         if slices is None:
+            record_decision(stats, "sharded_combine", "per_segment_sliced",
+                            "sharded_sliced", "slice_pad_over_budget")
             return base(self, ctx, aggs, segments, stats)
         merged = GroupByResult() if grouped else None
-        for chunk in slices:
+        for i, chunk in enumerate(slices):
             part = None
-            if len(chunk) > 1:
-                try:
-                    batch, out, plan = self._run_sharded(ctx, chunk, stats)
-                    part = (decode_grouped_result(plan, batch, out)
-                            if grouped
-                            else decode_scalar_result(plan, batch, out))
-                except (PlanError, ValueError):
-                    part = None  # per-segment path serves this slice
-            if part is None:
-                part = base(self, ctx, aggs, chunk, stats)
-            if grouped:
-                merged.merge(part, aggs)
-            elif merged is None:
-                merged = part
-            else:
-                merged.merge(part, aggs)
-            # slice boundary: unpin + demote so the next slice fits; a
-            # repeat pass over the same data promotes from the host tier
-            self.residency.release_slice(lease)
+            with maybe_span(stats, "Slice", index=i,
+                            segments=len(chunk)):
+                if len(chunk) > 1:
+                    try:
+                        batch, out, plan = self._run_sharded(ctx, chunk,
+                                                             stats)
+                        part = (decode_grouped_result(plan, batch, out)
+                                if grouped
+                                else decode_scalar_result(plan, batch, out))
+                    except (PlanError, ValueError):
+                        part = None  # per-segment path serves this slice
+                if part is None:
+                    part = base(self, ctx, aggs, chunk, stats)
+                if grouped:
+                    merged.merge(part, aggs)
+                elif merged is None:
+                    merged = part
+                else:
+                    merged.merge(part, aggs)
+                # slice boundary: unpin + demote so the next slice fits; a
+                # repeat pass over the same data promotes from the host
+                # tier
+                self.residency.release_slice(lease)
         return merged
 
     # -- sharded execution ---------------------------------------------------
@@ -326,19 +343,72 @@ class ShardedQueryExecutor(ServerQueryExecutor):
                     self._launch_cache.move_to_end(launch_key)
         if cached is None:
             plan = plan_segment(ctx, batch)
-            kernel, params = self._bind_launch(plan, batch, S)
+            kernel, params = self._bind_launch(plan, batch, S, stats)
             self._remember(pkey, plan, kernel, params)
         elif kernel is None:
             # launch tier evicted under this param entry: rebind (the plan
             # is in hand, so this costs a kernel-cache lookup, not a replan)
-            kernel, params = self._bind_launch(plan, batch, S)
+            kernel, params = self._bind_launch(plan, batch, S, stats)
             self._remember(pkey, plan, kernel, params)
         num_docs = self._device_num_docs(batch, S)
 
-        trace_on = ctx.trace_enabled
-        t0 = time.perf_counter() if trace_on else 0.0
+        # span covers dispatcher queue + launch + D2H; the queue-vs-work
+        # split comes from the launch request's measured queue wait
+        rec = stats_tracer(stats)
+        sp = rec.span_begin("ShardedCombine") if rec is not None else None
+        req_out: list = []
+        try:
+            out = self._launch_sharded(pkey, plan, batch, S, kernel, params,
+                                       num_docs, stats, req_out)
+        finally:
+            if sp is not None:
+                req = req_out[-1] if req_out else None
+                rec.span_end(
+                    sp,
+                    queue_ms=(round(req.queue_wait_ms, 3)
+                              if req is not None else None),
+                    kernel="pallas" if req is not None
+                    and req.kernel.is_pallas else "jnp",
+                    segments=batch.num_segments,
+                    batch_size=req.batch_size if req is not None else 0,
+                    mesh=f"{self.mesh.shape[SEG_AXIS]}x"
+                         f"{self.mesh.shape[DOC_AXIS]}")
+
+        # arrays were staged above: re-measure the resident and enforce the
+        # budget now rather than waiting for end_query
+        self.residency.account(bkey, lease)
+        # estimate-drift feedback for the batch path: the admission/slice
+        # estimates were per-segment sums; the measured batch bytes (incl.
+        # the mesh seg-axis pad) are the truth slicing should pick k from
+        # on the next pass
+        if lease is not None and lease._est:
+            est = sum(lease._est.get(s.segment_name, 0) for s in segments)
+            measured = self.residency.resident_nbytes(bkey)
+            if est > 0 and measured > 0:
+                self.residency.observe_estimate(est, measured)
+
+        stats.num_segments_processed += batch.num_segments
+        stats.total_docs += batch.num_docs
+        seg_matched = out["seg_matched"][:batch.num_segments]
+        stats.num_docs_scanned += int(seg_matched.sum())
+        stats.num_segments_matched += int((seg_matched > 0).sum())
+        if plan.spec[2]:  # grouped: record the ladder rung that served
+            rung = grouped_rung(plan.spec, out)
+            stats.group_by_rung = (rung if stats.group_by_rung
+                                   in (None, rung) else "mixed")
+        return batch, out, plan
+
+    def _launch_sharded(self, pkey, plan, batch, S, kernel, params,
+                        num_docs, stats, req_out):
+        """Dispatch through the launch scheduler with the pallas->jnp
+        repair path; returns the unpacked output tree and appends the
+        final launch request to ``req_out`` (the span above reads its
+        queue wait)."""
+        from pinot_tpu.engine.kernels import unpack_outputs
+
         try:
             req = self.launcher.submit(kernel, params, num_docs)
+            req_out.append(req)
             packed = req.result()
         except (PlanError, ValueError):
             raise
@@ -369,9 +439,12 @@ class ShardedQueryExecutor(ServerQueryExecutor):
             with self._cache_lock:
                 self._param_cache.pop(pkey, None)
                 self._launch_cache.pop(kernel.key, None)
+            record_decision(stats, "pallas", "jnp_combine",
+                            "pallas_combine", "pallas_exec_failed")
             kernel, params = self._bind_jnp(plan, batch, S)
             self._remember(pkey, plan, kernel, params)
             req = self.launcher.submit(kernel, params, num_docs)
+            req_out.append(req)
             packed = req.result()
         # coalescing outcome -> per-query stats (merged across shards and
         # servers; see QueryStats.merge for the sum-vs-max key split).
@@ -393,39 +466,7 @@ class ShardedQueryExecutor(ServerQueryExecutor):
         else:
             stats.launch = cur
         # ONE D2H fetch decodes the entire query result
-        out = unpack_outputs(packed, plan.spec, num_seg=S)
-        if trace_on:
-            stats.add_trace(
-                "ShardedCombine", (time.perf_counter() - t0) * 1e3,
-                kernel="pallas" if kernel.is_pallas else "jnp",
-                segments=batch.num_segments,
-                batch_size=req.batch_size,
-                mesh=f"{self.mesh.shape[SEG_AXIS]}x"
-                     f"{self.mesh.shape[DOC_AXIS]}")
-
-        # arrays were staged above: re-measure the resident and enforce the
-        # budget now rather than waiting for end_query
-        self.residency.account(bkey, lease)
-        # estimate-drift feedback for the batch path: the admission/slice
-        # estimates were per-segment sums; the measured batch bytes (incl.
-        # the mesh seg-axis pad) are the truth slicing should pick k from
-        # on the next pass
-        if lease is not None and lease._est:
-            est = sum(lease._est.get(s.segment_name, 0) for s in segments)
-            measured = self.residency.resident_nbytes(bkey)
-            if est > 0 and measured > 0:
-                self.residency.observe_estimate(est, measured)
-
-        stats.num_segments_processed += batch.num_segments
-        stats.total_docs += batch.num_docs
-        seg_matched = out["seg_matched"][:batch.num_segments]
-        stats.num_docs_scanned += int(seg_matched.sum())
-        stats.num_segments_matched += int((seg_matched > 0).sum())
-        if plan.spec[2]:  # grouped: record the ladder rung that served
-            rung = grouped_rung(plan.spec, out)
-            stats.group_by_rung = (rung if stats.group_by_rung
-                                   in (None, rung) else "mixed")
-        return batch, out, plan
+        return unpack_outputs(packed, plan.spec, num_seg=S)
 
     def _remember(self, pkey: Tuple, plan: SegmentPlan, kernel, params
                   ) -> None:
@@ -458,14 +499,17 @@ class ShardedQueryExecutor(ServerQueryExecutor):
                     self._launch_cache.popitem(last=False)
             return kernel
 
-    def _bind_launch(self, plan: SegmentPlan, batch: SegmentBatch, S: int):
+    def _bind_launch(self, plan: SegmentPlan, batch: SegmentBatch, S: int,
+                     stats: Optional[QueryStats] = None):
         """-> (LaunchKernel, device params): fused Pallas when eligible,
         jnp masked-vector combine otherwise. The kernel is shared across
         literals (its key is the literal-normalized plan fingerprint);
         the params are this query's runtime arrays, committed to device
         once (per-call H2D uploads are tunnel roundtrips the serving path
-        cannot afford)."""
-        bound = self._bind_pallas(plan, batch, S)
+        cannot afford). Binding happens once per shape (cache miss), so
+        the pallas decline recorded here is the per-shape decision — NOT
+        re-counted on every repeat query."""
+        bound = self._bind_pallas(plan, batch, S, stats)
         if bound is not None:
             return bound
         return self._bind_jnp(plan, batch, S)
@@ -497,10 +541,13 @@ class ShardedQueryExecutor(ServerQueryExecutor):
             tuple(plan.params), NamedSharding(self.mesh, P()))
         return kernel, params
 
-    def _bind_pallas(self, plan: SegmentPlan, batch: SegmentBatch, S: int):
+    def _bind_pallas(self, plan: SegmentPlan, batch: SegmentBatch, S: int,
+                     stats: Optional[QueryStats] = None):
         """(LaunchKernel, device params) via the sharded fused Pallas
         kernel (VERDICT r3 item 2: the flagship kernel serves the combine
-        path), or None when the plan/backing isn't eligible."""
+        path), or None when the plan/backing isn't eligible — every None
+        records its reason on the decision ledger (the "why is
+        pallas_kernels 0" forensics the BENCH rounds were missing)."""
         import logging
 
         from dataclasses import replace
@@ -512,12 +559,18 @@ class ShardedQueryExecutor(ServerQueryExecutor):
         from pinot_tpu.engine.pallas_kernels import extract_plan
         from pinot_tpu.parallel.combine import build_sharded_pallas_kernel
 
+        def declined(reason: str) -> None:
+            record_decision(stats, "pallas", "jnp_combine",
+                            "pallas_combine", reason)
+
         interpret = self._pallas_mode()
         if interpret is None:
+            declined("pallas_disabled_on_backend")
             return None
         if plan.spec in self._pallas_blocked:
+            declined("pallas_shape_blocked")
             return None
-        pp = extract_plan(plan, batch)
+        pp = extract_plan(plan, batch, on_decline=declined)
         if pp is None:
             return None
         n_seg = self.mesh.shape[SEG_AXIS]
@@ -528,6 +581,7 @@ class ShardedQueryExecutor(ServerQueryExecutor):
             for nm in pp.packed_names:
                 staged = self._staged_pallas(batch, nm, S, "packed")
                 if staged is None:
+                    declined("pallas_column_not_packable")
                     return None
                 packed_cols.append(staged[0])
                 bits.append(staged[1])
@@ -535,6 +589,7 @@ class ShardedQueryExecutor(ServerQueryExecutor):
             for nm in pp.value_names:
                 staged = self._staged_pallas(batch, nm, S, "value")
                 if staged is None:
+                    declined("pallas_value_layout_unsupported")
                     return None
                 value_cols.append(staged)
             spec = replace(
@@ -564,6 +619,7 @@ class ShardedQueryExecutor(ServerQueryExecutor):
         except Exception:
             logging.getLogger(__name__).exception(
                 "sharded pallas build failed; using jnp combine")
+            declined("pallas_build_failed")
             return None
         return kernel, params
 

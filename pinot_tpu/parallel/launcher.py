@@ -340,6 +340,15 @@ class LaunchScheduler:
                             "disabling coalescing for this kernel",
                             kernel.key[:2])
                         kernel.batchable = False
+                        # path-decision ledger: a kernel degrading to
+                        # serial launches is a throughput decline worth
+                        # explaining (no per-query stats on the
+                        # dispatcher thread — the process histogram
+                        # carries it)
+                        from pinot_tpu.common.tracing import record_decision
+
+                        record_decision(None, "launch", "serial_launches",
+                                        "vmap_batch", "vmap_failed")
                 for j, p in enumerate(chunk):
                     try:
                         outs[start + j] = kernel.run_one(p, num_docs)

@@ -147,9 +147,19 @@ class QueryContext:
 
     @property
     def trace_enabled(self) -> bool:
-        """OPTION(trace=true) — request-scoped tracing
-        (ref: trace flag at BaseBrokerRequestHandler)."""
+        """OPTION(trace=true) — request-scoped tracing: the query records
+        a full lifecycle span tree (common/tracing.py) returned in
+        ``traceInfo`` (ref: trace flag at BaseBrokerRequestHandler).
+        Untraced queries may still be sampled server-side via
+        ``pinot.server.query.trace.sample``."""
         return self.options.get("trace", "").lower() == "true"
+
+    @property
+    def request_id(self) -> Optional[str]:
+        """OPTION(requestId=...) — client-supplied correlation id,
+        surfaced in ``/debug/queries`` and the slow-query log (ref: the
+        requestId threaded through BaseBrokerRequestHandler)."""
+        return self.options.get("requestId")
 
     def __str__(self) -> str:
         return (f"QueryContext(table={self.table_name}, "

@@ -40,13 +40,16 @@ def _auto_concurrent() -> int:
 
 
 class _Ticket:
-    """One admission; ``release`` through the gate is idempotent."""
+    """One admission; ``release`` through the gate is idempotent.
+    ``wait_ms`` is the queue wait this admission paid — the tracing
+    layer's queue-vs-work attribution at the admission level."""
 
-    __slots__ = ("released", "gated")
+    __slots__ = ("released", "gated", "wait_ms")
 
-    def __init__(self, gated: bool):
+    def __init__(self, gated: bool, wait_ms: float = 0.0):
         self.released = False
         self.gated = gated
+        self.wait_ms = wait_ms
 
 
 class AdmissionGate:
@@ -147,6 +150,7 @@ class AdmissionGate:
             return _Ticket(gated=False)
         t0 = time.monotonic()
         reject: Optional[Any] = None
+        wait_ms = 0.0
         with self._cond:
             if self._inflight >= self._slots \
                     and self._waiting >= self._max_queue:
@@ -192,7 +196,7 @@ class AdmissionGate:
             self._mark("ADMISSION_REJECTED")
             raise QueryRejectedError(msg, queue_depth=depth, reason=reason)
         self._mark("ADMISSION_ADMITTED")
-        return _Ticket(gated=True)
+        return _Ticket(gated=True, wait_ms=wait_ms)
 
     def release(self, ticket: Optional[_Ticket]) -> None:
         """Free the ticket's slot (idempotent; None is a no-op so error

@@ -119,13 +119,29 @@ class QueryScheduler:
                 self._inflight -= 1
                 self._drained.notify_all()
 
+        t_submit = time.perf_counter()
+
         def run():
+            self._note_wait((time.perf_counter() - t_submit) * 1e3)
             try:
                 return fn()
             finally:
                 done()
 
         return self._pool.submit(run, on_skip=done)
+
+    def _note_wait(self, wait_ms: float) -> None:
+        """Scheduler-queue wait accounting — the queue half of the
+        queue-vs-work attribution at the scheduler level (the span tree's
+        SchedulerQueue spans carry the per-query value; these totals feed
+        ``/debug/scheduler``). Lazily-initialized so subclasses that own
+        their queues (priority/SEWF) share it without base ``__init__``."""
+        with self._lock:
+            self.queue_waits = getattr(self, "queue_waits", 0) + 1
+            self.queue_wait_ms_total = \
+                getattr(self, "queue_wait_ms_total", 0.0) + wait_ms
+            if wait_ms > getattr(self, "queue_wait_ms_max", 0.0):
+                self.queue_wait_ms_max = wait_ms
 
     def queue_depth(self) -> int:
         return self._pool.qsize()
@@ -134,10 +150,16 @@ class QueryScheduler:
         """``/debug/scheduler`` body: live policy/queue/in-flight state."""
         with self._lock:
             inflight = self._inflight
+            waits = getattr(self, "queue_waits", 0)
+            wait_total = getattr(self, "queue_wait_ms_total", 0.0)
+            wait_max = getattr(self, "queue_wait_ms_max", 0.0)
         return {"policy": type(self).__name__,
                 "workers": self.num_workers,
                 "inflight": inflight,
-                "queued": self.queue_depth()}
+                "queued": self.queue_depth(),
+                "queueWaits": waits,
+                "queueWaitMsTotal": round(wait_total, 3),
+                "queueWaitMsMax": round(wait_max, 3)}
 
     def shutdown(self, timeout_s: float = 30.0) -> None:
         """Disable new queries, drain in-flight ones
@@ -384,6 +406,7 @@ class SewfScheduler(QueryScheduler):
             if entry is None:
                 continue
             _t_enq, shape, fut, fn = entry
+            self._note_wait((time.monotonic() - _t_enq) * 1e3)
             if not fut.set_running_or_notify_cancel():
                 self._done(shape, None)  # cancelled while queued
                 continue
@@ -437,7 +460,12 @@ class SewfScheduler(QueryScheduler):
                     "queued": len(self._pending),
                     "shapesTracked": len(self._ewma_ms),
                     "starvationBoosts": self.starvation_boosts,
-                    "agingBoost": self.aging_boost}
+                    "agingBoost": self.aging_boost,
+                    "queueWaits": getattr(self, "queue_waits", 0),
+                    "queueWaitMsTotal": round(
+                        getattr(self, "queue_wait_ms_total", 0.0), 3),
+                    "queueWaitMsMax": round(
+                        getattr(self, "queue_wait_ms_max", 0.0), 3)}
 
     def shutdown(self, timeout_s: float = 30.0) -> None:
         with self._lock:

@@ -89,6 +89,11 @@ class ServerInstance:
         admission = getattr(self.executor, "admission", None)
         if admission is not None:
             admission.bind_metrics(self.metrics)
+        # path-decision ledger -> /metrics: every decline of a faster
+        # rung becomes a decision_declined_total_* counter
+        from pinot_tpu.common.tracing import LEDGER
+
+        LEDGER.bind_metrics(self.metrics)
         self.segment_dir = segment_dir
         self.consumer_tick_s = consumer_tick_s
         self._started = False
@@ -414,6 +419,16 @@ class ServerInstance:
             # TimerContext values at ServerQueryExecutorV1Impl:122-303)
             dt.stats.add_phase_ms(ServerQueryPhase.SCHEDULER_WAIT, wait_ms)
             dt.stats.add_phase_ms(ServerQueryPhase.QUERY_EXECUTION, exec_ms)
+            if dt.stats.spans:
+                # scheduler-queue wait happened before the executor's
+                # span tree opened; retroactively attribute it as the
+                # root's FIRST child (pure queue time) so the tree
+                # accounts the full server-side lifecycle
+                from pinot_tpu.common.tracing import attach_root_child
+
+                attach_root_child(dt.stats, "SchedulerQueue",
+                                  wall_ms=wait_ms, queue_ms=wait_ms,
+                                  front=True)
             self.metrics.timer(
                 ServerQueryPhase.QUERY_EXECUTION).update_ms(exec_ms)
             self.metrics.meter(ServerMeter.DOCS_SCANNED).mark(
@@ -562,6 +577,19 @@ class ServerInstance:
         qflight = getattr(self.executor, "_query_flight", None)
         if qflight is not None:
             out["queryFlight"] = qflight.snapshot()
+        return out
+
+    def queries_debug(self) -> Dict[str, Any]:
+        """``GET /debug/queries``: currently-running queries (id, sql,
+        phase, elapsed, pins held), the completed ring buffer, and the
+        slow-query log — full span trees retained for over-threshold
+        queries even when trace/sampling missed them
+        (``pinot.server.query.slow.threshold.ms``)."""
+        registry = getattr(self.executor, "queries", None)
+        if registry is None:
+            return {"enabled": False}
+        out: Dict[str, Any] = {"instance": self.instance_id}
+        out.update(registry.snapshot())
         return out
 
     def memory_debug(self) -> Dict[str, Any]:

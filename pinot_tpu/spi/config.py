@@ -203,3 +203,18 @@ class CommonConstants:
     DEFAULT_ADMISSION_MAX_QUEUE = 0
     ADMISSION_MAX_WAIT_MS_KEY = "pinot.server.query.admission.max.wait.ms"
     DEFAULT_ADMISSION_MAX_WAIT_MS = 10_000.0
+    # Query lifecycle tracing (common/tracing.py): span trees are
+    # recorded when the request carries OPTION(trace=true) OR this sample
+    # rate (0..1) hits — sampled traces ship in the response exactly like
+    # requested ones. 0 (the default) keeps the untraced path at its
+    # zero-allocation cost.
+    TRACE_SAMPLE_KEY = "pinot.server.query.trace.sample"
+    DEFAULT_TRACE_SAMPLE = 0.0
+    # Slow-query log (/debug/queries): a query over this wall-time
+    # threshold retains its FULL span tree in the server's slow log even
+    # when trace/sampling missed it — while the threshold is configured,
+    # the executor records spans for every query and ships them only for
+    # traced ones. 0 (the default) disables the forced recording so the
+    # serving path stays span-free.
+    SLOW_THRESHOLD_MS_KEY = "pinot.server.query.slow.threshold.ms"
+    DEFAULT_SLOW_THRESHOLD_MS = 0.0

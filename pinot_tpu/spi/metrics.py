@@ -201,3 +201,21 @@ class ServerQueryPhase:
     SCHEDULER_WAIT = "SCHEDULER_WAIT"
     SEGMENT_PRUNING = "SEGMENT_PRUNING"
     QUERY_EXECUTION = "QUERY_EXECUTION"
+
+
+_METRIC_SAFE = None
+
+
+def decision_meter_name(point: str, reason: str) -> str:
+    """Meter name for one path-decision histogram cell (the decision
+    ledger's /metrics surface, common/tracing.py DecisionLedger): reason
+    codes are already snake_case, but defend against stray characters —
+    prometheus names admit only [a-zA-Z0-9_:]."""
+    global _METRIC_SAFE
+    if _METRIC_SAFE is None:
+        import re
+
+        _METRIC_SAFE = re.compile(r"[^a-zA-Z0-9_]+")
+    p = _METRIC_SAFE.sub("_", point)
+    r = _METRIC_SAFE.sub("_", reason)
+    return f"decision_declined_total_{p}_{r}"

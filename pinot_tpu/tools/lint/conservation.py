@@ -41,6 +41,17 @@ caught:
   must be read by ``nbytes()`` AND cleared by ``release()`` — a staged
   cache that accounting cannot see, or that eviction cannot drop, is the
   tiered-storage follow-up's landmine.
+- **spanpair** (every function, package-wide): a ``span_begin(...)`` call
+  must reach a ``span_end`` mentioning its holder on ALL paths including
+  exception edges (the hostacct machinery over the same CFG) — an open
+  span that never closes corrupts the query's trace tree AND pins its
+  attribute payload for the query lifetime. Discharges: a ``span_end``
+  call naming the holder, returning the holder (the caller owns the
+  close), storing it on an attribute (a teardown hook owns it), or a
+  nested function that closes it (the done-callback shape). A bare
+  ``span_begin`` whose result is discarded can never be closed and is
+  flagged outright. ``with recorder.span(...)`` creates no obligation —
+  the context manager self-closes.
 """
 
 from __future__ import annotations
@@ -493,16 +504,184 @@ def check_conservation(ctx: LintContext) -> List[Finding]:
     findings: List[Finding] = []
     for mod in ctx.modules:
         for node in ast.walk(mod.tree):
-            if not isinstance(node, ast.ClassDef):
-                continue
-            methods = {n.name for n in node.body
-                       if isinstance(n, (ast.FunctionDef,
-                                         ast.AsyncFunctionDef))}
-            if "_release_all" in methods:
-                _check_manager(mod, node, findings)
-            if "nbytes" in methods and "release" in methods:
-                _check_cache_parity(mod, node, findings)
+            if isinstance(node, ast.ClassDef):
+                methods = {n.name for n in node.body
+                           if isinstance(n, (ast.FunctionDef,
+                                             ast.AsyncFunctionDef))}
+                if "_release_all" in methods:
+                    _check_manager(mod, node, findings)
+                if "nbytes" in methods and "release" in methods:
+                    _check_cache_parity(mod, node, findings)
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                _check_spanpair(mod, node, findings)
     return findings
+
+
+# --------------------------------------------------------------------------
+# spanpair: span_begin must reach span_end on all paths (exception edges
+# included) — the trace-tree integrity obligation
+# --------------------------------------------------------------------------
+
+def _call_last_name(n: ast.Call) -> str:
+    f = n.func
+    if isinstance(f, ast.Attribute):
+        return f.attr
+    if isinstance(f, ast.Name):
+        return f.id
+    return ""
+
+
+def _names_in(nodes) -> Set[str]:
+    out: Set[str] = set()
+    for node in nodes:
+        for x in ast.walk(node):
+            if isinstance(x, ast.Name):
+                out.add(x.id)
+    return out
+
+
+class _SpanPairAnalysis:
+    """Forward obligation analysis over one function: every span_begin
+    assigned to a local must meet a span_end naming it on every path to
+    exit — the same CFG/exception-edge machinery the hostacct obligation
+    uses, scoped package-wide (spans open anywhere)."""
+
+    def __init__(self, fn: ast.AST):
+        self.fn = fn
+        self.immediate: List[int] = []
+        self.obligation_lines: Dict[Tuple, int] = {}
+
+    def transfer(self, state: _State, st: Optional[ast.AST],
+                 nid: int) -> _State:
+        if st is None or not isinstance(st, ast.stmt):
+            return state
+        out: _State = dict(state)
+        all_holders = frozenset(
+            h for (p, hs) in out.values() if p for h in hs)
+
+        # discharges
+        ended: Set[str] = set()
+        for n in stmt_scan(st):
+            if isinstance(n, ast.Call) \
+                    and _call_last_name(n) == "span_end":
+                ended |= _names_in(list(n.args)
+                                   + [k.value for k in n.keywords])
+        if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # done-callback shape: a nested function owning the close
+            # discharges at its def (the closure pins the span until then)
+            for n in ast.walk(st):
+                if isinstance(n, ast.Call) \
+                        and _call_last_name(n) == "span_end":
+                    ended |= _names_in(list(n.args)
+                                       + [k.value for k in n.keywords])
+        returned: Set[str] = set()
+        if isinstance(st, ast.Return) and st.value is not None:
+            returned = _names_in([st.value])
+        stored_names: Set[str] = set()
+        if all_holders:
+            for n in stmt_scan(st):
+                if isinstance(n, ast.Assign) \
+                        and any(isinstance(t, ast.Attribute)
+                                for t in n.targets):
+                    stored_names |= _names_in([n.value]) & all_holders
+        for oid, (p, hs) in list(out.items()):
+            if p and hs & (ended | returned | stored_names):
+                out[oid] = (False, hs)
+
+        # kills: rebinding a holder to something unrelated
+        if isinstance(st, ast.Assign) and len(st.targets) == 1 \
+                and isinstance(st.targets[0], ast.Name) \
+                and not _mentions(st.value, all_holders):
+            dead = st.targets[0].id
+            for oid, (p, hs) in list(out.items()):
+                if dead in hs:
+                    out[oid] = (p, hs - {dead})
+
+        # new obligations
+        for n in stmt_scan(st):
+            if not (isinstance(n, ast.Call)
+                    and _call_last_name(n) == "span_begin"):
+                continue
+            holders: Set[str] = set()
+            if isinstance(st, ast.Assign):
+                for t in st.targets:
+                    if isinstance(t, ast.Name):
+                        holders.add(t.id)
+            elif isinstance(st, ast.AnnAssign) \
+                    and isinstance(st.target, ast.Name):
+                holders.add(st.target.id)
+            elif isinstance(st, ast.Return):
+                continue  # returned to the caller: it owns the close
+            if holders:
+                oid = ("span", n.lineno, n.col_offset)
+                out.setdefault(oid, (True, frozenset(holders)))
+                self.obligation_lines[oid] = n.lineno
+            elif isinstance(st, ast.Expr):
+                # bare call, result discarded: can never be closed
+                self.immediate.append(n.lineno)
+            # attribute-target assigns fall through obligation-free: the
+            # span escaped to object state, a teardown hook owns it
+        return out
+
+    def run(self) -> List[int]:
+        cfg = build_cfg(self.fn)
+
+        def join(a: _State, b: _State) -> _State:
+            out = dict(a)
+            for oid, (p, h) in b.items():
+                if oid in out:
+                    p0, h0 = out[oid]
+                    out[oid] = (p or p0, h0 | h)
+                else:
+                    out[oid] = (p, h)
+            return out
+
+        def refine(state: _State, test, is_true: bool) -> _State:
+            if test is None:
+                return state
+            parsed = _parse_none_test(test)
+            if parsed is None:
+                return state
+            var, none_when_true = parsed
+            if none_when_true != is_true:
+                return state
+            out: _State = {}
+            for oid, (p, h) in state.items():
+                if p and var in h:
+                    h2 = h - {var}
+                    out[oid] = (p if h2 else False, h2)
+                else:
+                    out[oid] = (p, h)
+            return out
+
+        fa = ForwardAnalysis(cfg, {}, self.transfer, join, refine=refine,
+                             exc_filter=lambda s: s)
+        inn = fa.run()
+        exit_state = inn.get(cfg.exit, {})
+        return [self.obligation_lines[oid]
+                for oid, (p, _h) in sorted(exit_state.items()) if p]
+
+
+def _check_spanpair(mod: Module, fn: ast.AST,
+                    findings: List[Finding]) -> None:
+    if not any(isinstance(n, ast.Call)
+               and _call_last_name(n) == "span_begin"
+               for n in walk_no_nested(fn)):
+        return
+    sa = _SpanPairAnalysis(fn)
+    for line in sa.run():
+        findings.append(Finding(
+            "conservation", mod.relpath, line,
+            f"{fn.name}:spanpair",
+            f"span_begin in {fn.name}() never reaches span_end on some "
+            f"path (exception edges included) — the span tree is left "
+            f"open and the query's trace is corrupted"))
+    for line in sa.immediate:
+        findings.append(Finding(
+            "conservation", mod.relpath, line,
+            f"{fn.name}:spanpair-discard",
+            f"span_begin result discarded in {fn.name}() — the span can "
+            f"never be closed"))
 
 
 def _check_manager(mod: Module, node: ast.ClassDef,

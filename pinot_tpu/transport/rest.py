@@ -528,6 +528,11 @@ class ServerAdminApi(_Api):
         # bounds/rejections, adaptive launch window, kernel single-flight
         self.route("GET", r"/debug/scheduler",
                    lambda m, b: (200, s.scheduler_debug()))
+        # query lifecycle registry: running queries (id/sql/phase/elapsed/
+        # pins), completed ring buffer, and the slow-query log with
+        # retained span trees (pinot.server.query.slow.threshold.ms)
+        self.route("GET", r"/debug/queries",
+                   lambda m, b: (200, s.queries_debug()))
         # ops hook for the HBM budget knob: force-drop one resident's
         # device arrays (in-flight queries keep theirs via python refs;
         # the next query re-stages)

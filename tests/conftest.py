@@ -37,6 +37,11 @@ def pytest_configure(config):
         "residency_tier: tiered residency (host-RAM spill tier, "
         "restage-cost-aware eviction, budget-sliced sharded combine; "
         "pytest -m residency_tier runs it in isolation; part of tier-1)")
+    config.addinivalue_line(
+        "markers",
+        "trace: query lifecycle tracing (span trees, decision ledger, "
+        "slow-query log; pytest -m trace runs it in isolation; part of "
+        "tier-1)")
 
 
 @pytest.fixture(scope="session")

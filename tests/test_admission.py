@@ -376,11 +376,11 @@ class TestExecutorAdmission:
         go = threading.Event()
         real = ex._execute_admitted
 
-        def counted(c, segs):
+        def counted(c, segs, **kw):
             calls.append(1)
             entered.set()
             go.wait(10)
-            return real(c, segs)
+            return real(c, segs, **kw)
 
         ex._execute_admitted = counted
         results = []

@@ -927,6 +927,97 @@ def test_conservation_host_tier_pop_must_account(tmp_path):
                    for f in cf), [f.render() for f in new]
 
 
+def test_conservation_spanpair_open_without_close(tmp_path):
+    """The spanpair obligation: a span_begin assigned to a local must
+    reach a span_end naming it on every path — an open that never closes
+    corrupts the query's trace tree. With-statement spans and
+    returned/stored spans create no obligation."""
+    new = _lint(tmp_path, """\
+        def open_no_close(rec):
+            sp = rec.span_begin("x")
+            do_work(sp)
+
+        def open_ok_finally(rec):
+            sp = rec.span_begin("x")
+            try:
+                do_work(sp)
+            finally:
+                rec.span_end(sp)
+
+        def open_ok_with(rec):
+            with rec.span("x"):
+                do_work()
+
+        def open_ok_returned(rec):
+            sp = rec.span_begin("x")
+            return sp
+
+        def open_ok_stored(rec, stats):
+            sp = rec.span_begin("x")
+            stats._root_span = sp
+
+        def open_ok_closure(rec):
+            sp = rec.span_begin("x")
+
+            def done(result):
+                rec.span_end(sp)
+                return result
+
+            return done
+
+        def discarded(rec):
+            rec.span_begin("x")
+            do_work()
+""")
+    cf = _by_checker(new, "conservation")
+    assert any("open_no_close" in f.symbol and "spanpair" in f.symbol
+               for f in cf), [f.render() for f in new]
+    assert any("discarded" in f.symbol and "spanpair-discard" in f.symbol
+               for f in cf), [f.render() for f in new]
+    for ok in ("open_ok_finally", "open_ok_with", "open_ok_returned",
+               "open_ok_stored", "open_ok_closure"):
+        assert not any(ok in f.symbol for f in cf), \
+            [f.render() for f in cf]
+
+
+def test_conservation_spanpair_exception_edge(tmp_path):
+    """A span_end that lives only on the try fall-through leaks the span
+    on the handler path — exception edges are part of the obligation."""
+    new = _lint(tmp_path, """\
+        def exc_leak(rec):
+            sp = rec.span_begin("x")
+            try:
+                do_work()
+            except ValueError:
+                return None
+            rec.span_end(sp)
+
+        def exc_ok(rec):
+            sp = rec.span_begin("x")
+            try:
+                do_work()
+            except ValueError:
+                rec.span_end(sp)
+                return None
+            rec.span_end(sp)
+
+        def none_guard_ok(rec, traced):
+            sp = rec.span_begin("x") if traced else None
+            try:
+                do_work()
+            finally:
+                if sp is not None:
+                    rec.span_end(sp)
+""")
+    cf = _by_checker(new, "conservation")
+    assert any("exc_leak" in f.symbol and "spanpair" in f.symbol
+               for f in cf), [f.render() for f in new]
+    assert not any("exc_ok" in f.symbol for f in cf), \
+        [f.render() for f in cf]
+    assert not any("none_guard_ok" in f.symbol for f in cf), \
+        [f.render() for f in cf]
+
+
 # --------------------------------------------------------------------------
 # CLI: --json / --families
 # --------------------------------------------------------------------------

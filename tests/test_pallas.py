@@ -297,12 +297,12 @@ def test_lowering_failure_blocks_only_that_shape(setup, host_exec,
 
     real = pk.run_segment
 
-    def flaky(plan, staged, cache, interpret):
+    def flaky(plan, staged, cache, interpret, **kw):
         if not bad_spec:
             bad_spec["spec"] = plan.spec
         if plan.spec == bad_spec["spec"]:
             raise RuntimeError("simulated Mosaic lowering failure")
-        return real(plan, staged, cache, interpret)
+        return real(plan, staged, cache, interpret, **kw)
 
     monkeypatch.setattr(pk, "run_segment", flaky)
     got, _ = ex.execute(compile_query(bad_sql), segs)     # falls back

@@ -173,7 +173,125 @@ def emit(results: dict, tpu_attempts: int) -> None:
     for s in SUITES:
         if s in results:
             out[s] = results[s]
+    out["trajectory"] = trajectory_gate(results)
     print(json.dumps(out), flush=True)
+
+
+# ==========================================================================
+# trajectory gate: this round vs every prior BENCH_r*.json
+# ==========================================================================
+
+# suite -> (headline scalar key, higher_is_better): the per-suite number
+# the cross-round trajectory is computed over
+_TRAJECTORY_KEYS = {
+    "ssb": ("p50_ms_per_query", False),
+    "qps": ("qps", True),
+    "micro": ("p50_ms_per_query", False),
+    "startree": ("ms", False),
+    "sketches": ("p50_ms_per_query", False),
+    "residency": ("sliced_p50_ms_per_query", False),
+    "cluster": ("p50_ms_per_query", False),
+}
+REGRESSION_X = 1.3
+
+
+def load_prior_rounds(root: str = None) -> dict:
+    """round tag ('r05') -> that round's final bench JSON. Rounds are the
+    checked-in ``BENCH_r*.json`` wrappers (the driver stores the worker's
+    stdout in ``tail``); a bare result JSON parses too."""
+    import glob
+    import re as _re
+
+    root = root or os.path.dirname(os.path.abspath(__file__))
+    rounds = {}
+    for path in sorted(glob.glob(os.path.join(root, "BENCH_r*.json"))):
+        m = _re.search(r"BENCH_(r\d+)\.json$", path)
+        if m is None:
+            continue
+        try:
+            with open(path) as f:
+                wrapper = json.load(f)
+        except (OSError, ValueError):
+            continue
+        if isinstance(wrapper, dict) and "metric" in wrapper:
+            rounds[m.group(1)] = wrapper
+            continue
+        for line in reversed(str(wrapper.get("tail", "")).splitlines()):
+            line = line.strip()
+            if not line.startswith("{"):
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            if isinstance(rec, dict) and "metric" in rec:
+                rounds[m.group(1)] = rec
+                break
+    return rounds
+
+
+def _comparable(suite: str, cur: dict, prior: dict) -> bool:
+    """Cross-round numbers only compare like-for-like: same backend, and
+    — where the suite records a scale — the same row count (a 24M-row TPU
+    round vs a 3M-row CPU round is not a regression signal)."""
+    if cur.get("backend") != prior.get("backend"):
+        return False
+    if "rows" in cur or "rows" in prior:
+        return cur.get("rows") == prior.get("rows")
+    return True
+
+
+def trajectory_gate(results: dict, rounds: dict = None) -> dict:
+    """The cross-round delta table nobody was computing: per suite, this
+    round's headline scalar vs the best comparable prior round, with a
+    LOUD warning on a >1.3x p50 regression (or >1.3x QPS drop).
+    ``BENCH_ALLOW_REGRESSION=1`` downgrades the warning to a note (capped
+    budgets, tiny hosts). Never throws — a broken history must not cost
+    the round its numbers."""
+    try:
+        rounds = load_prior_rounds() if rounds is None else rounds
+    except Exception:
+        return {"error": "prior-round load failed"}
+    table: dict = {}
+    regressions = []
+    for suite, (key, higher_better) in _TRAJECTORY_KEYS.items():
+        cur = results.get(suite) or {}
+        value = cur.get(key)
+        if not isinstance(value, (int, float)):
+            continue
+        best = None
+        best_round = None
+        for tag, rec in sorted(rounds.items()):
+            prior = rec.get(suite) or {}
+            pv = prior.get(key)
+            if not isinstance(pv, (int, float)) or pv <= 0 \
+                    or not _comparable(suite, cur, prior):
+                continue
+            if best is None or (pv > best if higher_better else pv < best):
+                best, best_round = pv, tag
+        row = {"current": value, "unit": key}
+        if best is not None:
+            ratio = (best / value) if higher_better else (value / best)
+            row.update(best_prior=best, best_round=best_round,
+                       ratio=round(ratio, 3),
+                       regressed=bool(value and ratio > REGRESSION_X))
+            if row["regressed"]:
+                regressions.append(
+                    f"{suite}: {key} {value} vs {best} in {best_round} "
+                    f"({row['ratio']}x worse)")
+        table[suite] = row
+    out = {"vs_rounds": sorted(rounds), "suites": table}
+    if regressions:
+        allowed = bool(os.environ.get("BENCH_ALLOW_REGRESSION"))
+        out["regressions"] = regressions
+        out["allowed"] = allowed
+        banner = ("TRAJECTORY REGRESSION (allowed by "
+                  "BENCH_ALLOW_REGRESSION): " if allowed else
+                  f"TRAJECTORY REGRESSION (> {REGRESSION_X}x vs best "
+                  f"prior round): ")
+        for r in regressions:
+            _log(banner + r)
+    return out
 
 
 # ==========================================================================

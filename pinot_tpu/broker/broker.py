@@ -100,6 +100,15 @@ class BrokerRequestHandler:
         self.coalesce = coalesce
         self._flights = SingleFlight()
         self._leading = _threading.local()
+        # continuous telemetry: the broker front door records per-table
+        # windowed latency/error (the SLO tracker's input) and exposes
+        # the process telemetry families on this registry's /metrics
+        from pinot_tpu.common.telemetry import TELEMETRY
+
+        TELEMETRY.configure()
+        self.metrics.bind_telemetry(TELEMETRY)
+        TELEMETRY.recorder.register_provider(
+            "brokerScheduler", self.scheduler_snapshot)
 
     # -- transport registry --------------------------------------------------
     def register_server(self, instance_id: str, server) -> None:
@@ -165,6 +174,26 @@ class BrokerRequestHandler:
         return {"singleFlight": self._flights.snapshot(),
                 "admission": self.admission.snapshot()}
 
+    # -- continuous telemetry (process-wide center; broker-side routes) ------
+    def telemetry_snapshot(self) -> Dict[str, object]:
+        """``GET /debug/telemetry``: windowed (table, phase) histograms
+        with sliding AND lifetime quantiles + the gauge-history rings."""
+        from pinot_tpu.common.telemetry import TELEMETRY
+
+        return TELEMETRY.snapshot()
+
+    def slo_snapshot(self) -> Dict[str, object]:
+        """``GET /debug/slo``: per-table objectives + multi-window burn."""
+        from pinot_tpu.common.telemetry import TELEMETRY
+
+        return TELEMETRY.slo_snapshot()
+
+    def flightrecorder_snapshot(self) -> Dict[str, object]:
+        """``GET /debug/flightrecorder``: bundle index + last bundle."""
+        from pinot_tpu.common.telemetry import TELEMETRY
+
+        return TELEMETRY.recorder.snapshot()
+
     def _handle_sql(self, sql: str, principal=None,
                     access_control=None) -> BrokerResponse:
         """``access_control``/``principal`` enable per-table authorization
@@ -177,6 +206,7 @@ class BrokerRequestHandler:
         start = time.perf_counter()
         self.metrics.meter(BrokerMeter.QUERIES).mark()
         response = BrokerResponse()
+        tel_table: List[str] = [""]  # resolved after compile, read by finish
 
         def phase(name: str, t0: float) -> float:
             """Record a broker phase (ref: BrokerQueryPhase timers at
@@ -193,6 +223,14 @@ class BrokerRequestHandler:
             # the failure mode (parse / no table / unavailable / reduce)
             if resp.has_exceptions:
                 self.metrics.meter(BrokerMeter.EXCEPTIONS).mark()
+            # every front-door outcome lands in the per-table windowed
+            # latency histogram + the SLO error-budget counters — the
+            # continuous (sliding-percentile) view of broker latency
+            from pinot_tpu.common.telemetry import TELEMETRY
+
+            TELEMETRY.note_broker_query(
+                tel_table[0], (time.perf_counter() - start) * 1e3,
+                resp.has_exceptions)
             return resp
 
         try:
@@ -200,6 +238,7 @@ class BrokerRequestHandler:
         except SqlParseError as e:
             response.add_exception(SQL_PARSING_ERROR, str(e))
             return finish(response)
+        tel_table[0] = ctx.table_name or ""
         t = phase(BrokerQueryPhase.COMPILATION, start)
 
         if access_control is not None:

@@ -376,32 +376,40 @@ class DecisionLedger:
         self._counts: Dict[Tuple[str, str, str, str], int] = {}  # guarded-by: _lock
         self._registries: List[Any] = []  # guarded-by-writes: _lock
 
+    # the one labeled prometheus family every decline lands in
+    METRIC_FAMILY = "decision_declined_total"
+
     def record(self, point: str, chosen: str, declined: str,
                reason: str) -> None:
         key = (point, chosen, declined, reason)
         with self._lock:
             self._counts[key] = self._counts.get(key, 0) + 1
             regs = list(self._registries)
-        if regs:
-            from pinot_tpu.spi.metrics import decision_meter_name
+        for reg in regs:
+            reg.labeled_meter(self.METRIC_FAMILY,
+                              point=point, reason=reason).mark()
+        if point == "pallas":
+            # pallas-decline burst is a flight-recorder anomaly trigger:
+            # a storm of declines is how "pallas_kernels: 0" looks live
+            from pinot_tpu.common.telemetry import TELEMETRY
 
-            name = decision_meter_name(point, reason)
-            for reg in regs:
-                reg.meter(name).mark()
+            TELEMETRY.note_event("pallas_decline")
 
     def bind_metrics(self, registry: Any) -> None:
-        """Surface the histogram on a MetricsRegistry: each (point,
-        reason) pair becomes a ``decision_declined_total_*`` counter on
-        ``/metrics``."""
+        """Surface the histogram on a MetricsRegistry as ONE labeled
+        ``decision_declined_total{point=...,reason=...}`` family on
+        ``/metrics`` (one name-mangled counter per cell pre-dates labeled
+        families; see spi/metrics.py labeled_meter)."""
         with self._lock:
             if registry not in self._registries:
                 self._registries.append(registry)
             existing = dict(self._counts)
-        if existing:
-            from pinot_tpu.spi.metrics import decision_meter_name
-
-            for (point, _c, _d, reason), n in existing.items():
-                registry.meter(decision_meter_name(point, reason)).mark(n)
+        registry.set_help(self.METRIC_FAMILY,
+                          "Path decisions where execution declined a "
+                          "faster rung, by decision point and reason.")
+        for (point, _c, _d, reason), n in existing.items():
+            registry.labeled_meter(self.METRIC_FAMILY,
+                                   point=point, reason=reason).mark(n)
 
     def snapshot(self) -> Dict[str, int]:
         """``"point:declined->chosen:reason" -> count`` (the same key
@@ -535,6 +543,16 @@ class QueryRegistry:
                 self._slow.append(entry)
                 if len(self._slow) > self.slow_log_size:
                     del self._slow[0]
+        if stats is not None and stats.spans:
+            # flight-recorder feed: every completed query whose span tree
+            # was recorded (traced / sampled / slow-log-forced) lands in
+            # the black box's bounded ring — copied like the slow log, so
+            # the executor clearing the wire field can't empty it
+            from pinot_tpu.common.telemetry import TELEMETRY
+
+            fr = dict(entry)
+            fr.setdefault("spans", list(stats.spans))
+            TELEMETRY.recorder.note_query(fr)
         return elapsed_ms
 
     def snapshot(self) -> Dict[str, Any]:

@@ -180,6 +180,19 @@ class ServerQueryExecutor:
             _CC.TRACE_SAMPLE_KEY, _CC.DEFAULT_TRACE_SAMPLE)
         self.queries = QueryRegistry(slow_threshold_ms=cfg.get_float(
             _CC.SLOW_THRESHOLD_MS_KEY, _CC.DEFAULT_SLOW_THRESHOLD_MS))
+        # continuous telemetry (common/telemetry.py): apply config
+        # (sampler resolution, SLO objectives, flight-recorder knobs) to
+        # the process-wide center and register this executor's state as
+        # flight-recorder bundle providers — a frozen bundle carries the
+        # residency + admission snapshots of the LAST executor built
+        # (one per process everywhere outside multi-instance tests)
+        from pinot_tpu.common.telemetry import TELEMETRY
+
+        TELEMETRY.configure(cfg)
+        TELEMETRY.recorder.register_provider("residency",
+                                             self.residency.snapshot)
+        TELEMETRY.recorder.register_provider("admission",
+                                             self.admission.snapshot)
         # backend selection is itself a path decision: a CPU default
         # backend is why no pallas kernel can compile — record it ONCE so
         # the ledger explains the whole pallas story, not just per-plan
@@ -243,6 +256,7 @@ class ServerQueryExecutor:
         before stats existed — lands as the first child with full queue
         attribution."""
         stats = QueryStats(num_segments_queried=len(segments))
+        stats._tel_table = ctx.table_name or ""  # telemetry attribution
         requested = ctx.trace_enabled
         if not requested and self.trace_sample > 0:
             import random
@@ -279,6 +293,11 @@ class ServerQueryExecutor:
     def _execute_instance_admitted(self, ctx: QueryContext,
                                    segments: List[ImmutableSegment],
                                    admit_wait_ms: float = 0.0):
+        import time as _time
+
+        from pinot_tpu.common.telemetry import observe_ms
+
+        t0 = _time.perf_counter()
         stats, token = self._open_query(ctx, segments, admit_wait_ms)
         error = None
         try:
@@ -288,6 +307,8 @@ class ServerQueryExecutor:
             raise
         finally:
             self._close_query(stats, token, error=error)
+            observe_ms(ctx.table_name, "server_exec",
+                       (_time.perf_counter() - t0) * 1e3)
 
     def _execute_instance_traced(self, ctx: QueryContext,
                                  segments: List[ImmutableSegment],
@@ -391,6 +412,11 @@ class ServerQueryExecutor:
                           segments: List[ImmutableSegment],
                           admit_wait_ms: float = 0.0
                           ) -> Tuple[ResultTable, QueryStats]:
+        import time as _time
+
+        from pinot_tpu.common.telemetry import observe_ms
+
+        t0 = _time.perf_counter()
         stats, token = self._open_query(ctx, segments, admit_wait_ms)
         error = None
         try:
@@ -400,6 +426,8 @@ class ServerQueryExecutor:
             raise
         finally:
             self._close_query(stats, token, error=error)
+            observe_ms(ctx.table_name, "server_exec",
+                       (_time.perf_counter() - t0) * 1e3)
 
     def _execute_traced(self, ctx: QueryContext,
                         segments: List[ImmutableSegment],
@@ -554,6 +582,7 @@ class ServerQueryExecutor:
         locals_ = [QueryStats() for _ in segments]
         for st in locals_:  # the pin set must ride into worker threads
             st._staging_lease = lease
+            st._tel_table = getattr(stats, "_tel_table", "")
             if traced:
                 # recorders are thread-confined: each worker records into
                 # its private stats; merge() below re-parents the
@@ -841,12 +870,19 @@ class ServerQueryExecutor:
             # fused-kernel launch + ONE D2H; followers decode the shared
             # tree. id()-keying is sound because the leader's closure pins
             # both objects alive for the flight's lifetime.
+            import time as _time
+
+            from pinot_tpu.common.telemetry import observe_ms
+
+            t0 = _time.perf_counter()
             with maybe_span(stats, "Kernel", kernel="pallas",
                             segment=seg.segment_name) as sp:
                 out, _ = self._kernel_flight.do(
                     ("pallas", id(plan), id(staged)), launch)
                 if sp is not None:
                     sp.attrs["served"] = out is not None
+            observe_ms(getattr(stats, "_tel_table", ""), "kernel",
+                       (_time.perf_counter() - t0) * 1e3)
         except Exception:  # lowering/compile failure -> jnp kernels
             import logging
 
@@ -891,10 +927,17 @@ class ServerQueryExecutor:
         # plan object + same staged resident) share one launch + D2H.
         # Upsert-managed plans are excluded — their valid mask advances
         # between calls, so two launches are NOT interchangeable.
+        import time as _time
+
+        from pinot_tpu.common.telemetry import observe_ms
+
         key = None if has_validdocs else ("seg", id(plan), id(staged))
+        t0 = _time.perf_counter()
         with maybe_span(stats, "Kernel", kernel="jnp",
                         segment=seg.segment_name):
             out, _ = self._kernel_flight.do(key, launch)
+        observe_ms(getattr(stats, "_tel_table", ""), "kernel",
+                   (_time.perf_counter() - t0) * 1e3)
         self._track_kernel_stats(out, seg, stats)
         return out
 

@@ -1123,12 +1123,31 @@ class ResidencyManager:
                        lambda: float(self.host_budget_bytes or 0))
         registry.gauge("staging_host_entries",
                        lambda: float(self.host_entry_count()))
+        # gauge-history rings: staged/host-tier bytes at few-second
+        # resolution (the history dashboards need behind /debug/memory's
+        # instants). The accessors take the manager lock and read running
+        # counters — never a device sync.
+        from pinot_tpu.common.telemetry import TELEMETRY
+
+        TELEMETRY.track_gauge("staging.staged_bytes",
+                              lambda: float(self.staged_bytes()))
+        TELEMETRY.track_gauge("staging.host_bytes",
+                              lambda: float(self.host_bytes()))
 
     def _mark(self, name: Optional[str]) -> None:
         self._mark_n(name, 1)
 
     def _mark_n(self, name: Optional[str], n: int) -> None:
-        if self._metrics is None or name is None or n <= 0:
+        if name is None or n <= 0:
+            return
+        # flight-recorder anomaly feed (always on, metrics bound or not):
+        # an eviction/demotion STORM is a freeze trigger. note_storm_event
+        # never freezes synchronously, so marking under the manager lock
+        # is safe.
+        from pinot_tpu.common.telemetry import note_storm_event
+
+        note_storm_event(name, n)
+        if self._metrics is None:
             return
         from pinot_tpu.spi.metrics import ServerMeter
 

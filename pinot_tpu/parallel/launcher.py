@@ -381,6 +381,13 @@ class LaunchScheduler:
     def _note(self, reqs, uniq, launches: int, n_failed: int) -> None:
         n = len(reqs)
         wait = [r.queue_wait_ms for r in reqs]
+        # windowed dispatcher-queue-wait histogram: the launch tier's
+        # sliding-percentile view (per-mesh, no table attribution here)
+        from pinot_tpu.common.telemetry import TELEMETRY
+
+        wh = TELEMETRY.histo("", "launch_queue")
+        for w in wait:
+            wh.record(w)
         with self._stats_lock:
             self.requests += n
             self.launches += launches
@@ -473,4 +480,16 @@ def launcher_for_mesh(mesh) -> LaunchScheduler:
         if sched is None:
             sched = LaunchScheduler(name=f"combine-launch-{len(_LAUNCHERS)}")
             _LAUNCHERS[key] = sched
+            # gauge-history rings for the dispatcher: queue depth and the
+            # arrival-interval EWMA (the adaptive window's input) at
+            # few-second resolution — the history behind /debug/launches'
+            # instants. len()/float reads are GIL-atomic, never a sync.
+            from pinot_tpu.common.telemetry import TELEMETRY
+
+            TELEMETRY.track_gauge(
+                f"{sched._name}.queue_depth",
+                lambda s=sched: float(len(s._queue)))
+            TELEMETRY.track_gauge(
+                f"{sched._name}.arrival_ewma_ms",
+                lambda s=sched: float(s._arrival_ewma_ms or 0.0))
         return sched

@@ -134,12 +134,15 @@ class AdmissionGate:
         """Admit one query (blocking, bounded) or raise
         :class:`QueryRejectedError`. The returned ticket MUST be released
         in a ``finally`` — the graftlint pairing family enforces it."""
+        from pinot_tpu.common.telemetry import TELEMETRY
+
         if self._quota is not None and table \
                 and not self._quota.acquire(table):
             with self._cond:
                 self.rejected_quota += 1
                 depth = self._waiting
             self._mark("ADMISSION_REJECTED")
+            TELEMETRY.note_rejection(table)
             raise QueryRejectedError(
                 f"query quota exceeded for table {table}",
                 queue_depth=depth, reason="quota")
@@ -194,8 +197,14 @@ class AdmissionGate:
         if reject is not None:
             reason, msg, depth = reject
             self._mark("ADMISSION_REJECTED")
+            # flight-recorder anomaly feed: a rejection BURST (not one
+            # rejection — that's load shedding working) freezes the box
+            TELEMETRY.note_rejection(table)
             raise QueryRejectedError(msg, queue_depth=depth, reason=reason)
         self._mark("ADMISSION_ADMITTED")
+        # windowed gate-wait histogram per (table, phase): the queue half
+        # of the admission tier's queue-vs-work attribution, continuously
+        TELEMETRY.observe(table or "", "admission_wait", wait_ms)
         return _Ticket(gated=True, wait_ms=wait_ms)
 
     def release(self, ticket: Optional[_Ticket]) -> None:
@@ -213,12 +222,23 @@ class AdmissionGate:
 
     # -- observability -------------------------------------------------------
     def bind_metrics(self, registry) -> None:
+        from pinot_tpu.common.telemetry import TELEMETRY
+
         self._metrics = registry
         # gauge lambdas run on scrape threads: single-int reads are
         # GIL-atomic under the writes-only guards above
         registry.gauge("admission_inflight", lambda: float(self._inflight))
         registry.gauge("admission_queue_depth",
                        lambda: float(self._waiting))
+        # gauge-history rings: queue depth + cumulative rejections at
+        # few-second resolution (rejection RATE is the ring's derivative)
+        TELEMETRY.track_gauge(f"{self._name}.queue_depth",
+                              lambda: float(self._waiting))
+        TELEMETRY.track_gauge(
+            f"{self._name}.rejected",
+            lambda: float(self.rejected_queue_full
+                          + self.rejected_wait_expired
+                          + self.rejected_quota))
 
     def _mark(self, name: str) -> None:
         if self._metrics is None:

@@ -122,7 +122,8 @@ class QueryScheduler:
         t_submit = time.perf_counter()
 
         def run():
-            self._note_wait((time.perf_counter() - t_submit) * 1e3)
+            self._note_wait((time.perf_counter() - t_submit) * 1e3,
+                            table=table)
             try:
                 return fn()
             finally:
@@ -130,18 +131,23 @@ class QueryScheduler:
 
         return self._pool.submit(run, on_skip=done)
 
-    def _note_wait(self, wait_ms: float) -> None:
+    def _note_wait(self, wait_ms: float, table: str = "") -> None:
         """Scheduler-queue wait accounting — the queue half of the
         queue-vs-work attribution at the scheduler level (the span tree's
         SchedulerQueue spans carry the per-query value; these totals feed
-        ``/debug/scheduler``). Lazily-initialized so subclasses that own
-        their queues (priority/SEWF) share it without base ``__init__``."""
+        ``/debug/scheduler``; the windowed (table, scheduler_wait)
+        histogram gives the sliding-percentile view). Lazily-initialized
+        so subclasses that own their queues (priority/SEWF) share it
+        without base ``__init__``."""
         with self._lock:
             self.queue_waits = getattr(self, "queue_waits", 0) + 1
             self.queue_wait_ms_total = \
                 getattr(self, "queue_wait_ms_total", 0.0) + wait_ms
             if wait_ms > getattr(self, "queue_wait_ms_max", 0.0):
                 self.queue_wait_ms_max = wait_ms
+        from pinot_tpu.common.telemetry import observe_ms
+
+        observe_ms(table, "scheduler_wait", wait_ms)
 
     def queue_depth(self) -> int:
         return self._pool.qsize()
@@ -406,7 +412,10 @@ class SewfScheduler(QueryScheduler):
             if entry is None:
                 continue
             _t_enq, shape, fut, fn = entry
-            self._note_wait((time.monotonic() - _t_enq) * 1e3)
+            table = shape[0] if isinstance(shape, tuple) and shape \
+                and isinstance(shape[0], str) else \
+                (shape if isinstance(shape, str) else "")
+            self._note_wait((time.monotonic() - _t_enq) * 1e3, table=table)
             if not fut.set_running_or_notify_cancel():
                 self._done(shape, None)  # cancelled while queued
                 continue

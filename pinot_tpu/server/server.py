@@ -90,10 +90,22 @@ class ServerInstance:
         if admission is not None:
             admission.bind_metrics(self.metrics)
         # path-decision ledger -> /metrics: every decline of a faster
-        # rung becomes a decision_declined_total_* counter
+        # rung becomes a cell of the labeled decision_declined_total family
         from pinot_tpu.common.tracing import LEDGER
 
         LEDGER.bind_metrics(self.metrics)
+        # continuous telemetry: export the histogram/SLO families on this
+        # server's /metrics, give the flight recorder this instance's
+        # scheduler/memory state, and ring-track the scheduler queue depth
+        from pinot_tpu.common.telemetry import TELEMETRY
+
+        TELEMETRY.configure(config)
+        self.metrics.bind_telemetry(TELEMETRY)
+        TELEMETRY.recorder.register_provider("scheduler",
+                                             self.scheduler_debug)
+        TELEMETRY.track_gauge(
+            f"scheduler.queue_depth.{instance_id}",
+            lambda: float(self.scheduler.queue_depth()))
         self.segment_dir = segment_dir
         self.consumer_tick_s = consumer_tick_s
         self._started = False
@@ -591,6 +603,30 @@ class ServerInstance:
         out: Dict[str, Any] = {"instance": self.instance_id}
         out.update(registry.snapshot())
         return out
+
+    def telemetry_debug(self) -> Dict[str, Any]:
+        """``GET /debug/telemetry``: the continuous-telemetry view —
+        windowed (table, phase) latency histograms with sliding AND
+        lifetime quantiles, plus the gauge-history rings (staged/host
+        bytes, queue depths, arrival EWMA, rejection counters)."""
+        from pinot_tpu.common.telemetry import TELEMETRY
+
+        return TELEMETRY.snapshot()
+
+    def slo_debug(self) -> Dict[str, Any]:
+        """``GET /debug/slo``: per-table latency/error objectives + the
+        short/long-window burn rates."""
+        from pinot_tpu.common.telemetry import TELEMETRY
+
+        return TELEMETRY.slo_snapshot()
+
+    def flightrecorder_debug(self) -> Dict[str, Any]:
+        """``GET /debug/flightrecorder``: the black box — frozen bundle
+        index, the last post-mortem bundle, live ring occupancy, and the
+        anomaly-event totals."""
+        from pinot_tpu.common.telemetry import TELEMETRY
+
+        return TELEMETRY.recorder.snapshot()
 
     def memory_debug(self) -> Dict[str, Any]:
         """Bytes-accurate HBM residency + native mmap accounting

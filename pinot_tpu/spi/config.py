@@ -218,3 +218,24 @@ class CommonConstants:
     # serving path stays span-free.
     SLOW_THRESHOLD_MS_KEY = "pinot.server.query.slow.threshold.ms"
     DEFAULT_SLOW_THRESHOLD_MS = 0.0
+    # Continuous telemetry (common/telemetry.py): sampler resolution for
+    # the gauge-history rings (staged/host bytes, queue depths, arrival
+    # EWMA, rejection counters) and the flight recorder's anomaly checks.
+    TELEMETRY_RESOLUTION_S_KEY = "pinot.server.telemetry.resolution.s"
+    DEFAULT_TELEMETRY_RESOLUTION_S = 2.0
+    # Flight recorder (common/telemetry.py FlightRecorder): post-mortem
+    # bundle directory (default <tmp>/pinot_tpu_flightrecorder), the
+    # freeze debounce, and the windowed-p99-vs-EWMA spike factor.
+    FLIGHT_DIR_KEY = "pinot.server.telemetry.flightrecorder.dir"
+    FLIGHT_MIN_INTERVAL_S_KEY = \
+        "pinot.server.telemetry.flightrecorder.min.interval.s"
+    FLIGHT_P99_FACTOR_KEY = \
+        "pinot.server.telemetry.flightrecorder.p99.factor"
+    # Per-table SLOs (common/telemetry.py SloTracker): latency and error
+    # objectives parsed from the RAW key strings so table names survive
+    # relaxed-key normalization —
+    #   pinot.broker.slo.<table>.p99.ms   (latency objective, ms)
+    #   pinot.broker.slo.<table>.error.pct (error-rate objective, percent)
+    # Burn rates (>1 = over-burning the budget) ride /debug/slo and the
+    # slo_burn_rate exposition gauges.
+    SLO_KEY_PREFIX = "pinot.broker.slo."

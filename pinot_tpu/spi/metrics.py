@@ -11,10 +11,19 @@ per update (python '+=' is not atomic across threads).
 
 from __future__ import annotations
 
+import re
 import threading
 import time
 
-from typing import Any, Callable, Dict, Optional, Union
+from typing import Any, Callable, Dict, Tuple, Union
+
+# prometheus metric names admit only [a-zA-Z0-9_:] (label VALUES are free
+# text); every exported name is sanitized through this
+_NAME_UNSAFE = re.compile(r"[^a-zA-Z0-9_:]+")
+
+
+def sanitize_metric_name(name: str) -> str:
+    return _NAME_UNSAFE.sub("_", name)
 
 
 class Meter:
@@ -83,6 +92,12 @@ class MetricsRegistry:
         self._meters: Dict[str, Meter] = {}
         self._timers: Dict[str, Timer] = {}
         self._gauges: Dict[str, GaugeFn] = {}
+        # family -> {sorted (label, value) tuple -> Meter}: counters that
+        # export as ONE prometheus metric family with label dimensions
+        # instead of N name-mangled metric names
+        self._labeled: Dict[str, Dict[Tuple[Tuple[str, str], ...], Meter]] = {}
+        self._help: Dict[str, str] = {}
+        self._telemetry = None
         self._lock = threading.Lock()
 
     def meter(self, name: str) -> Meter:
@@ -92,6 +107,19 @@ class MetricsRegistry:
                 m = self._meters.setdefault(name, Meter())
         return m
 
+    def labeled_meter(self, family: str, **labels: str) -> Meter:
+        """Counter cell of a labeled family — exported as
+        ``family{k="v",...} n`` under one HELP/TYPE header."""
+        key = tuple(sorted((k, str(v)) for k, v in labels.items()))
+        cells = self._labeled.get(family)
+        if cells is not None:
+            m = cells.get(key)
+            if m is not None:
+                return m
+        with self._lock:
+            cells = self._labeled.setdefault(family, {})
+            return cells.setdefault(key, Meter())
+
     def timer(self, name: str) -> Timer:
         t = self._timers.get(name)
         if t is None:
@@ -100,36 +128,78 @@ class MetricsRegistry:
         return t
 
     def gauge(self, name: str, fn: GaugeFn) -> None:
+        """Register a gauge. ``fn`` runs on SCRAPE threads: it must never
+        materialize a device value (``np.asarray``/``.item()``/casts on a
+        jax array block the scrape on device execution) — the graftlint
+        ``sync`` family gates gauge callbacks for exactly this."""
         self._gauges[name] = fn
+
+    def set_help(self, name: str, text: str) -> None:
+        """Optional HELP text for one exported family."""
+        self._help[name] = text
+
+    def bind_telemetry(self, telemetry) -> None:
+        """Attach a :class:`~pinot_tpu.common.telemetry.Telemetry` center:
+        its histogram/SLO families ride this registry's exposition."""
+        self._telemetry = telemetry
 
     # -- export --------------------------------------------------------------
     def _prefix(self, name: str) -> str:
         p = f"pinot_{self.role}_" if self.role else "pinot_"
-        return p + name
+        return sanitize_metric_name(p + name)
+
+    def _header(self, lines, full: str, mtype: str, name: str,
+                fallback: str) -> None:
+        lines.append(f"# HELP {full} {self._help.get(name, fallback)}")
+        lines.append(f"# TYPE {full} {mtype}")
 
     def export_prometheus(self) -> str:
-        """Prometheus text exposition (the /metrics endpoint body)."""
+        """Prometheus text exposition (the /metrics endpoint body):
+        HELP/TYPE headers on every family, sanitized names, labeled
+        families rendered with label dimensions, and — when a telemetry
+        center is bound — the histogram ``_bucket``/``_sum``/``_count``
+        series and SLO burn gauges."""
         lines = []
         for name, m in sorted(self._meters.items()):
             full = self._prefix(name)
-            lines.append(f"# TYPE {full} counter")
+            self._header(lines, full, "counter", name,
+                         f"Cumulative count of {name}.")
             lines.append(f"{full} {m.count}")
+        for family, cells in sorted(self._labeled.items()):
+            full = self._prefix(family)
+            self._header(lines, full, "counter", family,
+                         f"Cumulative count of {family} by label.")
+            for key in sorted(cells):
+                labels = ",".join(
+                    f'{sanitize_metric_name(k)}="{v}"' for k, v in key)
+                lines.append(f"{full}{{{labels}}} {cells[key].count}")
         for name, g in sorted(self._gauges.items()):
             full = self._prefix(name)
             v = g() if callable(g) else g
-            lines.append(f"# TYPE {full} gauge")
+            self._header(lines, full, "gauge", name,
+                         f"Instantaneous value of {name}.")
             lines.append(f"{full} {float(v)}")
         for name, t in sorted(self._timers.items()):
             full = self._prefix(name)
-            lines.append(f"# TYPE {full}_ms summary")
+            self._header(lines, f"{full}_ms", "summary", name,
+                         f"Duration of {name} in milliseconds.")
             lines.append(f"{full}_ms_count {t.count}")
             lines.append(f"{full}_ms_sum {round(t.total_ms, 3)}")
+            self._header(lines, f"{full}_ms_max", "gauge", name + "_max",
+                         f"Maximum observed {name} duration (ms).")
             lines.append(f"{full}_ms_max {round(t.max_ms, 3)}")
-        return "\n".join(lines) + "\n"
+        body = "\n".join(lines) + "\n"
+        if self._telemetry is not None:
+            p = f"pinot_{self.role}_" if self.role else "pinot_"
+            body += self._telemetry.export_prometheus(sanitize_metric_name(p))
+        return body
 
     def to_dict(self) -> Dict[str, Any]:
         return {
             "meters": {n: m.count for n, m in self._meters.items()},
+            "labeled": {family: {"|".join(f"{k}={v}" for k, v in key):
+                                 m.count for key, m in cells.items()}
+                        for family, cells in self._labeled.items()},
             "gauges": {n: (g() if callable(g) else g)
                        for n, g in self._gauges.items()},
             "timers": {n: {"count": t.count,

@@ -18,6 +18,13 @@ PR 3 removed the global combine lock to escape:
   ``threading.Thread(target=...)`` call sites in launcher modules and
   closes over name-resolved calls. (Worker-pool threads are deliberately
   NOT roots: per-query decode D2H is the design, not a hazard.)
+- **inside a metrics/telemetry gauge callback**: callables registered via
+  ``MetricsRegistry.gauge`` / ``Telemetry.track_gauge`` run on SCRAPE and
+  sampler threads — a device sink there silently stalls every scrape (and
+  the telemetry sampler's whole tick) on device execution. Both lambda
+  registrations (checked against the registering function's taint set)
+  and named-function registrations (which join the lock/dispatcher
+  context machinery) are gated.
 
 Taint sources: ``jnp.*`` / ``jax.*`` / ``pallas_call`` call results
 (minus host-metadata entry points like ``jax.devices()`` /
@@ -395,6 +402,90 @@ def _dispatcher_functions(eng: _TaintEngine) -> Dict[int, str]:
     return out
 
 
+_GAUGE_REGISTRARS = {"gauge", "track_gauge"}
+
+
+def _gauge_call_arg(node: ast.AST) -> Optional[ast.expr]:
+    """The callback argument of a ``<registry>.gauge(name, fn)`` /
+    ``track_gauge(name, fn)`` registration, else None."""
+    if not isinstance(node, ast.Call) or len(node.args) < 2:
+        return None
+    f = node.func
+    name = f.attr if isinstance(f, ast.Attribute) else \
+        (f.id if isinstance(f, ast.Name) else None)
+    if name not in _GAUGE_REGISTRARS:
+        return None
+    return node.args[1]
+
+
+def _gauge_functions(eng: _TaintEngine) -> Dict[int, str]:
+    """id(fn) -> witness for NAMED functions registered as gauge/telemetry
+    callbacks — they run on scrape/sampler threads, where a device sink
+    stalls every scrape."""
+    out: Dict[int, str] = {}
+    for mod in eng.ctx.modules:
+        for node in ast.walk(mod.tree):
+            fnarg = _gauge_call_arg(node)
+            if fnarg is None or isinstance(fnarg, ast.Lambda):
+                continue
+            scope = _enclosing_scope(eng.idx, mod, node)
+            try:
+                hit = eng.idx.resolve_callable(fnarg, mod, scope)
+            except Exception:
+                hit = None
+            targets: List[ast.AST] = [hit[1]] if hit is not None else []
+            if not targets and isinstance(fnarg, ast.Attribute):
+                cands = eng.graph.methods_by_name.get(fnarg.attr, [])
+                if 0 < len(cands) <= AMBIG_CAP:
+                    targets = [fn for _ci, fn in cands]
+            for t in targets:
+                out.setdefault(
+                    id(t),
+                    f"registered as a metrics gauge callback "
+                    f"({mod.relpath}:{node.lineno}) — runs on scrape "
+                    f"threads")
+    return out
+
+
+def _gauge_lambda_findings(eng: _TaintEngine, funcs) -> List[Finding]:
+    """Sinks inside gauge-registered LAMBDAS, checked against the
+    registering function's flow-insensitive taint set (a lambda closing
+    over a device value and materializing it syncs at every scrape)."""
+    findings: List[Finding] = []
+    seen: Set[str] = set()
+    for mod, qual, fn in funcs:
+        if isinstance(fn, ast.Lambda):
+            continue
+        scope = eng.idx.scope_of.get(id(fn))
+        lams: List[Tuple[ast.Call, ast.Lambda]] = []
+        for node in walk_no_nested(fn):
+            fnarg = _gauge_call_arg(node)
+            if fnarg is not None and isinstance(fnarg, ast.Lambda):
+                lams.append((node, fnarg))
+        if not lams:
+            continue
+        S = frozenset(eng.flow_insensitive_taint(fn, mod, scope))
+        for call_node, lam in lams:
+            for sub in ast.walk(lam.body):
+                if not isinstance(sub, ast.Call):
+                    continue
+                kind = eng.sink_kind(sub, S, mod, scope)
+                if kind is None:
+                    continue
+                sym = f"{qual}:gauge-lambda:{kind}"
+                key = f"{mod.relpath}:{sym}"
+                if key in seen:
+                    continue
+                seen.add(key)
+                findings.append(Finding(
+                    "sync", mod.relpath, sub.lineno, sym,
+                    f"gauge callback registered in {qual}() materializes "
+                    f"a device value via {kind} — the sink runs at SCRAPE "
+                    f"time, silently stalling every /metrics pull and "
+                    f"telemetry sampler tick on device execution"))
+    return findings
+
+
 def _held_map(fn: ast.AST, ci) -> Dict[int, FrozenSet[str]]:
     """ast-node-id -> lock names lexically held there (nested defs reset)."""
     held_at: Dict[int, FrozenSet[str]] = {}
@@ -431,13 +522,14 @@ def check_sync(ctx: LintContext) -> List[Finding]:
     eng.compute_summaries(funcs)
     lock_ctx = _lock_held_functions(eng)
     thread_ctx = _dispatcher_functions(eng)
+    gauge_ctx = _gauge_functions(eng)
 
     class_of: Dict[int, Any] = {}
     for ci in eng.classes:
         for m in ci.methods.values():
             class_of[id(m)] = ci
 
-    findings: List[Finding] = []
+    findings: List[Finding] = list(_gauge_lambda_findings(eng, funcs))
     seen: Set[str] = set()
     for mod, qual, fn in funcs:
         ci = class_of.get(id(fn))
@@ -450,6 +542,8 @@ def check_sync(ctx: LintContext) -> List[Finding]:
             contexts.append(lock_ctx[id(fn)])
         if id(fn) in thread_ctx:
             contexts.append(thread_ctx[id(fn)])
+        if id(fn) in gauge_ctx:
+            contexts.append(gauge_ctx[id(fn)])
         has_with_lock = ci is not None and any(
             isinstance(n, ast.With) and _with_locks(n, ci)
             for n in walk_no_nested(fn))

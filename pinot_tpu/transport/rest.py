@@ -460,6 +460,16 @@ class BrokerApi(_Api):
         # (broker half of the scheduler-tier ops view)
         self.route("GET", r"/debug/scheduler",
                    lambda m, b: (200, broker.scheduler_snapshot()))
+        # continuous telemetry: windowed (table, phase) histograms with
+        # sliding p50/p95/p99 + gauge-history rings
+        self.route("GET", r"/debug/telemetry",
+                   lambda m, b: (200, broker.telemetry_snapshot()))
+        # per-table SLO objectives + multi-window burn rates
+        self.route("GET", r"/debug/slo",
+                   lambda m, b: (200, broker.slo_snapshot()))
+        # the flight recorder's bundle index + last post-mortem bundle
+        self.route("GET", r"/debug/flightrecorder",
+                   lambda m, b: (200, broker.flightrecorder_snapshot()))
 
     def start(self) -> None:
         super().start()
@@ -533,6 +543,17 @@ class ServerAdminApi(_Api):
         # retained span trees (pinot.server.query.slow.threshold.ms)
         self.route("GET", r"/debug/queries",
                    lambda m, b: (200, s.queries_debug()))
+        # continuous telemetry: sliding-percentile (table, phase) latency
+        # histograms + the gauge-history rings behind the instant gauges
+        self.route("GET", r"/debug/telemetry",
+                   lambda m, b: (200, s.telemetry_debug()))
+        # per-table SLO burn rates (objectives from pinot.broker.slo.*)
+        self.route("GET", r"/debug/slo",
+                   lambda m, b: (200, s.slo_debug()))
+        # anomaly-triggered flight recorder: post-mortem bundle index +
+        # the last frozen bundle (span roots, decision deltas, snapshots)
+        self.route("GET", r"/debug/flightrecorder",
+                   lambda m, b: (200, s.flightrecorder_debug()))
         # ops hook for the HBM budget knob: force-drop one resident's
         # device arrays (in-flight queries keep theirs via python refs;
         # the next query re-stages)

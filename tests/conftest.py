@@ -42,6 +42,11 @@ def pytest_configure(config):
         "trace: query lifecycle tracing (span trees, decision ledger, "
         "slow-query log; pytest -m trace runs it in isolation; part of "
         "tier-1)")
+    config.addinivalue_line(
+        "markers",
+        "telemetry: continuous telemetry (windowed histograms, SLO burn "
+        "tracking, flight recorder; pytest -m telemetry runs it in "
+        "isolation; part of tier-1)")
 
 
 @pytest.fixture(scope="session")

@@ -682,6 +682,44 @@ def test_sync_catches_dispatcher_thread_materialization(tmp_path):
                for f in sf), [f.render() for f in new]
 
 
+def test_sync_catches_gauge_callback_materialization(tmp_path):
+    """Gauge/telemetry callbacks registered via ``MetricsRegistry.gauge``
+    (or ``Telemetry.track_gauge``) run on scrape/sampler threads: a
+    device sink inside one silently stalls every /metrics pull on device
+    execution. Both the lambda-closure and named-function registration
+    shapes must flag; an int-only gauge stays clean."""
+    new = _lint(tmp_path, """\
+        import jax.numpy as jnp
+        import numpy as np
+
+
+        class Exporter:
+            def __init__(self, registry):
+                self._staged = jnp.zeros(8)
+                self.depth = 3
+                dev = jnp.sum(self._staged)
+                # BAD: the lambda closes over a device value and
+                # materializes it at scrape time
+                registry.gauge("staged_total", lambda: float(dev))
+                # OK: plain host int
+                registry.gauge("queue_depth", lambda: float(self.depth))
+
+            def bind(self, registry):
+                # BAD: named callback sinking a device value per scrape
+                registry.gauge("staged_sum", self._read_total)
+
+            def _read_total(self):
+                total = jnp.sum(self._staged)
+                return np.asarray(total)
+        """)
+    sf = _by_checker(new, "sync")
+    assert any("gauge-lambda:float()" in f.symbol for f in sf), \
+        [f.render() for f in new]
+    assert any("_read_total" in f.symbol and "asarray" in f.symbol
+               for f in sf), [f.render() for f in new]
+    assert not any("queue_depth" in f.render() for f in sf)
+
+
 def test_sync_metadata_reads_never_flag(tmp_path):
     """.nbytes/.shape/.dtype on a device array are host-side metadata —
     reading them never syncs, even under a lock."""

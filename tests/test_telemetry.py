@@ -1,0 +1,542 @@
+"""Continuous telemetry: histograms, SLO burn, flight recorder, endpoints.
+
+Four layers:
+
+- **Histogram correctness** (the quantitative foundation): log-bucket
+  quantile estimates vs numpy references on known distributions, with the
+  error bound the bucket ratio implies; window rotation/expiry on a fake
+  clock; merge; and a multi-thread record hammer.
+- **SLO burn tracking**: objectives from config RAW keys (table names
+  with underscores survive), burn-rate math on both windows.
+- **Flight recorder**: burst triggers, deferred freeze, bundle contents
+  and persistence, debounce.
+- **End-to-end**: the bench_qps-shaped overload run on a live cluster —
+  sliding p99 visible on ``/debug/telemetry`` and distinct from the
+  lifetime mean, a nonzero SLO burn for the loaded table, and a frozen
+  ``rejection_burst`` bundle carrying span roots + decision deltas +
+  residency/admission snapshots; plus the ``/debug/*`` endpoint
+  inventory over every registered debug route.
+
+``pytest -m telemetry`` runs this module in isolation (tier-1).
+"""
+
+import json
+import threading
+import urllib.request
+
+import numpy as np
+import pytest
+
+from pinot_tpu.common.telemetry import (
+    BUCKET_BOUNDS_MS,
+    FlightRecorder,
+    Histogram,
+    Telemetry,
+    TELEMETRY,
+    WindowCounter,
+    WindowedHistogram,
+)
+
+pytestmark = pytest.mark.telemetry
+
+# the log-bucket growth ratio bounds the relative quantile error
+_BUCKET_RATIO = BUCKET_BOUNDS_MS[1] / BUCKET_BOUNDS_MS[0]
+
+
+class FakeClock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, s):
+        self.t += s
+
+
+# --------------------------------------------------------------------------
+# histogram correctness
+# --------------------------------------------------------------------------
+
+class TestHistogram:
+    @pytest.mark.parametrize("dist,args", [
+        ("uniform", (1.0, 500.0)),
+        ("lognormal", (3.0, 1.0)),
+        ("exponential", (40.0,)),
+    ])
+    def test_quantile_accuracy_vs_numpy(self, dist, args):
+        rng = np.random.default_rng(7)
+        vals = getattr(rng, dist)(*args, size=20_000)
+        vals = np.clip(vals, 1e-3, None)
+        h = Histogram()
+        for v in vals:
+            h.record(float(v))
+        for q in (0.5, 0.9, 0.95, 0.99):
+            est = h.quantile(q)
+            true = float(np.percentile(vals, q * 100))
+            rel = abs(est - true) / true
+            # one log bucket of slack (ratio ~1.19) is the design bound
+            assert rel <= _BUCKET_RATIO - 1.0 + 0.02, \
+                (dist, q, est, true, rel)
+
+    def test_count_sum_max_exact(self):
+        h = Histogram()
+        vals = [0.5, 1.0, 2.5, 100.0, 100000.0]  # incl. overflow bucket
+        for v in vals:
+            h.record(v)
+        snap = h.snapshot()
+        assert snap["count"] == len(vals)
+        assert snap["sumMs"] == pytest.approx(sum(vals), rel=1e-9)
+        assert snap["maxMs"] == pytest.approx(max(vals))
+
+    def test_overflow_bucket_quantile_is_max(self):
+        h = Histogram()
+        for v in (200_000.0, 300_000.0):  # beyond the top bound
+            h.record(v)
+        assert h.quantile(0.99) == pytest.approx(300_000.0)
+
+    def test_count_over_threshold(self):
+        h = Histogram()
+        vals = np.linspace(1.0, 1000.0, 5000)
+        for v in vals:
+            h.record(float(v))
+        true = int((vals > 250.0).sum())
+        est = h.count_over(250.0)
+        assert abs(est - true) / true <= 0.2, (est, true)
+
+    def test_merge_equals_combined(self):
+        rng = np.random.default_rng(11)
+        a_vals = rng.lognormal(2, 1, 3000)
+        b_vals = rng.uniform(1, 50, 3000)
+        a, b, both = Histogram(), Histogram(), Histogram()
+        for v in a_vals:
+            a.record(float(v))
+            both.record(float(v))
+        for v in b_vals:
+            b.record(float(v))
+            both.record(float(v))
+        a.merge(b)
+        assert a.counts == both.counts
+        assert a.count == both.count
+        assert a.sum == pytest.approx(both.sum)
+        assert a.quantile(0.95) == pytest.approx(both.quantile(0.95))
+
+    def test_multithread_record_hammer(self):
+        """8 threads x 5000 records: no lost updates under the record
+        lock, bucket totals consistent with the scalar counters."""
+        wh = WindowedHistogram(window_s=3600.0)
+        rng = np.random.default_rng(3)
+        per_thread = [rng.lognormal(2, 1, 5000) for _ in range(8)]
+
+        def pump(vals):
+            for v in vals:
+                wh.record(float(v))
+
+        threads = [threading.Thread(target=pump, args=(per_thread[i],))
+                   for i in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        total = 8 * 5000
+        assert wh.lifetime.count == total
+        assert sum(wh.lifetime.counts) == total
+        assert wh.lifetime.sum == pytest.approx(
+            sum(float(v) for vals in per_thread for v in vals), rel=1e-6)
+        assert wh.sliding().count == total  # nothing rotated out
+
+
+# --------------------------------------------------------------------------
+# window rotation / expiry
+# --------------------------------------------------------------------------
+
+class TestWindowRotation:
+    def test_sliding_expires_lifetime_keeps(self):
+        clock = FakeClock()
+        wh = WindowedHistogram(window_s=10.0, num_windows=3, clock=clock)
+        for _ in range(50):
+            wh.record(100.0)
+        assert wh.sliding().count == 50
+        clock.advance(15.0)  # one rotation: still inside the horizon
+        wh.record(1.0)
+        assert wh.sliding().count == 51
+        clock.advance(35.0)  # past the whole 30 s horizon
+        assert wh.sliding().count == 0
+        assert wh.lifetime.count == 51  # lifetime never expires
+
+    def test_partial_rotation_drops_oldest_window_only(self):
+        clock = FakeClock()
+        wh = WindowedHistogram(window_s=10.0, num_windows=3, clock=clock)
+        wh.record(5.0)          # window 0
+        clock.advance(10.0)
+        wh.record(6.0)          # window 1
+        clock.advance(10.0)
+        wh.record(7.0)          # window 2
+        assert wh.sliding().count == 3
+        clock.advance(10.0)     # reuses window 0's slot: first value gone
+        wh.record(8.0)
+        assert wh.sliding().count == 3
+
+    def test_sliding_differs_from_lifetime_after_shift(self):
+        """The acceptance shape: a latency regime change shows in the
+        sliding percentiles while the lifetime mean still averages the
+        old regime in."""
+        clock = FakeClock()
+        wh = WindowedHistogram(window_s=10.0, num_windows=3, clock=clock)
+        for _ in range(200):
+            wh.record(2.0)       # fast regime
+        clock.advance(40.0)      # fast regime rotates out entirely
+        for _ in range(50):
+            wh.record(400.0)     # slow regime
+        sliding_p99 = wh.sliding().quantile(0.99)
+        lifetime_mean = wh.lifetime.mean
+        assert sliding_p99 > 300.0
+        assert lifetime_mean < 150.0
+        assert abs(sliding_p99 - lifetime_mean) > 100.0
+
+    def test_window_counter(self):
+        clock = FakeClock()
+        wc = WindowCounter(window_s=10.0, num_windows=4, clock=clock)
+        wc.add(5)
+        clock.advance(10.0)
+        wc.add(3)
+        assert wc.in_window() == 8
+        assert wc.in_window(1) == 3
+        assert wc.total == 8
+        clock.advance(45.0)
+        assert wc.in_window() == 0
+        assert wc.total == 8
+
+
+# --------------------------------------------------------------------------
+# SLO burn
+# --------------------------------------------------------------------------
+
+class TestSlo:
+    def test_burn_rates_latency_and_error(self):
+        clock = FakeClock()
+        t = Telemetry(window_s=10.0, num_windows=4, clock=clock)
+        t.slo.set_objective("tbl", p99_ms=50.0, error_pct=2.0)
+        # 100 requests, 10 over the 50 ms objective (10% bad vs 1%
+        # allowed -> burn 10), 4 errors (4% vs 2% -> burn 2)
+        for i in range(100):
+            t.note_broker_query("tbl", 500.0 if i < 10 else 5.0,
+                                error=i < 4)
+        snap = t.slo_snapshot()["tables"]["tbl"]
+        assert snap["objectives"]["p99_ms"] == 50.0
+        assert snap["latency"]["long"]["burnRate"] == pytest.approx(10.0,
+                                                                    rel=0.15)
+        assert snap["errors"]["long"]["burnRate"] == pytest.approx(2.0,
+                                                                   rel=0.05)
+        # burn gauges surface the same numbers for /metrics
+        burns = t.burn_gauges()
+        assert burns[("tbl", "p99", "long")] == \
+            snap["latency"]["long"]["burnRate"]
+
+    def test_short_window_reacts_long_window_smooths(self):
+        clock = FakeClock()
+        t = Telemetry(window_s=10.0, num_windows=6, clock=clock)
+        t.slo.set_objective("tbl", p99_ms=50.0)
+        for _ in range(300):
+            t.note_broker_query("tbl", 1.0, error=False)  # healthy regime
+        clock.advance(45.0)  # healthy data ages toward the horizon edge
+        for _ in range(30):
+            t.note_broker_query("tbl", 500.0, error=False)  # incident
+        snap = t.slo_snapshot()["tables"]["tbl"]["latency"]
+        assert snap["short"]["burnRate"] > snap["long"]["burnRate"]
+        assert snap["short"]["burnRate"] > 50  # ~100% bad vs 1% allowed
+
+    def test_objectives_parse_from_raw_config_keys(self):
+        from pinot_tpu.spi.config import PinotConfiguration
+
+        cfg = PinotConfiguration(
+            {"pinot.broker.slo.ssb_lineorder_OFFLINE.p99.ms": "250",
+             "pinot.broker.slo.ssb_lineorder_OFFLINE.error.pct": "0.5",
+             "pinot.broker.slo.other_table.p99.ms": "100"},
+            use_env=False)
+        t = Telemetry()
+        t.configure(cfg)
+        obj = t.slo.objectives()
+        # underscored table names survive relaxed-key normalization
+        assert obj["ssb_lineorder_OFFLINE"] == {"p99_ms": 250.0,
+                                                "error_pct": 0.5}
+        assert obj["other_table"]["p99_ms"] == 100.0
+
+
+# --------------------------------------------------------------------------
+# prometheus exposition
+# --------------------------------------------------------------------------
+
+class TestExposition:
+    def test_histogram_family_shape(self):
+        from pinot_tpu.spi.metrics import MetricsRegistry
+
+        t = Telemetry()
+        for v in (1.0, 5.0, 50.0):
+            t.observe("tbl", "broker", v)
+        reg = MetricsRegistry(role="server")
+        reg.bind_telemetry(t)
+        text = reg.export_prometheus()
+        fam = "pinot_server_query_phase_latency_ms"
+        assert f"# TYPE {fam} histogram" in text
+        assert f"# HELP {fam} " in text
+        rows = [ln for ln in text.splitlines()
+                if ln.startswith(f'{fam}_bucket{{table="tbl"')]
+        # cumulative and monotonic, +Inf last and == _count
+        counts = [int(ln.rsplit(" ", 1)[1]) for ln in rows]
+        assert counts == sorted(counts)
+        assert rows[-1].rsplit(" ", 1)[0].endswith('le="+Inf"}')
+        assert counts[-1] == 3
+        assert f'{fam}_count{{table="tbl",phase="broker"}} 3' in text
+        assert f'{fam}_sum{{table="tbl",phase="broker"}} 56.0' in text
+
+    def test_help_type_and_sanitized_names(self):
+        from pinot_tpu.spi.metrics import MetricsRegistry
+
+        reg = MetricsRegistry(role="server")
+        reg.meter("weird name-with.bad:chars_total").mark(2)
+        reg.gauge("g", 1.5)
+        reg.timer("T").update_ms(2.0)
+        text = reg.export_prometheus()
+        # every family carries HELP + TYPE; names are sanitized
+        assert "# TYPE pinot_server_weird_name_with_bad:chars_total " \
+               "counter" in text
+        assert "pinot_server_weird_name_with_bad:chars_total 2" in text
+        for needle in ("# HELP pinot_server_g ", "# TYPE pinot_server_g "
+                       "gauge", "# TYPE pinot_server_T_ms summary"):
+            assert needle in text, text
+
+    def test_slo_burn_gauge_family(self):
+        from pinot_tpu.spi.metrics import MetricsRegistry
+
+        t = Telemetry()
+        t.slo.set_objective("tbl", p99_ms=1.0)
+        for _ in range(10):
+            t.note_broker_query("tbl", 100.0, error=False)
+        reg = MetricsRegistry(role="broker")
+        reg.bind_telemetry(t)
+        text = reg.export_prometheus()
+        assert "# TYPE pinot_broker_slo_burn_rate gauge" in text
+        assert ('pinot_broker_slo_burn_rate{table="tbl",objective="p99",'
+                'window="long"}') in text
+
+
+# --------------------------------------------------------------------------
+# flight recorder
+# --------------------------------------------------------------------------
+
+class TestFlightRecorder:
+    def test_burst_trips_and_freeze_is_deferred(self, tmp_path):
+        fr = FlightRecorder(out_dir=str(tmp_path))
+        fr.bursts = {"rejection": (5, 5.0)}
+        for _ in range(4):
+            fr.note_event("rejection")
+        assert fr.snapshot()["pendingTriggers"] == []  # under threshold
+        fr.note_event("rejection")
+        assert fr.snapshot()["pendingTriggers"] == ["rejection_burst"]
+        assert fr.snapshot()["bundles"] == []  # note_event never freezes
+        bundles = fr.process_pending()
+        assert len(bundles) == 1
+        assert bundles[0]["trigger"] == "rejection_burst"
+
+    def test_bundle_contents_and_persistence(self, tmp_path):
+        fr = FlightRecorder(out_dir=str(tmp_path))
+        fr.note_query({"sql": "SELECT 1", "spans": [{"name": "ServerQuery",
+                                                     "ms": 5.0}]})
+        fr.note_ledger_mark({"pallas:a->b:x": 1}, ts=100.0)
+        fr.note_ledger_mark({"pallas:a->b:x": 4}, ts=110.0)
+        fr.register_provider("residency", lambda: {"stagedBytes": 123})
+        fr.register_provider("broken", lambda: 1 / 0)
+        b = fr.freeze("manual")
+        assert b["spanRoots"][0]["spans"][0]["name"] == "ServerQuery"
+        assert b["decisions"]["delta"] == {"pallas:a->b:x": 3}
+        assert b["snapshots"]["residency"] == {"stagedBytes": 123}
+        assert "error" in b["snapshots"]["broken"]  # provider crash isolated
+        with open(b["path"]) as f:
+            on_disk = json.load(f)
+        assert on_disk["trigger"] == "manual"
+        snap = fr.snapshot()
+        assert snap["frozen"] == 1 and snap["last"]["trigger"] == "manual"
+
+    def test_freeze_debounce(self, tmp_path):
+        fr = FlightRecorder(out_dir=str(tmp_path),
+                            min_freeze_interval_s=3600.0)
+        fr.bursts = {"rejection": (1, 5.0)}
+        fr.note_event("rejection")
+        assert fr.process_pending()
+        fr.note_event("rejection")  # inside the debounce interval
+        assert fr.snapshot()["pendingTriggers"] == []
+        assert not fr.process_pending()
+
+    def test_p99_spike_trigger(self):
+        clock = FakeClock()
+        t = Telemetry(window_s=10.0, num_windows=3, clock=clock)
+        t.recorder.min_freeze_interval_s = 0.0
+        for _ in range(100):
+            t.observe("tbl", "broker", 2.0)
+        t.sample_now()  # seeds the p99 EWMA baseline on the fast regime
+        clock.advance(40.0)
+        for _ in range(100):
+            t.observe("tbl", "broker", 2000.0)  # 1000x spike
+        t.sample_now()
+        snap = t.recorder.snapshot()
+        triggers = [b["trigger"] for b in snap["bundles"]] \
+            + snap["pendingTriggers"]
+        assert any(tr.startswith("p99_spike:tbl:broker") for tr in triggers), \
+            snap
+
+
+# --------------------------------------------------------------------------
+# end-to-end: overload run + endpoint inventory on a live cluster
+# --------------------------------------------------------------------------
+
+def _get_json(port, path):
+    with urllib.request.urlopen(f"http://localhost:{port}{path}",
+                                timeout=10) as r:
+        assert r.status == 200, (path, r.status)
+        return json.loads(r.read().decode())
+
+
+@pytest.fixture()
+def overload_cluster(tmp_path):
+    """A 2-server cluster with a fresh process-wide telemetry center,
+    bundles landing under tmp_path."""
+    from pinot_tpu.spi import DataType, FieldSpec, FieldType, Schema
+    from pinot_tpu.spi.table import TableConfig
+    from pinot_tpu.tools.cluster import EmbeddedCluster
+
+    TELEMETRY.reset()
+    TELEMETRY.recorder.out_dir = str(tmp_path / "flight")
+    c = EmbeddedCluster(num_servers=2, data_dir=str(tmp_path / "c"))
+    schema = Schema("tel", [
+        FieldSpec("city", DataType.STRING),
+        FieldSpec("v", DataType.LONG, FieldType.METRIC)])
+    c.create_table(TableConfig("tel"), schema)
+    rng = np.random.default_rng(9)
+    for i in range(2):
+        c.ingest_rows("tel_OFFLINE", schema, {
+            "city": np.array(["sf", "nyc", "oak"])[rng.integers(0, 3, 600)],
+            "v": rng.integers(0, 50, 600).astype(np.int64)},
+            segment_name=f"tel_{i}")
+    assert c.wait_for_ev_converged("tel_OFFLINE")
+    yield c
+    c.shutdown()
+    TELEMETRY.reset()
+
+
+class TestOverloadEndToEnd:
+    def test_saturated_cluster_produces_telemetry_slo_and_blackbox(
+            self, overload_cluster):
+        """The acceptance run: bench_qps's saturation shape against a
+        live cluster. Must produce (a) sliding p99 on /debug/telemetry
+        distinct from the lifetime mean, (b) nonzero SLO burn for the
+        loaded table, (c) >= 1 flight-recorder bundle triggered by
+        rejection_burst carrying span roots + decision deltas +
+        residency/admission snapshots."""
+        from pinot_tpu.transport.rest import BrokerApi, ServerAdminApi
+
+        c = overload_cluster
+        c.broker.coalesce = False  # distinct executions, not one flight
+        # an unreachable p99 objective: every request burns budget
+        TELEMETRY.slo.set_objective("tel", p99_ms=0.01, error_pct=1.0)
+        # seed the span ring before overload: the burst can trip within
+        # milliseconds, possibly before any overload-phase traced query
+        # completes — a frozen bundle must still carry span roots
+        c.query("SELECT city, sum(v) FROM tel GROUP BY city "
+                "OPTION(trace=true)")
+        for server in c.servers.values():
+            server.executor.admission.configure(
+                max_concurrent=1, max_queue=-1, max_wait_ms=50)
+        TELEMETRY.sample_now()  # opening decision-ledger mark
+
+        queries = [f"SELECT city, sum(v) FROM tel WHERE v > {i} "
+                   f"GROUP BY city OPTION(trace=true)" for i in range(6)]
+
+        def pump(i):
+            for k in range(12):
+                c.query(queries[(i + k) % len(queries)])
+
+        threads = [threading.Thread(target=pump, args=(i,))
+                   for i in range(12)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        # closing mark + freeze of the rejection burst the overload caused
+        TELEMETRY.sample_now()
+
+        apis = [BrokerApi(c.broker, port=0),
+                ServerAdminApi(c.servers["server_0"], port=0)]
+        for api in apis:
+            api.start()
+        try:
+            broker_port, server_port = apis[0].port, apis[1].port
+            # (a) sliding p99 != lifetime mean on /debug/telemetry
+            tel = _get_json(server_port, "/debug/telemetry")
+            h = tel["histograms"].get("tel:server_exec")
+            assert h is not None, sorted(tel["histograms"])
+            assert h["sliding"]["count"] > 0
+            assert h["sliding"]["p99"] > 0
+            assert h["sliding"]["p99"] != h["lifetime"]["meanMs"]
+            # (b) nonzero SLO burn for the loaded table
+            slo = _get_json(broker_port, "/debug/slo")["tables"]["tel"]
+            assert slo["latency"]["long"]["burnRate"] > 0
+            # rejections surfaced as exceptions -> error burn too
+            assert slo["errors"]["long"]["requests"] > 0
+            # (c) a rejection_burst bundle with the full black-box payload
+            box = _get_json(server_port, "/debug/flightrecorder")
+            triggers = [b["trigger"] for b in box["bundles"]]
+            assert "rejection_burst" in triggers, box
+            last = box["last"]
+            if last["trigger"] != "rejection_burst":
+                last = next(b for b in TELEMETRY.recorder.bundles
+                            if b["trigger"] == "rejection_burst")
+            assert last["spanRoots"], "no span roots in the bundle"
+            assert any(e.get("spans") for e in last["spanRoots"])
+            assert last["decisions"]["delta"], "no decision delta"
+            assert "residency" in last["snapshots"]
+            assert "admission" in last["snapshots"]
+            assert last["snapshots"]["admission"].get("rejected", 0) > 0
+            # the bundle persisted to disk as timestamped JSON
+            assert last.get("path") and json.load(open(last["path"]))
+        finally:
+            for api in apis:
+                api.stop()
+
+
+class TestDebugEndpointInventory:
+    @pytest.mark.parametrize("role", ["broker", "server"])
+    def test_every_debug_route_serves_json(self, role, overload_cluster):
+        """EVERY registered GET /debug/* route answers valid JSON on a
+        live two-server cluster — route discovery is from the router
+        itself, so a new debug endpoint joins the gate automatically."""
+        from pinot_tpu.transport.rest import BrokerApi, ServerAdminApi
+
+        c = overload_cluster
+        c.query("SELECT count(*) FROM tel")  # warm every subsystem
+        api = BrokerApi(c.broker, port=0) if role == "broker" else \
+            ServerAdminApi(c.servers["server_0"], port=0)
+        api.start()
+        try:
+            debug_routes = [
+                (m, pat) for m, pat, _fn, _scope in api._routes
+                if m == "GET" and pat.pattern.startswith(r"/debug/")]
+            assert debug_routes, "no debug routes registered"
+            hit = []
+            for _m, pat in debug_routes:
+                # substitute each capture group with the live table name
+                path = pat.pattern.replace(r"([^/]+)", "tel")
+                body = _get_json(api.port, path)
+                assert isinstance(body, (dict, list)), path
+                hit.append(path)
+            expected = {"broker": ["/debug/scheduler", "/debug/telemetry",
+                                   "/debug/slo", "/debug/flightrecorder",
+                                   "/debug/routing/tel"],
+                        "server": ["/debug/memory", "/debug/launches",
+                                   "/debug/scheduler", "/debug/queries",
+                                   "/debug/telemetry", "/debug/slo",
+                                   "/debug/flightrecorder"]}[role]
+            for path in expected:
+                assert path in hit, (path, hit)
+        finally:
+            api.stop()

@@ -448,8 +448,11 @@ class TestDecisionLedger:
             "pallas:pallas_kernel->jnp_kernel:pallas_distinct_agg"] == 2
         assert led.reason_histogram()["pallas_distinct_agg"] == 2
         text = reg.export_prometheus()
-        assert "decision_declined_total_pallas_pallas_distinct_agg 2" \
-            in text
+        # ONE labeled family, not N name-mangled counters: every decline
+        # cell is a (point, reason) label pair under one TYPE header
+        assert "# TYPE pinot_server_decision_declined_total counter" in text
+        assert ('pinot_server_decision_declined_total{point="pallas",'
+                'reason="pallas_distinct_agg"} 2') in text
         # delta: the bench's per-suite view
         mark = led.snapshot()
         led.record("plan", "host_engine", "device_kernel",

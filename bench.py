@@ -1,18 +1,28 @@
 """Benchmark: SSB on the TPU query path vs an external CPU baseline.
 
-Architecture (round-5 redesign; round-4 postmortem: four fixed-timeout
-probes burned 640s, fell back to CPU, and the "146x" denominator was this
-framework's own host engine — a strawman):
+Architecture (round-6 redesign — probe-and-run in ONE process; round-5
+postmortem: every recorded round shows ``tpu_attempts: 7`` worker
+subprocesses each dying somewhere in init/build and dropping the WHOLE
+suite record, so no partial ever survived the flapping tunnel):
 
-- **supervisor** (default entry): fights for the real chip across the WHOLE
-  time budget. It launches worker subprocesses that init the backend and run
-  the suites IN THAT PROCESS (a separate probe process leaves a gap the
-  flapping tunnel falls into); a worker whose backend init hangs self-kills
-  via a watchdog thread. Partial results stream to a JSONL file per
-  sub-suite, so a mid-run tunnel flap still leaves numbers. When the
-  remaining budget hits the CPU reserve, one forced-CPU worker fills in
-  whatever sub-suites the chip never served. Per-sub-suite ``backend`` tags
-  make any fallback LOUD in the output.
+- **probe-and-run** (default entry): a cheap subprocess PROBE (--probe:
+  import jax, print the backend, exit) establishes chip liveness under a
+  bounded timeout; failed probes retry on one unified exponential backoff
+  that is clamped so it can NEVER burn into the CPU reserve (the old
+  supervisor slept after rc 3/4 but retried rc -1 immediately, and its
+  sleeps could eat the reserve). Once the probe sees a chip, the suites
+  run DIRECTLY IN THIS PROCESS — no worker respawn, no re-build, no gap
+  for the tunnel to flap into — streaming a partial JSON record per
+  sub-suite AND per SSB query as each completes, so a mid-suite TPU loss
+  still records everything that ran. A backend init that hangs AFTER a
+  successful probe is caught by a watchdog that launches the CPU reserve
+  pass itself before exiting.
+- **CPU reserve** (kept as the fallback): when the chip never shows (or
+  died mid-run), whatever sub-suites lack a record are filled in by a
+  forced-CPU pass — in-process when jax was never initialized here, as a
+  ``--worker`` subprocess otherwise (a process that touched the TPU
+  runtime cannot re-init on CPU). Per-sub-suite ``backend`` tags make any
+  fallback LOUD in the output.
 - **worker** (``--worker``): builds/loads the SSB table (parallel segment
   builder, manifest-keyed reuse across attempts), runs the sub-suites, and
   appends one JSON line each to BENCH_RESULT_FILE.
@@ -45,6 +55,8 @@ import threading
 import time
 import traceback
 
+from typing import Optional
+
 import numpy as np
 
 _T_START = time.time()
@@ -69,53 +81,94 @@ def _log(msg: str) -> None:
 
 
 # ==========================================================================
-# supervisor
+# probe-and-run (single process; CPU reserve as the fallback)
 # ==========================================================================
 
-def supervise() -> None:
+def merge_results(result_file: str, results: dict) -> None:
+    """Fold the JSONL partials into ``results`` (keyed by suite; per-SSB-
+    query partials ride as ``"ssb:Q1.1"`` keys) and truncate the file."""
+    try:
+        with open(result_file) as f:
+            for line in f:
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue
+                suite = rec.pop("suite", None)
+                if suite is None:
+                    continue
+                # a SUCCESSFUL real-chip result is never overwritten by
+                # a CPU one — but a chip ERROR record must not block the
+                # CPU reserve from filling the suite in
+                if (suite in results
+                        and results[suite].get("backend") != "cpu"
+                        and "error" not in results[suite]
+                        and rec.get("backend") == "cpu"):
+                    continue
+                results[suite] = rec
+        open(result_file, "w").close()
+    except FileNotFoundError:
+        pass
+
+
+def _backoff_sleep(attempt: int, reserve_deadline: float) -> bool:
+    """Unified retry backoff for EVERY failed chip probe — hung init,
+    no-chip, and timeout alike (the old supervisor backed off on rc 3/4
+    but retried a TimeoutExpired immediately, and its sleep could burn
+    into the CPU reserve). Exponential 5s -> 60s, clamped so the sleep
+    never crosses ``reserve_deadline`` minus the margin another attempt
+    needs. False = no budget for another attempt."""
+    room = reserve_deadline - time.time() - 120
+    if room <= 0:
+        return False
+    delay = min(60.0, 5.0 * (2 ** max(0, attempt - 1)), room)
+    _log(f"chip probe failed (attempt {attempt}); backing off "
+         f"{delay:.0f}s")
+    time.sleep(delay)
+    return True
+
+
+def probe_chip(timeout: float) -> Optional[str]:
+    """Bounded subprocess probe: init jax in a throwaway process and
+    report the default backend. None = no chip (timeout, hang, cpu-only,
+    or init error) — the caller decides whether to retry."""
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--probe"],
+            timeout=max(timeout, 10), capture_output=True, text=True)
+    except subprocess.TimeoutExpired:
+        return None
+    if proc.returncode != 0:
+        return None
+    backend = (proc.stdout or "").strip().splitlines()
+    return backend[-1] if backend else None
+
+
+def probe_and_run() -> None:
     deadline = _T_START + TIME_BUDGET_S
+    reserve_deadline = deadline - CPU_RESERVE_S
     result_file = os.environ.get("BENCH_RESULT_FILE") or os.path.join(
         tempfile.mkdtemp(prefix="bench_res_"), "results.jsonl")
     data_dir = os.environ.get("BENCH_DATA_DIR") or tempfile.mkdtemp(
         prefix="bench_data_")
+    os.environ["BENCH_RESULT_FILE"] = result_file
+    os.environ["BENCH_DATA_DIR"] = data_dir
     results: dict = {}
     tpu_attempts = 0
 
-    def merge() -> None:
-        try:
-            with open(result_file) as f:
-                for line in f:
-                    try:
-                        rec = json.loads(line)
-                    except ValueError:
-                        continue
-                    suite = rec.pop("suite", None)
-                    if suite is None:
-                        continue
-                    # a SUCCESSFUL real-chip result is never overwritten by
-                    # a CPU one — but a chip ERROR record must not block the
-                    # CPU reserve from filling the suite in
-                    if (suite in results
-                            and results[suite].get("backend") != "cpu"
-                            and "error" not in results[suite]
-                            and rec.get("backend") == "cpu"):
-                        continue
-                    results[suite] = rec
-            open(result_file, "w").close()
-        except FileNotFoundError:
-            pass
-
     def run_worker(backend: str, timeout: float, rows: int) -> int:
+        """Forced-backend worker subprocess (the CPU reserve pass)."""
         env = dict(os.environ,
                    BENCH_RESULT_FILE=result_file,
                    BENCH_DATA_DIR=data_dir,
                    BENCH_WANT_BACKEND=backend,
                    BENCH_WORKER_ROWS=str(rows),
-                   BENCH_WORKER_DEADLINE=str(deadline - (
-                       CPU_RESERVE_S if backend != "cpu" else 30)),
+                   BENCH_WORKER_DEADLINE=str(deadline - 30),
                    BENCH_SKIP_SUITES=",".join(
-                       s for s, r in results.items()
-                       if r.get("backend") != "cpu" and "error" not in r))
+                       s for s in SUITES
+                       if s in results
+                       and results[s].get("backend") != "cpu"
+                       and "error" not in results[s]))
         _log(f"launching {backend} worker (timeout {timeout:.0f}s, "
              f"rows {rows}, skip [{env['BENCH_SKIP_SUITES']}])")
         try:
@@ -127,33 +180,72 @@ def supervise() -> None:
             _log(f"{backend} worker timed out")
             return -1
 
-    while True:
-        remaining = deadline - time.time()
-        if remaining < CPU_RESERVE_S + 120:
-            break
+    def cpu_reserve(in_process: bool) -> None:
+        missing = [s for s in SUITES if s not in results
+                   or "error" in results[s]]
+        if not missing:
+            return
+        _log(f"CPU reserve pass for {missing} "
+             f"({'in-process' if in_process else 'subprocess'})")
+        if in_process:
+            os.environ["BENCH_WANT_BACKEND"] = "cpu"
+            os.environ["BENCH_WORKER_ROWS"] = str(CPU_SSB_ROWS)
+            os.environ["BENCH_WORKER_DEADLINE"] = str(deadline - 30)
+            os.environ["BENCH_SKIP_SUITES"] = ",".join(
+                s for s in SUITES if s not in missing)
+            try:
+                _Worker().run()
+            except Exception:
+                traceback.print_exc(file=sys.stderr)
+        else:
+            run_worker("cpu", deadline - time.time() - 30, CPU_SSB_ROWS)
+        merge_results(result_file, results)
+
+    # -- phase 1: fight for the chip (bounded probes, unified backoff) ----
+    backend = None
+    while time.time() + 120 < reserve_deadline:
         tpu_attempts += 1
-        rc = run_worker("tpu", remaining - CPU_RESERVE_S, TPU_SSB_ROWS)
-        merge()
-        done_on_chip = [s for s in SUITES
-                        if results.get(s, {}).get("backend")
-                        not in (None, "cpu")
-                        and "error" not in results.get(s, {})]
-        _log(f"tpu attempt {tpu_attempts} rc={rc}; chip-served suites: "
-             f"{done_on_chip}")
-        if len(done_on_chip) == len(SUITES):
+        backend = probe_chip(min(INIT_TIMEOUT_S,
+                                 reserve_deadline - time.time()))
+        if backend and backend != "cpu":
             break
-        if rc in (3, 4):
-            # backend init hung / tunnel handed us no chip: wait a bit for
-            # the tunnel to flap back before burning another attempt
-            time.sleep(min(60, max(5, deadline - time.time()
-                                   - CPU_RESERVE_S - 60)))
-    merge()
-    missing = [s for s in SUITES if s not in results
-               or "error" in results[s]]
-    if missing:
-        _log(f"CPU reserve pass for {missing}")
-        run_worker("cpu", deadline - time.time() - 30, CPU_SSB_ROWS)
-        merge()
+        backend = None
+        if not _backoff_sleep(tpu_attempts, reserve_deadline):
+            break
+
+    # -- phase 2: run the suites IN THIS PROCESS on the probed chip ------
+    if backend is not None:
+        _log(f"chip probe ok ({backend}); running suites in-process")
+        os.environ["BENCH_WANT_BACKEND"] = "tpu"
+        os.environ["BENCH_WORKER_ROWS"] = str(TPU_SSB_ROWS)
+        os.environ["BENCH_WORKER_DEADLINE"] = str(reserve_deadline)
+        os.environ["BENCH_SKIP_SUITES"] = ""
+
+        def on_hang() -> None:
+            # the probe said chip but the in-process init wedged: this
+            # thread runs the CPU reserve subprocess itself, emits, and
+            # kills the process (the main thread is unrecoverable)
+            _log("in-process backend init hung after successful probe; "
+                 "watchdog running CPU reserve")
+            merge_results(result_file, results)
+            cpu_reserve(in_process=False)
+            emit(results, tpu_attempts)
+            os._exit(0)
+
+        try:
+            _Worker(on_hang=on_hang).run()
+        except Exception:
+            # mid-run chip loss: per-sub-suite and per-SSB-query partials
+            # already on disk; the reserve pass fills the gaps
+            traceback.print_exc(file=sys.stderr)
+        merge_results(result_file, results)
+        cpu_reserve(in_process=False)
+    else:
+        # the chip never showed: jax was never initialized here, so the
+        # reserve pass runs in-process (no subprocess respawn gap)
+        merge_results(result_file, results)
+        cpu_reserve(in_process=True)
+
     emit(results, tpu_attempts)
 
 
@@ -173,6 +265,22 @@ def emit(results: dict, tpu_attempts: int) -> None:
     for s in SUITES:
         if s in results:
             out[s] = results[s]
+    # per-SSB-query partials: when the full SSB record is missing (chip
+    # died mid-suite) the completed queries still ship, with their rungs
+    # and pallas kernel counts — the record shows exactly which queries
+    # fired pallas before the loss
+    partial = {k.split(":", 1)[1]: v for k, v in results.items()
+               if k.startswith("ssb:")}
+    if partial and ("ssb" not in results or "error" in results.get(
+            "ssb", {})):
+        out["ssb_partial"] = {
+            "queries_completed": sorted(partial),
+            "per_query_ms": {q: v.get("p50_ms") for q, v in
+                             sorted(partial.items())},
+            "rungs": {q: v.get("rung") for q, v in sorted(partial.items())},
+            "pallas_kernels": {q: v.get("pallas_kernels") for q, v in
+                               sorted(partial.items())},
+        }
     out["trajectory"] = trajectory_gate(results)
     print(json.dumps(out), flush=True)
 
@@ -298,7 +406,7 @@ def trajectory_gate(results: dict, rounds: dict = None) -> dict:
 # worker
 # ==========================================================================
 
-def _init_backend(want: str) -> str:
+def _init_backend(want: str, on_hang=None) -> str:
     if want == "cpu":
         os.environ["JAX_PLATFORMS"] = "cpu"
         import jax
@@ -312,6 +420,11 @@ def _init_backend(want: str) -> str:
 
     def watchdog():
         if not ok.wait(INIT_TIMEOUT_S):
+            if on_hang is not None:
+                # probe-and-run mode: the watchdog OWNS recovery (CPU
+                # reserve + emit) because the main thread is wedged in
+                # backend init and nothing else will run
+                on_hang()
             print("bench worker: backend init hung; self-terminating",
                   file=sys.stderr, flush=True)
             os._exit(3)
@@ -327,13 +440,37 @@ def _init_backend(want: str) -> str:
     ok.set()
     backend = jax.default_backend()
     if backend == "cpu":
-        os._exit(4)  # wanted the chip; the supervisor decides what's next
+        os._exit(4)  # wanted the chip; the caller decides what's next
     return backend
 
 
+def probe_main() -> None:
+    """--probe entry: init the backend in this throwaway process and
+    report it on stdout. rc 0 + a non-cpu name = chip available."""
+    ok = threading.Event()
+
+    def watchdog():
+        if not ok.wait(INIT_TIMEOUT_S):
+            os._exit(3)
+
+    threading.Thread(target=watchdog, daemon=True).start()
+    import jax
+
+    try:
+        jax.devices()
+    except Exception:
+        traceback.print_exc(file=sys.stderr)
+        sys.exit(4)
+    ok.set()
+    backend = jax.default_backend()
+    print(backend, flush=True)
+    sys.exit(0 if backend != "cpu" else 4)
+
+
 class _Worker:
-    def __init__(self):
-        self.backend = _init_backend(os.environ["BENCH_WANT_BACKEND"])
+    def __init__(self, on_hang=None):
+        self.backend = _init_backend(os.environ["BENCH_WANT_BACKEND"],
+                                     on_hang=on_hang)
         self.rows = int(os.environ["BENCH_WORKER_ROWS"])
         self.deadline = float(os.environ["BENCH_WORKER_DEADLINE"])
         self.result_file = os.environ["BENCH_RESULT_FILE"]
@@ -397,7 +534,7 @@ class _Worker:
         scalar = rec.get("p50_ms_per_query",
                          rec.get("ms", rec.get(
                              "qps", rec.get("sliced_p50_ms_per_query",
-                                            ""))))
+                                            rec.get("p50_ms", "")))))
         _log(f"recorded {suite}: {scalar}")
 
     def run(self) -> None:
@@ -428,6 +565,14 @@ class _Worker:
                 traceback.print_exc(file=sys.stderr)
                 self.record(suite, {
                     "error": f"{type(exc).__name__}: {exc}"[:300]})
+
+    def _pallas_kernel_counts(self) -> dict:
+        """Fused-kernel counters: compiled sharded-combine programs (incl.
+        group-range probes) + the per-segment run_segment kernel cache."""
+        return {"sharded": len(self.dev._pallas_sharded),
+                "segment": len(self.dev.pallas_kernels),
+                "total": (len(self.dev._pallas_sharded)
+                          + len(self.dev.pallas_kernels))}
 
     # -- data ---------------------------------------------------------------
     def segments(self):
@@ -536,6 +681,16 @@ class _Worker:
                 samples.append((time.perf_counter() - t0) * 1e3)
             per_q50[qid] = float(np.percentile(samples, 50))
             per_q99[qid] = float(np.percentile(samples, 99))
+            # partial record PER QUERY: a mid-suite chip loss still ships
+            # every completed query with its rung + pallas kernel counts
+            # (exactly which queries fired pallas before the loss)
+            self.record(f"ssb:{qid}", {
+                "p50_ms": round(per_q50[qid], 3),
+                "p99_ms": round(per_q99[qid], 3),
+                "rung": rungs.get(qid),
+                "docs_scanned": docs_scanned.get(qid),
+                "pallas_kernels": self._pallas_kernel_counts(),
+            })
         n = len(ctxs)
         dev50 = sum(per_q50.values()) / n
         base50 = sum(base_ms.values()) / n
@@ -581,7 +736,11 @@ class _Worker:
             "per_query_p99_ms": {q: round(v, 2) for q, v in per_q99.items()},
             "group_by_rung": rungs,
             "docs_scanned": docs_scanned,
-            "pallas_kernels": len(self.dev._pallas_sharded),
+            # BOTH pallas counters: the sharded combine kernels (what the
+            # serving path fires) AND the per-segment run_segment cache
+            # (star-tree-free per-segment flights) — the old record
+            # counted only the sharded dict, hiding per-segment firings
+            "pallas_kernels": self._pallas_kernel_counts(),
             "parity": "ok",
         }
 
@@ -1107,8 +1266,11 @@ def main() -> None:
     if "--worker" in sys.argv:
         _Worker().run()
         return
+    if "--probe" in sys.argv:
+        probe_main()
+        return
     try:
-        supervise()
+        probe_and_run()
     except Exception as exc:  # never leave the round without a JSON line
         traceback.print_exc(file=sys.stderr)
         print(json.dumps({

@@ -342,12 +342,31 @@ _DECLINE_RULES: Tuple[Tuple[str, str], ...] = (
     ("non-numeric/MV agg value column", "pallas_value_not_numeric_sv"),
     ("no stats for int value bound", "pallas_no_int_stats"),
     ("i64-staged value column", "pallas_i64_value_column"),
+    ("i64 sum bound over i64", "pallas_i64_sum_bound_over_i64"),
+    ("i64 column in float expression", "pallas_i64_in_float_expr"),
     ("missing agg value", "pallas_missing_agg_value"),
     ("int expr bound exceeds i32", "pallas_expression_bound_over_i32"),
     ("agg value", "pallas_agg_value_op_unsupported"),
     ("mv aggregation", "pallas_mv_aggregation"),
     ("int min/max not f32-exact", "pallas_minmax_not_f32_exact"),
 )
+
+# Reason codes recorded DIRECTLY at decline sites (never routed through
+# classify_decline's message table). The graftlint ``decline`` family
+# checks every ``decline("...")`` literal in engine/pallas_kernels.py
+# against this registry plus _DECLINE_RULES' code column, so a new
+# decline site can never reach the ledger as an unregistered code.
+DIRECT_DECLINE_CODES = frozenset({
+    "pallas_too_many_groups",
+    "pallas_distinct_agg",
+    "pallas_docs_over_i32",
+    "pallas_column_not_packable",
+    "pallas_value_layout_unsupported",
+    "pallas_disabled_on_backend",
+    "pallas_shape_blocked",
+    "pallas_exec_failed",
+    "pallas_build_failed",
+})
 
 _SANITIZE = re.compile(r"[^a-z0-9]+")
 _DIGITS = re.compile(r"\d+")

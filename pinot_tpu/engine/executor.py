@@ -162,6 +162,9 @@ class ServerQueryExecutor:
         cfg = config if config is not None else PinotConfiguration()
         self.worker_threads = max(1, cfg.get_int(
             _CC.WORKER_THREADS_KEY, min(os.cpu_count() or 1, 8)))
+        # pallas LUT interval-run cap (the "ivs" fallback bound)
+        self._pallas_lut_runs = max(1, cfg.get_int(
+            _CC.PALLAS_LUT_MAX_RUNS_KEY, _CC.DEFAULT_PALLAS_LUT_MAX_RUNS))
         self._segment_pool = None
         self._segment_pool_lock = threading.Lock()
         # request-tier admission: bounded concurrency + bounded queue in
@@ -757,9 +760,11 @@ class ServerQueryExecutor:
 
     def _run_device_scalar(self, plan: SegmentPlan, seg: ImmutableSegment,
                            stats: QueryStats) -> AggResult:
-        out = self._try_pallas(plan, seg, stats)
-        if out is None:
-            out = self._run_kernel(plan, seg, stats)
+        served = self._try_pallas(plan, seg, stats)
+        if served is not None:
+            out, eff = served
+            return decode_scalar_result(eff, seg, out)
+        out = self._run_kernel(plan, seg, stats)
         return decode_scalar_result(plan, seg, out)
 
     # -- group-by ----------------------------------------------------------
@@ -826,23 +831,39 @@ class ServerQueryExecutor:
 
     def _run_device_grouped(self, plan: SegmentPlan, seg: ImmutableSegment,
                             stats: QueryStats) -> GroupByResult:
-        out = self._try_pallas(plan, seg, stats)
-        if out is None:
-            out = self._run_kernel(plan, seg, stats)
+        served = self._try_pallas(plan, seg, stats)
+        if served is not None:
+            # decode against the EFFECTIVE plan: the probe-narrowed shape
+            # (large sparse key spaces) carries its own strides/bases
+            out, eff = served
+            result = decode_grouped_result(eff, seg, out)
+            stats.group_by_rung = grouped_rung(eff.spec, out)
+            return result
+        out = self._run_kernel(plan, seg, stats)
         result = decode_grouped_result(plan, seg, out)
         stats.group_by_rung = grouped_rung(plan.spec, out)
         return result
 
     def _try_pallas(self, plan: SegmentPlan, seg: ImmutableSegment,
-                    stats: QueryStats) -> Optional[Dict[str, Any]]:
-        """Fused Pallas scan when the plan is eligible; returns the unpacked
-        output tree (same shape as the jnp kernel's) or None."""
+                    stats: QueryStats
+                    ) -> Optional[Tuple[Dict[str, Any], SegmentPlan]]:
+        """Fused Pallas scan when the plan is eligible; returns the
+        unpacked output tree (same shape as the jnp kernel's) plus the
+        EFFECTIVE plan it decodes against (the original, or the
+        probe-narrowed plan for large-group shapes), or None."""
         from pinot_tpu.engine import pallas_kernels
         from pinot_tpu.engine.kernels import unpack_outputs
 
         interpret = self._pallas_mode()
         if interpret is None:
-            record_decision(stats, "pallas", "jnp_kernel", "pallas_kernel",
+            # auto mode on a non-TPU backend is a BACKEND decision, not a
+            # pallas-eligibility one: it records under the backend point
+            # so the ledger still explains the fallback per query, while
+            # the pallas histogram (and its decline-burst trigger) stays
+            # reserved for real eligibility gaps. Explicit config
+            # (use_pallas=False / GPU) keeps the pallas-point record.
+            point = "backend" if self.use_pallas is None else "pallas"
+            record_decision(stats, point, "jnp_kernel", "pallas_kernel",
                             "pallas_disabled_on_backend")
             return None
         if plan.spec in self._pallas_blocked:
@@ -857,12 +878,13 @@ class ServerQueryExecutor:
                             reason)
 
         def launch():
-            packed = pallas_kernels.run_segment(plan, staged,
-                                                self.pallas_kernels,
-                                                interpret,
-                                                on_decline=declined)
-            return None if packed is None \
-                else unpack_outputs(packed, plan.spec)
+            served = pallas_kernels.run_segment(
+                plan, staged, self.pallas_kernels, interpret,
+                on_decline=declined, lut_run_cap=self._pallas_lut_runs)
+            if served is None:
+                return None
+            packed, eff = served
+            return unpack_outputs(packed, eff.spec), eff
 
         try:
             # per-segment coalescing contract: concurrent identical queries
@@ -877,10 +899,10 @@ class ServerQueryExecutor:
             t0 = _time.perf_counter()
             with maybe_span(stats, "Kernel", kernel="pallas",
                             segment=seg.segment_name) as sp:
-                out, _ = self._kernel_flight.do(
+                served, _ = self._kernel_flight.do(
                     ("pallas", id(plan), id(staged)), launch)
                 if sp is not None:
-                    sp.attrs["served"] = out is not None
+                    sp.attrs["served"] = served is not None
             observe_ms(getattr(stats, "_tel_table", ""), "kernel",
                        (_time.perf_counter() - t0) * 1e3)
         except Exception:  # lowering/compile failure -> jnp kernels
@@ -895,10 +917,10 @@ class ServerQueryExecutor:
             self._pallas_blocked.add(plan.spec)
             declined("pallas_exec_failed")
             return None
-        if out is None:
+        if served is None:
             return None
-        self._track_kernel_stats(out, seg, stats)
-        return out
+        self._track_kernel_stats(served[0], seg, stats)
+        return served
 
     # -- shared ------------------------------------------------------------
     def _run_kernel(self, plan: SegmentPlan, seg: ImmutableSegment,

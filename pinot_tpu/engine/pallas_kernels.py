@@ -53,7 +53,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from pinot_tpu.engine.staging import PALLAS_TILE, StagedSegment
+from pinot_tpu.engine.staging import LIMB_BITS, PALLAS_TILE, StagedSegment
 
 # one-hot chunk width along the group dimension (lane count)
 _G_CHUNK = 128
@@ -63,7 +63,9 @@ _G_CHUNK = 128
 MAX_PALLAS_GROUPS = 8192
 # int values are split into limbs of this many bits so every per-tile limb
 # matmul partial is f32-exact: (2^12 - 1) * PALLAS_TILE < 2^24
-_LIMB_BITS = 12
+# (staging.LIMB_BITS is the same constant — the host-side limb-plane split
+# for i64 columns must mirror the in-kernel split bit-for-bit)
+_LIMB_BITS = LIMB_BITS
 _LIMB_MASK = (1 << _LIMB_BITS) - 1
 # f32 can represent integers exactly below 2^24 (min/max value bound)
 _F32_EXACT = 1 << 24
@@ -99,26 +101,38 @@ class PallasSpec:
     # None for float sums and non-sum aggregations
     aggs: Tuple[Tuple[str, Optional[Tuple], Optional[int]], ...]
     value_is_int: Tuple[bool, ...]        # per value input
-    interpret: bool
+    # per value input: 0 = one staged f32/i32 array ref; L > 0 = the input
+    # is an i64-staged column shipped as L pre-split 12-bit limb PLANES
+    # (i32 refs, host-split with the kernel's exact shift/mask scheme) —
+    # its sums accumulate limb-by-limb with no i64 math in-kernel
+    value_limbs: Tuple[int, ...] = ()
+    interpret: bool = False
 
 
 class _Ineligible(Exception):
     pass
 
 
-# max interval runs a boolean dictId LUT may decompose into before the
-# pallas path declines it (each run is one compare pair in-kernel)
+# max interval runs a boolean dictId LUT decomposes into as STATIC spec
+# leaves (each run is one compare pair baked into the filter tree); more
+# runs fall back to the padded interval-set node below
 _MAX_LUT_RUNS = 8
+# default runtime cap on interval runs the padded "ivs" (interval-bitmap)
+# fallback accepts: each run is one SMEM compare pair per tile, so the cap
+# bounds in-kernel work. Configurable via
+# pinot.server.query.pallas.lut.max.runs (callers thread it through).
+DEFAULT_LUT_RUN_CAP = 64
 
 
-def _lut_runs(lut: np.ndarray) -> Optional[List[Tuple[int, int]]]:
+def _lut_runs(lut: np.ndarray,
+              cap: int = DEFAULT_LUT_RUN_CAP) -> Optional[List[Tuple[int, int]]]:
     """Boolean LUT -> [(lo, hi)] inclusive dictId runs, or None if more
-    than _MAX_LUT_RUNS (fall back to the jnp LUT-gather kernel)."""
+    than ``cap`` (fall back to the jnp LUT-gather kernel)."""
     idx = np.nonzero(np.asarray(lut, dtype=bool))[0]
     if idx.size == 0:
         return []
     breaks = np.nonzero(np.diff(idx) > 1)[0]
-    if breaks.size + 1 > _MAX_LUT_RUNS:
+    if breaks.size + 1 > cap:
         return None
     runs = []
     start = 0
@@ -148,6 +162,8 @@ class PallasPlan:
     num_groups_padded: int
     aggs: Tuple[Tuple[str, Optional[Tuple], Optional[int]], ...]
     static_params: np.ndarray             # [2 * n_slots] i32 interval bounds
+    # per value input: limb-plane count (0 = plain f32/i32 array)
+    value_limbs: Tuple[int, ...] = ()
 
     def spec(self, num_segs: int, tiles_per_seg: int,
              interpret: bool) -> PallasSpec:
@@ -159,6 +175,7 @@ class PallasPlan:
             group_key_offset=self.group_key_offset,
             num_groups_padded=self.num_groups_padded,
             aggs=self.aggs, value_is_int=self.value_is_int,
+            value_limbs=self.value_limbs,
             interpret=interpret)
 
 
@@ -169,13 +186,20 @@ def _limbs_for(max_abs: int) -> int:
     return max(1, -(-max(max_abs.bit_length(), 1) // _LIMB_BITS))
 
 
-def extract_plan(plan, provider, on_decline=None) -> Optional[PallasPlan]:
+def extract_plan(plan, provider, on_decline=None,
+                 lut_run_cap: int = DEFAULT_LUT_RUN_CAP,
+                 unchecked_groups: bool = False) -> Optional[PallasPlan]:
     """SegmentPlan -> PallasPlan, or None when the query shape isn't covered
     by the fused kernel. ``provider`` supplies column metadata (an
     ImmutableSegment or a SegmentBatch with unified stats). ``on_decline``
     (if given) receives the machine-readable reason code whenever None is
     returned — the path-decision ledger's hook; every ineligibility is
-    classified, never ``unknown``."""
+    classified, never ``unknown``. ``lut_run_cap`` bounds the interval-set
+    fallback for many-run LUT predicates. ``unchecked_groups`` skips the
+    MAX_PALLAS_GROUPS bound — the group-range probe path extracts the full
+    plan first, derives a probe kernel from it, and re-extracts against the
+    probe-narrowed plan (never build a grouped kernel from an unchecked
+    extraction directly)."""
     from pinot_tpu.engine.kernels import _ParamCursor
     from pinot_tpu.engine.staging import staged_int_dtype
 
@@ -184,7 +208,8 @@ def extract_plan(plan, provider, on_decline=None) -> Optional[PallasPlan]:
             on_decline(reason)
 
     filter_spec, agg_specs, group_specs, num_groups, _ = plan.spec
-    if group_specs and num_groups > MAX_PALLAS_GROUPS:
+    if group_specs and num_groups > MAX_PALLAS_GROUPS \
+            and not unchecked_groups:
         decline("pallas_too_many_groups")
         return None
     if any(a[0] in ("distinctcount", "distinctcounthll")
@@ -234,15 +259,31 @@ def extract_plan(plan, provider, on_decline=None) -> Optional[PallasPlan]:
             if op == "lut":
                 # boolean LUT over a SORTED dictionary = union of dictId
                 # runs; small run counts become OR-of-intervals (covers
-                # IN / merged-EQ / many REGEXP predicates)
+                # IN / merged-EQ / many REGEXP predicates); past
+                # _MAX_LUT_RUNS and up to ``lut_run_cap`` the runs ride ONE
+                # padded interval-set node ("ivs") — the interval-bitmap
+                # fallback: a pow2-padded block of runtime interval slots
+                # (empty pads encoded (1, 0)) OR-reduced in-kernel, so the
+                # spec stays stable across literal sets with similar run
+                # counts instead of baking each run into the tree shape
                 lut = np.asarray(pc.take())
-                runs = _lut_runs(lut)
+                runs = _lut_runs(lut, max(_MAX_LUT_RUNS, lut_run_cap))
                 if runs is None:
                     raise _Ineligible("lut with too many runs")
                 if not runs:
                     return ("not", (("true",),))
-                leaves = tuple(iv_leaf(node[1], lo, hi) for lo, hi in runs)
-                return leaves[0] if len(leaves) == 1 else ("or", leaves)
+                if len(runs) <= _MAX_LUT_RUNS:
+                    leaves = tuple(iv_leaf(node[1], lo, hi)
+                                   for lo, hi in runs)
+                    return leaves[0] if len(leaves) == 1 else ("or", leaves)
+                pi = packed_idx(node[1])
+                n_pad = 1 << (len(runs) - 1).bit_length()
+                slot0 = len(intervals)
+                for lo, hi in runs:
+                    intervals.append((lo, hi))
+                for _ in range(n_pad - len(runs)):
+                    intervals.append((1, 0))   # empty interval pad
+                return ("ivs", pi, slot0, n_pad)
             raise _Ineligible(op)
 
         tree = walk(filter_spec)
@@ -268,33 +309,46 @@ def extract_plan(plan, provider, on_decline=None) -> Optional[PallasPlan]:
         # -- aggregation value expressions (ref: the reference evaluates
         # transform expressions inside the aggregation operator,
         # AggregationFunctionUtils + TransformOperator; here int exprs run
-        # exactly in i32, float exprs in f32, inside the fused kernel)
+        # exactly in i32, float exprs in f32, inside the fused kernel).
+        # i64-staged columns (stats beyond i32) ship as pre-split 12-bit
+        # limb PLANES (staging.value_limb_planes) and ride the existing
+        # multi-limb i32 accumulation at the value-load layer: the limb
+        # rows come straight from the planes, no i64 math in-kernel.
         value_names: List[str] = []
         value_is_int: List[bool] = []
+        value_limbs: List[int] = []
 
-        def leaf_idx(name: str) -> Tuple[int, bool, Optional[int]]:
+        def leaf_idx(name: str) -> Tuple[Tuple, bool, Optional[int]]:
             cm = provider.metadata.column(name)
             if not (cm.single_value and cm.data_type.is_numeric):
                 raise _Ineligible("non-numeric/MV agg value column")
             is_int = cm.data_type.is_integral
             max_abs: Optional[int] = None
+            limbs = 0
             if is_int:
                 if cm.min_value is None or cm.max_value is None:
                     raise _Ineligible("no stats for int value bound")
-                if staged_int_dtype(cm) != np.dtype(np.int32):
-                    raise _Ineligible("i64-staged value column")
                 max_abs = max(abs(int(cm.min_value)), abs(int(cm.max_value)))
+                if staged_int_dtype(cm) != np.dtype(np.int32):
+                    # exact reassembly needs the provider-wide sum inside
+                    # i64 (the carry-chain rows shift by up to 62 bits)
+                    if max_abs * max(1, provider.metadata.num_docs) \
+                            >= (1 << 62):
+                        raise _Ineligible("i64 sum bound over i64")
+                    limbs = _limbs_for(max_abs)
             if name not in value_names:
                 value_names.append(name)
                 value_is_int.append(is_int)
-            return value_names.index(name), is_int, max_abs
+                value_limbs.append(limbs)
+            vi = value_names.index(name)
+            leaf = ("v64", vi) if limbs else ("v", vi)
+            return leaf, is_int, max_abs
 
         def compile_vexpr(vspec) -> Tuple[Tuple, bool, Optional[int]]:
             if vspec is None:
                 raise _Ineligible("missing agg value")
             if vspec[0] == "col":
-                vi, is_int, max_abs = leaf_idx(vspec[1])
-                return ("v", vi), is_int, max_abs
+                return leaf_idx(vspec[1])
             if vspec[0] == "lit":
                 # literal params become SPEC constants: units/factors are
                 # low-cardinality, so keying the kernel cache on them is
@@ -312,9 +366,14 @@ def extract_plan(plan, provider, on_decline=None) -> Optional[PallasPlan]:
                 if li and ri:
                     max_abs = lm * rm if vspec[1] == "times" else lm + rm
                     if max_abs > _I32_MAX:
-                        # in-kernel i32 arithmetic would wrap
+                        # in-kernel i32 arithmetic would wrap (an i64
+                        # operand always lands here: its bound alone
+                        # exceeds i32, so limb planes stay sum-only)
                         raise _Ineligible("int expr bound exceeds i32")
                     return (vspec[1], le, re_), True, max_abs
+                if _has_v64(le) or _has_v64(re_):
+                    # limb planes carry no per-doc value to convert to f32
+                    raise _Ineligible("i64 column in float expression")
                 return (vspec[1], le, re_), False, None
             # mod/floordiv deliberately stay jnp-served: Mosaic integer
             # division support is not guaranteed, and one lowering failure
@@ -337,7 +396,8 @@ def extract_plan(plan, provider, on_decline=None) -> Optional[PallasPlan]:
                              else None))
             else:
                 # min/max rows reduce in f32: int values >= 2^24 would round
-                # (the jnp kernel keeps them exact in i32) -> ineligible
+                # (the jnp kernel keeps them exact in i32) -> ineligible;
+                # i64 limb planes are sum-only (covered by this bound too)
                 if is_int and max_abs >= _F32_EXACT:
                     raise _Ineligible("int min/max not f32-exact")
                 aggs.append((base, vexpr, None))
@@ -365,7 +425,127 @@ def extract_plan(plan, provider, on_decline=None) -> Optional[PallasPlan]:
         n_slots=len(intervals), group_idx=tuple(group_idx),
         group_strides=tuple(strides), group_key_offset=key_offset,
         num_groups_padded=G,
-        aggs=tuple(aggs), static_params=params)
+        aggs=tuple(aggs), static_params=params,
+        value_limbs=tuple(value_limbs))
+
+
+def _has_v64(vexpr: Tuple) -> bool:
+    if vexpr[0] == "v64":
+        return True
+    if vexpr[0] in ("v", "litc", "litf", "id"):
+        return False
+    return _has_v64(vexpr[1]) or _has_v64(vexpr[2])
+
+
+# --------------------------------------------------------------------------
+# group-range probe: the narrowing pass that puts LARGE-but-sparse composed
+# key spaces (SSB Q3.2/Q4.3: city x city x year, brand x city x year) on the
+# dense one-hot rung. The filter makes those spaces sparse (only one
+# nation's cities, one category's brands survive), but plan-time narrowing
+# can only use predicates ON the group columns themselves. The probe runs
+# the SAME fused scan (unpack + filter) with per-group-column masked
+# min/max-of-dictId aggregations — a tiny min/max-row kernel, no matmul —
+# and the host narrows each column's key range to the observed [lo, hi]
+# before building the real kernel (plan.narrow_plan_groups rewrites
+# strides/bases, so decode/merge machinery applies unchanged). Sorted
+# dictionaries make the correlated value sets contiguous, so the narrowed
+# product collapses to the live group count's scale.
+# --------------------------------------------------------------------------
+
+def probe_plan_of(pp: PallasPlan) -> PallasPlan:
+    """Derive the group-range probe plan from an (unchecked-groups) full
+    extraction: same packed columns / filter tree / interval params, no
+    value inputs, and one (min, max) masked-dictId aggregation pair per
+    group column via the ``("id", packed_idx)`` value node."""
+    aggs: List[Tuple[str, Optional[Tuple], Optional[int]]] = []
+    for gi in pp.group_idx:
+        aggs.append(("min", ("id", gi), None))
+        aggs.append(("max", ("id", gi), None))
+    return PallasPlan(
+        packed_names=list(pp.packed_names), value_names=[],
+        value_is_int=(), filter_tree=pp.filter_tree, n_slots=pp.n_slots,
+        group_idx=(), group_strides=(), group_key_offset=0,
+        num_groups_padded=_G_CHUNK, aggs=tuple(aggs),
+        static_params=pp.static_params, value_limbs=())
+
+
+def decode_probe_ranges(spec: PallasSpec, out_mm,
+                        n_cols: int) -> List[Tuple[int, int]]:
+    """Probe kernel output -> per-group-column inclusive (lo, hi) observed
+    dictId ranges. A column no matched row touched (min row still +inf)
+    collapses to (0, 0) — a 1-slot key space is enough for an empty
+    result."""
+    _, _, mm_row, _, _, _ = _row_layout(spec)
+    mm = np.asarray(out_mm)
+    ranges: List[Tuple[int, int]] = []
+    for i in range(n_cols):
+        vexpr = spec.aggs[2 * i][1]
+        lo = float(mm[mm_row[(vexpr, "min")], 0])
+        hi = float(mm[mm_row[(vexpr, "max")], 0])
+        if not (np.isfinite(lo) and np.isfinite(hi)) or lo > hi:
+            ranges.append((0, 0))
+        else:
+            ranges.append((int(lo), int(hi)))
+    return ranges
+
+
+def probe_narrowed_plan(plan, provider, run_probe, lut_run_cap, decline
+                        ) -> Optional[Tuple]:
+    """Group-range narrowing orchestration shared by the per-segment and
+    sharded callers: full unchecked extraction -> probe kernel (executed
+    by ``run_probe(probe_pp, probe_spec_fn)``, which stages the packed
+    inputs its own way and returns the out_mm rows) -> narrowed effective
+    SegmentPlan -> re-extraction. Returns (PallasPlan, effective plan) or
+    None (with the reason on ``decline``)."""
+    from pinot_tpu.engine.plan import narrow_plan_groups
+
+    pp_full = extract_plan(plan, provider, on_decline=decline,
+                           lut_run_cap=lut_run_cap, unchecked_groups=True)
+    if pp_full is None:
+        return None
+    # min/max rows reduce in f32: dictIds past 2^24 would round
+    for card in plan.group_cards:
+        if card >= _F32_EXACT:
+            decline("pallas_too_many_groups")
+            return None
+    probe_pp = probe_plan_of(pp_full)
+    out_mm = run_probe(probe_pp)
+    if out_mm is None:
+        return None   # run_probe recorded its own reason
+    ranges = decode_probe_ranges(
+        probe_pp.spec(num_segs=1, tiles_per_seg=1, interpret=True),
+        out_mm, len(plan.group_cards))
+    eff = narrow_plan_groups(plan, ranges)
+    if eff.num_groups > MAX_PALLAS_GROUPS:
+        decline("pallas_too_many_groups")
+        return None
+    pp = extract_plan(eff, provider, on_decline=decline,
+                      lut_run_cap=lut_run_cap)
+    if pp is None:
+        return None
+    return pp, eff
+
+
+class _DeferredDecline:
+    """Capture extract declines so the probe path can retry on
+    ``pallas_too_many_groups`` without double-recording; ``flush`` forwards
+    the captured reason when no retry succeeded."""
+
+    def __init__(self, on_decline):
+        self.on_decline = on_decline
+        self.reasons: List[str] = []
+
+    def __call__(self, reason: str) -> None:
+        self.reasons.append(reason)
+
+    @property
+    def only_group_bound(self) -> bool:
+        return self.reasons == ["pallas_too_many_groups"]
+
+    def flush(self) -> None:
+        if self.on_decline is not None:
+            for r in self.reasons:
+                self.on_decline(r)
 
 
 # --------------------------------------------------------------------------
@@ -429,6 +609,15 @@ def build_kernel(spec: PallasSpec):
     n_chunks = G // _G_CHUNK
     n_packed = len(spec.packed_bits)
     n_values = len(spec.value_is_int)
+    # per value input: how many refs it occupies (1 plain array, or L
+    # pre-split 12-bit limb planes for i64-staged columns) and where its
+    # ref block starts
+    vlimbs = spec.value_limbs or (0,) * n_values
+    v_start: List[int] = []
+    n_value_refs = 0
+    for l in vlimbs:
+        v_start.append(n_value_refs)
+        n_value_refs += l if l else 1
     S = spec.num_segs
     TPS = spec.tiles_per_seg
 
@@ -441,8 +630,8 @@ def build_kernel(spec: PallasSpec):
 
     def kernel(params_ref, *refs):
         packed = refs[:n_packed]
-        values = refs[n_packed:n_packed + n_values]
-        out_f, out_i, out_mm, out_seg = refs[n_packed + n_values:]
+        values = refs[n_packed:n_packed + n_value_refs]
+        out_f, out_i, out_mm, out_seg = refs[n_packed + n_value_refs:]
         s = pl.program_id(0)
         t = pl.program_id(1)
 
@@ -495,6 +684,17 @@ def build_kernel(spec: PallasSpec):
                 return m
             if op == "not":
                 return ~emit(node[1][0])
+            if op == "ivs":
+                # interval-set fallback for many-run LUTs: OR over a
+                # pow2-padded block of runtime interval slots (pads are
+                # empty (1, 0) intervals matching nothing)
+                _, pi, slot0, n_runs = node
+                m = jnp.zeros((RT, 128), dtype=bool)
+                for j in range(n_runs):
+                    lo = params_ref[2 * (slot0 + j)]
+                    hi = params_ref[2 * (slot0 + j) + 1]
+                    m = m | ((ids[pi] >= lo) & (ids[pi] <= hi))
+                return m
             _, pi, slot = node                     # "iv"
             lo = params_ref[2 * slot]
             hi = params_ref[2 * slot + 1]
@@ -512,8 +712,16 @@ def build_kernel(spec: PallasSpec):
             v = vexpr_cache.get(vexpr)
             if v is not None:
                 return v
-            if vexpr[0] == "v":
-                v = values[vexpr[1]][0, 0]
+            if vexpr[0] == "v64":
+                # limb planes carry no single per-doc value; extract_plan
+                # keeps them sum-only (their limb rows read planes directly)
+                raise AssertionError("v64 leaves never emit as values")
+            if vexpr[0] == "id":
+                # unpacked dictIds as a value row (the group-range probe's
+                # masked min/max-of-id aggregations)
+                v = ids[vexpr[1]]
+            elif vexpr[0] == "v":
+                v = values[v_start[vexpr[1]]][0, 0]
             elif vexpr[0] == "litc":
                 v = jnp.int32(vexpr[1])
             elif vexpr[0] == "litf":
@@ -554,6 +762,16 @@ def build_kernel(spec: PallasSpec):
             rows.append(emit_vexpr(vexpr).astype(jnp.float32) * mask_f)
         rows.append(mask_f)                        # count row (out_i row 0)
         for vexpr, (start, L) in int_sums:
+            if vexpr[0] == "v64":
+                # i64-staged column: the limb rows ARE the staged planes
+                # (host-split with the identical shift/mask scheme), so the
+                # accumulation below is bit-for-bit the in-kernel split
+                base_ref = v_start[vexpr[1]]
+                for k in range(L):
+                    plane = values[base_ref + k][0, 0]
+                    rows.append(jnp.where(mask, plane, 0)
+                                .astype(jnp.float32))
+                continue
             v = jnp.where(mask, emit_vexpr(vexpr), 0)
             for k in range(L):
                 if k < L - 1:
@@ -628,7 +846,7 @@ def build_kernel(spec: PallasSpec):
     for bits in spec.packed_bits:
         W = T // (32 // bits)
         in_specs.append(block((W // 128, 128)))
-    for _ in range(n_values):
+    for _ in range(n_value_refs):
         in_specs.append(block((RT, 128)))
 
     out_specs = (
@@ -700,6 +918,11 @@ def assemble_outputs(plan_spec: Tuple, spec: PallasSpec, out_f, out_i, out_mm,
         start, L = isum_row[vexpr]
         acc = jnp.zeros((n,), dtype=jnp.int64)
         for k in range(L + 2):
+            if k * _LIMB_BITS >= 63:
+                # rows past the i64 range are provably zero (eligibility
+                # bounds the exact sum inside i64); shifting >= 64 bits is
+                # undefined, so skip them instead of lowering the shift
+                continue
             acc = acc + (out_i[start + k, :n].astype(jnp.int64)
                          << (k * _LIMB_BITS))
         return acc
@@ -736,22 +959,9 @@ def assemble_outputs(plan_spec: Tuple, spec: PallasSpec, out_f, out_i, out_mm,
 # per-segment runner (engine/executor.py fallback path)
 # --------------------------------------------------------------------------
 
-def run_segment(plan, staged: StagedSegment, cache: PallasKernelCache,
-                interpret: bool, on_decline=None):
-    """Run the fused kernel over one staged segment; returns the PACKED f64
-    output vector (kernels.pack_outputs layout, single D2H fetch) or None
-    when the plan/staging isn't eligible (``on_decline`` receives the
-    reason code, same contract as ``extract_plan``)."""
-    from pinot_tpu.engine.kernels import pack_outputs
-
-    def decline(reason: str) -> None:
-        if on_decline is not None:
-            on_decline(reason)
-
-    pp = extract_plan(plan, staged.segment, on_decline=on_decline)
-    if pp is None:
-        return None
-
+def _stage_packed(pp: PallasPlan, staged: StagedSegment, decline):
+    """(packed device blocks, bits) for the plan's packed columns, or None
+    (reason recorded)."""
     packed_cols = []
     bits = []
     for nm in pp.packed_names:
@@ -762,32 +972,118 @@ def run_segment(plan, staged: StagedSegment, cache: PallasKernelCache,
         bits.append(pc.bits)
         W = PALLAS_TILE // pc.vals_per_word
         packed_cols.append(pc.words.reshape(1, -1, W // 128, 128))
+    return packed_cols, bits
+
+
+def _stage_values(pp: PallasPlan, staged: StagedSegment, decline):
+    """Value refs in kernel order: one f32/i32 array per plain input, L
+    i32 limb planes per i64-staged input (the value-load layer of the
+    multi-limb accumulation). None (reason recorded) when a column can't
+    serve the fused layout."""
+    vlimbs = pp.value_limbs or (0,) * len(pp.value_names)
     value_cols = []
-    for nm in pp.value_names:
+    for nm, L in zip(pp.value_names, vlimbs):
+        if L:
+            planes = staged.value_limb_planes(nm, L)
+            if planes is None:
+                decline("pallas_value_layout_unsupported")
+                return None
+            value_cols.extend(
+                p.reshape(1, -1, PALLAS_TILE // 128, 128) for p in planes)
+            continue
         v = staged.value_column(nm)
         if v is None or v.dtype not in (jnp.float32, jnp.int32):
             decline("pallas_value_layout_unsupported")
             return None
         value_cols.append(v.reshape(1, -1, PALLAS_TILE // 128, 128))
+    return value_cols
+
+
+def _segment_params(pp: PallasPlan, staged: StagedSegment):
+    return jnp.concatenate([
+        jnp.asarray(pp.static_params, dtype=jnp.int32).reshape(-1),
+        jnp.asarray([staged.num_docs, 0], dtype=jnp.int32),
+    ])
+
+
+def _run_probe_segment(probe_pp: PallasPlan, staged: StagedSegment,
+                       cache: PallasKernelCache, interpret: bool, decline):
+    """Launch the group-range probe over one staged segment -> out_mm."""
+    got = _stage_packed(probe_pp, staged, decline)
+    if got is None:
+        return None
+    packed_cols, bits = got
+    tiles = staged.pallas_capacity() // PALLAS_TILE
+    spec = _with_bits(
+        probe_pp.spec(num_segs=1, tiles_per_seg=tiles, interpret=interpret),
+        tuple(bits))
+    kernel = cache.get(spec)
+    try:
+        _f, _i, out_mm, _s = kernel(_segment_params(probe_pp, staged),
+                                    *packed_cols)
+    except Exception:
+        cache.pop(spec)
+        raise
+    return out_mm
+
+
+def run_segment(plan, staged: StagedSegment, cache: PallasKernelCache,
+                interpret: bool, on_decline=None,
+                lut_run_cap: int = DEFAULT_LUT_RUN_CAP):
+    """Run the fused kernel over one staged segment; returns
+    ``(packed, effective_plan)`` — the PACKED f64 output vector
+    (kernels.pack_outputs layout, single D2H fetch) plus the plan whose
+    spec describes it (the original plan, or the probe-narrowed plan for
+    large-group shapes; the caller MUST unpack/decode against it) — or
+    None when the plan/staging isn't eligible (``on_decline`` receives the
+    reason code, same contract as ``extract_plan``)."""
+    from pinot_tpu.engine.kernels import pack_outputs
+
+    def decline(reason: str) -> None:
+        if on_decline is not None:
+            on_decline(reason)
+
+    defer = _DeferredDecline(on_decline)
+    pp = extract_plan(plan, staged.segment, on_decline=defer,
+                      lut_run_cap=lut_run_cap)
+    eff = plan
+    if pp is None:
+        if not defer.only_group_bound:
+            defer.flush()
+            return None
+
+        def run_probe(probe_pp):
+            return _run_probe_segment(probe_pp, staged, cache, interpret,
+                                      decline)
+
+        res = probe_narrowed_plan(plan, staged.segment, run_probe,
+                                  lut_run_cap, decline)
+        if res is None:
+            return None
+        pp, eff = res
+
+    got = _stage_packed(pp, staged, decline)
+    if got is None:
+        return None
+    packed_cols, bits = got
+    value_cols = _stage_values(pp, staged, decline)
+    if value_cols is None:
+        return None
 
     tiles = staged.pallas_capacity() // PALLAS_TILE
     spec = pp.spec(num_segs=1, tiles_per_seg=tiles, interpret=interpret)
     spec = _with_bits(spec, tuple(bits))
     kernel = cache.get(spec)
 
-    params = jnp.concatenate([
-        jnp.asarray(pp.static_params, dtype=jnp.int32).reshape(-1),
-        jnp.asarray([staged.num_docs, 0], dtype=jnp.int32),
-    ])
     try:
-        out_f, out_i, out_mm, out_seg = kernel(params, *packed_cols,
-                                               *value_cols)
+        out_f, out_i, out_mm, out_seg = kernel(
+            _segment_params(pp, staged), *packed_cols, *value_cols)
     except Exception:
         cache.pop(spec)  # symmetric with the sharded handler's eviction
         raise
-    tree = assemble_outputs(plan.spec, spec, out_f, out_i, out_mm,
+    tree = assemble_outputs(eff.spec, spec, out_f, out_i, out_mm,
                             seg_matched=None)
-    return pack_outputs(tree, plan.spec)
+    return pack_outputs(tree, eff.spec), eff
 
 
 def _with_bits(spec: PallasSpec, bits: Tuple[int, ...]) -> PallasSpec:

@@ -563,6 +563,58 @@ def expected_param_count(spec: Tuple) -> int:
     return n
 
 
+def narrow_plan_groups(plan: SegmentPlan,
+                       ranges: List[Tuple[int, int]]) -> SegmentPlan:
+    """Rebuild a group-by plan with each group column's key range narrowed
+    to the OBSERVED dictId bounds ``ranges`` (inclusive, raw dictIds — the
+    pallas group-range probe's output). Exact: the bounds are min/max over
+    the very rows the filter matches, so no live doc composes a key outside
+    the narrowed space. The narrowed plan keeps the spec shape (and the
+    params list length/order — only the strides/bases arrays are replaced
+    in place), so kernels, pack/unpack, and the group decode apply
+    unchanged; ``_narrowed_from`` carries the original spec for the
+    executor's per-shape blocklists."""
+    assert plan.group_cards and len(ranges) == len(plan.group_cards)
+    cards: List[int] = []
+    bases: List[int] = []
+    for (lo, hi), card, base in zip(ranges, plan.group_cards,
+                                    plan.group_bases):
+        lo = max(base, int(lo))
+        hi = min(base + card - 1, int(hi))
+        if lo > hi:            # no matched rows touched this column
+            lo = hi = base
+        cards.append(hi - lo + 1)
+        bases.append(lo)
+    total = 1
+    for c in cards:
+        total *= c
+    num_groups = _next_pow2(total)
+    strides = np.ones(len(cards), dtype=np.int32)
+    for i in range(len(cards) - 2, -1, -1):
+        strides[i] = strides[i + 1] * cards[i + 1]
+
+    filter_spec, agg_specs, group_specs, _old, capacity = plan.spec
+    spec = (filter_spec, agg_specs, group_specs, num_groups, capacity)
+
+    def walk_filter(node: Tuple) -> int:
+        op = node[0]
+        if op in ("and", "or", "not"):
+            return sum(walk_filter(c) for c in node[1])
+        return _FILTER_PARAMS[op]
+
+    n_filter = walk_filter(filter_spec)
+    params = list(plan.params)
+    params[n_filter] = strides
+    params[n_filter + 1] = np.asarray(bases, dtype=np.int64)
+    narrowed = SegmentPlan(
+        spec=spec, params=params, columns=list(plan.columns),
+        group_defs=list(plan.group_defs), group_cards=cards,
+        group_strides=strides, num_groups=num_groups,
+        agg_defs=plan.agg_defs, group_bases=bases)
+    narrowed._narrowed_from = getattr(plan, "_narrowed_from", plan.spec)
+    return narrowed
+
+
 def _conjunctive_dict_ranges(filter_spec: Tuple,
                              params: List[np.ndarray]
                              ) -> Dict[str, Tuple[int, int]]:

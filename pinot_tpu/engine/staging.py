@@ -77,6 +77,11 @@ class StagedColumn:
 # io/util/PinotDataBitSet.java:25).
 PALLAS_TILE = 4096
 
+# 12-bit value limbs for the fused kernel's exact integer accumulation
+# (pallas_kernels._LIMB_BITS aliases this): i64-staged value columns ship
+# as pre-split limb PLANES so the kernel never touches i64 math
+LIMB_BITS = 12
+
 
 def pack_bits(bits_needed: int) -> int:
     """Device bit width: power-of-two so values never straddle words."""
@@ -357,6 +362,67 @@ class StagedSegment:
                         v = jnp.pad(v, (0, pad))
                     self._values[name] = v
         return v
+
+    @staticmethod
+    def _limb_key(name: str, k: int) -> str:
+        # '#' can't appear in a column name, so limb-plane cache entries
+        # never collide with value_column entries in _values
+        return f"{name}#limb{k}"
+
+    def value_limb_planes(self, name: str,
+                          limbs: int) -> Optional[List[jnp.ndarray]]:
+        """i64-staged value column as ``limbs`` pre-split 12-bit limb
+        PLANES [pallas_capacity] i32 (plane ``k`` = ``(v >> 12k) & 0xFFF``;
+        the top plane keeps the sign via arithmetic shift — bit-for-bit
+        the fused kernel's own in-kernel split, applied host-side at the
+        value-load layer). Cached in ``_values`` under reserved keys, so
+        the residency conservation contract (nbytes/release/demote/
+        promote) covers the planes like any staged value array."""
+        keys = [self._limb_key(name, k) for k in range(limbs)]
+        got = [self._values.get(k) for k in keys]
+        if all(v is not None for v in got):
+            return got
+        ds = self.segment.data_source(name)
+        cm = ds.metadata
+        if not (cm.single_value and cm.data_type.is_numeric
+                and cm.data_type.is_integral):
+            return None
+        with self._lock:
+            got = [self._values.get(k) for k in keys]
+            if all(v is not None for v in got):
+                return got
+            img = self._host_image
+            if img is not None:
+                hv = [img.values.pop(k, None) for k in keys]
+                if all(v is not None for v in hv):
+                    planes = [jnp.asarray(v) for v in hv]
+                    for k, p in zip(keys, planes):
+                        self._values[k] = p
+                    return planes
+                for k, v in zip(keys, hv):   # partial image: rebuild cold
+                    if v is not None:
+                        img.values[k] = v
+            fwd = np.asarray(ds.forward_index)
+            if cm.has_dictionary:
+                vals = np.asarray(ds.dictionary.device_values()
+                                  ).astype(np.int64)
+                v = vals[fwd]
+            else:
+                v = fwd.astype(np.int64)
+            pad = self.pallas_capacity() - v.shape[0]
+            if pad:
+                v = np.pad(v, (0, pad))
+            mask = np.int64((1 << LIMB_BITS) - 1)
+            planes = []
+            for k in range(limbs):
+                if k < limbs - 1:
+                    p = ((v >> (k * LIMB_BITS)) & mask).astype(np.int32)
+                else:
+                    p = (v >> (k * LIMB_BITS)).astype(np.int32)
+                planes.append(jnp.asarray(p))
+            for k, p in zip(keys, planes):
+                self._values[k] = p
+        return planes
 
     def startree_nodes(self, tree_index: int) -> Dict[str, jnp.ndarray]:
         """Device image of star-tree ``tree_index``'s node record columns:

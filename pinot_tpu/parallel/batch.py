@@ -290,7 +290,8 @@ class SegmentBatch:
     def value_column_batch(self, name: str, pad_segments: int = 0,
                            min_tiles: int = 1):
         """[S, tiles, TILE/128, 128] f32/i32 per-doc numeric values, or None
-        when the column can't serve fused-kernel value rows."""
+        when the column can't serve fused-kernel value rows (i64-staged
+        columns ride ``value_limb_batch`` planes instead)."""
         from pinot_tpu.engine.staging import PALLAS_TILE, staged_int_dtype
 
         cm = self.metadata.column(name)
@@ -313,6 +314,39 @@ class SegmentBatch:
         out = np.zeros((S, tiles * PALLAS_TILE), dtype=vals.dtype)
         out[:, :vals.shape[1]] = vals
         return out.reshape(S, tiles, PALLAS_TILE // 128, 128)
+
+    def value_limb_batch(self, name: str, limbs: int, pad_segments: int = 0,
+                         min_tiles: int = 1):
+        """i64-staged value column as ``limbs`` pre-split 12-bit limb
+        planes, each [S, tiles, TILE/128, 128] i32 — the batch analogue of
+        ``StagedSegment.value_limb_planes`` (identical split scheme, so the
+        sharded fused kernel's limb accumulation is bit-exact with the
+        per-segment path). None when the column isn't integral SV."""
+        from pinot_tpu.engine.staging import LIMB_BITS, PALLAS_TILE
+
+        cm = self.metadata.column(name)
+        if not (cm.single_value and cm.data_type.is_numeric
+                and cm.data_type.is_integral):
+            return None
+        tree = self.stacked_column(name, pad_segments=pad_segments)
+        fwd = tree["fwd"]
+        if cm.has_dictionary:
+            v = tree["dictvals"].astype(np.int64)[fwd]
+        else:
+            v = fwd.astype(np.int64)
+        S = v.shape[0]
+        tiles = self.pallas_tiles(min_tiles)
+        padded = np.zeros((S, tiles * PALLAS_TILE), dtype=np.int64)
+        padded[:, :v.shape[1]] = v
+        mask = np.int64((1 << LIMB_BITS) - 1)
+        planes = []
+        for k in range(limbs):
+            if k < limbs - 1:
+                p = ((padded >> (k * LIMB_BITS)) & mask).astype(np.int32)
+            else:
+                p = (padded >> (k * LIMB_BITS)).astype(np.int32)
+            planes.append(p.reshape(S, tiles, PALLAS_TILE // 128, 128))
+        return planes
 
 
 def _merge_dictionaries(dicts: List[Dictionary], data_type: DataType):

@@ -345,11 +345,51 @@ def build_sharded_pallas_kernel(spec, plan_spec: Tuple, mesh: Mesh):
         return pack_outputs(tree, plan_spec)
 
     pk_spec = P(SEG_AXIS, DOC_AXIS, None, None)
+    n_value_refs = sum(l if l else 1 for l in
+                       (spec.value_limbs or (0,) * len(spec.value_is_int)))
     sharded = _shard_map(
         per_device, mesh=mesh,
         in_specs=(P(),
                   [pk_spec] * len(spec.packed_bits),
-                  [pk_spec] * len(spec.value_is_int),
+                  [pk_spec] * n_value_refs,
                   P(SEG_AXIS)),
+        out_specs=P())
+    return jax.jit(sharded)
+
+
+def build_sharded_pallas_probe(spec, mesh: Mesh):
+    """jitted fn(static_params, packed_cols, num_docs) -> out_mm rows,
+    min/max-reduced over both mesh axes.
+
+    ``spec`` is the group-range PROBE PallasSpec
+    (pallas_kernels.probe_plan_of): the same fused unpack+filter scan with
+    one masked (min, max)-of-dictId aggregation pair per group column and
+    no matmul — the narrowing pass that collapses large sparse composed
+    key spaces onto the dense one-hot rung. Totally ordered through the
+    launch dispatcher like any other multi-device program."""
+    from pinot_tpu.engine.pallas_kernels import _row_layout, build_kernel
+    from pinot_tpu.engine.staging import PALLAS_TILE
+
+    T_l = spec.tiles_per_seg
+    call = build_kernel(spec)
+    _, _, mm_row, _, _, _ = _row_layout(spec)
+    axes = (SEG_AXIS, DOC_AXIS)
+
+    def per_device(static_params, packed_cols, num_docs):
+        doc_base = (jax.lax.axis_index(DOC_AXIS)
+                    * (T_l * PALLAS_TILE)).astype(jnp.int32)
+        params = jnp.concatenate([
+            static_params.astype(jnp.int32).reshape(-1),
+            num_docs.astype(jnp.int32), doc_base[None]])
+        _f, _i, out_mm, _s = call(params, *packed_cols)
+        rows = list(out_mm)
+        for (_, kind), r in mm_row.items():
+            rows[r] = _cross_reduce(out_mm[r], kind, axes, mesh)
+        return jnp.stack(rows)
+
+    pk_spec = P(SEG_AXIS, DOC_AXIS, None, None)
+    sharded = _shard_map(
+        per_device, mesh=mesh,
+        in_specs=(P(), [pk_spec] * len(spec.packed_bits), P(SEG_AXIS)),
         out_specs=P())
     return jax.jit(sharded)

@@ -343,12 +343,14 @@ class ShardedQueryExecutor(ServerQueryExecutor):
                     self._launch_cache.move_to_end(launch_key)
         if cached is None:
             plan = plan_segment(ctx, batch)
-            kernel, params = self._bind_launch(plan, batch, S, stats)
+            kernel, params, plan = self._bind_launch(plan, batch, S, stats)
             self._remember(pkey, plan, kernel, params)
         elif kernel is None:
             # launch tier evicted under this param entry: rebind (the plan
-            # is in hand, so this costs a kernel-cache lookup, not a replan)
-            kernel, params = self._bind_launch(plan, batch, S, stats)
+            # is in hand, so this costs a kernel-cache lookup, not a
+            # replan; a probe-narrowed plan re-extracts directly without
+            # re-probing — its num_groups is already inside the bound)
+            kernel, params, plan = self._bind_launch(plan, batch, S, stats)
             self._remember(pkey, plan, kernel, params)
         num_docs = self._device_num_docs(batch, S)
 
@@ -425,13 +427,18 @@ class ShardedQueryExecutor(ServerQueryExecutor):
             logging.getLogger(__name__).exception(
                 "sharded pallas kernel failed at run; disabling pallas "
                 "for this query shape")
-            self._pallas_blocked.add(plan.spec)
+            # block the ORIGINAL spec: a probe-narrowed plan's own spec is
+            # never what _bind_pallas checks (it sees the planner's plan)
+            orig = getattr(plan, "_narrowed_from", plan.spec)
+            self._pallas_blocked.add(orig)
             # evict the poisoned compiled kernel too — the blocklist makes
             # it unreachable, so keeping it only leaks the closure.
             # snapshot + pop: two threads can fail on the same kernel
             # concurrently, and the second delete must be a no-op
+            # (probe kernels key ("probe", spec, orig plan spec) — the
+            # last slot matches either way)
             for k in list(self._pallas_sharded):
-                if k[1] == plan.spec:
+                if k[-1] in (plan.spec, orig):
                     self._pallas_sharded.pop(k, None)
             # evict FIRST: the jnp bind may itself raise PlanError (pallas
             # pads tiles where the jnp path demands divisibility), and the
@@ -441,7 +448,7 @@ class ShardedQueryExecutor(ServerQueryExecutor):
                 self._launch_cache.pop(kernel.key, None)
             record_decision(stats, "pallas", "jnp_combine",
                             "pallas_combine", "pallas_exec_failed")
-            kernel, params = self._bind_jnp(plan, batch, S)
+            kernel, params, plan = self._bind_jnp(plan, batch, S)
             self._remember(pkey, plan, kernel, params)
             req = self.launcher.submit(kernel, params, num_docs)
             req_out.append(req)
@@ -501,14 +508,17 @@ class ShardedQueryExecutor(ServerQueryExecutor):
 
     def _bind_launch(self, plan: SegmentPlan, batch: SegmentBatch, S: int,
                      stats: Optional[QueryStats] = None):
-        """-> (LaunchKernel, device params): fused Pallas when eligible,
-        jnp masked-vector combine otherwise. The kernel is shared across
-        literals (its key is the literal-normalized plan fingerprint);
-        the params are this query's runtime arrays, committed to device
-        once (per-call H2D uploads are tunnel roundtrips the serving path
-        cannot afford). Binding happens once per shape (cache miss), so
-        the pallas decline recorded here is the per-shape decision — NOT
-        re-counted on every repeat query."""
+        """-> (LaunchKernel, device params, effective plan): fused Pallas
+        when eligible, jnp masked-vector combine otherwise. The kernel is
+        shared across literals (its key is the literal-normalized plan
+        fingerprint); the params are this query's runtime arrays,
+        committed to device once (per-call H2D uploads are tunnel
+        roundtrips the serving path cannot afford). The effective plan is
+        what the output decodes against — the probe-narrowed plan when
+        the group-range probe collapsed a large sparse key space, the
+        input plan otherwise. Binding happens once per shape (cache
+        miss), so the pallas decline recorded here is the per-shape
+        decision — NOT re-counted on every repeat query."""
         bound = self._bind_pallas(plan, batch, S, stats)
         if bound is not None:
             return bound
@@ -539,15 +549,21 @@ class ShardedQueryExecutor(ServerQueryExecutor):
         kernel = self._launch_kernel(launch_key, make_call, is_pallas=False)
         params = jax.device_put(
             tuple(plan.params), NamedSharding(self.mesh, P()))
-        return kernel, params
+        return kernel, params, plan
 
     def _bind_pallas(self, plan: SegmentPlan, batch: SegmentBatch, S: int,
                      stats: Optional[QueryStats] = None):
-        """(LaunchKernel, device params) via the sharded fused Pallas
-        kernel (VERDICT r3 item 2: the flagship kernel serves the combine
-        path), or None when the plan/backing isn't eligible — every None
-        records its reason on the decision ledger (the "why is
-        pallas_kernels 0" forensics the BENCH rounds were missing)."""
+        """(LaunchKernel, device params, effective plan) via the sharded
+        fused Pallas kernel (VERDICT r3 item 2: the flagship kernel serves
+        the combine path), or None when the plan/backing isn't eligible —
+        every None records its reason on the decision ledger (the "why is
+        pallas_kernels 0" forensics the BENCH rounds were missing).
+
+        Large sparse group spaces (SSB Q3.2/Q4.3) run the group-range
+        PROBE first — the same fused scan with min/max-of-dictId rows over
+        the whole batch, reduced across the mesh — and bind against the
+        probe-narrowed plan, so the dense one-hot rung serves shapes the
+        plan-time narrowing alone cannot admit."""
         import logging
 
         from dataclasses import replace
@@ -556,8 +572,15 @@ class ShardedQueryExecutor(ServerQueryExecutor):
 
         from jax.sharding import NamedSharding, PartitionSpec as P
 
-        from pinot_tpu.engine.pallas_kernels import extract_plan
-        from pinot_tpu.parallel.combine import build_sharded_pallas_kernel
+        from pinot_tpu.engine.pallas_kernels import (
+            _DeferredDecline,
+            extract_plan,
+            probe_narrowed_plan,
+        )
+        from pinot_tpu.parallel.combine import (
+            build_sharded_pallas_kernel,
+            build_sharded_pallas_probe,
+        )
 
         def declined(reason: str) -> None:
             record_decision(stats, "pallas", "jnp_combine",
@@ -565,17 +588,77 @@ class ShardedQueryExecutor(ServerQueryExecutor):
 
         interpret = self._pallas_mode()
         if interpret is None:
-            declined("pallas_disabled_on_backend")
+            # auto-disable on a non-TPU backend records under the BACKEND
+            # point (the fallback stays explained per query) instead of
+            # the pallas point, which is reserved for real eligibility
+            # gaps; explicit config keeps the pallas-point record
+            point = "backend" if self.use_pallas is None else "pallas"
+            record_decision(stats, point, "jnp_combine", "pallas_combine",
+                            "pallas_disabled_on_backend")
             return None
-        if plan.spec in self._pallas_blocked:
+        orig_spec = getattr(plan, "_narrowed_from", plan.spec)
+        if orig_spec in self._pallas_blocked:
             declined("pallas_shape_blocked")
-            return None
-        pp = extract_plan(plan, batch, on_decline=declined)
-        if pp is None:
             return None
         n_seg = self.mesh.shape[SEG_AXIS]
         n_doc = self.mesh.shape[DOC_AXIS]
         tiles = batch.pallas_tiles(min_tiles=n_doc)
+
+        def spec_of(p):
+            return p.spec(num_segs=S // n_seg, tiles_per_seg=tiles // n_doc,
+                          interpret=bool(interpret))
+
+        def run_probe(probe_pp):
+            """Stage the probe's packed columns batch-wide and launch the
+            sharded probe through the dispatcher; -> out_mm rows."""
+            packed_cols, bits = [], []
+            for nm in probe_pp.packed_names:
+                staged = self._staged_pallas(batch, nm, S, "packed")
+                if staged is None:
+                    declined("pallas_column_not_packable")
+                    return None
+                packed_cols.append(staged[0])
+                bits.append(staged[1])
+            probe_spec = replace(spec_of(probe_pp), packed_bits=tuple(bits))
+            launch_key = ("pallas_probe", probe_spec, orig_spec,
+                          batch.metadata.segment_name, S)
+
+            def make_call():
+                kkey = ("probe", probe_spec, orig_spec)
+                fn = self._pallas_sharded.get(kkey)
+                if fn is None:
+                    fn = build_sharded_pallas_probe(probe_spec, self.mesh)
+                    self._pallas_sharded[kkey] = fn
+                return lambda params, num_docs: fn(params, packed_cols,
+                                                   num_docs)
+
+            probe_kernel = self._launch_kernel(launch_key, make_call,
+                                               is_pallas=True)
+            pparams = jax.device_put(probe_pp.static_params,
+                                     NamedSharding(self.mesh, P()))
+            req = self.launcher.submit(probe_kernel, pparams,
+                                       self._device_num_docs(batch, S))
+            return np.asarray(req.result())
+
+        eff = plan
+        defer = _DeferredDecline(declined)
+        pp = extract_plan(plan, batch, on_decline=defer,
+                          lut_run_cap=self._pallas_lut_runs)
+        if pp is None:
+            if not defer.only_group_bound:
+                defer.flush()
+                return None
+            try:
+                res = probe_narrowed_plan(plan, batch, run_probe,
+                                          self._pallas_lut_runs, declined)
+            except Exception:
+                logging.getLogger(__name__).exception(
+                    "sharded pallas group probe failed; using jnp combine")
+                declined("pallas_build_failed")
+                return None
+            if res is None:
+                return None
+            pp, eff = res
         try:
             packed_cols, bits = [], []
             for nm in pp.packed_names:
@@ -586,27 +669,33 @@ class ShardedQueryExecutor(ServerQueryExecutor):
                 packed_cols.append(staged[0])
                 bits.append(staged[1])
             value_cols = []
-            for nm in pp.value_names:
+            vlimbs = pp.value_limbs or (0,) * len(pp.value_names)
+            for nm, limbs in zip(pp.value_names, vlimbs):
+                if limbs:
+                    staged = self._staged_pallas(batch, nm, S, "limb",
+                                                 limbs=limbs)
+                    if staged is None:
+                        declined("pallas_value_layout_unsupported")
+                        return None
+                    value_cols.extend(staged)
+                    continue
                 staged = self._staged_pallas(batch, nm, S, "value")
                 if staged is None:
                     declined("pallas_value_layout_unsupported")
                     return None
                 value_cols.append(staged)
-            spec = replace(
-                pp.spec(num_segs=S // n_seg, tiles_per_seg=tiles // n_doc,
-                        interpret=bool(interpret)),
-                packed_bits=tuple(bits))
-            launch_key = ("pallas", spec, plan.spec,
+            spec = replace(spec_of(pp), packed_bits=tuple(bits))
+            launch_key = ("pallas", spec, eff.spec,
                           batch.metadata.segment_name, S)
 
             def make_call():
-                # keyed by (spec, plan.spec): the closure bakes plan.spec
-                # into the output layout, and distinct plans CAN collide on
-                # spec alone (num_groups_padded rounds to 128)
-                kkey = (spec, plan.spec)
+                # keyed by (spec, eff.spec): the closure bakes the plan
+                # spec into the output layout, and distinct plans CAN
+                # collide on spec alone (num_groups_padded rounds to 128)
+                kkey = (spec, eff.spec)
                 fn = self._pallas_sharded.get(kkey)
                 if fn is None:
-                    fn = build_sharded_pallas_kernel(spec, plan.spec,
+                    fn = build_sharded_pallas_kernel(spec, eff.spec,
                                                      self.mesh)
                     self._pallas_sharded[kkey] = fn
                 return lambda params, num_docs: fn(params, packed_cols,
@@ -621,12 +710,14 @@ class ShardedQueryExecutor(ServerQueryExecutor):
                 "sharded pallas build failed; using jnp combine")
             declined("pallas_build_failed")
             return None
-        return kernel, params
+        return kernel, params, eff
 
     def _staged_pallas(self, batch: SegmentBatch, name: str, S: int,
-                       kind: str):
+                       kind: str, limbs: int = 0):
         """Device-committed pallas-layout arrays per (batch, column, S):
-        kind 'packed' -> (words, bits); kind 'value' -> values array."""
+        kind 'packed' -> (words, bits); kind 'value' -> values array;
+        kind 'limb' -> list of ``limbs`` i32 limb planes (i64-staged
+        columns riding the multi-limb accumulation)."""
         import jax
 
         from jax.sharding import NamedSharding, PartitionSpec as P
@@ -645,6 +736,12 @@ class ShardedQueryExecutor(ServerQueryExecutor):
                     return None
                 words, bits = host
                 staged = (jax.device_put(words, sharding), bits)
+            elif kind == "limb":
+                host = batch.value_limb_batch(name, limbs, pad_segments=S,
+                                              min_tiles=n_doc)
+                if host is None:
+                    return None
+                staged = [jax.device_put(p, sharding) for p in host]
             else:
                 host = batch.value_column_batch(name, pad_segments=S,
                                                 min_tiles=n_doc)

@@ -169,6 +169,14 @@ class CommonConstants:
     # the pre-knob hardcoded fan-out width.
     RUNNER_THREADS_KEY = "pinot.server.query.runner.threads"
     DEFAULT_RUNNER_THREADS = 8
+    # Pallas LUT eligibility (engine/pallas_kernels.py): max interval runs
+    # a boolean dictId LUT (IN / REGEXP / TEXT_MATCH predicates) may
+    # decompose into before the fused kernel declines to the jnp
+    # LUT-gather path. Small run counts bake into the filter tree; past
+    # _MAX_LUT_RUNS and up to this cap they ride the padded interval-set
+    # ("ivs") fallback node — each run is one SMEM compare pair per tile.
+    PALLAS_LUT_MAX_RUNS_KEY = "pinot.server.query.pallas.lut.max.runs"
+    DEFAULT_PALLAS_LUT_MAX_RUNS = 64
     WORKER_THREADS_KEY = "pinot.server.query.worker.threads"
     # Launch coalescing (parallel/launcher.py): max requests one vmapped
     # combine launch may carry. 1 disables batching (dedup + single-thread

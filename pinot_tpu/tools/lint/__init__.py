@@ -38,6 +38,12 @@ path-enumerating dispatch executor with call-graph summaries):
   re-runs byte accounting, and ``nbytes()``/``release()`` classes count
   and clear every field they populate.
 
+ISSUE 11 adds ``decline`` (declines.py): every ``_Ineligible("...")`` /
+``decline("...")`` literal in ``engine/pallas_kernels.py`` must resolve
+to a registered ledger code (``tracing._DECLINE_RULES`` needle or
+``DIRECT_DECLINE_CODES`` entry) — new decline sites can never reach the
+ledger as an unregistered reason.
+
 Pure stdlib ``ast`` — importing this package must never pull jax or the
 engine (the CLI runs in CI before anything else).
 """

@@ -123,6 +123,7 @@ def _load_checkers() -> None:
         return
     from pinot_tpu.tools.lint import (  # noqa: F401
         conservation,
+        declines,
         locks,
         pairing,
         protocol,

@@ -47,6 +47,11 @@ def pytest_configure(config):
         "telemetry: continuous telemetry (windowed histograms, SLO burn "
         "tracking, flight recorder; pytest -m telemetry runs it in "
         "isolation; part of tier-1)")
+    config.addinivalue_line(
+        "markers",
+        "pallas: fused Pallas scan kernel (interpret-mode parity, SSB-13 "
+        "eligibility, group-range probe narrowing; pytest -m pallas runs "
+        "it in isolation; part of tier-1)")
 
 
 @pytest.fixture(scope="session")

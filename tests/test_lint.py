@@ -1094,6 +1094,63 @@ def test_cli_families_filter(tmp_path):
 
 
 # --------------------------------------------------------------------------
+# decline family: pallas decline-reason drift (engine/pallas_kernels.py
+# strings must resolve to registered ledger codes)
+# --------------------------------------------------------------------------
+
+def test_decline_catches_unclassifiable_ineligible(tmp_path):
+    """A NEW _Ineligible message with no classify_decline rule would mint
+    an ad-hoc sanitized code on the ledger — flagged at lint time."""
+    new = _lint(tmp_path, """\
+        class _Ineligible(Exception):
+            pass
+
+        def extract(plan):
+            raise _Ineligible("some brand new unlisted obstacle")
+        """, name="pallas_kernels.py")
+    found = _by_checker(new, "decline")
+    assert len(found) == 1
+    assert "classify_decline" in found[0].message
+
+
+def test_decline_catches_unregistered_code(tmp_path):
+    """decline('...') literals are direct ledger codes: they must appear
+    in tracing.DIRECT_DECLINE_CODES (or the rules table)."""
+    new = _lint(tmp_path, """\
+        def bind(decline):
+            decline("pallas_brand_new_unregistered_code")
+        """, name="pallas_kernels.py")
+    found = _by_checker(new, "decline")
+    assert len(found) == 1
+    assert "DIRECT_DECLINE_CODES" in found[0].message
+
+
+def test_decline_known_strings_are_clean(tmp_path):
+    """Registered codes and classifiable messages pass; dynamic args are
+    exempt (runtime namespacing covers them)."""
+    new = _lint(tmp_path, """\
+        class _Ineligible(Exception):
+            pass
+
+        def extract(plan, decline, op):
+            decline("pallas_too_many_groups")
+            if plan:
+                raise _Ineligible("lut with too many runs")
+            raise _Ineligible(op)   # dynamic: exempt
+        """, name="pallas_kernels.py")
+    assert not _by_checker(new, "decline")
+
+
+def test_decline_only_scopes_pallas_kernels_module(tmp_path):
+    """Other modules calling something named decline() are out of scope."""
+    new = _lint(tmp_path, """\
+        def f(decline):
+            decline("not_a_pallas_code_at_all")
+        """, name="other_module.py")
+    assert not _by_checker(new, "decline")
+
+
+# --------------------------------------------------------------------------
 # suppression machinery
 # --------------------------------------------------------------------------
 

@@ -11,6 +11,8 @@ import pandas as pd
 import pytest
 
 from pinot_tpu.engine import ServerQueryExecutor
+
+pytestmark = pytest.mark.pallas
 from pinot_tpu.engine.plan import plan_segment
 from pinot_tpu.engine.staging import PALLAS_TILE, StagingCache, pack_bits
 from pinot_tpu.query import compile_query

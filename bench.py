@@ -38,7 +38,10 @@ Workloads (BASELINE.json configs):
 - **micro** (configs #1/#2): the round-2/3 7-query suite vs the host engine
   (kept ONLY for cross-round continuity; not the headline baseline).
 - **star-tree** (config #3) and **sketches** (config #4).
-- **cluster**: 2-server broker scatter-gather over the full wire path.
+- **cluster**: broker scatter-gather over the full wire path, scaled
+  2 -> 8 servers over partition-aligned segments; records per-query
+  scatter fan-out + prune ratio and loud-fails if a partition-filtered
+  query prunes <=50% of the 8 servers (BENCH_ALLOW_NO_PRUNE escapes).
 
 Prints ONE JSON line {"metric", "value", "unit", "vs_baseline", ...}:
 value = device p50 SSB ms/query, vs_baseline = pandas_baseline / device.
@@ -261,6 +264,12 @@ def emit(results: dict, tpu_attempts: int) -> None:
         "tpu_attempts": tpu_attempts,
         "suite_backends": {s: results.get(s, {}).get("backend", "missing")
                            for s in SUITES},
+        # mesh shape per suite record: >1 on a real multi-chip slice OR
+        # the conftest-forced virtual CPU mesh; 1 means every sharded
+        # combine psum in that suite was a single-device no-op
+        "mesh_devices": {s: results.get(s, {}).get("mesh_devices",
+                                                   "missing")
+                         for s in SUITES},
     }
     for s in SUITES:
         if s in results:
@@ -521,8 +530,21 @@ class _Worker:
 
         return LEDGER.delta(mark)
 
+    @staticmethod
+    def _mesh_devices():
+        """Device count the sharded combine's mesh spans (conftest-forced
+        virtual CPU devices count too) — recorded per suite so every round
+        says what mesh shape produced its numbers."""
+        try:
+            import jax
+
+            return len(jax.devices())
+        except Exception:
+            return None
+
     def record(self, suite: str, rec: dict) -> None:
         rec = dict(rec, suite=suite, backend=rec.get("backend", self.backend))
+        rec.setdefault("mesh_devices", self._mesh_devices())
         with open(self.result_file, "a") as f:
             f.write(json.dumps(rec) + "\n")
             f.flush()
@@ -1107,40 +1129,98 @@ class _Worker:
         }
 
     def bench_cluster(self) -> dict:
-        """SSB through the FULL distributed path: broker parse -> routing ->
-        2-server scatter -> DataTable wire -> broker reduce (BASELINE
-        config #5's distributed half)."""
-        from pinot_tpu.spi.table import TableConfig
+        """SSB through the FULL distributed path, scaled 2 -> 8 servers:
+        broker parse -> partition-aware routing -> scatter -> DataTable
+        wire -> broker reduce. Segments are partition-aligned (one d_year
+        per segment, Modulo partition metadata recorded at build), the
+        table config enables the broker partition pruner, and every query
+        records its scatter fan-out (numServersQueried) + prune ratio.
+        LOUD-FAIL: at 8 servers a partition-filtered SSB query must prune
+        >50% of the scatter targets (BENCH_ALLOW_NO_PRUNE records anyway)."""
+        from pinot_tpu.spi.table import (
+            RoutingConfig,
+            SegmentsValidationConfig,
+            TableConfig,
+        )
         from pinot_tpu.tools import ssb
         from pinot_tpu.tools.cluster import EmbeddedCluster
 
-        cluster = EmbeddedCluster(
-            num_servers=2, data_dir=os.path.join(self.data_dir, "cluster"))
-        try:
-            cluster.create_table(TableConfig("ssb_lineorder"),
-                                 ssb.ssb_schema())
-            rows = min(self.rows, 500_000)
-            seg_dir = os.path.join(self.data_dir, "cluster_segs")
-            ssb.build_segments(0, seg_dir, num_segments=4, rows=rows)
-            for i in range(4):
-                cluster.upload_segment_dir(
-                    "ssb_lineorder_OFFLINE", f"{seg_dir}/ssb_{i}")
-            assert cluster.wait_for_ev_converged("ssb_lineorder_OFFLINE"), \
-                "external view did not converge: refusing a partial bench"
-            queries = [ssb.QUERIES[q] for q in ("Q1.1", "Q2.1", "Q4.2")]
-            for q in queries:
-                cluster.query(q)
-            t0 = time.perf_counter()
-            iters = 5
-            for _ in range(iters):
-                for q in queries:
-                    resp = cluster.query(q)
-                    assert not resp.exceptions, resp.exceptions
-            per = (time.perf_counter() - t0) / (iters * len(queries))
-            return {"rows": rows, "servers": 2,
-                    "p50_ms_per_query": round(per * 1e3, 3)}
-        finally:
-            cluster.shutdown()
+        rows = min(self.rows, 500_000)
+        n_segs = 8
+        seg_dir = os.path.join(self.data_dir, "cluster_segs_part")
+        if not os.path.isdir(os.path.join(seg_dir,
+                                          f"ssb_part_{n_segs - 1}")):
+            ssb.build_segments(0, seg_dir, num_segments=n_segs, rows=rows,
+                               partitioned=True)
+        qids = ("Q1.1", "Q2.1", "Q4.2")
+        # queries with a d_year eq/IN predicate the partition pruner eats
+        partition_filtered = ("Q1.1", "Q4.2")
+        iters = 5
+        per_servers = {}
+        for n_servers in (2, 8):
+            cluster = EmbeddedCluster(
+                num_servers=n_servers,
+                data_dir=os.path.join(self.data_dir,
+                                      f"cluster_{n_servers}"))
+            try:
+                cluster.create_table(
+                    TableConfig(
+                        "ssb_lineorder",
+                        validation_config=SegmentsValidationConfig(
+                            time_column_name="d_yearmonthnum"),
+                        routing_config=RoutingConfig(
+                            segment_pruner_types=["partition"])),
+                    ssb.ssb_schema())
+                for i in range(n_segs):
+                    cluster.upload_segment_dir(
+                        "ssb_lineorder_OFFLINE",
+                        f"{seg_dir}/ssb_part_{i}")
+                assert cluster.wait_for_ev_converged(
+                    "ssb_lineorder_OFFLINE"), \
+                    "external view did not converge: refusing a partial bench"
+                hosting = cluster.hosting_servers("ssb_lineorder_OFFLINE")
+                fanout, prune_ratio, p50 = {}, {}, {}
+                for qid in qids:
+                    sql = ssb.QUERIES[qid]
+                    cluster.query(sql)  # warm: staging + kernel compile
+                    samples = []
+                    queried = 0
+                    for _ in range(iters):
+                        t0 = time.perf_counter()
+                        resp = cluster.query(sql)
+                        samples.append(time.perf_counter() - t0)
+                        assert not resp.exceptions, resp.exceptions
+                        assert (resp.num_servers_responded
+                                == resp.num_servers_queried), \
+                            f"{qid}: partial gather in a healthy cluster"
+                        queried = resp.num_servers_queried
+                    fanout[qid] = queried
+                    prune_ratio[qid] = round(
+                        1.0 - queried / max(len(hosting), 1), 3)
+                    p50[qid] = round(
+                        float(np.percentile(samples, 50)) * 1e3, 3)
+                per_servers[str(n_servers)] = {
+                    "servers_hosting": len(hosting),
+                    "scatter_fanout": fanout,
+                    "prune_ratio": prune_ratio,
+                    "p50_ms": p50,
+                }
+            finally:
+                cluster.shutdown()
+        top = per_servers["8"]
+        for qid in partition_filtered:
+            if top["prune_ratio"][qid] <= 0.5 \
+                    and not os.environ.get("BENCH_ALLOW_NO_PRUNE"):
+                raise AssertionError(
+                    f"cluster: partition-filtered {qid} pruned only "
+                    f"{top['prune_ratio'][qid]:.0%} of 8 servers' scatter "
+                    f"targets (want >50%) — routing regressed; set "
+                    f"BENCH_ALLOW_NO_PRUNE=1 to record anyway")
+        return {"rows": rows, "servers": 8, "servers_scaled": [2, 8],
+                "p50_ms_per_query": round(
+                    sum(top["p50_ms"].values()) / len(qids), 3),
+                "partition_filtered": list(partition_filtered),
+                "per_servers": per_servers}
 
 
 # ==========================================================================

@@ -335,10 +335,14 @@ class BrokerRequestHandler:
         tables: List[DataTable] = []
         servers_queried = set()
         servers_responded = set()
+        # broker-side stats carrier: routing + gather decisions recorded
+        # here merge into the reduced stats so the response's decision
+        # ledger explains why each server was or wasn't scattered to
+        broker_stats = QueryStats()
         for table, sub_ctx in self._split_hybrid(ctx, physical):
             t = time.perf_counter()
-            routing, unavailable = self.routing.get_routing_table(
-                table, sub_ctx)
+            route = self.routing.route(table, sub_ctx, stats=broker_stats)
+            routing, unavailable = route.routing, route.unavailable
             t = phase(BrokerQueryPhase.ROUTING, t)
             if unavailable:
                 self.metrics.meter(BrokerMeter.NO_SERVING_HOST).mark(
@@ -351,10 +355,11 @@ class BrokerRequestHandler:
                 continue
             if self._use_streaming(sub_ctx, routing):
                 gathered, queried, responded = \
-                    self._scatter_gather_streaming(table, sub_ctx, routing)
+                    self._scatter_gather_streaming(table, sub_ctx, routing,
+                                                   broker_stats)
             else:
                 gathered, queried, responded = self._scatter_gather(
-                    table, sub_ctx, routing)
+                    table, sub_ctx, routing, broker_stats)
             phase(BrokerQueryPhase.SCATTER_GATHER, t)
             tables.extend(gathered)
             servers_queried |= queried
@@ -362,9 +367,11 @@ class BrokerRequestHandler:
 
         response.num_servers_queried = len(servers_queried)
         response.num_servers_responded = len(servers_responded)
+        broker_stats.num_servers_queried = len(servers_queried)
+        broker_stats.num_servers_responded = len(servers_responded)
         if not tables:
             # an existing-but-empty table answers with an empty result
-            response.stats = QueryStats()
+            response.stats = broker_stats
             response.time_used_ms = (time.perf_counter() - start) * 1e3
             return finish(response)
 
@@ -377,6 +384,12 @@ class BrokerRequestHandler:
 
                 table = apply_gapfill(ctx, table, gapfill_spec)
             response.result_table = table
+            # fold the broker-side routing/gather ledger + scatter
+            # accounting into the reduced stats: numServersQueried /
+            # numServersResponded ride the stats (and thus the wire /
+            # QueryStats merges) so a partial result is LOUD everywhere
+            # the stats travel, not just on the top-level response
+            stats.merge(broker_stats)
             response.stats = stats
             traced_stats = stats if (stats.trace or stats.spans) else None
             for msg in server_errors:
@@ -384,6 +397,7 @@ class BrokerRequestHandler:
                 response.add_exception(SERVER_NOT_RESPONDING_ERROR, msg)
         except QueryError as e:
             traced_stats = None
+            response.stats = broker_stats
             response.add_exception(QUERY_EXECUTION_ERROR, str(e))
         phase(BrokerQueryPhase.REDUCE, t)
         response.time_used_ms = (time.perf_counter() - start) * 1e3
@@ -532,8 +546,11 @@ class BrokerRequestHandler:
     # offset+limit rows arrived — the wire analogue of
     # SelectionOnlyCombineOperator's early exit.
     def _scatter_gather_streaming(self, table: str, ctx: QueryContext,
-                                  routing: Dict[str, List[str]]):
+                                  routing: Dict[str, List[str]],
+                                  broker_stats: Optional[QueryStats] = None):
         import threading
+
+        from pinot_tpu.common.tracing import record_decision
 
         need = ctx.offset + ctx.limit
         queried, responded = set(), set()
@@ -571,21 +588,35 @@ class BrokerRequestHandler:
             if fut is None:
                 gathered.append(DataTable.for_exception(
                     f"server {instance_id} is not connected"))
+                record_decision(broker_stats, "gather", "partial_result",
+                                "full_result", "server_not_connected")
                 continue
             try:
                 remaining = max(deadline - time.monotonic(), 0.001)
+                ok = False
                 for dt in fut.result(timeout=remaining):
                     _tag_trace(dt, instance_id)
                     gathered.append(dt)
-                responded.add(instance_id)
+                    ok = ok or not dt.exceptions
+                # responded = returned at least one USABLE block; a server
+                # that only errored is down for accounting purposes
+                if ok:
+                    responded.add(instance_id)
+                else:
+                    record_decision(broker_stats, "gather", "partial_result",
+                                    "full_result", "server_error")
             except FutureTimeout:
                 enough.set()  # stop the straggler's pull loop
                 gathered.append(DataTable.for_exception(
                     f"server {instance_id} timed out after "
                     f"{self.query_timeout_s}s"))
+                record_decision(broker_stats, "gather", "partial_result",
+                                "full_result", "server_timeout")
             except Exception as e:  # noqa: BLE001
                 gathered.append(DataTable.for_exception(
                     f"server {instance_id} failed: {e!r}"))
+                record_decision(broker_stats, "gather", "partial_result",
+                                "full_result", "server_error")
         return gathered, queried, responded
 
     def _use_streaming(self, ctx: QueryContext,
@@ -597,7 +628,14 @@ class BrokerRequestHandler:
 
     # -- scatter/gather (ref: QueryRouter.submitQuery:85) --------------------
     def _scatter_gather(self, table: str, ctx: QueryContext,
-                        routing: Dict[str, List[str]]):
+                        routing: Dict[str, List[str]],
+                        broker_stats: Optional[QueryStats] = None):
+        """Per-server failure handling: a down / not-connected / timed-out
+        server yields a partial result — its error travels as an exception
+        DataTable, it is NOT counted as responded, and the reason lands on
+        the decision ledger — never a hung or silently-wrong answer."""
+        from pinot_tpu.common.tracing import record_decision
+
         queried, responded = set(), set()
         futures = {}
         for instance_id, segments in routing.items():
@@ -615,20 +653,33 @@ class BrokerRequestHandler:
             if fut is None:
                 gathered.append(DataTable.for_exception(
                     f"server {instance_id} is not connected"))
+                record_decision(broker_stats, "gather", "partial_result",
+                                "full_result", "server_not_connected")
                 continue
             try:
                 remaining = max(deadline - time.monotonic(), 0.001)
                 dt = fut.result(timeout=remaining)
                 _tag_trace(dt, instance_id)
                 gathered.append(dt)
-                responded.add(instance_id)
+                # responded = came back with a USABLE DataTable; a server
+                # that answered with only an error (shut down mid-scatter,
+                # table not hosted) is accounted as a gather failure
+                if dt.exceptions:
+                    record_decision(broker_stats, "gather", "partial_result",
+                                    "full_result", "server_error")
+                else:
+                    responded.add(instance_id)
             except FutureTimeout:
                 gathered.append(DataTable.for_exception(
                     f"server {instance_id} timed out after "
                     f"{self.query_timeout_s}s"))
+                record_decision(broker_stats, "gather", "partial_result",
+                                "full_result", "server_timeout")
             except Exception as e:
                 gathered.append(DataTable.for_exception(
                     f"server {instance_id} failed: {e!r}"))
+                record_decision(broker_stats, "gather", "partial_result",
+                                "full_result", "server_error")
         return gathered, queried, responded
 
     def shutdown(self) -> None:

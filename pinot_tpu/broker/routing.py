@@ -2,20 +2,36 @@
 selection.
 
 Re-design of ``pinot-broker/.../routing/RoutingManager.java:85``
-(``buildRouting:300``, ``getRoutingTable:459``) + instance selectors
-(``routing/instanceselector/BaseInstanceSelector.java``) + broker-side
-segment pruners (``routing/segmentpruner/TimeSegmentPruner``) + the hybrid
-time boundary (``routing/timeboundary/TimeBoundaryManager.java:52``).
-Routing follows the ExternalView: only segments a live server actually
-serves are routable.
+(``buildRouting:300``, ``getRoutingTable:459``, ``onAssignmentChange:562``)
++ instance selectors (``routing/instanceselector/BaseInstanceSelector.java``)
++ broker-side segment pruners (``routing/segmentpruner/TimeSegmentPruner``,
+``PartitionSegmentPruner``) + the hybrid time boundary
+(``routing/timeboundary/TimeBoundaryManager.java:52``).
+
+The per-query hot path reads a per-table :class:`RoutingTable` SNAPSHOT —
+replicas, resolved partition functions, and time ranges per segment —
+built once from the state store and invalidated by store watches (the
+reference pushes ExternalView/IdealState/ZK-metadata changes into each
+``RoutingEntry`` the same way: ``buildRouting`` on change, never a ZK
+round-trip per query). Routing follows the ExternalView: only segments a
+live server actually serves are routable.
+
+Every routing outcome lands on the path-decision ledger: a prune records
+``routing:all_servers->pruned:partition_prune`` / ``:time_prune``; a
+configured pruner that could NOT prune records why
+(``no_filter`` / ``no_partition_predicate`` / ``no_partition_metadata`` /
+``partition_all_match`` / ``no_time_bound`` / ``time_all_match``), so
+post-mortem bundles explain why a server was or wasn't scattered to.
 """
 
 from __future__ import annotations
 
 import threading
 
+from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
+from pinot_tpu.common.tracing import record_decision
 from pinot_tpu.controller.state import CONSUMING, ONLINE, ClusterStateStore
 from pinot_tpu.query.context import QueryContext
 from pinot_tpu.query.expressions import (
@@ -80,10 +96,26 @@ class StrictReplicaGroupInstanceSelector(ReplicaGroupInstanceSelector):
         return in_group[0] if in_group else None
 
 
-def _top_level_eq_values(node: FilterNode) -> Dict[str, List]:
-    """column -> literal values from top-level AND-ed EQ/IN predicates
-    (the only shapes partition pruning can use soundly)."""
+# how wide a closed integer RANGE on the partition column may be before
+# enumerating its values stops being cheaper than scattering everywhere
+_MAX_PARTITION_RANGE_ENUM = 1024
+
+
+def _int_literal(v) -> Optional[int]:
+    """The literal as an int ONLY when it already is one — a string
+    column's lexicographic range ('1'..'3' matches '25') must never be
+    enumerated numerically."""
+    return v if isinstance(v, int) and not isinstance(v, bool) else None
+
+
+def _partition_filter_values(node: Optional[FilterNode]) -> Dict[str, List]:
+    """column -> candidate literal values from top-level AND-ed EQ/IN
+    predicates, plus closed integer RANGEs narrow enough to enumerate —
+    the only shapes partition pruning can use soundly (a matched row's
+    value is guaranteed to be in the returned list)."""
     out: Dict[str, List] = {}
+    if node is None:
+        return out
 
     def visit(n: FilterNode):
         if n.op is FilterOp.AND:
@@ -99,9 +131,25 @@ def _top_level_eq_values(node: FilterNode) -> Dict[str, List]:
             out.setdefault(p.lhs.name, []).append(p.value)
         elif p.type is PredicateType.IN:
             out.setdefault(p.lhs.name, []).extend(p.values)
+        elif p.type is PredicateType.RANGE:
+            lo = _int_literal(p.lower)
+            hi = _int_literal(p.upper)
+            if lo is None or hi is None:
+                return
+            lo += 0 if p.lower_inclusive else 1
+            hi -= 0 if p.upper_inclusive else 1
+            if lo > hi or hi - lo + 1 > _MAX_PARTITION_RANGE_ENUM:
+                return
+            out.setdefault(p.lhs.name, []).extend(range(lo, hi + 1))
 
     visit(node)
     return out
+
+
+# kept under its historical name: callers/tests predating the RANGE
+# enumeration use it for the EQ/IN shapes
+def _top_level_eq_values(node: FilterNode) -> Dict[str, List]:
+    return _partition_filter_values(node)
 
 
 def extract_time_interval(node: Optional[FilterNode], time_column: str
@@ -160,9 +208,55 @@ class TimeBoundaryManager:
         return max(end_times) - 1
 
 
+@dataclass(frozen=True)
+class SegmentRouteInfo:
+    """Everything routing needs about one segment, resolved at table-build
+    time (the 'metadata pushed into the routing table' half of the ref's
+    SegmentZKMetadata handling in buildRouting)."""
+
+    replicas: Tuple[str, ...]                 # instances serving it (EV)
+    # (start, end) time range; None = never time-prunable (missing
+    # metadata, or a CONSUMING segment whose range is still growing)
+    time_range: Optional[Tuple[int, int]]
+    # per partitioned column: (column, partition function, partition set)
+    partitions: Tuple[Tuple[str, object, frozenset], ...] = ()
+
+
+@dataclass
+class RoutingTable:
+    """Per-table routing snapshot. Immutable once built; replaced (never
+    mutated) when a watch invalidates it."""
+
+    table: str
+    version: int                              # store version at build
+    segments: Dict[str, SegmentRouteInfo]
+    time_column: Optional[str]
+    partition_pruning: bool                   # pruner configured on table
+    has_partition_metadata: bool              # any segment carries it
+    selector: object
+
+
+@dataclass
+class RouteResult:
+    """One query's routing outcome with the prune accounting the bench's
+    scatter fan-out / prune-ratio gates read."""
+
+    routing: Dict[str, List[str]]
+    unavailable: List[str]
+    segments_total: int = 0
+    segments_routed: int = 0
+    time_pruned: int = 0
+    partition_pruned: int = 0
+    # scatter fan-out had no pruning happened vs what was actually used
+    servers_unpruned: int = 0
+    servers_routed: int = 0
+
+
 class RoutingManager:
-    """Ref: RoutingManager.java:85. Watches ExternalView + instance liveness
-    and serves per-query routing tables."""
+    """Ref: RoutingManager.java:85. Watches ExternalView + instance
+    liveness and serves per-query routing tables from per-table cached
+    snapshots (``onAssignmentChange``-style invalidation, zero state-store
+    reads on the warmed hot path)."""
 
     def __init__(self, store: ClusterStateStore):
         self.store = store
@@ -170,10 +264,11 @@ class RoutingManager:
         self.time_boundary = TimeBoundaryManager(store)
         self._request_id = 0  # guarded-by: _lock
         self._lock = threading.Lock()
-        # table -> (selector kind, groups key, selector): rebuilt only when
-        # the routing config / instance partitions change (ref:
-        # InstanceSelectorFactory caching per RoutingEntry)
-        self._selector_cache: Dict[str, Tuple] = {}
+        # table -> RoutingTable snapshot (guarded-by: _lock); invalidated
+        # by the prefix watches below — the Helix-spectator push model
+        self._tables: Dict[str, RoutingTable] = {}
+        # (store version, dead-instance frozenset) (guarded-by: _lock)
+        self._dead: Optional[Tuple[int, frozenset]] = None
         # table -> (store version at compute time, hidden segment set); the
         # version stamp closes the TOCTOU where a watch-driven clear lands
         # between computing the set and caching it (the stale insert would
@@ -181,6 +276,24 @@ class RoutingManager:
         self._lineage_cache: Dict[str, Tuple[int, frozenset]] = {}
         store.watch("lineage/",
                     lambda path, value: self._lineage_cache.clear())
+        # routing follows every input that fed the snapshot: segment ZK
+        # metadata, ExternalView, table config, instance partitions
+        for prefix in ("segments/", "externalview/", "tables/",
+                       "instancepartitions/"):
+            store.watch(prefix, self._on_table_change)
+        store.watch("instances/", self._on_instance_change)
+
+    # -- watch callbacks (ref: onAssignmentChange:562 / onInstancesChange) --
+    def _on_table_change(self, path: str, value) -> None:
+        parts = path.split("/")
+        if len(parts) < 2:
+            return
+        with self._lock:
+            self._tables.pop(parts[1], None)
+
+    def _on_instance_change(self, path: str, value) -> None:
+        with self._lock:
+            self._dead = None
 
     def _next_request_id(self) -> int:
         with self._lock:
@@ -193,41 +306,159 @@ class RoutingManager:
     def table_exists(self, table_with_type: str) -> bool:
         return self.store.get_table_config(table_with_type) is not None
 
+    # -- snapshot build (ref: buildRouting:300) ------------------------------
+    def _routing_entry(self, table: str) -> RoutingTable:
+        with self._lock:
+            entry = self._tables.get(table)
+        if entry is not None:
+            return entry
+        entry = self._build_entry(table)
+        with self._lock:
+            self._tables[table] = entry
+        # a mutation racing this build may have fired the invalidating
+        # watch BEFORE the insert above; self-evict so the stale snapshot
+        # can't outlive the race (any post-mutation clear removes it too)
+        if self.store.version != entry.version:
+            with self._lock:
+                if self._tables.get(table) is entry:
+                    del self._tables[table]
+        return entry
+
+    def _build_entry(self, table: str) -> RoutingTable:
+        from pinot_tpu.utils.partition import get_partition_function
+
+        ver = self.store.version
+        ev = self.store.get_external_view(table)
+        cfg = self.store.get_table_config(table)
+        time_column = (cfg.validation_config.time_column_name
+                       if cfg else None)
+        pruners = (cfg.routing_config.segment_pruner_types if cfg else [])
+        partition_pruning = any(p.lower() == "partition" for p in pruners)
+        mds = {md.segment_name: md
+               for md in self.store.segment_metadata_list(table)}
+
+        segments: Dict[str, SegmentRouteInfo] = {}
+        any_partition_md = False
+        for seg, imap in ev.items():
+            md = mds.get(seg)
+            time_range = None
+            parts: Tuple = ()
+            if md is not None:
+                # consuming segments are never time-pruned: their range is
+                # still growing (ref: TimeSegmentPruner consuming skip)
+                if (md.status != CONSUMING and md.start_time is not None
+                        and md.end_time is not None):
+                    time_range = (md.start_time, md.end_time)
+                if partition_pruning and md.partition_metadata:
+                    built = []
+                    for col, pm in md.partition_metadata.items():
+                        if pm and pm.get("partitions"):
+                            fn = get_partition_function(
+                                pm["functionName"], pm["numPartitions"])
+                            built.append((col, fn,
+                                          frozenset(pm["partitions"])))
+                    parts = tuple(built)
+                    any_partition_md = any_partition_md or bool(parts)
+            segments[seg] = SegmentRouteInfo(
+                replicas=tuple(sorted(
+                    inst for inst, st in imap.items()
+                    if st in (ONLINE, CONSUMING))),
+                time_range=time_range, partitions=parts)
+        return RoutingTable(
+            table=table, version=ver, segments=segments,
+            time_column=time_column, partition_pruning=partition_pruning,
+            has_partition_metadata=any_partition_md,
+            selector=self._build_selector(cfg, table))
+
+    def _build_selector(self, cfg, table: str):
+        """Per-table instance selector from the routing config
+        (ref: InstanceSelectorFactory); part of the snapshot, so a config
+        or instance-partitions change rebuilds it with the table entry."""
+        kind = (cfg.routing_config.instance_selector_type
+                if cfg else "balanced")
+        if kind == "balanced":
+            return self.selector
+        groups = self.store.get_instance_partitions(table) or []
+        return (StrictReplicaGroupInstanceSelector(groups)
+                if kind == "strictReplicaGroup"
+                else ReplicaGroupInstanceSelector(groups))
+
+    def _dead_instances(self) -> frozenset:
+        with self._lock:
+            cached = self._dead
+        if cached is not None:
+            return cached[1]
+        ver = self.store.version
+        dead = frozenset(i.instance_id
+                         for i in self.store.instances("SERVER")
+                         if not i.alive)
+        with self._lock:
+            self._dead = (ver, dead)
+        if self.store.version != ver:
+            with self._lock:
+                if self._dead is not None and self._dead[0] == ver:
+                    self._dead = None
+        return dead
+
     # -- the routing table ---------------------------------------------------
     def get_routing_table(self, table: str, ctx: Optional[QueryContext] = None,
                           request_id: Optional[int] = None
                           ) -> Tuple[Dict[str, List[str]], List[str]]:
-        """-> ({server: [segments]}, unavailable_segments). Routes from the
-        ExternalView (segments actually being served), prunes by time range,
-        picks one replica per segment."""
+        """-> ({server: [segments]}, unavailable_segments). Thin wrapper
+        over :meth:`route` for callers without stats plumbing."""
+        res = self.route(table, ctx, request_id=request_id)
+        return res.routing, res.unavailable
+
+    def route(self, table: str, ctx: Optional[QueryContext] = None,
+              request_id: Optional[int] = None,
+              stats=None) -> RouteResult:
+        """Routes from the cached snapshot (segments actually being
+        served), prunes by partition + time metadata, picks one replica
+        per segment. ``stats`` (a QueryStats, usually the broker-side
+        one) receives the routing decision records."""
         if request_id is None:
             request_id = self._next_request_id()
-        ev = self.store.get_external_view(table)
-        dead = frozenset(i.instance_id for i in self.store.instances("SERVER")
-                         if not i.alive)
+        entry = self._routing_entry(table)
+        dead = self._dead_instances()
 
-        segments = list(ev.keys())
+        segments = list(entry.segments.keys())
         # lineage visibility: replaced inputs / in-flight outputs are hidden
         # (ref: SegmentLineageUtils.filterSegmentsBasedOnLineageInPlace)
         hidden = self._lineage_hidden(table)
         if hidden:
             segments = [s for s in segments if s not in hidden]
+        total = len(segments)
 
-        pruned = self._time_prune(table, ctx, segments)
-        pruned = self._partition_prune(table, ctx, pruned)
-        selector = self._selector_for(table)
+        after_time = self._time_prune(entry, ctx, segments, stats)
+        pruned = self._partition_prune(entry, ctx, after_time, stats)
+        res = RouteResult(
+            routing={}, unavailable=[], segments_total=total,
+            segments_routed=len(pruned),
+            time_pruned=total - len(after_time),
+            partition_pruned=len(after_time) - len(pruned))
 
-        routing: Dict[str, List[str]] = {}
-        unavailable: List[str] = []
-        for segment in pruned:
-            replicas = [inst for inst, st in ev.get(segment, {}).items()
-                        if st in (ONLINE, CONSUMING)]
-            chosen = selector.select(segment, replicas, request_id, dead)
-            if chosen is None:
-                unavailable.append(segment)
-            else:
-                routing.setdefault(chosen, []).append(segment)
-        return routing, unavailable
+        def select(seg_list):
+            routing: Dict[str, List[str]] = {}
+            unavailable: List[str] = []
+            for segment in seg_list:
+                replicas = list(entry.segments[segment].replicas)
+                chosen = entry.selector.select(segment, replicas,
+                                               request_id, dead)
+                if chosen is None:
+                    unavailable.append(segment)
+                else:
+                    routing.setdefault(chosen, []).append(segment)
+            return routing, unavailable
+
+        res.routing, res.unavailable = select(pruned)
+        res.servers_routed = len(res.routing)
+        if len(pruned) != total:
+            # the counterfactual fan-out: same selector, same requestId,
+            # over the UNPRUNED list — what the prune-ratio gates compare
+            res.servers_unpruned = len(select(segments)[0])
+        else:
+            res.servers_unpruned = res.servers_routed
+        return res
 
     def _lineage_hidden(self, table: str) -> frozenset:
         cached = self._lineage_cache.get(table)
@@ -246,88 +477,81 @@ class RoutingManager:
             self._lineage_cache.pop(table, None)
         return hidden
 
-    def _selector_for(self, table: str):
-        """Per-table instance selector from the routing config
-        (ref: InstanceSelectorFactory), cached against its inputs."""
-        cfg = self.store.get_table_config(table)
-        kind = (cfg.routing_config.instance_selector_type
-                if cfg else "balanced")
-        if kind == "balanced":
-            return self.selector
-        groups = self.store.get_instance_partitions(table) or []
-        key = (kind, tuple(tuple(g) for g in groups))
-        cached = self._selector_cache.get(table)
-        if cached is not None and cached[0] == key:
-            return cached[1]
-        sel = (StrictReplicaGroupInstanceSelector(groups)
-               if kind == "strictReplicaGroup"
-               else ReplicaGroupInstanceSelector(groups))
-        self._selector_cache[table] = (key, sel)
-        return sel
-
-    def _partition_prune(self, table: str, ctx: Optional[QueryContext],
-                         segments: List[str]) -> List[str]:
+    def _partition_prune(self, entry: RoutingTable,
+                         ctx: Optional[QueryContext],
+                         segments: List[str], stats) -> List[str]:
         """Ref: PartitionSegmentPruner — top-level AND-ed EQ/IN predicates
-        on a partitioned column keep only segments whose recorded partition
-        set contains the literal's partition."""
-        if ctx is None or ctx.filter is None:
-            return segments
-        cfg = self.store.get_table_config(table)
-        pruners = (cfg.routing_config.segment_pruner_types if cfg else [])
-        if not any(p.lower() == "partition" for p in pruners):
+        (+ narrow closed int ranges) on a partitioned column keep only
+        segments whose recorded partition set contains a literal's
+        partition. Every outcome is a ledger record."""
+        if not entry.partition_pruning:
             return segments  # ref: PartitionSegmentPruner runs only when
             #                  configured in routing.segmentPrunerTypes
-        from pinot_tpu.utils.partition import get_partition_function
 
-        eq_values = _top_level_eq_values(ctx.filter)
-        if not eq_values:
+        def declined(reason: str) -> None:
+            if ctx is not None:
+                record_decision(stats, "routing", "all_servers", "pruned",
+                                reason)
+
+        if ctx is None or ctx.filter is None:
+            declined("no_filter")
+            return segments
+        if not entry.has_partition_metadata:
+            declined("no_partition_metadata")
+            return segments
+        values = _partition_filter_values(ctx.filter)
+        if not values:
+            declined("no_partition_predicate")
             return segments
         out = []
         for seg in segments:
-            md = self.store.get_segment_metadata(table, seg)
-            if md is None or not md.partition_metadata:
-                out.append(seg)
-                continue
+            info = entry.segments[seg]
             keep = True
-            for col, values in eq_values.items():
-                pm = md.partition_metadata.get(col)
-                if not pm or not pm.get("partitions"):
+            for col, fn, parts in info.partitions:
+                lits = values.get(col)
+                if not lits:
                     continue
-                fn = get_partition_function(pm["functionName"],
-                                            pm["numPartitions"])
-                if not any(fn.partition(v) in pm["partitions"]
-                           for v in values):
+                if not any(fn.partition(v) in parts for v in lits):
                     keep = False
                     break
             if keep:
                 out.append(seg)
+        if len(out) < len(segments):
+            record_decision(stats, "routing", "pruned", "all_servers",
+                            "partition_prune")
+        else:
+            declined("partition_all_match")
         return out
 
-    def _time_prune(self, table: str, ctx: Optional[QueryContext],
-                    segments: List[str]) -> List[str]:
+    def _time_prune(self, entry: RoutingTable, ctx: Optional[QueryContext],
+                    segments: List[str], stats) -> List[str]:
         """Ref: TimeSegmentPruner — drop segments whose [start,end] time
         range cannot intersect the query's time interval."""
-        if ctx is None:
+        if ctx is None or entry.time_column is None:
             return segments
-        cfg = self.store.get_table_config(table)
-        tc = cfg.validation_config.time_column_name if cfg else None
-        if not tc:
-            return segments
-        lo, hi = extract_time_interval(ctx.filter, tc)
+
+        def declined(reason: str) -> None:
+            record_decision(stats, "routing", "all_servers", "pruned",
+                            reason)
+
+        lo, hi = extract_time_interval(ctx.filter, entry.time_column)
         if lo is None and hi is None:
+            declined("no_time_bound")
             return segments
         out = []
         for seg in segments:
-            md = self.store.get_segment_metadata(table, seg)
-            if md is None or md.status == CONSUMING:
-                out.append(seg)  # consuming segments are never time-pruned
+            tr = entry.segments[seg].time_range
+            if tr is None:
+                out.append(seg)  # consuming / missing range: never pruned
                 continue
-            if md.start_time is None or md.end_time is None:
-                out.append(seg)
+            if hi is not None and tr[0] > hi:
                 continue
-            if hi is not None and md.start_time > hi:
-                continue
-            if lo is not None and md.end_time < lo:
+            if lo is not None and tr[1] < lo:
                 continue
             out.append(seg)
+        if len(out) < len(segments):
+            record_decision(stats, "routing", "pruned", "all_servers",
+                            "time_prune")
+        else:
+            declined("time_all_match")
         return out

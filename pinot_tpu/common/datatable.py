@@ -308,6 +308,8 @@ class DataTable:
             num_docs_scanned=st.get("numDocsScanned", 0),
             total_docs=st.get("totalDocs", 0),
             num_groups_limit_reached=st.get("numGroupsLimitReached", False),
+            num_servers_queried=st.get("numServersQueried", 0),
+            num_servers_responded=st.get("numServersResponded", 0),
             group_by_rung=st.get("groupByRung"),
             staging=st.get("staging", {}),
             launch=st.get("launch", {}),

@@ -31,6 +31,11 @@ class BrokerResponse:
             "exceptions": self.exceptions,
             "numServersQueried": self.num_servers_queried,
             "numServersResponded": self.num_servers_responded,
+            # loud partial-result flag (ref: BrokerResponseNative
+            # partialResult): true when a scattered-to server returned no
+            # usable DataTable — the result stands on fewer servers
+            "partialResult": (self.num_servers_responded
+                              < self.num_servers_queried),
             "numSegmentsQueried": self.stats.num_segments_queried,
             "numSegmentsProcessed": self.stats.num_segments_processed,
             "numSegmentsMatched": self.stats.num_segments_matched,

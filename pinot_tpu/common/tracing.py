@@ -368,6 +368,31 @@ DIRECT_DECLINE_CODES = frozenset({
     "pallas_build_failed",
 })
 
+# Reason codes the broker-side ROUTING decision point records
+# (broker/routing.py): a prune that fired, or why a configured pruner
+# could not help. Registered for the same reason as DIRECT_DECLINE_CODES:
+# every reason reaching the ledger must be a known, stable code —
+# test_cluster_routing scans routing.py's record sites against this set.
+ROUTING_DECISION_REASONS = frozenset({
+    "partition_prune",
+    "time_prune",
+    "no_filter",
+    "no_partition_predicate",
+    "no_partition_metadata",
+    "partition_all_match",
+    "no_time_bound",
+    "time_all_match",
+})
+
+# Reason codes the broker GATHER point records (broker/broker.py) when a
+# scattered-to server fails to produce a usable DataTable — the loud
+# accounting behind every partial result.
+GATHER_DECISION_REASONS = frozenset({
+    "server_not_connected",
+    "server_timeout",
+    "server_error",
+})
+
 _SANITIZE = re.compile(r"[^a-z0-9]+")
 _DIGITS = re.compile(r"\d+")
 

@@ -69,6 +69,12 @@ class QueryStats:
     num_docs_scanned: int = 0
     total_docs: int = 0
     num_groups_limit_reached: bool = False
+    # scatter accounting, set by the BROKER after gather (servers leave
+    # them 0): responded counts only servers that returned a usable
+    # DataTable, so responded < queried IS the partial-result flag —
+    # on the wire, not just on the top-level BrokerResponse
+    num_servers_queried: int = 0
+    num_servers_responded: int = 0
     # group-by ladder rung that served ('dense'|'compact'|'hash'|'sort'|
     # 'startree_device'|'startree'|'host'; 'mixed' when segments split
     # across rungs) — the bench gates SSB Q2.x/Q3.x on this
@@ -124,6 +130,10 @@ class QueryStats:
         self.num_docs_scanned += other.num_docs_scanned
         self.total_docs += other.total_docs
         self.num_groups_limit_reached |= other.num_groups_limit_reached
+        # broker-only counters: exactly one side of any merge is nonzero
+        # (servers ship 0), so sum keeps the broker's gather accounting
+        self.num_servers_queried += other.num_servers_queried
+        self.num_servers_responded += other.num_servers_responded
         if other.group_by_rung is not None:
             self.group_by_rung = (
                 other.group_by_rung
@@ -163,6 +173,9 @@ class QueryStats:
             "numDocsScanned": self.num_docs_scanned,
             "totalDocs": self.total_docs,
             "numGroupsLimitReached": self.num_groups_limit_reached,
+            **({"numServersQueried": self.num_servers_queried,
+                "numServersResponded": self.num_servers_responded}
+               if self.num_servers_queried else {}),
             "phaseTimesMs": {k: round(v, 3)
                              for k, v in self.phase_ms.items()},
             **({"groupByRung": self.group_by_rung}

@@ -109,6 +109,13 @@ class EmbeddedCluster:
             raise RuntimeError(f"query failed: {resp.exceptions}")
         return resp.result_table.rows if resp.result_table else []
 
+    def hosting_servers(self, table: str) -> List[str]:
+        """Instances serving >=1 segment of ``table`` per the ExternalView
+        — the denominator of the bench's scatter prune ratio (a query that
+        was going to skip a data-free server anyway proves nothing)."""
+        ev = self.store.get_external_view(table)
+        return sorted({inst for m in ev.values() for inst in m})
+
     # -- convergence helpers (tests) -----------------------------------------
     def wait_for_ev_converged(self, table: str, timeout_s: float = 10.0) -> bool:
         deadline = time.monotonic() + timeout_s

@@ -175,42 +175,88 @@ def generate_table(num_segments: int, rows: int,
     return {k: np.concatenate([f[k] for f in frames]) for k in frames[0]}
 
 
-def ssb_indexing_config():
+PARTITION_COLUMN = "d_year"
+NUM_YEARS = 7  # dbgen's 1992..1998
+
+
+def generate_partitioned_frame(i: int, num_segments: int, n: int,
+                               seed: int = 42) -> Dict[str, np.ndarray]:
+    """Segment ``i``'s rows holding EXACTLY ONE ``d_year`` value
+    (1992 + i mod 7) — the partition-aligned segment layout the broker's
+    partition pruner feeds on: a ``d_year`` eq/range predicate then skips
+    every server holding no matching segment (ref: Kafka-partitioned
+    streams landing one partition per LLC segment)."""
+    rng = np.random.default_rng(seed * 2_000_003 + i)
+    cols = _flat_columns(rng, n)
+    year = 1992 + (i % NUM_YEARS)
+    cols["d_year"] = np.full(n, year, dtype=np.int64)
+    cols["d_yearmonthnum"] = (year * 100
+                              + rng.integers(1, 13, n)).astype(np.int64)
+    return cols
+
+
+def ssb_indexing_config(star_tree: bool = True, num_partitions: int = 0,
+                        partition_column: str = PARTITION_COLUMN):
     """Default lineorder indexing: the star-tree over the Q2.x dimensions
     (split order descending-ish cardinality under the determinism chain:
     brand determines category) with the revenue/supplycost/count pre-aggs —
     the index that turns the Q2.x flights from 3M-doc scans into
     few-thousand-node slices (ref: enableDefaultStarTree on lineorder in
-    the reference's SSB configs)."""
-    from pinot_tpu.spi.table import IndexingConfig, StarTreeIndexConfig
+    the reference's SSB configs). ``num_partitions`` > 0 adds a Modulo
+    segment-partition config on ``partition_column`` so the builder
+    records per-segment partition metadata (the broker pruner's input);
+    ``star_tree=False`` drops the tree (mesh-parity tests want every query
+    on the sharded combine)."""
+    from pinot_tpu.spi.table import (
+        IndexingConfig,
+        SegmentPartitionConfig,
+        StarTreeIndexConfig,
+    )
 
-    return IndexingConfig(star_tree_index_configs=[StarTreeIndexConfig(
+    trees = [StarTreeIndexConfig(
         dimensions_split_order=["d_year", "c_region", "s_region",
                                 "p_category", "p_brand1"],
         function_column_pairs=["SUM__lo_revenue", "SUM__lo_supplycost",
                                "COUNT__*"],
-        max_leaf_records=10_000)])
+        max_leaf_records=10_000)] if star_tree else []
+    spc = SegmentPartitionConfig(column_partition_map={
+        partition_column: {"functionName": "Modulo",
+                           "numPartitions": num_partitions},
+    }) if num_partitions > 0 else None
+    return IndexingConfig(star_tree_index_configs=trees,
+                          segment_partition_config=spc)
 
 
 def _build_one(i: int, num_segments: int, n: int, seed: int,
-               out_dir: str) -> str:
+               out_dir: str, partitioned: bool = False,
+               star_tree: bool = True) -> str:
     """Worker: generate + build one segment (process-pool entry point)."""
     from pinot_tpu.segment import SegmentBuilder
 
-    frame = generate_segment_frame(i, num_segments, n, seed)
-    SegmentBuilder(ssb_schema(), f"ssb_{i}",
-                   indexing_config=ssb_indexing_config()).build(frame,
-                                                               out_dir)
-    return f"ssb_{i}"
+    if partitioned:
+        frame = generate_partitioned_frame(i, num_segments, n, seed)
+        name = f"ssb_part_{i}"
+        cfg = ssb_indexing_config(star_tree=star_tree,
+                                  num_partitions=num_segments)
+    else:
+        frame = generate_segment_frame(i, num_segments, n, seed)
+        name = f"ssb_{i}"
+        cfg = ssb_indexing_config(star_tree=star_tree)
+    SegmentBuilder(ssb_schema(), name, indexing_config=cfg).build(frame,
+                                                                  out_dir)
+    return name
 
 
 def build_segments(sf: float, out_dir: str, num_segments: int = 8,
                    seed: int = 42, rows: int = 0,
-                   workers: int = 0) -> List:
+                   workers: int = 0, partitioned: bool = False,
+                   star_tree: bool = True) -> List:
     """Build + load ``num_segments`` SSB segments. ``workers`` > 1 builds
     segments in a spawn process pool (per-column creators are independent in
     the reference too — SegmentIndexCreationDriverImpl.java:81); 0 picks
-    min(num_segments, cpu_count)."""
+    min(num_segments, cpu_count). ``partitioned`` builds the
+    one-``d_year``-per-segment layout with Modulo partition metadata
+    (broker partition pruning); ``star_tree=False`` skips tree build."""
     from pinot_tpu.segment import load_segment
 
     n = rows or int(sf * ROWS_PER_SF)
@@ -221,7 +267,8 @@ def build_segments(sf: float, out_dir: str, num_segments: int = 8,
         take = min(per, left)
         if take <= 0:
             break
-        jobs.append((i, num_segments, take, seed, out_dir))
+        jobs.append((i, num_segments, take, seed, out_dir, partitioned,
+                     star_tree))
         left -= take
 
     if not workers:

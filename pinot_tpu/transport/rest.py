@@ -453,9 +453,23 @@ class BrokerApi(_Api):
         self._broker = broker
         self.route("GET", r"/metrics",
                    lambda m, b: (200, broker.metrics.export_prometheus()))
-        self.route("GET", r"/debug/routing/([^/]+)",
-                   lambda m, b: (200, dict(
-                       broker.routing.get_routing_table(m.group(1))[0])))
+        def debug_routing(m, b):
+            """The routing snapshot + scatter accounting for one table:
+            which servers would be scattered to, what's unavailable, and
+            the segment counts behind the prune ratio (the ops view of
+            the partition/time metadata pushed into the routing table)."""
+            res = broker.routing.route(m.group(1))
+            return 200, {
+                "routing": dict(res.routing),
+                "unavailable": list(res.unavailable),
+                "segmentsTotal": res.segments_total,
+                "segmentsRouted": res.segments_routed,
+                "timePruned": res.time_pruned,
+                "partitionPruned": res.partition_pruned,
+                "serversRouted": res.servers_routed,
+            }
+
+        self.route("GET", r"/debug/routing/([^/]+)", debug_routing)
         # single-flight coalescing + front-door admission counters
         # (broker half of the scheduler-tier ops view)
         self.route("GET", r"/debug/scheduler",

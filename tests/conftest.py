@@ -7,14 +7,28 @@ the driver's ``dryrun_multichip`` uses).
 
 import os
 
-# Must run before jax initializes a backend. Note: the environment presets
-# JAX_PLATFORMS=axon (the real-TPU tunnel) and the axon plugin overrides the
-# env var, so jax.config.update is the only reliable way to force CPU here.
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8").strip()
-os.environ["JAX_PLATFORMS"] = "cpu"
+FORCED_HOST_DEVICES = 8
+
+
+def _force_host_devices(n: int = FORCED_HOST_DEVICES) -> None:
+    """Force ``n`` virtual CPU devices BEFORE jax initializes a backend.
+
+    Subprocess-safe: the flag is appended to ``os.environ['XLA_FLAGS']``
+    (inherited by every child process — spawn-pool segment builders, bench
+    workers), idempotent (a flag already present, ours or the caller's, is
+    left alone), and pinned to CPU via BOTH the env var and
+    ``jax.config`` — the environment presets JAX_PLATFORMS=axon (the
+    real-TPU tunnel) and the axon plugin overrides the env var, so
+    jax.config.update is the only reliable way to force CPU here.
+    """
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={n}").strip()
+    os.environ["JAX_PLATFORMS"] = "cpu"
+
+
+_force_host_devices()
 
 import jax  # noqa: E402
 
@@ -52,6 +66,12 @@ def pytest_configure(config):
         "pallas: fused Pallas scan kernel (interpret-mode parity, SSB-13 "
         "eligibility, group-range probe narrowing; pytest -m pallas runs "
         "it in isolation; part of tier-1)")
+    config.addinivalue_line(
+        "markers",
+        "cluster_routing: partition-aware scatter routing + replica "
+        "groups + partial-result gather + the sharded combine on the "
+        "forced multi-device mesh (pytest -m cluster_routing runs it in "
+        "isolation; part of tier-1)")
 
 
 @pytest.fixture(scope="session")
@@ -61,3 +81,12 @@ def eight_devices():
     devs = jax.devices()
     assert len(devs) >= 8, f"expected >=8 virtual devices, got {devs}"
     return devs
+
+
+@pytest.fixture(scope="session")
+def forced_mesh_devices(eight_devices):
+    """The conftest-forced virtual device set the multi-device mesh tests
+    build their ``Mesh`` from (see ``_force_host_devices``: env-flag based,
+    so spawn subprocesses — segment builders, bench workers — inherit the
+    same device count)."""
+    return eight_devices

@@ -1,0 +1,452 @@
+"""Partition-aware scatter routing + replica groups + partial-result gather
+(``pytest -m cluster_routing``, part of tier-1).
+
+Covers ISSUE 12's cluster half: the cached RoutingTable snapshot (segment
+partition/time metadata pushed in by store watches — zero state-store reads
+on the warmed hot path), eq/IN/range partition pruning, the routing
+decision ledger, scatter fan-out accounting (numServersQueried /
+numServersResponded on QueryStats and the wire), and per-server failure
+handling in gather (a down or timed-out server yields a PARTIAL result
+with loud accounting and no pin/lease leak on the surviving servers).
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from pinot_tpu.broker.routing import RoutingManager
+from pinot_tpu.controller.state import (
+    ONLINE,
+    ClusterStateStore,
+    InstanceInfo,
+    SegmentZKMetadata,
+)
+from pinot_tpu.engine.results import QueryStats
+from pinot_tpu.query import compile_query
+from pinot_tpu.spi.table import (
+    RoutingConfig,
+    SegmentsValidationConfig,
+    TableConfig,
+)
+from pinot_tpu.tools import ssb
+from pinot_tpu.tools.cluster import EmbeddedCluster
+
+pytestmark = pytest.mark.cluster_routing
+
+TABLE = "part_OFFLINE"
+
+
+def _store_with_partitioned_segments(num_segments=4, num_partitions=4,
+                                     fn_name="Modulo", pruner=True,
+                                     time_ranges=None):
+    """A store holding ``num_segments`` segments, segment i owning
+    partition i (mod num_partitions) of column 'k', each served by its
+    own server — routing-only tests need no real segment files."""
+    store = ClusterStateStore()
+    from pinot_tpu.spi.data import DataType, FieldSpec, Schema
+
+    store.add_schema(Schema("part", [FieldSpec("k", DataType.INT)]))
+    store.add_table_config(TableConfig(
+        "part",
+        validation_config=SegmentsValidationConfig(
+            time_column_name="ts" if time_ranges else None),
+        routing_config=RoutingConfig(
+            segment_pruner_types=["partition"] if pruner else [])))
+    for i in range(num_segments):
+        store.register_instance(InstanceInfo(f"s{i}", "SERVER"))
+        md = SegmentZKMetadata(
+            segment_name=f"seg_{i}", table_name=TABLE,
+            partition_metadata={"k": {
+                "functionName": fn_name,
+                "numPartitions": num_partitions,
+                "partitions": [i % num_partitions]}})
+        if time_ranges:
+            md.start_time, md.end_time = time_ranges[i]
+        store.set_segment_metadata(md)
+        store.report_instance_state(TABLE, f"seg_{i}", f"s{i}", ONLINE)
+    return store
+
+
+def _routed_segments(rm, ctx=None, stats=None):
+    res = rm.route(TABLE, ctx, stats=stats)
+    return sorted(sum(res.routing.values(), [])), res
+
+
+class TestRoutingTableSnapshot:
+    def test_metadata_pushed_no_store_reads_on_hot_path(self):
+        """The warmed per-query path must not touch the state store: the
+        snapshot carries replicas + partition fns + time ranges (ref:
+        buildRouting caching per RoutingEntry)."""
+        store = _store_with_partitioned_segments()
+        rm = RoutingManager(store)
+        ctx = compile_query("SELECT count(*) FROM part WHERE k = 2")
+        routed, res = _routed_segments(rm, ctx)
+        assert routed == ["seg_2"]
+
+        def boom(*a, **k):
+            raise AssertionError("state store read on the routing hot path")
+
+        for name in ("get_segment_metadata", "segment_metadata_list",
+                     "get_external_view", "get_table_config",
+                     "get_instance_partitions", "instances"):
+            setattr(store, name, boom)
+        routed, res = _routed_segments(rm, ctx)
+        assert routed == ["seg_2"]
+        assert res.servers_routed == 1
+
+    def test_watch_invalidation_on_new_segment(self):
+        store = _store_with_partitioned_segments()
+        rm = RoutingManager(store)
+        assert _routed_segments(rm)[0] == [f"seg_{i}" for i in range(4)]
+        # a segment push + EV report must invalidate the cached snapshot
+        store.set_segment_metadata(SegmentZKMetadata(
+            segment_name="seg_4", table_name=TABLE,
+            partition_metadata={"k": {"functionName": "Modulo",
+                                      "numPartitions": 4,
+                                      "partitions": [0]}}))
+        store.report_instance_state(TABLE, "seg_4", "s0", ONLINE)
+        assert "seg_4" in _routed_segments(rm)[0]
+        ctx = compile_query("SELECT count(*) FROM part WHERE k = 4")
+        assert _routed_segments(rm, ctx)[0] == ["seg_0", "seg_4"]
+
+    def test_liveness_watch_refreshes_dead_set(self):
+        store = _store_with_partitioned_segments()
+        rm = RoutingManager(store)
+        _routed_segments(rm)  # warm the dead-instance cache
+        store.set_instance_alive("s1", False)
+        routed, res = _routed_segments(rm)
+        # seg_1's only replica is dead -> unavailable, not silently routed
+        assert "seg_1" not in routed
+        assert res.unavailable == ["seg_1"]
+        store.set_instance_alive("s1", True)
+        assert "seg_1" in _routed_segments(rm)[0]
+
+
+class TestPartitionPruning:
+    def test_eq_in_and_range_predicates(self):
+        store = _store_with_partitioned_segments()
+        rm = RoutingManager(store)
+        eq = compile_query("SELECT count(*) FROM part WHERE k = 6")
+        assert _routed_segments(rm, eq)[0] == ["seg_2"]
+        isin = compile_query("SELECT count(*) FROM part WHERE k IN (1, 2)")
+        assert _routed_segments(rm, isin)[0] == ["seg_1", "seg_2"]
+        # narrow closed int range enumerates its values (4..5 -> {0, 1})
+        rng = compile_query(
+            "SELECT count(*) FROM part WHERE k BETWEEN 4 AND 5")
+        assert _routed_segments(rm, rng)[0] == ["seg_0", "seg_1"]
+
+    def test_wide_and_open_ranges_do_not_prune(self):
+        store = _store_with_partitioned_segments()
+        rm = RoutingManager(store)
+        wide = compile_query(
+            "SELECT count(*) FROM part WHERE k BETWEEN 0 AND 100000")
+        assert len(_routed_segments(rm, wide)[0]) == 4
+        open_ = compile_query("SELECT count(*) FROM part WHERE k > 7")
+        assert len(_routed_segments(rm, open_)[0]) == 4
+
+    def test_or_filters_do_not_prune(self):
+        # a top-level OR is not conjunctive: pruning on either branch is
+        # wrong (same-column OR-of-eq may legally collapse to IN upstream,
+        # so the shape here mixes eq with an open range)
+        store = _store_with_partitioned_segments()
+        rm = RoutingManager(store)
+        ctx = compile_query(
+            "SELECT count(*) FROM part WHERE k = 2 OR k > 100000")
+        assert len(_routed_segments(rm, ctx)[0]) == 4
+
+    def test_murmur_partition_function(self):
+        store = _store_with_partitioned_segments(fn_name="Murmur")
+        rm = RoutingManager(store)
+        from pinot_tpu.utils.partition import get_partition_function
+
+        fn = get_partition_function("Murmur", 4)
+        v = 37
+        ctx = compile_query(f"SELECT count(*) FROM part WHERE k = {v}")
+        assert _routed_segments(rm, ctx)[0] == [f"seg_{fn.partition(v)}"]
+
+    def test_ledger_records_prune_and_declines(self):
+        store = _store_with_partitioned_segments()
+        rm = RoutingManager(store)
+        stats = QueryStats()
+        ctx = compile_query("SELECT count(*) FROM part WHERE k = 2")
+        _routed_segments(rm, ctx, stats=stats)
+        assert stats.decisions.get(
+            "routing:all_servers->pruned:partition_prune") == 1
+        # no usable predicate -> the decline says WHY nothing was pruned
+        stats = QueryStats()
+        _routed_segments(rm, compile_query("SELECT count(*) FROM part"),
+                         stats=stats)
+        assert stats.decisions.get(
+            "routing:pruned->all_servers:no_filter") == 1
+        stats = QueryStats()
+        _routed_segments(
+            rm, compile_query("SELECT count(*) FROM part WHERE k > 3"),
+            stats=stats)
+        assert stats.decisions.get(
+            "routing:pruned->all_servers:no_partition_predicate") == 1
+
+    def test_no_metadata_declines(self):
+        store = ClusterStateStore()
+        from pinot_tpu.spi.data import DataType, FieldSpec, Schema
+
+        store.add_schema(Schema("part", [FieldSpec("k", DataType.INT)]))
+        store.add_table_config(TableConfig(
+            "part", routing_config=RoutingConfig(
+                segment_pruner_types=["partition"])))
+        store.register_instance(InstanceInfo("s0", "SERVER"))
+        store.set_segment_metadata(SegmentZKMetadata(
+            segment_name="seg_0", table_name=TABLE))
+        store.report_instance_state(TABLE, "seg_0", "s0", ONLINE)
+        rm = RoutingManager(store)
+        stats = QueryStats()
+        ctx = compile_query("SELECT count(*) FROM part WHERE k = 2")
+        routed, _ = _routed_segments(rm, ctx, stats=stats)
+        assert routed == ["seg_0"]  # nothing prunable, nothing lost
+        assert stats.decisions.get(
+            "routing:pruned->all_servers:no_partition_metadata") == 1
+
+
+class TestReasonRegistry:
+    def test_routing_reason_literals_are_registered(self):
+        """Every reason literal broker/routing.py hands to
+        record_decision must be in tracing.ROUTING_DECISION_REASONS (and
+        broker.py's gather reasons in GATHER_DECISION_REASONS) — an
+        unregistered code would reach the ledger unexplained."""
+        import re
+
+        import pinot_tpu.broker.broker as broker_mod
+        import pinot_tpu.broker.routing as routing_mod
+        from pinot_tpu.common.tracing import (
+            GATHER_DECISION_REASONS,
+            ROUTING_DECISION_REASONS,
+        )
+
+        src = open(routing_mod.__file__.rstrip("c")).read()
+        declines = set(re.findall(r'declined\("([a-z_]+)"\)', src))
+        prunes = set(re.findall(
+            r'"pruned", "all_servers",\s*\n?\s*"([a-z_]+)"', src))
+        assert declines | prunes <= ROUTING_DECISION_REASONS
+        assert "partition_prune" in prunes and "time_prune" in prunes
+        bsrc = open(broker_mod.__file__.rstrip("c")).read()
+        gather = set(re.findall(
+            r'"full_result",\s*\n?\s*"([a-z_]+)"', bsrc))
+        assert gather == GATHER_DECISION_REASONS
+
+
+class TestTimePruning:
+    def test_time_prune_with_ledger(self):
+        store = _store_with_partitioned_segments(
+            time_ranges=[(0, 9), (10, 19), (20, 29), (30, 39)])
+        rm = RoutingManager(store)
+        stats = QueryStats()
+        ctx = compile_query(
+            "SELECT count(*) FROM part WHERE ts BETWEEN 12 AND 25")
+        routed, res = _routed_segments(rm, ctx, stats=stats)
+        assert routed == ["seg_1", "seg_2"]
+        assert res.time_pruned == 2
+        assert stats.decisions.get(
+            "routing:all_servers->pruned:time_prune") == 1
+
+
+@pytest.fixture(scope="module")
+def partitioned_cluster(tmp_path_factory):
+    """4 servers x 8 partition-aligned SSB segments (one d_year each,
+    Modulo(8) metadata recorded at build), partition pruner enabled."""
+    data_dir = str(tmp_path_factory.mktemp("part_cluster"))
+    seg_dir = f"{data_dir}/segs"
+    segs = ssb.build_segments(0, seg_dir, num_segments=8, rows=4000,
+                              partitioned=True, star_tree=False, workers=1)
+    cluster = EmbeddedCluster(num_servers=4, data_dir=data_dir)
+    cluster.create_table(
+        TableConfig("ssb_lineorder",
+                    validation_config=SegmentsValidationConfig(
+                        time_column_name="d_yearmonthnum"),
+                    routing_config=RoutingConfig(
+                        segment_pruner_types=["partition"])),
+        ssb.ssb_schema())
+    for i in range(8):
+        cluster.upload_segment_dir("ssb_lineorder_OFFLINE",
+                                   f"{seg_dir}/ssb_part_{i}")
+    assert cluster.wait_for_ev_converged("ssb_lineorder_OFFLINE")
+    yield cluster, segs
+    cluster.shutdown()
+
+
+class TestClusterScatterAccounting:
+    def test_partition_filtered_query_prunes_servers(self,
+                                                     partitioned_cluster):
+        cluster, _ = partitioned_cluster
+        resp = cluster.query(ssb.QUERIES["Q1.1"])
+        assert not resp.exceptions, resp.exceptions
+        # 1993 lives in exactly one segment -> one server of four
+        assert resp.num_servers_queried == 1
+        assert resp.num_servers_responded == 1
+        # the accounting ALSO rides QueryStats (and thus the wire)
+        assert resp.stats.num_servers_queried == 1
+        assert resp.stats.num_servers_responded == 1
+        assert resp.stats.decisions.get(
+            "routing:all_servers->pruned:partition_prune") == 1
+
+    def test_unfiltered_query_fans_out_to_all(self, partitioned_cluster):
+        cluster, segs = partitioned_cluster
+        resp = cluster.query("SELECT count(*) FROM ssb_lineorder")
+        assert not resp.exceptions
+        assert resp.result_table.rows[0][0] == sum(
+            s.metadata.num_docs for s in segs)
+        assert resp.num_servers_queried == 4
+        assert resp.num_servers_responded == 4
+        assert resp.to_dict()["partialResult"] is False
+        assert cluster.hosting_servers("ssb_lineorder_OFFLINE") \
+            == sorted(cluster.servers)
+
+    def test_pruned_answer_matches_oracle(self, partitioned_cluster):
+        """Pruning must be sound: the partition-filtered answer equals the
+        pandas oracle over the SAME generated frames."""
+        cluster, _ = partitioned_cluster
+        frames = [ssb.generate_partitioned_frame(i, 8, 500) for i in
+                  range(8)]
+        cols = {k: np.concatenate([f[k] for f in frames])
+                for k in frames[0]}
+        want = ssb.pandas_answer(cols, "Q1.1")
+        rows = cluster.query_rows(ssb.QUERIES["Q1.1"])
+        assert int(rows[0][0]) == want
+
+    def test_stats_wire_roundtrip_carries_server_counts(self):
+        from pinot_tpu.common.datatable import DataTable, ResponseType
+
+        stats = QueryStats(num_servers_queried=7, num_servers_responded=5)
+        dt = DataTable(ResponseType.AGGREGATION, {"states": []}, stats, [])
+        back = DataTable.from_bytes(dt.to_bytes())
+        assert back.stats.num_servers_queried == 7
+        assert back.stats.num_servers_responded == 5
+
+
+@pytest.fixture()
+def small_cluster(tmp_path):
+    """3 servers, replication 1 — every server owns exclusive segments, so
+    losing one MUST yield a partial result (nobody else holds its data)."""
+    cluster = EmbeddedCluster(num_servers=3, data_dir=str(tmp_path))
+    from pinot_tpu.spi.data import DataType, FieldSpec, FieldType, Schema
+
+    schema = Schema("sales", [
+        FieldSpec("region", DataType.STRING),
+        FieldSpec("qty", DataType.LONG, FieldType.METRIC)])
+    cluster.create_table(TableConfig("sales"), schema)
+    rng = np.random.default_rng(7)
+    for i in range(3):
+        cluster.ingest_rows(
+            "sales_OFFLINE", schema,
+            {"region": ["east", "west"] * 50,
+             "qty": rng.integers(1, 9, 100).tolist()},
+            segment_name=f"sales_{i}")
+    assert cluster.wait_for_ev_converged("sales_OFFLINE")
+    yield cluster
+    cluster.shutdown()
+
+
+def _assert_no_pins(cluster, skip=()):
+    for sid, server in cluster.servers.items():
+        if sid in skip:
+            continue
+        snap = server.executor.residency.snapshot()
+        pinned = {n: d["pins"] for n, d in snap["stagedSegments"].items()
+                  if d["pins"]}
+        assert not pinned, f"{sid} leaked pins after partial gather: {pinned}"
+
+
+class TestPartialGather:
+    def test_timed_out_server_yields_partial_with_accounting(
+            self, small_cluster, monkeypatch):
+        cluster = small_cluster
+        victim_id = sorted(cluster.servers)[0]
+        victim = cluster.servers[victim_id]
+        real = victim.execute_query
+        release = [0.6]
+
+        def slow(ctx, table, segment_names=None):
+            time.sleep(release[0])
+            return real(ctx, table, segment_names)
+
+        monkeypatch.setattr(victim, "execute_query", slow)
+        monkeypatch.setattr(cluster.broker, "query_timeout_s", 0.15)
+        resp = cluster.query("SELECT sum(qty) FROM sales")
+        # partial result: the surviving servers' table stands, the broker
+        # flags the loss loudly instead of hanging or silently lying
+        assert resp.result_table is not None
+        assert resp.num_servers_queried == 3
+        assert resp.num_servers_responded < resp.num_servers_queried
+        assert resp.stats.num_servers_responded \
+            < resp.stats.num_servers_queried
+        assert any("timed out" in e["message"] for e in resp.exceptions)
+        assert resp.to_dict()["partialResult"] is True
+        assert resp.stats.decisions.get(
+            "gather:full_result->partial_result:server_timeout") == 1
+        # no pin/lease leak anywhere: the survivors released at end_query,
+        # and the straggler releases when its execution finally finishes
+        time.sleep(release[0] + 0.3)
+        _assert_no_pins(cluster)
+
+    def test_downed_server_yields_partial_not_wrong(self, small_cluster):
+        cluster = small_cluster
+        full = cluster.query("SELECT count(*) FROM sales")
+        assert full.result_table.rows[0][0] == 300
+        victim_id = sorted(cluster.servers)[1]
+        victim = cluster.servers[victim_id]
+        victim._queries_enabled = False  # kill mid-scatter: typed refusal
+        try:
+            resp = cluster.query("SELECT count(*) FROM sales")
+            assert resp.result_table is not None
+            # partial, and SAYS so: fewer rows counted, responded < queried
+            assert resp.result_table.rows[0][0] < 300
+            assert resp.num_servers_responded < resp.num_servers_queried
+            assert resp.stats.decisions.get(
+                "gather:full_result->partial_result:server_error") == 1
+            assert resp.exceptions
+            _assert_no_pins(cluster)
+        finally:
+            victim._queries_enabled = True
+        assert cluster.query(
+            "SELECT count(*) FROM sales").result_table.rows[0][0] == 300
+
+
+class TestReplicaGroupFanOut:
+    def test_one_group_of_eight_serves_each_query(self, tmp_path):
+        """8 servers in 2 replica groups of 4: every query scatters to at
+        most one group — the reference's QPS-scaling story at the ISSUE's
+        target server count."""
+        cluster = EmbeddedCluster(num_servers=8, data_dir=str(tmp_path))
+        try:
+            from pinot_tpu.spi.data import (
+                DataType,
+                FieldSpec,
+                FieldType,
+                Schema,
+            )
+
+            schema = Schema("rg8", [
+                FieldSpec("region", DataType.STRING),
+                FieldSpec("qty", DataType.LONG, FieldType.METRIC)])
+            cluster.create_table(
+                TableConfig("rg8",
+                            validation_config=SegmentsValidationConfig(
+                                replication=2),
+                            routing_config=RoutingConfig(
+                                instance_selector_type="replicaGroup")),
+                schema)
+            groups = cluster.store.get_instance_partitions("rg8_OFFLINE")
+            assert len(groups) == 2 and all(len(g) == 4 for g in groups)
+            for i in range(8):
+                cluster.ingest_rows(
+                    "rg8_OFFLINE", schema,
+                    {"region": ["east"] * 40, "qty": list(range(40))},
+                    segment_name=f"rg8_{i}")
+            assert cluster.wait_for_ev_converged("rg8_OFFLINE")
+            for _ in range(4):
+                resp = cluster.query("SELECT count(*) FROM rg8")
+                assert not resp.exceptions, resp.exceptions
+                assert resp.result_table.rows[0][0] == 320
+                # fan-out bounded by one replica group
+                assert resp.num_servers_queried <= 4
+        finally:
+            cluster.shutdown()

@@ -280,9 +280,10 @@ def test_lineage_endpoints(rest, tmp_path):
     eid = out["segmentLineageEntryId"]
     _http("POST", f"{ctrl_url}/segments/{table}/endReplaceSegments/{eid}")
     # replaced input is now hidden from routing
-    routing = _http("GET", f"{brk_url}/debug/routing/{table}")
-    routed = sorted(sum(routing.values(), []))
+    out = _http("GET", f"{brk_url}/debug/routing/{table}")
+    routed = sorted(sum(out["routing"].values(), []))
     assert segs[0] not in routed
+    assert out["segmentsRouted"] == len(routed)
 
 
 def test_server_admin_size_and_memory(cluster, tmp_path):

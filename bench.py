@@ -604,7 +604,11 @@ class _Worker:
         if self.ssb_segs is not None:
             return self.ssb_segs
         manifest = os.path.join(self.data_dir, "manifest.json")
-        want = {"rows": self.rows, "segments": NUM_SEGMENTS}
+        # treeConfig bumps when the default SSB tree set changes shape, so
+        # prebuilt segments from an older round rebuild instead of serving
+        # stale (fewer/smaller) trees: v2 = the 5-tree all-13-flights set
+        want = {"rows": self.rows, "segments": NUM_SEGMENTS,
+                "treeConfig": "v2-multitree"}
         have = None
         try:
             with open(manifest) as f:
@@ -637,6 +641,7 @@ class _Worker:
 
     # -- sub-suites ---------------------------------------------------------
     def bench_ssb(self) -> dict:
+        from pinot_tpu.common.tracing import parse_decision_key
         from pinot_tpu.query import compile_query
         from pinot_tpu.tools import ssb, ssb_baseline
 
@@ -655,6 +660,7 @@ class _Worker:
         parity_fail = []
         rungs = {}
         docs_scanned = {}
+        tree_index = {}
         for qid, ctx in ctxs.items():
             _log(f"ssb {qid}: baseline + device compile + parity")
             want = ssb_baseline.run_query(df, qid)
@@ -662,8 +668,9 @@ class _Worker:
             want = ssb_baseline.run_query(df, qid)
             base_ms[qid] = (time.perf_counter() - t0) * 1e3
             got, qstats = self.dev.execute(ctx, segs)   # compiles + warms
-            rungs[qid] = qstats.group_by_rung or "scalar"
+            rungs[qid] = _ssb_rung(qstats)
             docs_scanned[qid] = qstats.num_docs_scanned
+            tree_index[qid] = qstats.startree_tree_index
             if not ssb_baseline.rows_match(got.rows, want, rel=1e-6):
                 parity_fail.append(qid)
         if parity_fail:
@@ -678,18 +685,40 @@ class _Worker:
             raise AssertionError(
                 f"group-by rung regression: {regressed} fell back to "
                 f"{[rungs[q] for q in regressed]} (rungs: {rungs})")
-        # with the default lineorder star-tree, Q2.x must serve from the
-        # pre-aggregated node slices on DEVICE — regressing to the scan
-        # (or the host walker) silently re-pays the 3M-doc scan this PR
-        # removed (same loud-failure contract as the Q3.x rung gate)
-        if segs and segs[0].metadata.star_tree_count:
-            off_tree = [q for q in ("Q2.1", "Q2.2", "Q2.3")
+        # with the default multi-tree lineorder config, ALL 13 flights
+        # must serve from pre-aggregated node slices on DEVICE — any
+        # flight regressing to the scan (or the host walker) silently
+        # re-pays the full-table scan this tree set removed. The ledger
+        # must also carry ZERO of the two coverage-gap reasons the tree
+        # set exists to close. BENCH_ALLOW_SCAN_RUNG=1 opts out (tree-less
+        # experiments / capped-memory runs).
+        if segs and segs[0].metadata.star_tree_count \
+                and not os.environ.get("BENCH_ALLOW_SCAN_RUNG"):
+            off_tree = [q for q in ctxs
                         if rungs.get(q) != "startree_device"]
             if off_tree:
                 raise AssertionError(
                     f"star-tree rung regression: {off_tree} served by "
                     f"{[rungs[q] for q in off_tree]} instead of "
                     f"startree_device (rungs: {rungs})")
+            # docs_scanned per query: the pre-agg rung must stay orders of
+            # magnitude under the scan (a tree serving most of its records
+            # means the split order no longer matches the flight)
+            over = {q: n for q, n in docs_scanned.items()
+                    if n >= max(1, self.rows // 10)}
+            if over:
+                raise AssertionError(
+                    f"star-tree docs_scanned regression: {over} vs "
+                    f"{self.rows} rows — the sub-scan rung is not sub-scan")
+            closed = ("startree_expression_agg_no_pair",
+                      "startree_group_off_split_order")
+            reopened = [k for k in self._decision_delta(decision_mark)
+                        if parse_decision_key(k)[0] == "startree"
+                        and parse_decision_key(k)[3] in closed]
+            if reopened:
+                raise AssertionError(
+                    f"star-tree coverage gap reopened: {reopened} — the "
+                    "default tree set must fit every SSB flight")
 
         per_q50, per_q99 = {}, {}
         for qid, ctx in ctxs.items():
@@ -711,6 +740,7 @@ class _Worker:
                 "p99_ms": round(per_q99[qid], 3),
                 "rung": rungs.get(qid),
                 "docs_scanned": docs_scanned.get(qid),
+                "tree_index": tree_index.get(qid),
                 "pallas_kernels": self._pallas_kernel_counts(),
             })
         n = len(ctxs)
@@ -732,8 +762,6 @@ class _Worker:
         # CLASSIFIED reason code: an "unknown" means a decline path the
         # ledger cannot explain, and the next TPU-fight PR would be
         # aiming blind — fail loudly instead of shipping it
-        from pinot_tpu.common.tracing import parse_decision_key
-
         decisions = self._decision_delta(decision_mark)
         unknown = [k for k in decisions
                    if parse_decision_key(k)[0] == "pallas"
@@ -758,6 +786,10 @@ class _Worker:
             "per_query_p99_ms": {q: round(v, 2) for q, v in per_q99.items()},
             "group_by_rung": rungs,
             "docs_scanned": docs_scanned,
+            # which tree served each flight + what each tree cost to build
+            # (wall seconds summed across segments; creator-measured)
+            "startree_tree_index": tree_index,
+            "startree_build_s": _tree_build_times(segs),
             # BOTH pallas counters: the sharded combine kernels (what the
             # serving path fires) AND the per-segment run_segment cache
             # (star-tree-free per-segment flights) — the old record
@@ -985,10 +1017,17 @@ class _Worker:
                                 [st_ctx])
         scan_p50, _ = _time_suite(lambda c: self.dev.execute(c, segs),
                                   [scan_ctx])
-        return {"ms": round(st_p50 * 1e3, 3),
-                "scan_ms": round(scan_p50 * 1e3, 3),
-                "group_by_rung": st_stats.group_by_rung,
-                "docs_scanned": st_stats.num_docs_scanned}
+        out = {"ms": round(st_p50 * 1e3, 3),
+               "scan_ms": round(scan_p50 * 1e3, 3),
+               "group_by_rung": st_stats.group_by_rung,
+               "docs_scanned": st_stats.num_docs_scanned}
+        # tentpole (c) measurement: the default SSB tree set built by the
+        # lexsort engine at scale (BENCH_TREEBUILD_ROWS, e.g. 24_000_000)
+        # — per-tree wall seconds + record counts in the round JSON
+        scale_rows = int(os.environ.get("BENCH_TREEBUILD_ROWS", "0") or 0)
+        if scale_rows:
+            out["build_at_scale"] = _tree_build_at_scale(scale_rows)
+        return out
 
     def bench_sketches(self) -> dict:
         from pinot_tpu.query import compile_query
@@ -1030,10 +1069,13 @@ class _Worker:
         from pinot_tpu.tools import ssb
 
         segs = self.segments()
-        qids = ("Q1.1", "Q3.2", "Q4.2")  # scan/group flights off the
-        # star-tree rung: they exercise the sharded combine, not the
-        # per-segment node-slice path
-        ctxs = [compile_query(ssb.QUERIES[q] + " LIMIT 100000")
+        qids = ("Q1.1", "Q3.2", "Q4.2")
+        # useStarTree=false: since the multi-tree default covers ALL 13
+        # flights, the residency suite must opt out explicitly — it
+        # exercises the budget-sliced sharded combine over forward
+        # columns, not the per-segment node-slice path
+        ctxs = [compile_query(ssb.QUERIES[q]
+                              + " LIMIT 100000 OPTION(useStarTree=false)")
                 for q in qids]
 
         # 1) working set of THIS query set, measured uncapped
@@ -1292,6 +1334,77 @@ def _build_micro(tmpdir: str):
         b.build(_micro_frame(MICRO_DOCS, seed=100 + i), tmpdir)
         segs.append(load_segment(f"{tmpdir}/sales_{i}"))
     return segs
+
+
+def _tree_build_at_scale(rows: int) -> dict:
+    """Build the DEFAULT SSB tree set (all 5 trees) with the lexsort
+    engine over ``rows`` rows in ONE shot — dictIds factorized the same
+    way the segment creator does — and record per-tree build wall seconds
+    + record counts. The 24M-row number the ROADMAP asks for: build cost
+    must be measured where it scales, not inferred from 120k-row tests.
+    Each tree gets fresh metric dicts so derived-pair evaluation is
+    counted inside its own build time."""
+    from pinot_tpu.segment.creator import _sorted_factorize
+    from pinot_tpu.segment.startree import StarTreeConfig
+    from pinot_tpu.segment.startree import StarTreeBuilder
+    from pinot_tpu.tools import ssb
+
+    _log(f"startree: generating {rows} rows for the at-scale tree build")
+    t0 = time.perf_counter()
+    cols = ssb.generate_table(NUM_SEGMENTS, rows)
+    gen_s = time.perf_counter() - t0
+    configs = [StarTreeConfig.from_spi(c) for c in
+               ssb.ssb_indexing_config().star_tree_index_configs]
+    dims_needed = sorted({d for c in configs
+                          for d in c.dimensions_split_order})
+    t0 = time.perf_counter()
+    dict_ids = {d: _sorted_factorize(np.asarray(cols[d]))[1].astype(np.int32)
+                for d in dims_needed}
+    fact_s = time.perf_counter() - t0
+    metric_cols = ("lo_revenue", "lo_supplycost", "lo_extendedprice",
+                   "lo_discount")
+    metrics = {m: np.asarray(cols[m]) for m in metric_cols}
+    del cols  # the string columns are ~GBs at 24M rows; trees never read them
+    per_tree = {}
+    for i, cfg in enumerate(configs):
+        t0 = time.perf_counter()
+        tree = StarTreeBuilder(cfg).build(dict(dict_ids), dict(metrics),
+                                          rows)
+        per_tree[f"tree{i}"] = {
+            "build_s": round(time.perf_counter() - t0, 2),
+            "records": tree.num_records,
+            "dims": len(cfg.dimensions_split_order)}
+        _log(f"startree: tree{i} {per_tree[f'tree{i}']}")
+        del tree
+    return {"rows": rows, "engine": "lexsort",
+            "generate_s": round(gen_s, 2), "factorize_s": round(fact_s, 2),
+            "per_tree": per_tree}
+
+
+def _ssb_rung(qstats) -> str:
+    """The rung that served one SSB flight. Group-bys carry it directly;
+    scalar flights (Q1.x) derive it from the ledger's chosen-tree record
+    (startree:scan-><rung>:tree<i>) — a scalar query has no
+    group_by_rung but absolutely has a rung."""
+    if qstats.group_by_rung:
+        return qstats.group_by_rung
+    for k in qstats.decisions:
+        if k.startswith("startree:scan->startree_device:"):
+            return "startree_device"
+    for k in qstats.decisions:
+        if k.startswith("startree:scan->startree:"):
+            return "startree"
+    return "scalar"
+
+
+def _tree_build_times(segs) -> dict:
+    """Per-tree build wall seconds summed across segments (the creator
+    stamps them into segment metadata at build time)."""
+    out: dict = {}
+    for s in segs:
+        for i, b in enumerate(getattr(s.metadata, "star_tree_build_s", [])):
+            out[f"tree{i}"] = round(out.get(f"tree{i}", 0.0) + float(b), 3)
+    return out
 
 
 def _build_startree(tmpdir: str):

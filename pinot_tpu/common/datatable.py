@@ -311,6 +311,7 @@ class DataTable:
             num_servers_queried=st.get("numServersQueried", 0),
             num_servers_responded=st.get("numServersResponded", 0),
             group_by_rung=st.get("groupByRung"),
+            startree_tree_index=st.get("startreeTreeIndex"),
             staging=st.get("staging", {}),
             launch=st.get("launch", {}),
             phase_ms=st.get("phaseTimesMs", {}),

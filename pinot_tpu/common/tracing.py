@@ -384,6 +384,32 @@ ROUTING_DECISION_REASONS = frozenset({
     "time_all_match",
 })
 
+# Reason codes the STAR-TREE decision point records
+# (engine/startree_exec.py: pick_star_tree's note()/decline() sites and
+# _matching_ids' reason strings). Same contract as
+# ROUTING_DECISION_REASONS: every reason literal in startree_exec.py must
+# be registered here — test_startree's conformance test scans the source —
+# so a new decline site can never reach the ledger unregistered. The
+# CHOSEN-tree success records ("startree:scan-><rung>:tree<i>") carry the
+# dynamic reason matched by STARTREE_TREE_REASON instead.
+STARTREE_DECISION_REASONS = frozenset({
+    "startree_upsert_valid_docs",
+    "startree_filter_or_not_shape",
+    "startree_group_expression",
+    "startree_group_off_split_order",
+    "startree_filter_non_dimension",
+    "startree_predicate_type_unsupported",
+    "startree_agg_not_pairable",
+    "startree_expression_agg_no_pair",
+    "startree_missing_function_pair",
+    "startree_no_fitting_tree",
+    "startree_raw_dimension",
+    "startree_dictid_overflow_noncontiguous",
+})
+
+# the chosen-tree ledger reason: which of the segment's trees served
+STARTREE_TREE_REASON = re.compile(r"tree\d+\Z")
+
 # Reason codes the broker GATHER point records (broker/broker.py) when a
 # scattered-to server fails to produce a usable DataTable — the loud
 # accounting behind every partial result.

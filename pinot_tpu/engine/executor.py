@@ -664,10 +664,11 @@ class ServerQueryExecutor:
 
     def _star_tree_pick(self, ctx: QueryContext, aggs: List[AggDef],
                         seg: ImmutableSegment, on_decline=None):
-        """(tree, predicates) when a star-tree fits and the option allows
-        it, else None — the single gate for both executors.
-        ``on_decline`` receives the reason code when trees exist but none
-        fits (the decision ledger's hook)."""
+        """StarTreePick(tree, index, predicates) for the CHEAPEST fitting
+        tree when one exists and the option allows it, else None — the
+        single gate for both executors. ``on_decline`` receives the
+        most-specific reason code when trees exist but none fits (the
+        decision ledger's hook)."""
         from pinot_tpu.engine import startree_exec
 
         if ctx.options.get("useStarTree", "true").lower() == "false":
@@ -707,16 +708,28 @@ class ServerQueryExecutor:
         pick = self._star_tree_pick(ctx, aggs, seg, on_decline=declined)
         if pick is None:
             return None
-        tree, preds = pick
+        tree, tree_index, preds = pick
         matches = startree_exec.resolve_matches(seg, preds,
                                                 on_decline=declined)
         if matches is None:
             return None  # predicate not dictId-translatable -> scan path
+
+        def chose(rung: str) -> None:
+            # the CHOSEN tree rides the ledger and QueryStats: with
+            # multiple trees per segment, "which tree served" is the
+            # fact the bench records per query (startree:scan->
+            # startree_device:tree<i>)
+            record_decision(stats, "startree", rung, "scan",
+                            f"tree{tree_index}")
+            stats.startree_tree_index = tree_index
+
         if self.use_device and self._device_admitted(stats):
             try:
                 res = startree_device.execute_star_tree_device(
-                    self, ctx, aggs, seg, tree, matches, stats)
+                    self, ctx, aggs, seg, tree, matches, stats,
+                    tree_index=tree_index)
                 if res is not None:
+                    chose("startree_device")
                     return res, "startree_device"
             except PlanError as e:
                 # node plan over device limits -> host walker
@@ -724,7 +737,10 @@ class ServerQueryExecutor:
                                 "startree_device", e.reason_code)
         res = startree_exec.execute_with_matches(ctx, aggs, seg, tree,
                                                  matches, stats)
-        return None if res is None else (res, "startree")
+        if res is None:
+            return None
+        chose("startree")
+        return res, "startree"
 
     def _metadata_fast_path(self, ctx: QueryContext, aggs: List[AggDef],
                             seg: ImmutableSegment,

@@ -1000,6 +1000,21 @@ class ResidencyManager:
             doomed = self._enforce_locked()
         self._demote_or_release_all(doomed)
 
+    def release_startree(self, segment_name: str, tree_index: int) -> bool:
+        """Evict ONE star-tree's node arrays from a resident segment,
+        leaving sibling trees and staged columns untouched — finer grain
+        than whole-resident eviction when only tree bytes must go (a
+        memory-pressure actuator; /debug/memory shows the per-tree bytes
+        this frees). Accounting refreshes immediately."""
+        with self._lock:
+            e = self._entries.get(segment_name)
+            if e is None or not isinstance(e.resident, StagedSegment):
+                return False
+            freed = e.resident.release_startree(tree_index)
+            if freed:
+                self._refresh_locked()
+        return freed > 0
+
     # -- prefetch ------------------------------------------------------------
     def prefetch(self, segment, columns: Optional[List[str]] = None) -> None:
         """Enqueue background staging (segment add/reload hot path). Mutable
@@ -1236,7 +1251,11 @@ class ResidencyManager:
                 if isinstance(r, StagedSegment):
                     d.update(columns=len(r._columns), packed=len(r._packed),
                              values=len(r._values),
-                             startrees=len(r._startree))
+                             startrees=len(r._startree),
+                             # each tree accounted independently: evicting
+                             # one must not hide (or drop) its sibling
+                             startreeBytes={str(ti): b for ti, b in
+                                            r.startree_nbytes().items()})
                 else:
                     d["kind"] = type(r).__name__
                 residents[name] = d

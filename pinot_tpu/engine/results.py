@@ -79,6 +79,10 @@ class QueryStats:
     # 'startree_device'|'startree'|'host'; 'mixed' when segments split
     # across rungs) — the bench gates SSB Q2.x/Q3.x on this
     group_by_rung: Optional[str] = None
+    # index of the star-tree that served (segment.star_trees order; the
+    # bench records it per query), or None off the star-tree rungs. A
+    # table's segments share one tree config, so merge keeps any value
+    startree_tree_index: Optional[int] = None
     # HBM residency counters for this query (engine/residency.py):
     # hits/misses/evictions/pinBlockedEvictions/spills — and the tiered
     # keys promotions/demotions/slices (budget-slice boundaries the query
@@ -139,6 +143,8 @@ class QueryStats:
                 other.group_by_rung
                 if self.group_by_rung in (None, other.group_by_rung)
                 else "mixed")
+        if other.startree_tree_index is not None:
+            self.startree_tree_index = other.startree_tree_index
         for k, v in other.staging.items():
             if k.endswith("Bytes"):
                 self.staging[k] = max(self.staging.get(k, 0), v)
@@ -180,6 +186,8 @@ class QueryStats:
                              for k, v in self.phase_ms.items()},
             **({"groupByRung": self.group_by_rung}
                if self.group_by_rung else {}),
+            **({"startreeTreeIndex": self.startree_tree_index}
+               if self.startree_tree_index is not None else {}),
             **({"staging": self.staging} if self.staging else {}),
             **({"launch": self.launch} if self.launch else {}),
             **({"trace": self.trace} if self.trace else {}),

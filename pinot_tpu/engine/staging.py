@@ -442,6 +442,26 @@ class StagedSegment:
                     self._startree[key] = t
         return t
 
+    def release_startree(self, tree_index: int) -> int:
+        """Drop ONE star-tree's device arrays, leaving sibling trees (and
+        every staged column) resident — the per-tree eviction grain.
+        Returns the device bytes released. Host-image leftovers for the
+        tree are kept on purpose: a later ``startree_nodes`` call then
+        restages with one H2D promotion instead of a cold rebuild.
+        In-flight launches holding the popped dict keep their arrays alive
+        by reference; only the residency accounting lets go here."""
+        with self._lock:
+            t = self._startree.pop(int(tree_index), None)
+        if t is None:
+            return 0
+        return sum(int(getattr(a, "nbytes", 0)) for a in t.values())
+
+    def startree_nbytes(self) -> Dict[int, int]:
+        """Device bytes per resident tree index (each tree accounted
+        independently — /debug/memory's per-tree view)."""
+        return {ti: sum(int(getattr(a, "nbytes", 0)) for a in t.values())
+                for ti, t in list(self._startree.items())}
+
     def _promote_startree(self, key: int):
         img = self._host_image
         if img is None:

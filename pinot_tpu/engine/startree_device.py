@@ -135,16 +135,20 @@ def _decode_scalar(plan: StarTreePlan, out: Dict[str, Any]) -> AggResult:
 def execute_star_tree_device(executor, ctx: QueryContext,
                              aggs: List[AggDef], segment, tree,
                              matches: Dict[str, Any],
-                             stats: QueryStats) -> Optional[Any]:
+                             stats: QueryStats,
+                             tree_index: Optional[int] = None
+                             ) -> Optional[Any]:
     """-> AggResult / GroupByResult served from device-resident node
     arrays, or raises PlanError (host walker serves). ``executor`` provides
     the residency manager (staging + lease pinning) and the star-tree
-    kernel cache."""
+    kernel cache. ``tree_index`` is the pick's index into
+    ``segment.star_trees`` (derived by identity when omitted)."""
     import jax.numpy as jnp
 
     from pinot_tpu.engine.kernels import unpack_outputs
 
-    tree_index = segment.star_trees.index(tree)
+    if tree_index is None:
+        tree_index = segment.star_trees.index(tree)
     group_cols = [e.name for e in ctx.group_by]
     idx = tree.select_records(matches, group_cols)
     n = int(idx.shape[0])

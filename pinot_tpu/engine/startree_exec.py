@@ -9,7 +9,7 @@ Re-design of ``pinot-core/.../startree/StarTreeUtils.java:47``
 
 from __future__ import annotations
 
-from typing import Any, Dict, List, Optional, Set, Tuple
+from typing import Any, Dict, List, NamedTuple, Optional, Set, Tuple
 
 import numpy as np
 
@@ -23,6 +23,7 @@ from pinot_tpu.query.expressions import (
     Identifier,
     Predicate,
     PredicateType,
+    canonical_arith_key,
 )
 from pinot_tpu.segment.startree import STAR, DictIdRange, StarTree
 
@@ -52,14 +53,19 @@ def _flatten_and(node: Optional[FilterNode]) -> Optional[List[Predicate]]:
 
 
 def _agg_pair(agg: AggDef, fn: Function) -> Optional[Tuple[str, str]]:
-    """AggDef -> (function, column) pair stored in tree records."""
+    """AggDef -> (function, column) pair stored in tree records. The
+    column half may be a canonical EXPRESSION key (``(a*b)``) — derived
+    pre-agg pairs over +/-/* arithmetic, ref: the StarTreeV2 builder's
+    derived-column function-column pairs."""
     if agg.mv:
         return None
     vexpr = agg_value_expr(fn)
     if agg.base == "count" and vexpr is None:
         return ("count", "*")
-    if agg.base in ("sum", "min", "max") and isinstance(vexpr, Identifier):
-        return (agg.base, vexpr.name)
+    if agg.base in ("sum", "min", "max") and vexpr is not None:
+        key = canonical_arith_key(vexpr)
+        if key is not None:
+            return (agg.base, key)
     return None
 
 
@@ -70,20 +76,109 @@ def _pairs_needed(agg: AggDef, fn: Function) -> Optional[List[Tuple[str, str]]]:
     if p is not None:
         return [p]
     vexpr = agg_value_expr(fn)
-    if agg.base == "avg" and not agg.mv and isinstance(vexpr, Identifier):
-        return [("sum", vexpr.name), ("count", "*")]
+    if agg.base == "avg" and not agg.mv and vexpr is not None:
+        key = canonical_arith_key(vexpr)
+        if key is not None:
+            return [("sum", key), ("count", "*")]
     return None
 
 
+def _pair_column(fn: Function) -> str:
+    """Aggregation argument -> stored pair column key ('*' for COUNT(*),
+    a column name, or the canonical expression key)."""
+    vexpr = agg_value_expr(fn)
+    if vexpr is None:
+        return "*"
+    key = canonical_arith_key(vexpr)
+    return key if key is not None else "*"
+
+
+class StarTreePick(NamedTuple):
+    """``pick_star_tree``'s result: the chosen tree, its index in
+    ``segment.star_trees`` (rides the decision ledger + QueryStats), and
+    the flattened AND-ed predicate list."""
+
+    tree: StarTree
+    index: int
+    preds: List[Predicate]
+
+
+# Specificity rank of the per-tree decline reasons: how deep in the fit
+# checks a tree got before failing. With multiple trees, the MOST-specific
+# reason across trees reaches the ledger — a tree missing only a function
+# pair was one config line from serving; a tree whose split order lacks the
+# group columns never stood a chance, and reporting the latter when the
+# former exists would misdirect the operator.
+_REASON_RANK = {
+    "startree_group_off_split_order": 0,
+    "startree_filter_non_dimension": 1,
+    "startree_predicate_type_unsupported": 2,
+    "startree_agg_not_pairable": 3,
+    "startree_expression_agg_no_pair": 4,
+    "startree_missing_function_pair": 5,
+}
+
+
+def _pred_match_estimate(segment, pred: Predicate, card: int) -> int:
+    """Estimated count of dictIds a predicate matches — a plan-time proxy
+    (never materializes id sets; tree selection must stay cheap)."""
+    t = pred.type
+    if t is PredicateType.EQ:
+        return 1
+    if t is PredicateType.IN:
+        return min(card, len(pred.values))
+    if t is PredicateType.NOT_EQ:
+        return max(1, card - 1)
+    if t is PredicateType.NOT_IN:
+        return max(1, card - len(pred.values))
+    if t is PredicateType.RANGE:
+        try:
+            d = segment.data_source(pred.lhs.name).dictionary
+            if d is not None:
+                a, b = d.range_to_dict_id_interval(
+                    pred.lower, pred.upper, pred.lower_inclusive,
+                    pred.upper_inclusive)
+                return max(0, int(b) - int(a) + 1)
+        except (ValueError, TypeError, KeyError):
+            pass
+        return max(1, card // 3)
+    return card
+
+
+def _estimate_records(tree: StarTree, preds: List[Predicate],
+                      group_cols: List[str], segment) -> float:
+    """Records-read estimate for a FITTING tree — the selection cost
+    proxy: walk the split order; a predicated dim narrows to its match
+    estimate, a grouped dim fans out to its cardinality, a free dim
+    descends the star child (×1) unless star creation was skipped
+    (×cardinality). Capped at the tree's record count (a leaf-heavy tree
+    can never read more than it stores)."""
+    by_col: Dict[str, int] = {}
+    for p in preds:
+        col = p.lhs.name
+        card = segment.metadata.column(col).cardinality
+        est = _pred_match_estimate(segment, p, card)
+        by_col[col] = min(by_col.get(col, card), est)
+    grouped = set(group_cols)
+    est = 1.0
+    for d in tree.config.dimensions_split_order:
+        if d in by_col:
+            est *= max(1, by_col[d])
+        elif d in grouped or d in tree.config.skip_star_creation:
+            est *= max(1, segment.metadata.column(d).cardinality)
+    return min(est, float(tree.num_records))
+
+
 def pick_star_tree(ctx: QueryContext, aggs: List[AggDef],
-                   segment, on_decline=None
-                   ) -> Optional[Tuple[StarTree, List[Predicate]]]:
-    """Ref: StarTreeUtils.isFitForStarTree — first tree satisfying the
-    query, or None. ``on_decline`` (if given) receives a machine-readable
-    reason code when the segment HAS trees but none fits — the
-    path-decision ledger's hook (a segment without trees is not a
-    decline). The reported reason is the first tree's, the configured
-    primary."""
+                   segment, on_decline=None) -> Optional[StarTreePick]:
+    """Ref: StarTreeUtils.isFitForStarTree + StarTreeIndexConfig
+    multi-tree resolution — the CHEAPEST tree satisfying the query (every
+    fitting tree scored by :func:`_estimate_records`; the lower index
+    breaks ties), or None. ``on_decline`` (if given) receives a
+    machine-readable reason code when the segment HAS trees but none
+    fits — the path-decision ledger's hook (a segment without trees is
+    not a decline). With multiple trees the reported reason is the
+    most-specific across trees (``_REASON_RANK``)."""
 
     def decline(reason: str):
         if on_decline is not None:
@@ -105,14 +200,28 @@ def pick_star_tree(ctx: QueryContext, aggs: List[AggDef],
             return decline("startree_group_expression")
         group_cols.append(e.name)
 
+    # needed pairs are a property of the QUERY, not the tree: resolve once
+    needed: List[Tuple[str, str]] = []
+    for agg, fn in zip(aggs, ctx.aggregations):
+        ps = _pairs_needed(agg, fn)
+        if ps is None:
+            # not pair-able by ANY tree: non-arith expression aggs
+            # (sum(a/b), transforms) vs un-mergeable/MV aggregations
+            return decline("startree_expression_agg_no_pair"
+                           if isinstance(agg_value_expr(fn), Function)
+                           else "startree_agg_not_pairable")
+        needed.extend(ps)
+
     reason: Optional[str] = None
 
     def note(r: str) -> None:
         nonlocal reason
-        if reason is None:
+        if reason is None or (_REASON_RANK.get(r, 0)
+                              > _REASON_RANK.get(reason, 0)):
             reason = r
 
-    for tree in trees:
+    fitting: List[Tuple[float, int, StarTree]] = []
+    for ti, tree in enumerate(trees):
         dims = set(tree.config.dimensions_split_order)
         if any(c not in dims for c in group_cols):
             note("startree_group_off_split_order")
@@ -131,22 +240,20 @@ def pick_star_tree(ctx: QueryContext, aggs: List[AggDef],
                 break
         if not ok:
             continue
-        needed: List[Tuple[str, str]] = []
-        for agg, fn in zip(aggs, ctx.aggregations):
-            ps = _pairs_needed(agg, fn)
-            if ps is None:
-                # expression aggs (sum(a*b)) have no pre-agg pair — the
-                # Q1.x shape the ROADMAP names as the coverage gap
-                note("startree_expression_agg_no_pair")
-                needed = None
-                break
-            needed.extend(ps)
-        if needed is None:
+        missing = [c for f, c in needed if not tree.has_pair(f, c)]
+        if missing:
+            # the Q1.x ledger code when a derived pair is absent (the
+            # ROADMAP coverage gap); plain column pairs keep their own
+            note("startree_expression_agg_no_pair"
+                 if any(c.startswith("(") for c in missing)
+                 else "startree_missing_function_pair")
             continue
-        if all(tree.has_pair(f, c) for f, c in needed):
-            return tree, preds
-        note("startree_missing_function_pair")
-    return decline(reason or "startree_no_fitting_tree")
+        fitting.append((_estimate_records(tree, preds, group_cols, segment),
+                        ti, tree))
+    if not fitting:
+        return decline(reason or "startree_no_fitting_tree")
+    _est, ti, tree = min(fitting, key=lambda t: (t[0], t[1]))
+    return StarTreePick(tree, ti, preds)
 
 
 def _matching_ids(segment, pred: Predicate):
@@ -256,8 +363,7 @@ def _metric(tree: StarTree, fn: str, col: str, idx: np.ndarray) -> np.ndarray:
 
 def _scalar_state(tree: StarTree, agg: AggDef, fn: Function,
                   idx: np.ndarray) -> Any:
-    vexpr = agg_value_expr(fn)
-    col = vexpr.name if isinstance(vexpr, Identifier) else "*"
+    col = _pair_column(fn)
     if agg.base == "count":
         return int(_metric(tree, "count", "*", idx).sum())
     if idx.shape[0] == 0:
@@ -277,8 +383,7 @@ def _scalar_state(tree: StarTree, agg: AggDef, fn: Function,
 
 def _grouped_states(tree: StarTree, agg: AggDef, fn: Function,
                     idx: np.ndarray, gid: np.ndarray, n: int) -> List[Any]:
-    vexpr = agg_value_expr(fn)
-    col = vexpr.name if isinstance(vexpr, Identifier) else "*"
+    col = _pair_column(fn)
     if agg.base == "count":
         out = np.zeros(n, dtype=np.int64)
         np.add.at(out, gid, _metric(tree, "count", "*", idx))

@@ -109,6 +109,45 @@ def fold_constants(expr: Expr) -> Expr:
     return expr
 
 
+# Canonical keys for pre-aggregable arithmetic (star-tree expression
+# function-column pairs, ref: AggregationFunctionColumnPair over the
+# StarTreeV2 builder's derived columns): plus/minus/times over columns and
+# numeric literals. Commutative operands sort lexically so
+# ``sum(a * b)`` and ``SUM__b*a`` resolve to ONE stored pair. Divide is
+# excluded on purpose — float division breaks the exact-integer pre-agg
+# contract the tree metrics rely on (and '/' is not filename-safe for the
+# per-pair metric files).
+_ARITH_KEY_OPS = {"plus": "+", "minus": "-", "times": "*"}
+_ARITH_COMMUTATIVE = {"plus", "times"}
+
+
+def canonical_arith_key(e: Expr) -> Optional[str]:
+    """Deterministic key for a +/-/* expression over identifiers and
+    numeric literals — the star-tree derived-pair namespace — or None when
+    the expression is not pre-aggregable (division, transforms, MV,
+    virtual columns). A bare identifier canonicalizes to its name, so the
+    key space is a strict superset of plain column pairs."""
+    if isinstance(e, Identifier):
+        if e.name == "*" or e.name.startswith("$"):
+            return None
+        return e.name
+    if isinstance(e, Literal):
+        if isinstance(e.value, bool) or not isinstance(e.value, (int, float)):
+            return None
+        return str(e.value)
+    if isinstance(e, Function):
+        sym = _ARITH_KEY_OPS.get(e.name)
+        if sym is None or len(e.args) != 2:
+            return None
+        parts = [canonical_arith_key(a) for a in e.args]
+        if any(p is None for p in parts):
+            return None
+        if e.name in _ARITH_COMMUTATIVE:
+            parts.sort()
+        return f"({parts[0]}{sym}{parts[1]})"
+    return None
+
+
 # --------------------------------------------------------------------------
 # Filter tree
 # --------------------------------------------------------------------------

@@ -30,6 +30,8 @@ are masked by ``doc_id >= num_docs`` in kernels.
 from __future__ import annotations
 
 import os
+import time
+
 from typing import Any, Dict, Iterable, List, Mapping, Optional, Sequence, Union
 
 import numpy as np
@@ -207,7 +209,10 @@ class SegmentBuilder:
                     os.path.join(col_dir, f"{col}.fwdcc.bin"))
             return np.load(os.path.join(col_dir, f"{col}.fwd.npy"))
 
+        from pinot_tpu.segment.startree import derived_pair_expr
+
         count = 0
+        build_s: List[float] = []
         for cfg in configs:
             try:
                 dim_ids = {}
@@ -219,19 +224,27 @@ class SegmentBuilder:
                     dim_ids[d] = load_fwd(d).astype(np.int32)
                 metric_vals = {}
                 for fn, col in cfg.function_column_pairs:
-                    if col == "*" or col in metric_vals:
+                    if col == "*":
                         continue
-                    cm = sm.columns[col]
-                    if not (cm.single_value and cm.data_type.is_numeric):
-                        raise ValueError(f"metric {col} must be a numeric "
-                                         "SV column")
-                    fwd = load_fwd(col)
-                    if cm.has_dictionary:
-                        metric_vals[col] = load(col, "dict")[fwd]
-                    else:
-                        metric_vals[col] = fwd
+                    # derived pair columns ('sum__(a*b)') evaluate in the
+                    # builder from their base columns' raw values
+                    expr = derived_pair_expr(col)
+                    for c in (expr.columns() if expr is not None else [col]):
+                        if c in metric_vals:
+                            continue
+                        cm = sm.columns[c]
+                        if not (cm.single_value and cm.data_type.is_numeric):
+                            raise ValueError(f"metric {c} must be a numeric "
+                                             "SV column")
+                        fwd = load_fwd(c)
+                        if cm.has_dictionary:
+                            metric_vals[c] = load(c, "dict")[fwd]
+                        else:
+                            metric_vals[c] = fwd
+                t0 = time.perf_counter()
                 tree = StarTreeBuilder(cfg).build(dim_ids, metric_vals,
                                                   sm.num_docs)
+                build_s.append(round(time.perf_counter() - t0, 4))
                 tree.save(seg_dir, index=count)
                 count += 1
             except (ValueError, KeyError, OSError) as e:
@@ -239,6 +252,7 @@ class SegmentBuilder:
 
                 logging.getLogger(__name__).warning(
                     "skipping star-tree for %s: %s", self.segment_name, e)
+        sm.star_tree_build_s = build_s
         return count
 
     def _default_star_tree_config(self, sm: meta.SegmentMetadata):

@@ -194,6 +194,8 @@ class SegmentMetadata:
     crc: int = 0
     columns: Dict[str, ColumnMetadata] = field(default_factory=dict)
     star_tree_count: int = 0
+    # per-tree build wall seconds (creator fills; bench records)
+    star_tree_build_s: List[float] = field(default_factory=list)
     custom: Dict[str, Any] = field(default_factory=dict)
 
     @property
@@ -221,6 +223,7 @@ class SegmentMetadata:
             "maxTime": self.max_time,
             "crc": self.crc,
             "starTreeCount": self.star_tree_count,
+            "starTreeBuildS": self.star_tree_build_s,
             "columns": {n: c.to_dict() for n, c in self.columns.items()},
             "custom": self.custom,
         }
@@ -240,6 +243,7 @@ class SegmentMetadata:
             max_time=d.get("maxTime"),
             crc=d.get("crc", 0),
             star_tree_count=d.get("starTreeCount", 0),
+            star_tree_build_s=d.get("starTreeBuildS", []),
             columns={n: ColumnMetadata.from_dict(c)
                      for n, c in d.get("columns", {}).items()},
             custom=d.get("custom", {}),

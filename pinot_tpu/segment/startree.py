@@ -66,6 +66,70 @@ def match_bounds(match) -> Tuple[int, int]:
 # AggregationFunctionColumnPair; COUNT uses the catch-all '*' column)
 _MERGEABLE = {"count", "sum", "min", "max"}
 
+_IDENT_RE = None  # compiled lazily (keeps the numpy-only import surface)
+
+
+def canonical_pair_column(col: str) -> str:
+    """Normalize a function-column pair's column half: bare column names
+    pass through; arithmetic EXPRESSIONS (``lo_extendedprice*lo_discount``,
+    ref: StarTreeV2 builder configs with derived columns) parse and
+    canonicalize into the same key namespace the query side derives from
+    aggregation arguments, so ``SUM__a*b`` stores exactly the pair
+    ``sum(b * a)`` resolves. Raises ValueError for expressions outside the
+    pre-aggregable +/-/* subset."""
+    global _IDENT_RE
+    if _IDENT_RE is None:
+        import re
+
+        _IDENT_RE = re.compile(r"[A-Za-z_][A-Za-z0-9_]*\Z")
+    col = col.strip()
+    if col == "*" or _IDENT_RE.match(col):
+        return col
+    from pinot_tpu.query.expressions import canonical_arith_key
+    from pinot_tpu.query.parser import parse_expression
+
+    key = canonical_arith_key(parse_expression(col))
+    if key is None:
+        raise ValueError(f"function-column pair expression {col!r} is not "
+                         "pre-aggregable (+/-/* over columns only)")
+    return key
+
+
+def derived_pair_expr(col: str):
+    """The parsed expression behind a DERIVED pair column key (canonical,
+    parenthesized), or None for a plain column / '*'."""
+    if not col.startswith("("):
+        return None
+    from pinot_tpu.query.parser import parse_expression
+
+    return parse_expression(col)
+
+
+def eval_derived_column(expr, columns: Dict[str, np.ndarray],
+                        num_docs: int) -> np.ndarray:
+    """Vectorized one-shot evaluation of a derived pair column over raw
+    forward-column values (the build-time half of expression
+    pre-aggregation): integer inputs stay integral so the stored f64
+    pre-agg sums are exact."""
+    from pinot_tpu.query.expressions import Function, Identifier, Literal
+
+    def ev(e):
+        if isinstance(e, Identifier):
+            return np.asarray(columns[e.name][:num_docs])
+        if isinstance(e, Literal):
+            return e.value
+        assert isinstance(e, Function) and len(e.args) == 2, e
+        a, b = ev(e.args[0]), ev(e.args[1])
+        if e.name == "plus":
+            return a + b
+        if e.name == "minus":
+            return a - b
+        if e.name == "times":
+            return a * b
+        raise ValueError(f"derived column op {e.name} unsupported")
+
+    return ev(expr)
+
 
 @dataclass
 class StarTreeConfig:
@@ -78,11 +142,12 @@ class StarTreeConfig:
 
     @classmethod
     def from_spi(cls, spi_config) -> "StarTreeConfig":
-        """From spi.table.StarTreeIndexConfig ('SUM__revenue' pair syntax)."""
+        """From spi.table.StarTreeIndexConfig ('SUM__revenue' pair syntax;
+        the column half may be a +/-/* expression, 'SUM__a*b')."""
         pairs = []
         for p in spi_config.function_column_pairs:
             fn, _, col = p.partition("__")
-            pairs.append((fn.lower(), col or "*"))
+            pairs.append((fn.lower(), canonical_pair_column(col or "*")))
         return cls(list(spi_config.dimensions_split_order), pairs,
                    spi_config.max_leaf_records,
                    list(spi_config.skip_star_node_creation_for_dimensions))
@@ -101,7 +166,7 @@ class StarTreeConfig:
         pairs = []
         for p in d["functionColumnPairs"]:
             fn, _, col = p.partition("__")
-            pairs.append((fn, col or "*"))
+            pairs.append((fn, canonical_pair_column(col or "*")))
         return cls(d["dimensionsSplitOrder"], pairs, d["maxLeafRecords"],
                    d.get("skipStarNodeCreationForDimensions", []))
 
@@ -117,6 +182,24 @@ _NODE_DTYPE = np.dtype([
 ])
 
 
+class _BuildNode:
+    """Intermediate node for the lexsort construction: a record range
+    inside one chunk plus its children (value kids in dictId order, star
+    child last), assembled into the serialized DFS layout at the end."""
+
+    __slots__ = ("value", "chunk", "lo", "hi", "dim", "kids", "star", "idx")
+
+    def __init__(self, value: int, chunk: int, lo: int, hi: int):
+        self.value = value
+        self.chunk = chunk
+        self.lo = lo
+        self.hi = hi
+        self.dim = -1
+        self.kids: Optional[List["_BuildNode"]] = None
+        self.star: Optional["_BuildNode"] = None
+        self.idx = -1
+
+
 class StarTreeBuilder:
     """On-heap single-tree builder (ref: BaseSingleTreeBuilder, 541 LoC)."""
 
@@ -125,12 +208,17 @@ class StarTreeBuilder:
 
     def build(self, dim_dict_ids: Dict[str, np.ndarray],
               metric_values: Dict[str, np.ndarray],
-              num_docs: int) -> "StarTree":
+              num_docs: int, engine: str = "lexsort") -> "StarTree":
         """``dim_dict_ids``: per split-order dimension, [num_docs] dictIds.
-        ``metric_values``: per non-count pair column, [num_docs] raw values.
-        """
+        ``metric_values``: per non-count pair column, [num_docs] raw values
+        (derived pair columns evaluate here from their base columns unless
+        the caller pre-computed them under the canonical key).
+
+        ``engine``: 'lexsort' (default) runs the level-batched vectorized
+        construction; 'recursive' keeps the original per-node recursion —
+        both emit byte-identical arrays (pinned by test_startree), the
+        recursive path survives as the equality oracle."""
         cfg = self.config
-        D = len(cfg.dimensions_split_order)
         dims = np.stack([np.asarray(dim_dict_ids[d][:num_docs], dtype=np.int32)
                          for d in cfg.dimensions_split_order], axis=1)
 
@@ -139,13 +227,23 @@ class StarTreeBuilder:
             key = f"{fn}__{col}"
             if fn == "count":
                 metrics[key] = np.ones(num_docs, dtype=np.int64)
-            else:
-                metrics[key] = np.asarray(metric_values[col][:num_docs],
-                                          dtype=np.float64)
+                continue
+            if col not in metric_values:
+                expr = derived_pair_expr(col)
+                if expr is not None:
+                    metric_values[col] = eval_derived_column(
+                        expr, metric_values, num_docs)
+            metrics[key] = np.asarray(metric_values[col][:num_docs],
+                                      dtype=np.float64)
 
         # pass 1: sort by dims, aggregate duplicate dim tuples
         dims, metrics = self._sort_and_dedup(dims, metrics)
+        if engine == "recursive":
+            return self._construct_recursive(dims, metrics)
+        return self._construct_lexsort(dims, metrics)
 
+    def _construct_recursive(self, dims: np.ndarray,
+                             metrics: Dict[str, np.ndarray]) -> "StarTree":
         self._dims_rows: List[np.ndarray] = [dims]
         self._chunk_offsets: List[int] = [0]
         self._metric_rows: Dict[str, List[np.ndarray]] = {
@@ -161,7 +259,152 @@ class StarTreeBuilder:
         all_metrics = {k: np.concatenate(v, axis=0)
                        for k, v in self._metric_rows.items()}
         nodes = np.array([tuple(n) for n in self._nodes], dtype=_NODE_DTYPE)
-        return StarTree(cfg, all_dims, all_metrics, nodes)
+        return StarTree(self.config, all_dims, all_metrics, nodes)
+
+    # -- vectorized (lexsort) construction -----------------------------------
+    def _construct_lexsort(self, dims: np.ndarray,
+                           metrics: Dict[str, np.ndarray]) -> "StarTree":
+        """Level-batched construction: per depth, ONE boundary scan per
+        chunk finds every splitting node's children and ONE ``np.lexsort``
+        over all star-candidate records dedups every star child at that
+        depth (vs one sort + one ``np.unique`` PER NODE in the recursion —
+        the build hot loop at millions of rows). The final assembly replays
+        the recursion's DFS so node/record arrays come out byte-identical."""
+        cfg = self.config
+        D = len(cfg.dimensions_split_order)
+        max_leaf = cfg.max_leaf_records
+        chunks: List[Tuple[np.ndarray, Dict[str, np.ndarray]]] = [
+            (dims, metrics)]
+        root = _BuildNode(STAR, 0, 0, dims.shape[0])
+        level = [root]
+        for depth in range(D):
+            splitting = [n for n in level if n.hi - n.lo > max_leaf]
+            if not splitting:
+                break
+            dim_name = cfg.dimensions_split_order[depth]
+            make_star = dim_name not in cfg.skip_star_creation
+            # one boundary pass per chunk: every position where column
+            # ``depth`` changes (records are sorted within node ranges)
+            cuts: Dict[int, np.ndarray] = {}
+            for ci in {n.chunk for n in splitting}:
+                col = chunks[ci][0][:, depth]
+                cuts[ci] = np.flatnonzero(col[1:] != col[:-1]) + 1
+            next_level: List[_BuildNode] = []
+            star_jobs: List[_BuildNode] = []
+            for n in splitting:
+                n.dim = depth
+                b = cuts[n.chunk]
+                col = chunks[n.chunk][0][:, depth]
+                inner = b[np.searchsorted(b, n.lo, side="right"):
+                          np.searchsorted(b, n.hi, side="left")]
+                starts = [n.lo] + [int(x) for x in inner]
+                ends = starts[1:] + [n.hi]
+                n.kids = [_BuildNode(int(col[s]), n.chunk, s, e)
+                          for s, e in zip(starts, ends)]
+                next_level.extend(n.kids)
+                if make_star and len(n.kids) > 1:
+                    star_jobs.append(n)
+            if star_jobs:
+                self._batch_star_children(chunks, star_jobs, depth,
+                                          next_level)
+            level = next_level
+        return self._assemble(self.config, chunks, root)
+
+    def _batch_star_children(self, chunks, star_jobs: List[_BuildNode],
+                             depth: int,
+                             next_level: List[_BuildNode]) -> None:
+        """All star children of one level in ONE lexsort: concatenate the
+        splitting nodes' record ranges with the split dim starred, sort by
+        (node, dims), aggregate duplicate tuples segment-wise; each node's
+        star child is then a contiguous slice of the result, appended as
+        its own chunk exactly like the recursion's per-node append."""
+        D = chunks[0][0].shape[1]
+        keys = list(chunks[0][1].keys())
+        d_parts: List[np.ndarray] = []
+        id_parts: List[np.ndarray] = []
+        m_parts: Dict[str, List[np.ndarray]] = {k: [] for k in keys}
+        for j, n in enumerate(star_jobs):
+            cd, cm = chunks[n.chunk]
+            part = cd[n.lo:n.hi].copy()
+            part[:, depth] = STAR
+            d_parts.append(part)
+            id_parts.append(np.full(n.hi - n.lo, j, dtype=np.int64))
+            for k in keys:
+                m_parts[k].append(cm[k][n.lo:n.hi])
+        bd = np.concatenate(d_parts, axis=0)
+        bi = np.concatenate(id_parts)
+        bm = {k: np.concatenate(v) for k, v in m_parts.items()}
+        # node id is the PRIMARY key (np.lexsort: last key is most
+        # significant); within one node this is the recursion's exact
+        # _sort_and_dedup permutation (same stable sort, same keys — the
+        # starred/constant leading dims tie everywhere)
+        order = np.lexsort(tuple(bd[:, i] for i in range(D - 1, -1, -1))
+                           + (bi,))
+        bd, bi = bd[order], bi[order]
+        bm = {k: v[order] for k, v in bm.items()}
+        change = (bi[1:] != bi[:-1]) | np.any(bd[1:] != bd[:-1], axis=1)
+        starts = np.concatenate([[0], np.flatnonzero(change) + 1])
+        gid = np.zeros(bd.shape[0], dtype=np.int64)
+        gid[starts[1:]] = 1
+        gid = np.cumsum(gid)
+        ng = starts.shape[0]
+        dd = bd[starts]
+        di = bi[starts]
+        dm = {k: self._segmented(k, v, gid, ng) for k, v in bm.items()}
+        offs = np.searchsorted(di, np.arange(len(star_jobs) + 1))
+        for j, n in enumerate(star_jobs):
+            lo, hi = int(offs[j]), int(offs[j + 1])
+            ci = len(chunks)
+            chunks.append((dd[lo:hi],
+                           {k: v[lo:hi] for k, v in dm.items()}))
+            n.star = _BuildNode(STAR, ci, 0, hi - lo)
+            next_level.append(n.star)
+
+    @staticmethod
+    def _assemble(cfg: "StarTreeConfig", chunks, root: _BuildNode
+                  ) -> "StarTree":
+        """Replay the recursion's DFS over the built structure: node
+        indices allocate at the parent's split (value kids then star) and
+        each star chunk lands in the record stream at exactly the point
+        the recursion appended it, so offsets, node order, and child
+        ranges match the recursive builder byte for byte."""
+        chunk_off = {0: 0}
+        chunk_order = [0]
+        next_off = chunks[0][0].shape[0]
+        nodes: List[List[int]] = []
+
+        def alloc(bn: _BuildNode) -> None:
+            bn.idx = len(nodes)
+            off = chunk_off[bn.chunk]
+            nodes.append([-1, bn.value, off + bn.lo, off + bn.hi, -1, -1])
+
+        alloc(root)
+        stack = [root]
+        while stack:
+            bn = stack.pop()
+            if bn.kids is None:
+                continue
+            rec = nodes[bn.idx]
+            rec[0] = bn.dim
+            rec[4] = len(nodes)
+            for c in bn.kids:
+                alloc(c)
+            if bn.star is not None:
+                ci = bn.star.chunk
+                chunk_off[ci] = next_off
+                chunk_order.append(ci)
+                next_off += chunks[ci][0].shape[0]
+                alloc(bn.star)
+            rec[5] = len(nodes)
+            kids = bn.kids + ([bn.star] if bn.star is not None else [])
+            stack.extend(reversed(kids))
+        all_dims = np.concatenate([chunks[ci][0] for ci in chunk_order],
+                                  axis=0)
+        all_metrics = {k: np.concatenate([chunks[ci][1][k]
+                                          for ci in chunk_order])
+                       for k in chunks[0][1]}
+        nodes_arr = np.array([tuple(n) for n in nodes], dtype=_NODE_DTYPE)
+        return StarTree(cfg, all_dims, all_metrics, nodes_arr)
 
     # -- helpers -------------------------------------------------------------
     def _sort_and_dedup(self, dims, metrics):

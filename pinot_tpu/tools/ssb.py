@@ -197,28 +197,74 @@ def generate_partitioned_frame(i: int, num_segments: int, n: int,
 
 def ssb_indexing_config(star_tree: bool = True, num_partitions: int = 0,
                         partition_column: str = PARTITION_COLUMN):
-    """Default lineorder indexing: the star-tree over the Q2.x dimensions
-    (split order descending-ish cardinality under the determinism chain:
-    brand determines category) with the revenue/supplycost/count pre-aggs —
-    the index that turns the Q2.x flights from 3M-doc scans into
-    few-thousand-node slices (ref: enableDefaultStarTree on lineorder in
-    the reference's SSB configs). ``num_partitions`` > 0 adds a Modulo
+    """Default lineorder indexing: the MULTI-TREE star-tree set that puts
+    every SSB flight on a sub-scan rung (ref: StarTreeIndexConfig
+    multi-tree resolution; plan-time selection picks the cheapest fitting
+    tree per query):
+
+    - tree 0 — the PR-6 primary (Q2.x): category/brand drill-down under
+      the region filters, revenue/supplycost pre-aggs, plus the Q1.x
+      derived pair so the pair namespace is exercised on the primary too.
+    - tree 1 — Q1.x: the ``sum(lo_extendedprice * lo_discount)`` derived
+      pair (expression pre-aggregation) over the time/discount/quantity
+      filter dims the flight predicates touch.
+    - tree 2 — Q3.x: the geo drill-down (region -> nation -> city, both
+      sides) with d_yearmonthnum for the Q3.4 month filter.
+    - tree 3 — Q4.1/Q4.2: profit (``sum(lo_revenue - lo_supplycost)``
+      derived pair) by customer nation / supplier nation × category.
+    - tree 4 — Q4.3: profit by supplier city × brand under the
+      s_nation/category filters (splitting Q4 across two trees keeps
+      record counts bounded: nation×city×brand in ONE split order would
+      dedup nothing at SSB scale).
+
+    Keeping one tree per flight family bounds each tree's record count by
+    its own dim-tuple space — the cost model the cheapest-tree selection
+    scores against. ``num_partitions`` > 0 adds a Modulo
     segment-partition config on ``partition_column`` so the builder
     records per-segment partition metadata (the broker pruner's input);
-    ``star_tree=False`` drops the tree (mesh-parity tests want every query
-    on the sharded combine)."""
+    ``star_tree=False`` drops the trees (mesh-parity tests want every
+    query on the sharded combine)."""
     from pinot_tpu.spi.table import (
         IndexingConfig,
         SegmentPartitionConfig,
         StarTreeIndexConfig,
     )
 
-    trees = [StarTreeIndexConfig(
-        dimensions_split_order=["d_year", "c_region", "s_region",
-                                "p_category", "p_brand1"],
-        function_column_pairs=["SUM__lo_revenue", "SUM__lo_supplycost",
-                               "COUNT__*"],
-        max_leaf_records=10_000)] if star_tree else []
+    trees = [
+        StarTreeIndexConfig(
+            dimensions_split_order=["d_year", "c_region", "s_region",
+                                    "p_category", "p_brand1"],
+            function_column_pairs=["SUM__lo_revenue", "SUM__lo_supplycost",
+                                   "SUM__lo_extendedprice*lo_discount",
+                                   "COUNT__*"],
+            max_leaf_records=10_000),
+        StarTreeIndexConfig(
+            dimensions_split_order=["d_year", "d_yearmonthnum",
+                                    "d_weeknuminyear", "lo_discount",
+                                    "lo_quantity"],
+            function_column_pairs=["SUM__lo_extendedprice*lo_discount",
+                                   "SUM__lo_revenue", "COUNT__*"],
+            max_leaf_records=10_000),
+        StarTreeIndexConfig(
+            dimensions_split_order=["d_year", "d_yearmonthnum", "c_region",
+                                    "s_region", "c_nation", "s_nation",
+                                    "c_city", "s_city"],
+            function_column_pairs=["SUM__lo_revenue", "COUNT__*"],
+            max_leaf_records=10_000),
+        StarTreeIndexConfig(
+            dimensions_split_order=["d_year", "c_region", "s_region",
+                                    "p_mfgr", "c_nation", "s_nation",
+                                    "p_category"],
+            function_column_pairs=["SUM__lo_revenue-lo_supplycost",
+                                   "COUNT__*"],
+            max_leaf_records=10_000),
+        StarTreeIndexConfig(
+            dimensions_split_order=["d_year", "s_nation", "p_category",
+                                    "s_city", "p_brand1"],
+            function_column_pairs=["SUM__lo_revenue-lo_supplycost",
+                                   "COUNT__*"],
+            max_leaf_records=10_000),
+    ] if star_tree else []
     spc = SegmentPartitionConfig(column_partition_map={
         partition_column: {"functionName": "Modulo",
                            "numPartitions": num_partitions},

@@ -122,3 +122,38 @@ def test_generator_distributions(setup):
     np.testing.assert_array_equal(
         cols["lo_revenue"],
         cols["lo_extendedprice"] * (100 - cols["lo_discount"]) // 100)
+
+
+def test_all_13_flights_on_sub_scan_rung(setup, dev_exec):
+    """PR-13 acceptance: with the default multi-tree config every SSB
+    flight serves from the star-tree DEVICE rung — zero
+    expression-pair/group-off coverage-gap declines, docs_scanned orders
+    of magnitude under the scan, chosen tree recorded."""
+    cols, segs = setup
+    assert all(s.metadata.star_tree_count == 5 for s in segs)
+    for qid in sorted(ssb.QUERIES):
+        ctx = compile_query(ssb.QUERIES[qid] + " LIMIT 100000")
+        _, stats = dev_exec.execute(ctx, segs)
+        served = [k for k in stats.decisions
+                  if k.startswith("startree:scan->startree_device:tree")]
+        assert served, (qid, stats.decisions)
+        assert stats.startree_tree_index is not None, qid
+        if stats.group_by_rung:
+            assert stats.group_by_rung == "startree_device", \
+                (qid, stats.group_by_rung)
+        assert stats.num_docs_scanned < ROWS // 10, \
+            (qid, stats.num_docs_scanned)
+        gap = [k for k in stats.decisions
+               if "startree_expression_agg_no_pair" in k
+               or "startree_group_off_split_order" in k]
+        assert not gap, (qid, gap)
+
+
+def test_tree_build_times_recorded(setup):
+    """The creator stamps per-tree build wall time into segment metadata
+    (what the bench sums into the round JSON)."""
+    _, segs = setup
+    for s in segs:
+        bs = s.metadata.star_tree_build_s
+        assert len(bs) == s.metadata.star_tree_count
+        assert all(b >= 0 for b in bs)

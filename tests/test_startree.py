@@ -494,3 +494,327 @@ class TestShardedStarTree:
         assert stats.num_segments_processed == len(ssb_shaped)
         want, _ = host_exec.execute(compile_query(sql), ssb_shaped)
         _assert_identical("sharded", got.rows, want.rows)
+
+
+# ==========================================================================
+# PR-13: expression pre-agg pairs, multi-tree selection, lexsort build
+# ==========================================================================
+
+
+@pytest.fixture(scope="module")
+def expr_shaped(tmp_path_factory):
+    """Two segments whose tree carries DERIVED expression pairs
+    (ref: StarTreeV2 derived-column function-column pairs)."""
+    out = str(tmp_path_factory.mktemp("st_expr"))
+    cfg = IndexingConfig(star_tree_index_configs=[StarTreeIndexConfig(
+        dimensions_split_order=["d_year", "c_region", "lo_quantity"],
+        function_column_pairs=["COUNT__*", "SUM__lo_revenue",
+                               "SUM__lo_revenue*lo_quantity",
+                               "SUM__lo_revenue-lo_supplycost"],
+        max_leaf_records=64)])
+    segs = []
+    for i in range(2):
+        b = SegmentBuilder(ssb_shaped_schema(), f"loe_{i}",
+                           indexing_config=cfg)
+        b.build(ssb_shaped_frame(6000, seed=90 + i), out)
+        segs.append(load_segment(f"{out}/loe_{i}"))
+    assert all(s.metadata.star_tree_count == 1 for s in segs)
+    return segs
+
+
+class TestExpressionPairs:
+    """Tentpole (a): sum/avg over +/-/* expressions serve from derived
+    pre-agg pairs, bit-identical to both scan paths."""
+
+    EXPR_AGGS = ["sum(lo_revenue * lo_quantity)",
+                 "sum(lo_quantity * lo_revenue)",   # commutative canon
+                 "sum(lo_revenue - lo_supplycost)",
+                 "avg(lo_revenue * lo_quantity)",
+                 "count(*)"]
+
+    def test_parity_fuzz_expression_pairs(self, expr_shaped, device_exec,
+                                          host_exec):
+        rng = np.random.default_rng(23)
+        gpool = ["d_year", "c_region", "lo_quantity"]
+        ppool = ["c_region = 'ASIA'", "d_year BETWEEN 1993 AND 1996",
+                 "lo_quantity < 25", "d_year IN (1992, 1995)"]
+        for trial in range(12):
+            gdims = list(rng.choice(gpool, size=int(rng.integers(0, 3)),
+                                    replace=False))
+            aggs = list(rng.choice(self.EXPR_AGGS,
+                                   size=int(rng.integers(1, 4)),
+                                   replace=False))
+            preds = list(rng.choice(ppool, size=int(rng.integers(0, 3)),
+                                    replace=False))
+            sql = (f"SELECT {', '.join(gdims + aggs)} FROM lineorder_t "
+                   + (f"WHERE {' AND '.join(preds)} " if preds else "")
+                   + (f"GROUP BY {', '.join(gdims)} "
+                      f"ORDER BY {', '.join(gdims)} " if gdims else "")
+                   + "LIMIT 100000")
+            got, stats, scan, want = _run3(sql, expr_shaped, device_exec,
+                                           host_exec)
+            if gdims:
+                assert stats.group_by_rung == "startree_device", (trial, sql)
+            else:
+                assert stats.startree_tree_index == 0, (trial, sql)
+            _assert_identical(f"expr{trial}-scan", got.rows, scan.rows)
+            _assert_identical(f"expr{trial}-host", got.rows, want.rows)
+
+    def test_almost_eligible_expression_declines(self, expr_shaped,
+                                                 device_exec, host_exec):
+        """sum(a*b + c): a valid arithmetic shape whose derived pair is
+        NOT stored — must decline with the expression reason and still
+        answer correctly from the scan."""
+        sql = ("SELECT d_year, sum(lo_revenue * lo_quantity + lo_supplycost) "
+               "FROM lineorder_t GROUP BY d_year ORDER BY d_year")
+        got, stats = device_exec.execute(compile_query(sql), expr_shaped)
+        assert stats.group_by_rung not in ("startree_device", "startree")
+        assert any("startree_expression_agg_no_pair" in k
+                   for k in stats.decisions), stats.decisions
+        want, _ = host_exec.execute(compile_query(sql), expr_shaped)
+        _assert_identical("almost", got.rows, want.rows)
+
+    def test_division_never_pairs(self, expr_shaped, device_exec):
+        """sum(a/b) is outside the pre-aggregable subset (float division
+        breaks the exact-integer pre-agg contract) — scan serves."""
+        sql = ("SELECT sum(lo_revenue / lo_quantity) FROM lineorder_t "
+               "WHERE c_region = 'ASIA'")
+        _, stats = device_exec.execute(compile_query(sql), expr_shaped)
+        assert stats.startree_tree_index is None
+        assert any("startree_expression_agg_no_pair" in k
+                   for k in stats.decisions), stats.decisions
+
+
+class TestMultiTreeSelection:
+    """Tentpole (b): every fitting tree scored by estimated records-read;
+    cheapest wins, index breaks ties."""
+
+    def _segment(self, tmp_path, configs, name="orders_mt"):
+        df = make_df(1200, seed=21)
+        cfg = IndexingConfig(star_tree_index_configs=configs)
+        b = SegmentBuilder(make_schema(), name, indexing_config=cfg)
+        b.build({c: df[c].tolist() for c in df.columns}, str(tmp_path))
+        return load_segment(f"{tmp_path}/{name}")
+
+    def test_cheapest_tree_wins(self, tmp_path):
+        """Tree 0 skips star creation on its leading (free) dim, so a
+        category-filtered scalar query costs card(country) there; tree 1
+        answers it from one record slice — the pick must take tree 1."""
+        seg = self._segment(tmp_path, [
+            StarTreeIndexConfig(
+                dimensions_split_order=["country", "category"],
+                skip_star_node_creation_for_dimensions=["country"],
+                function_column_pairs=["COUNT__*", "SUM__revenue"],
+                max_leaf_records=4),
+            StarTreeIndexConfig(
+                dimensions_split_order=["category"],
+                function_column_pairs=["COUNT__*", "SUM__revenue"],
+                max_leaf_records=4),
+        ])
+        assert seg.metadata.star_tree_count == 2
+        ctx = compile_query(
+            "SELECT sum(revenue) FROM orders WHERE category = 'k3'")
+        aggs = [resolve_agg(f) for f in ctx.aggregations]
+        pick = pick_star_tree(ctx, aggs, seg)
+        assert pick is not None and pick.index == 1
+
+    def test_tie_breaks_on_lower_index(self, tmp_path):
+        """Two trees scoring identically: the configured order pins the
+        winner (index 0) — deterministic plans across restarts."""
+        twice = [StarTreeIndexConfig(
+            dimensions_split_order=["country", "category"],
+            function_column_pairs=["COUNT__*", "SUM__revenue"],
+            max_leaf_records=4)] * 2
+        seg = self._segment(tmp_path, twice, name="orders_tie")
+        assert seg.metadata.star_tree_count == 2
+        ctx = compile_query(
+            "SELECT sum(revenue) FROM orders WHERE country = 'c1'")
+        aggs = [resolve_agg(f) for f in ctx.aggregations]
+        pick = pick_star_tree(ctx, aggs, seg)
+        assert pick is not None and pick.index == 0
+
+    def test_selection_rides_ledger_and_stats(self, tmp_path):
+        seg = self._segment(tmp_path, [
+            StarTreeIndexConfig(
+                dimensions_split_order=["country"],
+                function_column_pairs=["COUNT__*"],
+                max_leaf_records=4),
+            StarTreeIndexConfig(
+                dimensions_split_order=["category", "channel"],
+                function_column_pairs=["COUNT__*", "SUM__revenue"],
+                max_leaf_records=4),
+        ], name="orders_led")
+        ex = ServerQueryExecutor(use_device=False)
+        _, stats = ex.execute(compile_query(
+            "SELECT channel, sum(revenue) FROM orders "
+            "GROUP BY channel ORDER BY channel"), [seg])
+        assert stats.startree_tree_index == 1
+        assert stats.decisions.get("startree:scan->startree:tree1") == 1
+
+    def test_most_specific_decline_reason_across_trees(self, tmp_path):
+        """Satellite: a tree failing on missing_function_pair (one config
+        line from serving) must out-report a sibling failing on
+        group_off_split_order — in EITHER tree order."""
+        a = StarTreeIndexConfig(
+            dimensions_split_order=["country"],
+            function_column_pairs=["COUNT__*"], max_leaf_records=4)
+        b = StarTreeIndexConfig(
+            dimensions_split_order=["country", "category"],
+            function_column_pairs=["COUNT__*"], max_leaf_records=4)
+        for name, configs in (("mt_ab", [a, b]), ("mt_ba", [b, a])):
+            seg = self._segment(tmp_path, configs, name=name)
+            ctx = compile_query(
+                "SELECT category, sum(revenue) FROM orders "
+                "GROUP BY category ORDER BY category")
+            aggs = [resolve_agg(f) for f in ctx.aggregations]
+            reasons = []
+            assert pick_star_tree(ctx, aggs, seg,
+                                  on_decline=reasons.append) is None
+            # tree [country] fails the group check; tree [country,
+            # category] fits the shape but lacks SUM__revenue — the
+            # more-specific reason wins regardless of order
+            assert reasons == ["startree_missing_function_pair"], (name,
+                                                                   reasons)
+
+
+class TestLexsortBuildEquality:
+    """Tentpole (c): the vectorized builder must emit byte-identical
+    arrays to the recursive oracle on the existing fixtures."""
+
+    @pytest.mark.parametrize("max_leaf,skip", [
+        (10_000, []), (16, []), (1, []), (64, ["country"]),
+        (8, ["category", "channel"]),
+    ])
+    def test_node_arrays_identical(self, max_leaf, skip):
+        df = make_df(N, seed=3)
+        cfg = StarTreeConfig(
+            ["country", "category", "channel"],
+            [("count", "*"), ("sum", "revenue"), ("min", "revenue"),
+             ("max", "revenue"), ("sum", "units")],
+            max_leaf_records=max_leaf, skip_star_creation=skip)
+        dims = {
+            "country": pd.Categorical(df.country).codes.astype(np.int32),
+            "category": pd.Categorical(df.category).codes.astype(np.int32),
+            "channel": pd.Categorical(df.channel).codes.astype(np.int32),
+        }
+        mets = {"revenue": df.revenue.to_numpy(),
+                "units": df.units.to_numpy()}
+        rec = StarTreeBuilder(cfg).build(dict(dims), dict(mets), len(df),
+                                         engine="recursive")
+        vec = StarTreeBuilder(cfg).build(dict(dims), dict(mets), len(df))
+        np.testing.assert_array_equal(rec.dims, vec.dims)
+        np.testing.assert_array_equal(rec.nodes, vec.nodes)
+        assert set(rec.metrics) == set(vec.metrics)
+        for k in rec.metrics:
+            np.testing.assert_array_equal(rec.metrics[k], vec.metrics[k],
+                                          err_msg=k)
+
+    def test_derived_pair_equality_and_values(self):
+        df = make_df(800, seed=31)
+        cfg = StarTreeConfig(
+            ["country"], [("count", "*"), ("sum", "(revenue*units)")],
+            max_leaf_records=8)
+        dims = {"country": pd.Categorical(df.country).codes.astype(np.int32)}
+        mets = {"revenue": df.revenue.to_numpy(),
+                "units": df.units.to_numpy()}
+        rec = StarTreeBuilder(cfg).build(dict(dims), dict(mets), len(df),
+                                         engine="recursive")
+        vec = StarTreeBuilder(cfg).build(dict(dims), dict(mets), len(df))
+        np.testing.assert_array_equal(rec.dims, vec.dims)
+        np.testing.assert_array_equal(rec.metrics["sum__(revenue*units)"],
+                                      vec.metrics["sum__(revenue*units)"])
+        idx = vec.select_records({}, [])
+        got = float(np.asarray(vec.metrics["sum__(revenue*units)"])[idx].sum())
+        assert got == pytest.approx(float((df.revenue * df.units).sum()))
+
+
+class TestPerTreeResidency:
+    def test_release_one_tree_keeps_sibling(self, tmp_path):
+        """Satellite: per-tree residency — evicting one tree must not drop
+        its sibling, and the accounting must move by exactly the released
+        tree's bytes."""
+        df = make_df(1500, seed=41)
+        cfg = IndexingConfig(star_tree_index_configs=[
+            StarTreeIndexConfig(
+                dimensions_split_order=["country", "category"],
+                function_column_pairs=["COUNT__*", "SUM__revenue"],
+                max_leaf_records=16),
+            StarTreeIndexConfig(
+                dimensions_split_order=["channel"],
+                function_column_pairs=["COUNT__*", "SUM__units"],
+                max_leaf_records=16),
+        ])
+        b = SegmentBuilder(make_schema(), "orders_rt", indexing_config=cfg)
+        b.build({c: df[c].tolist() for c in df.columns}, str(tmp_path))
+        seg = load_segment(f"{tmp_path}/orders_rt")
+        ex = ServerQueryExecutor()
+        # stage both trees through real queries
+        _, s1 = ex.execute(compile_query(
+            "SELECT country, sum(revenue) FROM orders "
+            "GROUP BY country ORDER BY country"), [seg])
+        _, s2 = ex.execute(compile_query(
+            "SELECT channel, sum(units) FROM orders "
+            "GROUP BY channel ORDER BY channel"), [seg])
+        assert s1.startree_tree_index == 0
+        assert s2.startree_tree_index == 1
+        name = seg.segment_name
+        resident = ex.residency._entries[name].resident
+        per_tree = resident.startree_nbytes()
+        assert set(per_tree) == {0, 1} and all(v > 0
+                                               for v in per_tree.values())
+        before = resident.nbytes()
+        snap = ex.residency.snapshot()["stagedSegments"][name]
+        assert snap["startrees"] == 2
+        assert set(snap["startreeBytes"]) == {"0", "1"}
+
+        assert ex.residency.release_startree(name, 0)
+        assert set(resident.startree_nbytes()) == {1}  # sibling intact
+        assert resident.nbytes() == before - per_tree[0]
+        snap = ex.residency.snapshot()["stagedSegments"][name]
+        assert snap["startrees"] == 1
+        assert snap["startreeBytes"] == {"1": per_tree[1]}
+        # double release is a no-op; unknown resident refuses
+        assert not ex.residency.release_startree(name, 0)
+        assert not ex.residency.release_startree("nope", 0)
+        # the evicted tree restages on demand, same answers
+        got, s3 = ex.execute(compile_query(
+            "SELECT country, sum(revenue) FROM orders "
+            "GROUP BY country ORDER BY country"), [seg])
+        assert s3.startree_tree_index == 0
+        assert set(resident.startree_nbytes()) == {0, 1}
+
+
+class TestStarTreeReasonRegistry:
+    def test_reason_literals_are_registered(self):
+        """Satellite: every reason literal startree_exec.py can hand the
+        ledger — note(...), decline(...), and _matching_ids' reason
+        strings — must be in tracing.STARTREE_DECISION_REASONS (the PR-12
+        ROUTING_DECISION_REASONS pattern); the executor's chosen-tree
+        record must match the registered tree<i> shape."""
+        import re
+
+        import pinot_tpu.engine.executor as executor_mod
+        import pinot_tpu.engine.startree_exec as exec_mod
+        from pinot_tpu.common.tracing import (
+            STARTREE_DECISION_REASONS,
+            STARTREE_TREE_REASON,
+        )
+
+        src = open(exec_mod.__file__.rstrip("c")).read()
+        # EVERY quoted startree_* literal in the module is a reason code
+        # (decline sites, note sites, _matching_ids reason returns, and
+        # the _REASON_RANK keys) — scan them all so a new site cannot
+        # slip an unregistered code past the call-shape regexes
+        literals = set(re.findall(r'"(startree_[a-z_]+)"', src))
+        assert len(literals) >= 10, "conformance scan found no decline sites"
+        unregistered = literals - STARTREE_DECISION_REASONS
+        assert not unregistered, unregistered
+        # ranked reasons are a subset of the registry too
+        assert set(exec_mod._REASON_RANK) <= STARTREE_DECISION_REASONS
+        # the success record in the executor rides the tree<i> pattern
+        esrc = open(executor_mod.__file__.rstrip("c")).read()
+        assert 'f"tree{tree_index}"' in esrc
+        assert STARTREE_TREE_REASON.match("tree0")
+        assert STARTREE_TREE_REASON.match("tree12")
+        assert not STARTREE_TREE_REASON.match("tree")
+        assert not STARTREE_TREE_REASON.match("tree0x")

@@ -75,7 +75,7 @@ WARMUP = 1
 ITERS = 5
 
 SUITES = ("ssb", "qps", "micro", "startree", "sketches", "residency",
-          "cluster")
+          "cluster", "reduce")
 
 
 def _log(msg: str) -> None:
@@ -308,6 +308,9 @@ _TRAJECTORY_KEYS = {
     "sketches": ("p50_ms_per_query", False),
     "residency": ("sliced_p50_ms_per_query", False),
     "cluster": ("p50_ms_per_query", False),
+    # headline = vectorized group-by reduce wall time on the 180k-group
+    # merge (the suite's own parity/speedup gates run inside bench_reduce)
+    "reduce": ("p50_ms", False),
 }
 REGRESSION_X = 1.3
 
@@ -566,7 +569,8 @@ class _Worker:
                           ("startree", self.bench_startree),
                           ("sketches", self.bench_sketches),
                           ("residency", self.bench_residency),
-                          ("cluster", self.bench_cluster)):
+                          ("cluster", self.bench_cluster),
+                          ("reduce", self.bench_reduce)):
             if suite in self.skip:
                 _log(f"{suite}: already chip-served, skipping")
                 continue
@@ -1222,10 +1226,12 @@ class _Worker:
                     "external view did not converge: refusing a partial bench"
                 hosting = cluster.hosting_servers("ssb_lineorder_OFFLINE")
                 fanout, prune_ratio, p50 = {}, {}, {}
+                reduce_p50 = {}
                 for qid in qids:
                     sql = ssb.QUERIES[qid]
                     cluster.query(sql)  # warm: staging + kernel compile
                     samples = []
+                    reduce_samples = []
                     queried = 0
                     for _ in range(iters):
                         t0 = time.perf_counter()
@@ -1236,16 +1242,25 @@ class _Worker:
                                 == resp.num_servers_queried), \
                             f"{qid}: partial gather in a healthy cluster"
                         queried = resp.num_servers_queried
+                        # broker reduce phase (the PR-9 Reduce span's
+                        # timer) — the array-native reduce's own cost,
+                        # recorded per query so reduce-tier regressions
+                        # show up independent of scatter/server time
+                        reduce_samples.append(
+                            resp.phase_times_ms.get("REDUCE", 0.0))
                     fanout[qid] = queried
                     prune_ratio[qid] = round(
                         1.0 - queried / max(len(hosting), 1), 3)
                     p50[qid] = round(
                         float(np.percentile(samples, 50)) * 1e3, 3)
+                    reduce_p50[qid] = round(
+                        float(np.percentile(reduce_samples, 50)), 3)
                 per_servers[str(n_servers)] = {
                     "servers_hosting": len(hosting),
                     "scatter_fanout": fanout,
                     "prune_ratio": prune_ratio,
                     "p50_ms": p50,
+                    "reduce_p50_ms": reduce_p50,
                 }
             finally:
                 cluster.shutdown()
@@ -1263,6 +1278,111 @@ class _Worker:
                     sum(top["p50_ms"].values()) / len(qids), 3),
                 "partition_filtered": list(partition_filtered),
                 "per_servers": per_servers}
+
+    def bench_reduce(self) -> dict:
+        """Broker reduce micro-suite: 8 synthesized servers' DataTables
+        through the REAL binary wire into BrokerReduceService, vectorized
+        vs the row-path oracle. Two shapes: a high-cardinality group-by
+        merge (>=100k distinct groups after the merge) and a 100k-row
+        ORDER BY LIMIT selection of pre-trimmed, pre-sorted server
+        blocks. LOUD-FAIL: vectorized group-by < 5x the oracle, selection
+        < 3x, or ANY row diverging bit-wise from the oracle
+        (BENCH_ALLOW_SLOW_REDUCE records the numbers anyway; parity has
+        no escape hatch)."""
+        import random
+
+        from pinot_tpu.broker.reduce import BrokerReduceService
+        from pinot_tpu.common.datatable import DataTable
+        from pinot_tpu.engine.results import DataSchema, QueryStats
+        from pinot_tpu.query import compile_query
+
+        rng = random.Random(20240814)
+        n_servers = 8
+        iters = 5
+        vec = BrokerReduceService(vectorized=True)
+        ora = BrokerReduceService(vectorized=False)
+
+        def timed(svc, ctx, raws):
+            best = None
+            rows = None
+            for _ in range(iters):
+                tables = [DataTable.from_bytes(r) for r in raws]
+                t0 = time.perf_counter()
+                table, _, _ = svc.reduce(ctx, tables)
+                dt = time.perf_counter() - t0
+                best = dt if best is None else min(best, dt)
+                rows = table.rows
+            return best * 1e3, rows
+
+        # -- group-by: 8 servers x 40k groups -> ~150k-group merge ------
+        gb_ctx = compile_query(
+            "SELECT k1, k2, sum(v), count(*) FROM t GROUP BY k1, k2 "
+            "ORDER BY sum(v) DESC LIMIT 1000")
+        gb_raws = []
+        for s in range(n_servers):
+            groups = {}
+            for _ in range(40_000):
+                k = ("brand%04d" % rng.randint(0, 499),
+                     rng.randint(0, 499))
+                groups[k] = [float(rng.randint(0, 10**6)),
+                             rng.randint(1, 100)]
+            gb_raws.append(DataTable.for_group_by(
+                groups, {"k1": "STRING", "k2": "INT"},
+                QueryStats()).to_bytes())
+        merged_groups = len({k for r in gb_raws
+                             for k in DataTable.from_bytes(r)
+                             .group_by_groups()})
+        vec_gb_ms, vec_gb_rows = timed(vec, gb_ctx, gb_raws)
+        ora_gb_ms, ora_gb_rows = timed(ora, gb_ctx, gb_raws)
+        assert vec_gb_rows == ora_gb_rows, \
+            "reduce: vectorized group-by diverged from the row-path oracle"
+
+        # -- selection: 100k rows total, ORDER BY LIMIT, pre-sorted -----
+        per_server = 100_000 // n_servers
+        sel_ctx = compile_query(
+            "SELECT a, b FROM t ORDER BY b, a LIMIT %d" % per_server)
+        schema = DataSchema(["a", "b"], ["STRING", "LONG"])
+        sel_raws = []
+        for s in range(n_servers):
+            rows = sorted(
+                [["city%03d" % rng.randint(0, 299),
+                  rng.randint(0, 10**6)] for _ in range(per_server)],
+                key=lambda r: (r[1], r[0]))
+            sel_raws.append(DataTable.for_selection(
+                schema, rows, QueryStats(),
+                sorted_rows=True).to_bytes())
+        vec_sel_ms, vec_sel_rows = timed(vec, sel_ctx, sel_raws)
+        ora_sel_ms, ora_sel_rows = timed(ora, sel_ctx, sel_raws)
+        assert vec_sel_rows == ora_sel_rows, \
+            "reduce: vectorized selection diverged from the row-path oracle"
+
+        gb_speedup = ora_gb_ms / max(vec_gb_ms, 1e-9)
+        sel_speedup = ora_sel_ms / max(vec_sel_ms, 1e-9)
+        rec = {
+            "servers": n_servers,
+            "groupby": {"merged_groups": merged_groups,
+                        "vectorized_ms": round(vec_gb_ms, 3),
+                        "oracle_ms": round(ora_gb_ms, 3),
+                        "speedup": round(gb_speedup, 2)},
+            "selection": {"rows": per_server * n_servers,
+                          "vectorized_ms": round(vec_sel_ms, 3),
+                          "oracle_ms": round(ora_sel_ms, 3),
+                          "speedup": round(sel_speedup, 2)},
+            "p50_ms": round(vec_gb_ms, 3),
+        }
+        if not os.environ.get("BENCH_ALLOW_SLOW_REDUCE"):
+            assert merged_groups >= 100_000, \
+                f"reduce: merge shape shrank to {merged_groups} groups"
+            assert gb_speedup >= 5.0, (
+                f"reduce: vectorized group-by only {gb_speedup:.1f}x over "
+                f"the row-path oracle (want >=5x) — the array-native "
+                f"merge regressed; set BENCH_ALLOW_SLOW_REDUCE=1 to "
+                f"record anyway")
+            assert sel_speedup >= 3.0, (
+                f"reduce: vectorized selection only {sel_speedup:.1f}x "
+                f"over the row-path oracle (want >=3x); set "
+                f"BENCH_ALLOW_SLOW_REDUCE=1 to record anyway")
+        return rec
 
 
 # ==========================================================================

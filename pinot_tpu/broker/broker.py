@@ -339,6 +339,11 @@ class BrokerRequestHandler:
         # here merge into the reduced stats so the response's decision
         # ledger explains why each server was or wasn't scattered to
         broker_stats = QueryStats()
+        # reduce-as-arrivals: every gathered DataTable folds into the
+        # merge state the moment it lands, so the reduce work overlaps
+        # the stragglers' network wait; finish() below runs only the
+        # final trim/HAVING/post-agg pass
+        acc = self.reduce_service.accumulator(ctx)
         for table, sub_ctx in self._split_hybrid(ctx, physical):
             t = time.perf_counter()
             route = self.routing.route(table, sub_ctx, stats=broker_stats)
@@ -356,10 +361,10 @@ class BrokerRequestHandler:
             if self._use_streaming(sub_ctx, routing):
                 gathered, queried, responded = \
                     self._scatter_gather_streaming(table, sub_ctx, routing,
-                                                   broker_stats)
+                                                   broker_stats, acc)
             else:
                 gathered, queried, responded = self._scatter_gather(
-                    table, sub_ctx, routing, broker_stats)
+                    table, sub_ctx, routing, broker_stats, acc)
             phase(BrokerQueryPhase.SCATTER_GATHER, t)
             tables.extend(gathered)
             servers_queried |= queried
@@ -377,8 +382,7 @@ class BrokerRequestHandler:
 
         t = time.perf_counter()
         try:
-            table, stats, server_errors = self.reduce_service.reduce(
-                ctx, tables)
+            table, stats, server_errors = acc.finish()
             if gapfill_spec is not None:
                 from pinot_tpu.broker.gapfill import apply_gapfill
 
@@ -414,7 +418,8 @@ class BrokerRequestHandler:
 
             root = build_broker_root(
                 response.phase_times_ms, traced_stats.spans,
-                response.time_used_ms, admission_wait_ms=admit_wait_ms)
+                response.time_used_ms, admission_wait_ms=admit_wait_ms,
+                reduce_folds=acc.fold_spans)
             response.trace_info = {"entries": traced_stats.trace,
                                    "spans": [root]}
         return finish(response)
@@ -547,7 +552,8 @@ class BrokerRequestHandler:
     # SelectionOnlyCombineOperator's early exit.
     def _scatter_gather_streaming(self, table: str, ctx: QueryContext,
                                   routing: Dict[str, List[str]],
-                                  broker_stats: Optional[QueryStats] = None):
+                                  broker_stats: Optional[QueryStats] = None,
+                                  acc=None):
         import threading
 
         from pinot_tpu.common.tracing import record_decision
@@ -565,7 +571,7 @@ class BrokerRequestHandler:
                 out.append(block)
                 if not block.exceptions:
                     with lock:
-                        have[0] += len(block.payload.get("rows", []))
+                        have[0] += block.num_rows()
                         if have[0] >= need:
                             enough.set()
                 if enough.is_set():
@@ -583,20 +589,27 @@ class BrokerRequestHandler:
                 lambda srv=server, segs=segments: pull(srv, segs))
 
         gathered: List[DataTable] = []
+
+        def took(dt: DataTable, instance_id: str) -> None:
+            gathered.append(dt)
+            if acc is not None:
+                acc.add(dt, instance=instance_id)
+
         deadline = time.monotonic() + self.query_timeout_s
-        for instance_id, fut in futures.items():
+        for instance_id, fut in self._as_arrivals(futures, deadline):
             if fut is None:
-                gathered.append(DataTable.for_exception(
-                    f"server {instance_id} is not connected"))
+                took(DataTable.for_exception(
+                    f"server {instance_id} is not connected"), instance_id)
                 record_decision(broker_stats, "gather", "partial_result",
                                 "full_result", "server_not_connected")
                 continue
             try:
-                remaining = max(deadline - time.monotonic(), 0.001)
+                if isinstance(fut, FutureTimeout):
+                    raise fut
                 ok = False
-                for dt in fut.result(timeout=remaining):
+                for dt in fut.result(timeout=0.001):
                     _tag_trace(dt, instance_id)
-                    gathered.append(dt)
+                    took(dt, instance_id)
                     ok = ok or not dt.exceptions
                 # responded = returned at least one USABLE block; a server
                 # that only errored is down for accounting purposes
@@ -607,17 +620,43 @@ class BrokerRequestHandler:
                                     "full_result", "server_error")
             except FutureTimeout:
                 enough.set()  # stop the straggler's pull loop
-                gathered.append(DataTable.for_exception(
+                took(DataTable.for_exception(
                     f"server {instance_id} timed out after "
-                    f"{self.query_timeout_s}s"))
+                    f"{self.query_timeout_s}s"), instance_id)
                 record_decision(broker_stats, "gather", "partial_result",
                                 "full_result", "server_timeout")
             except Exception as e:  # noqa: BLE001
-                gathered.append(DataTable.for_exception(
-                    f"server {instance_id} failed: {e!r}"))
+                took(DataTable.for_exception(
+                    f"server {instance_id} failed: {e!r}"), instance_id)
                 record_decision(broker_stats, "gather", "partial_result",
                                 "full_result", "server_error")
         return gathered, queried, responded
+
+    @staticmethod
+    def _as_arrivals(futures: Dict[str, object], deadline: float):
+        """Yield ``(instance_id, future)`` in COMPLETION order (the
+        reduce-as-arrivals contract: a fast server's table folds while
+        the stragglers are still on the wire). Not-connected entries
+        (None) yield first; a future still pending at the deadline
+        yields a ``FutureTimeout`` instance in its place."""
+        from concurrent.futures import as_completed
+
+        pending = {}
+        for instance_id, fut in futures.items():
+            if fut is None:
+                yield instance_id, None
+            else:
+                pending[fut] = instance_id
+        if not pending:
+            return
+        try:
+            for fut in as_completed(
+                    pending, timeout=max(deadline - time.monotonic(),
+                                         0.001)):
+                yield pending.pop(fut), fut
+        except FutureTimeout as e:
+            for fut, instance_id in pending.items():
+                yield instance_id, (fut if fut.done() else e)
 
     def _use_streaming(self, ctx: QueryContext,
                        routing: Dict[str, List[str]]) -> bool:
@@ -629,11 +668,16 @@ class BrokerRequestHandler:
     # -- scatter/gather (ref: QueryRouter.submitQuery:85) --------------------
     def _scatter_gather(self, table: str, ctx: QueryContext,
                         routing: Dict[str, List[str]],
-                        broker_stats: Optional[QueryStats] = None):
+                        broker_stats: Optional[QueryStats] = None,
+                        acc=None):
         """Per-server failure handling: a down / not-connected / timed-out
         server yields a partial result — its error travels as an exception
         DataTable, it is NOT counted as responded, and the reason lands on
-        the decision ledger — never a hung or silently-wrong answer."""
+        the decision ledger — never a hung or silently-wrong answer.
+
+        Tables are processed in COMPLETION order and folded into ``acc``
+        (the reduce accumulator) as they land — the broker reduces the
+        fast servers' answers while the stragglers are still running."""
         from pinot_tpu.common.tracing import record_decision
 
         queried, responded = set(), set()
@@ -648,19 +692,26 @@ class BrokerRequestHandler:
                 lambda srv=server, segs=segments:
                 srv.execute_query(ctx, table, segs))
         gathered: List[DataTable] = []
+
+        def took(dt: DataTable, instance_id: str) -> None:
+            gathered.append(dt)
+            if acc is not None:
+                acc.add(dt, instance=instance_id)
+
         deadline = time.monotonic() + self.query_timeout_s
-        for instance_id, fut in futures.items():
+        for instance_id, fut in self._as_arrivals(futures, deadline):
             if fut is None:
-                gathered.append(DataTable.for_exception(
-                    f"server {instance_id} is not connected"))
+                took(DataTable.for_exception(
+                    f"server {instance_id} is not connected"), instance_id)
                 record_decision(broker_stats, "gather", "partial_result",
                                 "full_result", "server_not_connected")
                 continue
             try:
-                remaining = max(deadline - time.monotonic(), 0.001)
-                dt = fut.result(timeout=remaining)
+                if isinstance(fut, FutureTimeout):
+                    raise fut
+                dt = fut.result(timeout=0.001)
                 _tag_trace(dt, instance_id)
-                gathered.append(dt)
+                took(dt, instance_id)
                 # responded = came back with a USABLE DataTable; a server
                 # that answered with only an error (shut down mid-scatter,
                 # table not hosted) is accounted as a gather failure
@@ -670,14 +721,14 @@ class BrokerRequestHandler:
                 else:
                     responded.add(instance_id)
             except FutureTimeout:
-                gathered.append(DataTable.for_exception(
+                took(DataTable.for_exception(
                     f"server {instance_id} timed out after "
-                    f"{self.query_timeout_s}s"))
+                    f"{self.query_timeout_s}s"), instance_id)
                 record_decision(broker_stats, "gather", "partial_result",
                                 "full_result", "server_timeout")
             except Exception as e:
-                gathered.append(DataTable.for_exception(
-                    f"server {instance_id} failed: {e!r}"))
+                took(DataTable.for_exception(
+                    f"server {instance_id} failed: {e!r}"), instance_id)
                 record_decision(broker_stats, "gather", "partial_result",
                                 "full_result", "server_error")
         return gathered, queried, responded

@@ -263,11 +263,18 @@ def flatten_spans(span_dicts: List[Dict[str, Any]]
 def build_broker_root(phase_ms: Dict[str, float],
                       server_spans: List[Dict[str, Any]],
                       total_ms: float,
-                      admission_wait_ms: float = 0.0) -> Dict[str, Any]:
+                      admission_wait_ms: float = 0.0,
+                      reduce_folds: Optional[List[Dict[str, Any]]] = None
+                      ) -> Dict[str, Any]:
     """Assemble the broker root span from the measured broker phases
     (COMPILATION/ROUTING/SCATTER_GATHER/REDUCE), re-parenting the
     per-server trees under the ScatterGather child — the reduce-side half
-    of the reference's per-server ``traceInfo`` keying."""
+    of the reference's per-server ``traceInfo`` keying.
+
+    ``reduce_folds`` is the reduce-as-arrivals split: one Fold child per
+    folded DataTable (its work overlapped the gather wait, so the folds'
+    wall time lives INSIDE ScatterGather; the Reduce child keeps the
+    final merge/trim/HAVING pass and carries a foldMs rollup)."""
     children: List[Dict[str, Any]] = []
     if admission_wait_ms > 0:
         children.append({"name": "Admission",
@@ -285,8 +292,13 @@ def build_broker_root(phase_ms: Dict[str, float],
         sg["children"] = list(server_spans)
     children.append(sg)
     if "REDUCE" in phase_ms:
-        children.append({"name": "Reduce",
-                         "ms": round(phase_ms["REDUCE"], 3)})
+        reduce_span: Dict[str, Any] = {"name": "Reduce",
+                                       "ms": round(phase_ms["REDUCE"], 3)}
+        if reduce_folds:
+            reduce_span["foldMs"] = round(
+                sum(f.get("ms", 0.0) for f in reduce_folds), 3)
+            reduce_span["children"] = list(reduce_folds)
+        children.append(reduce_span)
     return {"name": "BrokerQuery", "ms": round(total_ms, 3),
             "children": children}
 
@@ -417,6 +429,21 @@ GATHER_DECISION_REASONS = frozenset({
     "server_not_connected",
     "server_timeout",
     "server_error",
+})
+
+# Reason codes the broker REDUCE point records (broker/reduce.py) when
+# the vectorized (array-native) merge cannot prove bit-exactness against
+# the row-path oracle and falls back to it. Same contract as
+# ROUTING_DECISION_REASONS: every reason literal at a reduce.py record
+# site must be registered here — test_reduce_vectorized scans the source.
+REDUCE_DECISION_REASONS = frozenset({
+    "reduce_group_key_not_sortable",
+    "reduce_distinct_key_not_sortable",
+    "reduce_order_key_not_sortable",
+    "reduce_column_kind_mismatch",
+    "reduce_nan_numeric_state",
+    "reduce_nan_order_key",
+    "reduce_i64_sum_bound",
 })
 
 _SANITIZE = re.compile(r"[^a-z0-9]+")

@@ -364,8 +364,13 @@ class ServerQueryExecutor:
                     aliases=list(ctx.aliases) + [None] * len(hidden),
                     limit=ctx.offset + ctx.limit, offset=0)
                 table = self._selection(sub, segments, stats)
+                # server-side ORDER-BY trim: the block ships at most
+                # offset+limit rows ALREADY in query order — flagged so
+                # the broker merge treats it as a pre-sorted block
+                # (ref: SelectionOperatorUtils sorted-block contract)
                 return DataTable.for_selection(table.schema, table.rows,
-                                               stats, num_hidden=len(hidden))
+                                               stats, num_hidden=len(hidden),
+                                               sorted_rows=True)
 
             aggs = [resolve_agg(f) for f in ctx.aggregations]
             if ctx.is_group_by:

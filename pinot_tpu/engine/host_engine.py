@@ -143,10 +143,11 @@ def _order_key_array(segment: ImmutableSegment, e: Expr,
 
 
 def _lexsort(key_cols: List[np.ndarray], ascending: List[bool]) -> np.ndarray:
-    """Stable multi-key sort with per-key direction (strings included)."""
+    """Stable multi-key sort with per-key direction (strings included —
+    object AND unicode dtypes rank-encode so DESC can negate)."""
     processed = []
     for arr, asc in zip(key_cols, ascending):
-        if arr.dtype == object:
+        if arr.dtype == object or arr.dtype.kind in ("U", "S"):
             _, codes = np.unique(arr, return_inverse=True)
             arr = codes
         processed.append(arr if asc else _negate(arr))

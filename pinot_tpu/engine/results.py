@@ -244,6 +244,74 @@ class SelectionResult:
 
 
 # --------------------------------------------------------------------------
+# vectorized IndexedTable merge (the array-native half of the broker reduce)
+# --------------------------------------------------------------------------
+
+# numeric aggregation states whose cross-server merge is an elementwise
+# ufunc fold — everything else (tuples, sketches, decimal strings) merges
+# through AggDef.merge per key
+_VEC_STATE_FOLDS: Dict[str, Any] = {
+    "count": np.add,
+    "sum": np.add,
+    "min": np.minimum,
+    "max": np.maximum,
+}
+
+
+def lexsort_runs(sort_keys: List[np.ndarray]
+                 ) -> Tuple[np.ndarray, np.ndarray]:
+    """ONE stable ``np.lexsort`` over the concatenated key columns ->
+    ``(order, starts)``: ``order`` permutes rows so equal keys are
+    adjacent (ties keep input order — the dict-insertion semantics of the
+    row-path oracle), ``starts`` marks each run's first sorted position.
+    NaN keys never equal anything (ElementWise ``!=``), so every NaN row
+    is its own run — exactly the oracle's dict behavior."""
+    n = int(len(sort_keys[0])) if sort_keys else 0
+    if n == 0:
+        return np.empty(0, np.int64), np.empty(0, np.int64)
+    order = np.lexsort(tuple(reversed(sort_keys)))
+    if n == 1:
+        return order, np.zeros(1, np.int64)
+    diff = np.zeros(n - 1, dtype=bool)
+    for k in sort_keys:
+        ks = k[order]
+        diff |= ks[1:] != ks[:-1]
+    starts = np.concatenate(
+        (np.zeros(1, np.int64), np.flatnonzero(diff) + 1))
+    return order, starts
+
+
+def fold_grouped_runs(order: np.ndarray, starts: np.ndarray, n: int,
+                      agg_entries: List[Tuple[str, Any]],
+                      aggs: List[AggDef]) -> List[Any]:
+    """Fold per-run aggregation states: -> one folded-state sequence per
+    aggregation, in RUN (sorted) order.
+
+    ``agg_entries[i]`` is ``("vec", concat_array)`` for numeric array
+    states (``aggs[i].base`` must be in ``_VEC_STATE_FOLDS`` — one
+    boundary ``reduceat`` folds every group at once) or ``("obj",
+    boxed_list)`` for object states, merged per run through the existing
+    per-key ``AggDef.merge`` in ascending input order (the oracle's
+    arrival order — merge-order-sensitive sketches stay bit-identical)."""
+    out: List[Any] = []
+    ends = np.concatenate((starts[1:], np.asarray([n], dtype=np.int64)))
+    for (tag, data), agg in zip(agg_entries, aggs):
+        if tag == "vec":
+            out.append(_VEC_STATE_FOLDS[agg.base].reduceat(data[order],
+                                                           starts))
+        else:
+            states = []
+            for s, e in zip(starts, ends):
+                run = order[s:e]
+                st = data[int(run[0])]
+                for i in run[1:]:
+                    st = agg.merge(st, data[int(i)])
+                states.append(st)
+            out.append(states)
+    return out
+
+
+# --------------------------------------------------------------------------
 # reduce: merged results -> final ResultTable
 # --------------------------------------------------------------------------
 

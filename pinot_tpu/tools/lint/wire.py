@@ -18,8 +18,22 @@ anywhere in the scanned tree must be a declared constant value in
 ``CommonConstants`` (spi/config.py) — undeclared keys are typo'd or
 undocumented knobs.
 
-Both passes no-op when the anchor class isn't in the scanned file set
-(fixture runs), so they stay usable on arbitrary paths.
+``wire`` also carries the COLUMN-KIND dispatch obligation: the DataTable
+wire (common/datatable.py) assigns one ``_COL_<KIND> = <int>`` ordinal
+per column kind, and
+
+- ``_encode_column`` and ``_decode_column`` must each reference EVERY
+  kind (a new kind must update both sides of the wire),
+- any function/method anywhere in the scanned tree that dispatches on
+  kinds (references two or more ``_COL_*`` int constants — the
+  ``columns()`` consumers' dispatch shape) must reference ALL of them,
+  so a new kind cannot silently fall through a partial dispatch.
+
+Non-int ``_COL_*`` assignments (tuples like ``_COL_NUMERIC``) are kind
+GROUPS, not kinds — helpers built on them don't count as dispatchers.
+
+All passes no-op when the anchor class/function isn't in the scanned
+file set (fixture runs), so they stay usable on arbitrary paths.
 """
 
 from __future__ import annotations
@@ -78,7 +92,7 @@ def _dataclass_fields(cls: ast.ClassDef) -> List[Tuple[str, int]]:
 
 @register("wire")
 def check_wire(ctx: LintContext) -> List[Finding]:
-    findings: List[Finding] = []
+    findings: List[Finding] = list(_check_column_kinds(ctx))
     hit = _find_class(ctx, "QueryStats")
     if hit is None:
         return findings
@@ -148,6 +162,65 @@ def check_wire(ctx: LintContext) -> List[Finding]:
                                 f"max-merged key in QueryStats.merge() — "
                                 f"launcher and results disagree on merge "
                                 f"semantics"))
+    return findings
+
+
+COL_KIND_RE = re.compile(r"^_COL_[A-Z0-9]+$")
+
+
+def _name_refs(fn: ast.AST, names: Set[str]) -> Set[str]:
+    """Which of ``names`` are read (as bare Names) inside ``fn``."""
+    out: Set[str] = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Name) and node.id in names:
+            out.add(node.id)
+    return out
+
+
+def _check_column_kinds(ctx: LintContext) -> List[Finding]:
+    """Column-kind dispatch obligations (see module doc). Anchored on the
+    module that defines ``_encode_column``; no-op when it's not scanned."""
+    findings: List[Finding] = []
+    anchor = None
+    kinds: Set[str] = set()
+    kind_line = 0
+    for mod in ctx.modules:
+        for node in mod.tree.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and node.name == "_encode_column":
+                anchor = mod
+    if anchor is None:
+        return findings
+    for node in anchor.tree.body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name) \
+                and COL_KIND_RE.match(node.targets[0].id) \
+                and isinstance(node.value, ast.Constant) \
+                and isinstance(node.value.value, int):
+            kinds.add(node.targets[0].id)
+            kind_line = max(kind_line, node.lineno)
+    if not kinds:
+        return findings
+
+    required = {"_encode_column", "_decode_column"}
+    for mod in ctx.modules:
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                continue
+            refs = _name_refs(node, kinds)
+            is_required = node.name in required and mod is anchor
+            if not is_required and len(refs) < 2:
+                continue  # not a kind dispatcher (single-kind helpers ok)
+            missing = sorted(kinds - refs)
+            if missing:
+                findings.append(Finding(
+                    "wire", mod.relpath, node.lineno,
+                    f"colkind.{node.name}",
+                    f"{node.name} dispatches on column kinds but does not "
+                    f"handle {', '.join(missing)} — a new wire column "
+                    f"kind must update every encode/decode/accessor "
+                    f"dispatch (DataTable columns() consumers included)"))
     return findings
 
 

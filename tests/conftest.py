@@ -72,6 +72,12 @@ def pytest_configure(config):
         "groups + partial-result gather + the sharded combine on the "
         "forced multi-device mesh (pytest -m cluster_routing runs it in "
         "isolation; part of tier-1)")
+    config.addinivalue_line(
+        "markers",
+        "reduce: array-native broker reduce (columnar DataTables, "
+        "vectorized merge parity vs the row-path oracle, "
+        "reduce-as-arrivals; pytest -m reduce runs it in isolation; "
+        "part of tier-1)")
 
 
 @pytest.fixture(scope="session")

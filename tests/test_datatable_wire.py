@@ -113,3 +113,108 @@ def test_legacy_json_framing_still_decodes():
 def test_empty_group_by():
     out = _roundtrip(DataTable.for_group_by({}, {}, QueryStats()))
     assert out.group_by_groups() == {}
+
+
+# -- columnar accessors (columns()/rows() parity, lazy payload) -------------
+
+def _rand_cell(rng, kind):
+    if kind == "i64":
+        return rng.randint(-(1 << 62), 1 << 62)
+    if kind == "f64":
+        return rng.choice([
+            float(rng.randint(-1000, 1000)), rng.random() * 1e9,
+            float("inf"), float("-inf"), float("nan"), -0.0])
+    if kind == "str":
+        return rng.choice(["", "a", "héllo", "x" * rng.randint(0, 20), "α β"])
+    return rng.choice([
+        None, True, (1, 2.5), frozenset({1, "a"}), b"\x00\xff",
+        [1, [2]], (float("nan"),), "mixed-in-obj", 7])
+
+
+def test_columns_rows_parity_fuzz():
+    """Wire round-trip fuzz: ``columns()`` (typed buffers) and ``rows()``
+    (boxed view) agree cell-for-cell over mixed i64/f64/str/obj schemas,
+    non-finite floats included — and on EMPTY tables."""
+    import random
+
+    rng = random.Random(42)
+    for trial in range(30):
+        kinds = [rng.choice(["i64", "f64", "str", "obj"])
+                 for _ in range(rng.randint(1, 5))]
+        n = rng.choice([0, 1, 2, 17, 64])
+        rows = [[_rand_cell(rng, k) for k in kinds] for _ in range(n)]
+        schema = DataSchema([f"c{i}" for i in range(len(kinds))],
+                            ["STRING"] * len(kinds))
+        out = _roundtrip(DataTable.for_selection(schema, rows, QueryStats()))
+        assert out.num_rows() == n
+        cols = out.columns()
+        assert len(cols) == len(kinds)
+        boxed = out.rows()
+        for c, col in enumerate(cols):
+            assert col.n == n
+            colvals = col.tolist()
+            for i in range(n):
+                want = boxed[i][c]
+                got = colvals[i]
+                if isinstance(want, float) and math.isnan(want):
+                    assert isinstance(got, float) and math.isnan(got)
+                else:
+                    assert got == want and type(got) is type(want)
+            if n and kinds[c] in ("i64", "f64"):
+                # typed accessor: a real numpy view, dtype preserved
+                arr = col.array()
+                assert arr.dtype.kind == ("i" if kinds[c] == "i64" else "f")
+                assert arr.shape == (n,)
+
+
+def test_f64_json_safe_computed_from_array():
+    """The f64 decode computes json_safe from the ARRAY (no box-then-scan
+    double pass): non-finite columns re-wrap only at payload
+    materialization, finite ones pass through."""
+    schema = DataSchema(["f"], ["DOUBLE"])
+    fin = _roundtrip(DataTable.for_selection(
+        schema, [[1.5], [2.5]], QueryStats()))
+    assert fin.columns()[0].json_safe is True
+    inf = _roundtrip(DataTable.for_selection(
+        schema, [[1.5], [float("inf")]], QueryStats()))
+    assert inf.columns()[0].json_safe is False
+    assert inf.rows() == [[1.5], [float("inf")]]
+    # legacy payload view wraps the non-finite cell for JSON transport
+    assert inf.payload["rows"][1][0] == {"__t": "f", "v": "inf"}
+
+
+def test_payload_materializes_lazily():
+    """Wire-decoded tables keep the row section columnar until something
+    touches ``payload``; the boxed dict appears on demand and the JSON
+    framing still round-trips."""
+    schema = DataSchema(["a", "b"], ["STRING", "LONG"])
+    out = _roundtrip(DataTable.for_selection(
+        schema, [["x", 1], ["y", 2]], QueryStats()))
+    assert "rows" not in out._payload
+    assert out.num_rows() == 2          # no materialization
+    assert "rows" not in out._payload
+    assert out.payload["rows"] == [["x", 1], ["y", 2]]
+    again = DataTable.from_bytes(out.to_json_bytes())
+    assert again.rows() == [["x", 1], ["y", 2]]
+
+
+def test_group_columns_accessor():
+    groups = {("east", 2019): [10, 1.5], ("west", 2020): [20, -2.5]}
+    out = _roundtrip(DataTable.for_group_by(
+        groups, {"region": "STRING", "year": "INT"}, QueryStats()))
+    keys, aggs = out.group_columns()
+    assert [k.tolist() for k in keys] == [["east", "west"], [2019, 2020]]
+    assert [a.array().tolist() for a in aggs] == [[10, 20], [1.5, -2.5]]
+    assert out.group_by_groups() == groups  # boxed view still intact
+
+
+def test_take_boxed_partial_materialization():
+    schema = DataSchema(["s", "i", "f"], ["STRING", "LONG", "DOUBLE"])
+    rows = [[f"r{i}", i, float(i) / 2] for i in range(100)]
+    out = _roundtrip(DataTable.for_selection(schema, rows, QueryStats()))
+    import numpy as np
+
+    idx = np.asarray([5, 93, 7])
+    got = [c.take_boxed(idx) for c in out.columns()]
+    assert got == [["r5", "r93", "r7"], [5, 93, 7], [2.5, 46.5, 3.5]]
+    assert "rows" not in out._payload

@@ -475,6 +475,73 @@ def test_wire_catches_launch_key_merge_disagreement(tmp_path):
     assert "LAUNCH_MAX_KEYS.batchSize" not in syms
 
 
+COLKIND_FIXTURE = """\
+    _COL_I64 = 0
+    _COL_STR = 2
+    _COL_NEW = 7
+
+
+    def _encode_column(out, vals):
+        out.append(_COL_I64)
+
+
+    def _decode_column(buf, off, n):
+        kind = buf[off]
+        if kind == _COL_I64:
+            return [], off
+        if kind == _COL_STR:
+            return [], off
+        if kind == _COL_NEW:
+            return [], off
+        raise ValueError(kind)
+
+
+    def take_boxed(col):
+        if col.kind == _COL_I64:
+            return list(col.arr)
+        if col.kind == _COL_STR:
+            return col.strings()
+        raise ValueError(col.kind)
+
+
+    def single_kind_helper(col):
+        return col.kind == _COL_STR
+    """
+
+
+def test_wire_colkind_partial_dispatch_flagged(tmp_path):
+    """A new column kind (_COL_NEW) that encode and a columns() consumer
+    don't handle is flagged; the full decode dispatch and the single-kind
+    helper are clean."""
+    new = _lint(tmp_path, COLKIND_FIXTURE)
+    syms = {f.symbol for f in _by_checker(new, "wire")}
+    assert "colkind._encode_column" in syms
+    assert "colkind.take_boxed" in syms
+    assert "colkind._decode_column" not in syms
+    assert "colkind.single_kind_helper" not in syms
+
+
+def test_wire_colkind_full_dispatch_clean(tmp_path):
+    new = _lint(tmp_path, """\
+        _COL_I64 = 0
+        _COL_OBJ = 3
+        _COL_NUMERIC = (_COL_I64,)
+
+
+        def _encode_column(out, vals):
+            out.append(_COL_I64 if vals else _COL_OBJ)
+
+
+        def _decode_column(buf, off, n):
+            return {_COL_I64: 1, _COL_OBJ: 2}[buf[off]], off
+
+
+        def grouping_helper(col):
+            return col.kind in _COL_NUMERIC
+        """)
+    assert not _by_checker(new, "wire")
+
+
 def test_config_catches_undeclared_key(tmp_path):
     new = _lint(tmp_path, """\
         class CommonConstants:

@@ -1,0 +1,471 @@
+"""Array-native broker reduce: columnar DataTables end-to-end, vectorized
+merge parity vs the row-path oracle, server-side trim, reduce-as-arrivals.
+
+Every parity test feeds BOTH reduce paths tables decoded from the real
+binary wire (`to_bytes`/`from_bytes`), so the vectorized path runs over
+the zero-copy column buffers it would see in production — and asserts
+BIT-identical rows (values AND python types), never approx.
+"""
+
+import math
+import random
+
+import numpy as np
+import pytest
+
+from pinot_tpu.broker.reduce import (
+    BrokerReduceService,
+    MixedResponseTypeError,
+)
+from pinot_tpu.common import datatable as dt_mod
+from pinot_tpu.common.datatable import Column, DataTable, ResponseType
+from pinot_tpu.engine.errors import QueryError
+from pinot_tpu.engine.results import DataSchema, QueryStats
+from pinot_tpu.query import compile_query
+
+pytestmark = pytest.mark.reduce
+
+VEC = BrokerReduceService(vectorized=True)
+ORA = BrokerReduceService(vectorized=False)
+
+
+def _wire(dt: DataTable) -> DataTable:
+    return DataTable.from_bytes(dt.to_bytes())
+
+
+def _assert_bit_identical(a, b, label=""):
+    assert a.schema.to_dict() == b.schema.to_dict(), label
+    assert len(a.rows) == len(b.rows), (label, len(a.rows), len(b.rows))
+    for ra, rb in zip(a.rows, b.rows):
+        assert len(ra) == len(rb), label
+        for x, y in zip(ra, rb):
+            if isinstance(y, float) and math.isnan(y):
+                assert isinstance(x, float) and math.isnan(x), label
+            else:
+                assert x == y and type(x) is type(y), (label, ra, rb)
+
+
+def _both(ctx, tables):
+    rv, sv, ev = VEC.reduce(ctx, [_wire(t) for t in tables])
+    ro, so, eo = ORA.reduce(ctx, [_wire(t) for t in tables])
+    assert ev == eo
+    return (rv, sv), (ro, so)
+
+
+# --------------------------------------------------------------------------
+# group-by parity
+# --------------------------------------------------------------------------
+
+def _gb_tables(rng, n_servers, per_server, aggs_fn, key_fn,
+               schema_types=None, empties=()):
+    tables = []
+    for s in range(n_servers):
+        groups = {}
+        if s not in empties:
+            for _ in range(per_server):
+                groups.setdefault(key_fn(rng), aggs_fn(rng))
+        tables.append(DataTable.for_group_by(
+            groups, schema_types or {"k1": "STRING", "k2": "INT"},
+            QueryStats()))
+    return tables
+
+
+@pytest.mark.parametrize("sql", [
+    "SELECT k1, k2, sum(v), count(*) FROM t GROUP BY k1, k2 LIMIT 100000",
+    "SELECT k1, k2, sum(v), count(*), min(v), max(v), avg(v) FROM t "
+    "GROUP BY k1, k2 ORDER BY sum(v) DESC, k1 LIMIT 97",
+    "SELECT k2, count(*) FROM t GROUP BY k2, k1 "
+    "ORDER BY count(*) DESC, k2 LIMIT 13, 29",
+    "SELECT k1, sum(v) FROM t GROUP BY k1, k2 "
+    "HAVING sum(v) > 300 ORDER BY k1, sum(v) LIMIT 50",
+    "SELECT k1, k2, avg(v), distinctcount(v) FROM t GROUP BY k1, k2 "
+    "ORDER BY k1 LIMIT 40",
+])
+def test_group_by_parity(sql):
+    """Vectorized group-by merge (lexsort + reduceat fold) is
+    bit-identical to the per-key oracle across ORDER BY, OFFSET, HAVING,
+    object-state aggs (avg tuples, distinctcount frozensets), ties, and
+    an empty server."""
+    rng = random.Random(hash(sql) & 0xFFFF)
+    ctx = compile_query(sql)
+
+    def aggs_fn(r):
+        states = {
+            "sum(v)": float(r.randint(0, 1000)),
+            "count(*)": r.randint(1, 50),
+            "min(v)": float(r.randint(-100, 100)),
+            "max(v)": float(r.randint(-100, 100)),
+            "avg(v)": (float(r.randint(0, 500)), r.randint(1, 9)),
+            "distinctcount(v)": frozenset(
+                r.randint(0, 9) for _ in range(r.randint(0, 4))),
+        }
+        return [states[str(f)] for f in ctx.aggregations]
+
+    def key_fn(r):
+        return ("b%02d" % r.randint(0, 25), r.randint(0, 40))
+
+    tables = _gb_tables(rng, 5, 400, aggs_fn, key_fn, empties=(3,))
+    (rv, sv), (ro, so) = _both(ctx, tables)
+    _assert_bit_identical(rv, ro, sql)
+    assert not sv.decisions, sv.decisions  # fully vectorized, no fallback
+
+
+def test_group_by_numeric_keys_and_tie_heavy():
+    ctx = compile_query(
+        "SELECT k, sum(v) FROM t GROUP BY k ORDER BY sum(v), k LIMIT 1000")
+    rng = random.Random(7)
+    tables = _gb_tables(
+        rng, 8, 300,
+        lambda r: [float(r.randint(0, 3))],   # heavy value ties
+        lambda r: (r.randint(0, 60),),        # i64 single key
+        schema_types={"k": "INT"})
+    (rv, sv), (ro, _) = _both(ctx, tables)
+    _assert_bit_identical(rv, ro)
+    assert not sv.decisions
+
+
+def test_group_by_object_key_falls_back_with_ledger_reason():
+    """A None in a group key -> obj column -> row-path fallback, recorded
+    on the decision ledger — and still bit-identical."""
+    ctx = compile_query(
+        "SELECT k, count(*) FROM t GROUP BY k ORDER BY count(*) DESC LIMIT 10")
+    t1 = DataTable.for_group_by({("a",): [3], (None,): [5]}, {}, QueryStats())
+    t2 = DataTable.for_group_by({("a",): [2], ("b",): [1]}, {}, QueryStats())
+    (rv, sv), (ro, _) = _both(ctx, [t1, t2])
+    _assert_bit_identical(rv, ro)
+    assert sv.decisions == {
+        "reduce:vectorized->row_path:reduce_group_key_not_sortable": 1}
+
+
+def test_group_by_mixed_state_kind_falls_back():
+    """Server A ships int sums, server B floats: exact-int-then-float
+    oracle arithmetic is the contract, so the merge declines."""
+    ctx = compile_query("SELECT k, sum(v) FROM t GROUP BY k LIMIT 10")
+    t1 = DataTable.for_group_by({("a",): [3]}, {}, QueryStats())
+    t2 = DataTable.for_group_by({("a",): [2.5]}, {}, QueryStats())
+    (rv, sv), (ro, _) = _both(ctx, [t1, t2])
+    _assert_bit_identical(rv, ro)
+    assert "reduce:vectorized->row_path:reduce_column_kind_mismatch" \
+        in sv.decisions
+
+
+def test_group_by_num_groups_limit_trim_parity():
+    svc_v = BrokerReduceService(num_groups_limit=50, vectorized=True)
+    svc_o = BrokerReduceService(num_groups_limit=50, vectorized=False)
+    ctx = compile_query("SELECT k, count(*) FROM t GROUP BY k LIMIT 100000")
+
+    def build():
+        return [_wire(t) for t in _gb_tables(
+            random.Random(11), 4, 60, lambda r: [r.randint(1, 5)],
+            lambda r: (r.randint(0, 500),), schema_types={"k": "INT"})]
+
+    rv, sv, _ = svc_v.reduce(ctx, build())
+    ro, so, _ = svc_o.reduce(ctx, build())
+    _assert_bit_identical(rv, ro)
+    assert sv.num_groups_limit_reached and so.num_groups_limit_reached
+
+
+# --------------------------------------------------------------------------
+# selection parity (server-side trim + pre-sorted block merge)
+# --------------------------------------------------------------------------
+
+def _sel_tables(rng, n_servers, rows_per, ncols=3, sort_key=None,
+                trim=None, hidden=0, empties=()):
+    tables = []
+    for s in range(n_servers):
+        rows = [] if s in empties else [
+            ["s%02d" % rng.randint(0, 30), rng.randint(-500, 500),
+             float(rng.randint(0, 99))][:ncols]
+            for _ in range(rows_per)]
+        if sort_key is not None:
+            rows.sort(key=sort_key)
+        if trim is not None:
+            rows = rows[:trim]
+        tables.append(DataTable.for_selection(
+            DataSchema(["a", "b", "c"][:ncols],
+                       ["STRING", "LONG", "DOUBLE"][:ncols]),
+            rows, QueryStats(), num_hidden=hidden,
+            sorted_rows=sort_key is not None))
+    return tables
+
+
+@pytest.mark.parametrize("sql,sort_key", [
+    ("SELECT a, b, c FROM t LIMIT 200", None),
+    ("SELECT a, b, c FROM t ORDER BY b, a LIMIT 150",
+     lambda r: (r[1], r[0])),
+    ("SELECT a, b, c FROM t ORDER BY c DESC, b LIMIT 30, 77",
+     None),  # unsorted blocks: broker must still produce the oracle order
+])
+def test_selection_parity(sql, sort_key):
+    """Ordered + unordered selection reduce over pre-trimmed blocks:
+    identical rows/types incl. ties, offsets, and trim boundaries."""
+    rng = random.Random(hash(sql) & 0xFFFF)
+    ctx = compile_query(sql)
+    trim = ctx.offset + ctx.limit
+    tables = _sel_tables(rng, 6, 200, sort_key=sort_key, trim=trim,
+                         empties=(2,))
+    (rv, sv), (ro, _) = _both(ctx, tables)
+    _assert_bit_identical(rv, ro, sql)
+    assert not sv.decisions
+
+
+def test_selection_hidden_order_columns_parity():
+    """ORDER BY over a hidden trailing column (the executor's order-key
+    carry) trims to the visible schema on both paths."""
+    ctx = compile_query("SELECT a FROM t ORDER BY b DESC LIMIT 11, 23")
+    rng = random.Random(3)
+    tables = _sel_tables(rng, 4, 60, ncols=2,
+                         sort_key=lambda r: (-r[1], ), trim=34, hidden=1)
+    (rv, sv), (ro, _) = _both(ctx, tables)
+    _assert_bit_identical(rv, ro)
+    assert rv.schema.column_names == ["a"]
+
+
+def test_selection_single_presorted_block_skips_resort():
+    """One server, block flagged sorted: the trim window IS the answer
+    (no broker sort at all) — and matches the oracle's stable re-sort."""
+    ctx = compile_query("SELECT a, b FROM t ORDER BY b LIMIT 5, 10")
+    rng = random.Random(5)
+    [t] = _sel_tables(rng, 1, 50, ncols=2, sort_key=lambda r: (r[1],),
+                      trim=15)
+    assert _wire(t).selection_sorted
+    (rv, _), (ro, _) = _both(ctx, [t])
+    _assert_bit_identical(rv, ro)
+
+
+def test_selection_non_finite_floats_parity():
+    ctx = compile_query("SELECT a, b, c FROM t ORDER BY b LIMIT 40")
+    rows1 = [["x", i, float("inf") if i % 3 == 0 else float(i)]
+             for i in range(20)]
+    rows2 = [["y", i, float("-inf") if i % 4 == 0 else -float(i)]
+             for i in range(20)]
+    schema = DataSchema(["a", "b", "c"], ["STRING", "LONG", "DOUBLE"])
+    tables = [DataTable.for_selection(schema, r, QueryStats())
+              for r in (rows1, rows2)]
+    (rv, _), (ro, _) = _both(ctx, tables)
+    _assert_bit_identical(rv, ro)
+
+
+# --------------------------------------------------------------------------
+# distinct parity
+# --------------------------------------------------------------------------
+
+@pytest.mark.parametrize("sql", [
+    "SELECT DISTINCT a, b FROM t LIMIT 500",
+    "SELECT DISTINCT a, b FROM t ORDER BY b DESC, a LIMIT 7, 31",
+])
+def test_distinct_parity(sql):
+    """Vectorized unique over the concatenated key columns: first-seen
+    order, cross-server dedup, ORDER BY + OFFSET — all oracle-identical."""
+    rng = random.Random(hash(sql) & 0xFFFF)
+    ctx = compile_query(sql)
+    schema = DataSchema(["a", "b"], ["STRING", "LONG"])
+    tables = []
+    for s in range(5):
+        seen = {}
+        for _ in range(120):
+            r = ["d%d" % rng.randint(0, 12), rng.randint(0, 9)]
+            seen.setdefault(tuple(r), r)
+        tables.append(DataTable.for_distinct(schema, list(seen.values()),
+                                             QueryStats()))
+    tables.append(DataTable.for_distinct(schema, [], QueryStats()))
+    (rv, sv), (ro, _) = _both(ctx, tables)
+    _assert_bit_identical(rv, ro, sql)
+    assert not sv.decisions
+
+
+# --------------------------------------------------------------------------
+# aggregation + mixed-type guard + arrivals
+# --------------------------------------------------------------------------
+
+def test_aggregation_parity():
+    ctx = compile_query("SELECT sum(v), count(*), avg(v) FROM t")
+    tables = [DataTable.for_aggregation(
+        [float(i * 10), i, (float(i), i)], QueryStats())
+        for i in range(1, 7)]
+    (rv, _), (ro, _) = _both(ctx, tables)
+    _assert_bit_identical(rv, ro)
+
+
+def test_mixed_response_types_raise_typed_error():
+    """reduce.py:59 satellite: servers disagreeing on response type is a
+    typed QueryError, never a silent wrong-shaped merge."""
+    ctx = compile_query("SELECT count(*) FROM t")
+    t1 = DataTable.for_aggregation([3], QueryStats())
+    t2 = DataTable.for_group_by({("a",): [1]}, {}, QueryStats())
+    for svc in (VEC, ORA):
+        with pytest.raises(MixedResponseTypeError, match="disagree"):
+            svc.reduce(ctx, [_wire(t1), _wire(t2)])
+    # plain QueryError surface for callers that catch broadly
+    assert issubclass(MixedResponseTypeError, QueryError)
+
+
+def test_reduce_as_arrivals_accumulator():
+    """Folding tables one arrival at a time == batch reduce; fold spans
+    record one per-table split with instance tags."""
+    ctx = compile_query(
+        "SELECT k1, k2, sum(v), count(*) FROM t GROUP BY k1, k2 "
+        "ORDER BY sum(v) DESC LIMIT 50")
+    rng = random.Random(19)
+    tables = [_wire(t) for t in _gb_tables(
+        rng, 6, 200, lambda r: [float(r.randint(0, 99)), r.randint(1, 5)],
+        lambda r: ("g%d" % r.randint(0, 40), r.randint(0, 9)))]
+    batch, _, _ = VEC.reduce(ctx, [_wire_copy(t) for t in tables])
+
+    acc = VEC.accumulator(ctx)
+    for i, t in enumerate(tables):
+        acc.add(t, instance=f"server_{i}")
+    streamed, stats, _ = acc.finish()
+    _assert_bit_identical(streamed, batch)
+    assert len(acc.fold_spans) == 6
+    assert all(s["name"] == "Fold" and "ms" in s and "rows" in s
+               for s in acc.fold_spans)
+    assert acc.fold_spans[0]["instance"] == "server_0"
+
+
+def _wire_copy(t: DataTable) -> DataTable:
+    return DataTable.from_bytes(t.to_bytes())
+
+
+def test_exception_tables_still_partial_reduce():
+    ctx = compile_query("SELECT k, count(*) FROM t GROUP BY k LIMIT 10")
+    ok = DataTable.for_group_by({("a",): [4]}, {}, QueryStats())
+    bad = DataTable.for_exception("server s2 timed out")
+    table, _, errors = VEC.reduce(ctx, [_wire(ok), _wire(bad)])
+    assert table.rows == [["a", 4]]
+    assert errors == ["server s2 timed out"]
+    with pytest.raises(QueryError, match="timed out"):
+        VEC.reduce(ctx, [_wire(bad)])
+
+
+# --------------------------------------------------------------------------
+# zero-boxing acceptance
+# --------------------------------------------------------------------------
+
+def test_numeric_columns_never_box_through_vectorized_reduce(monkeypatch):
+    """The acceptance bar: numeric columns reach the reducer with ZERO
+    per-cell python boxing — Column.tolist on a numeric column and
+    decode_value both trap, and the lazy payload never materializes."""
+    calls = {"decode": 0}
+    real_decode = dt_mod.decode_value
+
+    def counting_decode(v):
+        calls["decode"] += 1
+        return real_decode(v)
+
+    real_tolist = Column.tolist
+
+    def guarded_tolist(self):
+        if self.is_numeric:
+            raise AssertionError("numeric column boxed via tolist()")
+        return real_tolist(self)
+
+    monkeypatch.setattr(dt_mod, "decode_value", counting_decode)
+    monkeypatch.setattr(Column, "tolist", guarded_tolist)
+
+    ctx = compile_query(
+        "SELECT k, sum(v), count(*) FROM t GROUP BY k "
+        "ORDER BY sum(v) DESC LIMIT 100")
+    tables = []
+    for s in range(4):
+        groups = {(i + s * 1000,): [float(i), i % 7 + 1]
+                  for i in range(500)}
+        tables.append(_wire_copy(DataTable.for_group_by(
+            groups, {"k": "INT"}, QueryStats())))
+    calls["decode"] = 0
+    result, stats, _ = VEC.reduce(ctx, tables)
+    assert len(result.rows) == 100 and not stats.decisions
+    assert calls["decode"] == 0
+    for t in tables:
+        assert "groups" not in t._payload  # lazy payload stayed columnar
+
+    # ordered selection: numeric key + output columns stay array-native
+    ctx2 = compile_query("SELECT b, c FROM t ORDER BY b LIMIT 50")
+    schema = DataSchema(["b", "c"], ["LONG", "DOUBLE"])
+    sel = []
+    for s in range(4):
+        rows = sorted([[random.Random(s * 97 + i).randint(0, 10_000),
+                        float(i)] for i in range(100)])
+        sel.append(_wire_copy(DataTable.for_selection(
+            schema, rows, QueryStats(), sorted_rows=True)))
+    calls["decode"] = 0
+    result2, stats2, _ = VEC.reduce(ctx2, sel)
+    assert len(result2.rows) == 50 and not stats2.decisions
+    assert calls["decode"] == 0
+    for t in sel:
+        assert "rows" not in t._payload
+
+
+# --------------------------------------------------------------------------
+# wire columns: empty tables + ledger-reason registry conformance
+# --------------------------------------------------------------------------
+
+def test_empty_tables_roundtrip_and_reduce():
+    ctx = compile_query("SELECT a, b FROM t ORDER BY b LIMIT 10")
+    schema = DataSchema(["a", "b"], ["STRING", "LONG"])
+    empty = _wire_copy(DataTable.for_selection(schema, [], QueryStats()))
+    assert empty.num_rows() == 0 and empty.rows() == []
+    assert [c.n for c in empty.columns()] == [0, 0]
+    table, _, _ = VEC.reduce(ctx, [empty, _wire_copy(
+        DataTable.for_selection(schema, [["x", 1]], QueryStats()))])
+    assert table.rows == [["x", 1]]
+
+
+# --------------------------------------------------------------------------
+# SSB: all 13 flights bit-identical between reduce paths
+# --------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def ssb_server_tables(tmp_path_factory):
+    """Two 'servers' (host executors over disjoint segment halves)
+    answer every SSB flight; the DataTables round-trip the binary wire —
+    exactly what the broker's reduce receives in a 2-server cluster."""
+    from pinot_tpu.engine import ServerQueryExecutor
+    from pinot_tpu.tools import ssb
+
+    out = tmp_path_factory.mktemp("ssb_reduce_segs")
+    segs = ssb.build_segments(0, str(out), num_segments=4, rows=40_000)
+    servers = [ServerQueryExecutor(use_device=False),
+               ServerQueryExecutor(use_device=False)]
+    halves = [segs[:2], segs[2:]]
+
+    def run(sql: str):
+        ctx = compile_query(sql)
+        return ctx, [DataTable.from_bytes(
+            srv.execute_instance(ctx, half).to_bytes())
+            for srv, half in zip(servers, halves)]
+
+    return run
+
+
+from pinot_tpu.tools import ssb as _ssb_queries  # noqa: E402
+
+
+@pytest.mark.parametrize("qid", sorted(_ssb_queries.QUERIES))
+def test_ssb_flight_reduce_parity(ssb_server_tables, qid):
+    from pinot_tpu.tools import ssb
+
+    # explicit LIMIT: full group sets, past the default group-by LIMIT 10
+    ctx, tables = ssb_server_tables(ssb.QUERIES[qid] + " LIMIT 100000")
+    rv, sv, _ = VEC.reduce(ctx, tables)
+    ro, _, _ = ORA.reduce(ctx, [_wire_copy(t) for t in tables])
+    _assert_bit_identical(rv, ro, qid)
+    # no reduce-point fallback: every flight stays on the vectorized path
+    # (server-side ledger entries ride the merged stats — ignore them)
+    assert not [k for k in sv.decisions if k.startswith("reduce:")], \
+        (qid, sv.decisions)
+
+
+def test_reduce_decline_reasons_registered():
+    """Every reason literal at a reduce.py record site must be in
+    tracing.REDUCE_DECISION_REASONS (same contract as routing/gather)."""
+    import re
+
+    from pinot_tpu.broker import reduce as reduce_src
+    from pinot_tpu.common.tracing import REDUCE_DECISION_REASONS
+
+    src = open(reduce_src.__file__).read()
+    used = set(re.findall(r"_decline\(\s*\"([a-z0-9_]+)\"", src))
+    assert used, "no decline sites found — scan pattern drifted"
+    unregistered = used - REDUCE_DECISION_REASONS
+    assert not unregistered, unregistered

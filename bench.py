@@ -658,6 +658,24 @@ class _Worker:
         ctxs = {qid: compile_query(q + " LIMIT 100000")
                 for qid, q in ssb.QUERIES.items()}
 
+        # plan-space kernel preflight BEFORE anything touches the chip:
+        # every flight's extracted spec (+ the fuzz grid) through the
+        # static lowering model; predicted-fail shapes pre-seed the
+        # per-shape blocklist with their pallas_preflight_<rule> reason
+        # so the engine declines them loudly instead of dying in Mosaic.
+        # The verdict table rides the round JSON AND /debug/pallas.
+        _log("ssb: kernel preflight (plan-space verdicts)")
+        from pinot_tpu.tools import preflight as _preflight
+
+        pf_table = _preflight.run_preflight(segs)
+        pf_seeded = _preflight.attach_verdicts(self.dev, pf_table)
+        pf = _preflight.serializable_table(pf_table)
+        self.record("preflight", {
+            "passed": pf["passed"], "failed": pf["failed"],
+            "ssb_failed": pf["ssb_failed"],
+            "seeded_blocklist": pf_seeded,
+            "model": pf["model"], "shapes": pf["shapes"]})
+
         _log("ssb: pandas baseline (build frame)")
         df = self.baseline_frame()
         base_ms = {}
@@ -775,7 +793,24 @@ class _Worker:
                 f"SSB pallas declines with unclassified reason codes: "
                 f"{unknown} — every decline must be classified "
                 f"(decisions: {decisions})")
+        # preflight-miss gate: a shape the preflight PASSED must never
+        # record pallas_exec_failed (predicted-fail shapes are seeded
+        # into the blocklist, so they decline before the chip — any
+        # exec failure left is a LOWERING-MODEL BUG and must be visible
+        # in the trajectory, not silently absorbed by the jnp fallback)
+        exec_failed = [k for k in decisions
+                       if parse_decision_key(k)[0] == "pallas"
+                       and parse_decision_key(k)[3] == "pallas_exec_failed"]
+        if exec_failed and not os.environ.get("BENCH_ALLOW_PREFLIGHT_MISS"):
+            raise AssertionError(
+                f"pallas_exec_failed recorded for shapes the preflight "
+                f"passed: {exec_failed} — the lowering model missed a "
+                f"constraint; turn the Mosaic failure into a preflight "
+                f"rule (BENCH_ALLOW_PREFLIGHT_MISS=1 records anyway)")
         return {
+            "preflight": {"passed": pf["passed"], "failed": pf["failed"],
+                          "ssb_failed": pf["ssb_failed"],
+                          "seeded_blocklist": pf_seeded},
             "decisions": decisions,
             "staging": staging,
             "rows": self.rows,

@@ -446,6 +446,140 @@ REDUCE_DECISION_REASONS = frozenset({
     "reduce_i64_sum_bound",
 })
 
+# Reason codes the KERNEL PREFLIGHT seeds into the per-shape pallas
+# blocklist (tools/preflight.py): one code per lowering-model rule. A
+# blocked shape then declines with ``pallas_preflight_<rule>`` instead of
+# the generic ``pallas_shape_blocked``, so the ledger says WHICH lowering
+# constraint the shape was predicted to violate — before any chip saw it.
+PALLAS_PREFLIGHT_REASONS = frozenset({
+    "pallas_preflight_tile_align",
+    "pallas_preflight_vmem_budget",
+    "pallas_preflight_smem_budget",
+    "pallas_preflight_groups_bound",
+    "pallas_preflight_grid_bound",
+    "pallas_preflight_dtype_unsupported",
+    "pallas_preflight_limb_planes",
+})
+
+
+# --------------------------------------------------------------------------
+# unified reason registry: ONE lookup + ONE conformance harness for every
+# reason namespace above (they were five hand-rolled frozensets with four
+# near-duplicate source-scanning tests; the namespaces keep their public
+# frozenset names — plenty of code imports them — but registration,
+# lookup, and conformance scanning now go through here).
+# --------------------------------------------------------------------------
+
+class ReasonNamespace:
+    """One decision-point reason namespace: the registered code set plus
+    everything the generic conformance harness needs to scan its source
+    module — regexes whose group(1) captures a reason literal at a record
+    site, an optional prefix that makes EVERY quoted ``"<prefix>..."``
+    literal in the module a reason, an optional pattern for allowed
+    dynamic reasons (``tree<i>``), and a floor on sites found (a scan
+    that finds nothing means the patterns drifted, not that the module
+    conformed)."""
+
+    __slots__ = ("name", "codes", "module", "literal_patterns", "prefix",
+                 "dynamic", "min_sites", "exact")
+
+    def __init__(self, name: str, codes: frozenset, module: str,
+                 literal_patterns: Tuple[str, ...] = (),
+                 prefix: Optional[str] = None,
+                 dynamic: Optional["re.Pattern"] = None,
+                 min_sites: int = 1, exact: bool = False):
+        self.name = name
+        self.codes = codes
+        self.module = module
+        self.literal_patterns = literal_patterns
+        self.prefix = prefix
+        self.dynamic = dynamic
+        self.min_sites = min_sites
+        self.exact = exact
+
+    def scan_source(self) -> set:
+        """All reason literals found at this namespace's record sites (by
+        pattern and/or prefix) in its module's source."""
+        import importlib
+
+        mod = importlib.import_module(self.module)
+        with open(mod.__file__.rstrip("c"), encoding="utf-8") as f:
+            src = f.read()
+        found: set = set()
+        for pat in self.literal_patterns:
+            found |= set(re.findall(pat, src))
+        if self.prefix:
+            found |= set(re.findall(rf'"({self.prefix}[a-z0-9_]+)"', src))
+        return found
+
+    def conformance(self) -> Tuple[set, set]:
+        """(literals found, unregistered literals) — the generic
+        source-scanning conformance check. Dynamic reasons matching
+        ``dynamic`` are allowed without registration."""
+        found = self.scan_source()
+        bad = {r for r in found - self.codes
+               if not (self.dynamic and self.dynamic.fullmatch(r))}
+        return found, bad
+
+
+_REASON_REGISTRY: Dict[str, ReasonNamespace] = {}
+
+
+def _register_reasons(ns: ReasonNamespace) -> None:
+    _REASON_REGISTRY[ns.name] = ns
+
+
+def reason_registry(name: Optional[str] = None):
+    """The unified reason-namespace registry. With ``name``, the one
+    :class:`ReasonNamespace`; without, the full ``{name: namespace}``
+    dict. Every reason code that can reach the ledger from a registered
+    decision point lives in exactly one namespace here."""
+    if name is None:
+        return dict(_REASON_REGISTRY)
+    return _REASON_REGISTRY[name]
+
+
+def registered_reason_codes() -> frozenset:
+    """Union of every namespace's code set."""
+    out: set = set()
+    for ns in _REASON_REGISTRY.values():
+        out |= ns.codes
+    return frozenset(out)
+
+
+# the five pre-existing namespaces + the preflight namespace, registered
+# through the one harness (tests/test_reasons.py parameterizes over this
+# registry — the four per-module conformance tests collapsed into it)
+_register_reasons(ReasonNamespace(
+    "pallas", DIRECT_DECLINE_CODES | frozenset(
+        code for _needle, code in _DECLINE_RULES
+        if code.startswith("pallas_")),
+    "pinot_tpu.engine.pallas_kernels",
+    literal_patterns=(r'decline\("([a-z0-9_]+)"\)',),
+    min_sites=3))
+_register_reasons(ReasonNamespace(
+    "routing", ROUTING_DECISION_REASONS, "pinot_tpu.broker.routing",
+    literal_patterns=(r'declined\("([a-z_]+)"\)',
+                      r'"pruned", "all_servers",\s*\n?\s*"([a-z_]+)"'),
+    min_sites=4))
+_register_reasons(ReasonNamespace(
+    "gather", GATHER_DECISION_REASONS, "pinot_tpu.broker.broker",
+    literal_patterns=(r'"full_result",\s*\n?\s*"([a-z_]+)"',),
+    min_sites=3, exact=True))
+_register_reasons(ReasonNamespace(
+    "startree", STARTREE_DECISION_REASONS,
+    "pinot_tpu.engine.startree_exec",
+    prefix="startree_", dynamic=STARTREE_TREE_REASON, min_sites=10))
+_register_reasons(ReasonNamespace(
+    "reduce", REDUCE_DECISION_REASONS, "pinot_tpu.broker.reduce",
+    literal_patterns=(r'_decline\(\s*"([a-z0-9_]+)"',), min_sites=3))
+_register_reasons(ReasonNamespace(
+    "pallas_preflight", PALLAS_PREFLIGHT_REASONS,
+    "pinot_tpu.tools.preflight",
+    literal_patterns=(r'_Rule\(\s*"([a-z0-9_]+)"',), min_sites=5,
+    exact=True))
+
+
 _SANITIZE = re.compile(r"[^a-z0-9]+")
 _DIGITS = re.compile(r"\d+")
 

@@ -134,9 +134,9 @@ class ServerQueryExecutor:
         # interpret mode to tests that opt in explicitly
         self.use_pallas = use_pallas
         # plan.spec values whose pallas kernel failed to lower/run on this
-        # backend: those shapes take the jnp path, everything else keeps
-        # the fused kernel
-        self._pallas_blocked: set = set()
+        # backend — or that the kernel preflight predicted would — take
+        # the jnp path; everything else keeps the fused kernel. Created
+        # below once the config (persistence path) is resolved.
         # ordered-selection top-k kernels (engine/selection_device.py);
         # LRU-capped like the sibling caches (k rides in the key, so
         # unbounded LIMIT variety must not pin kernels forever)
@@ -165,6 +165,15 @@ class ServerQueryExecutor:
         # pallas LUT interval-run cap (the "ivs" fallback bound)
         self._pallas_lut_runs = max(1, cfg.get_int(
             _CC.PALLAS_LUT_MAX_RUNS_KEY, _CC.DEFAULT_PALLAS_LUT_MAX_RUNS))
+        # per-shape pallas blocklist (reason-carrying, optionally
+        # persisted): runtime lowering failures + preflight-seeded shapes
+        from pinot_tpu.engine.pallas_blocklist import PallasBlocklist
+
+        self._pallas_blocked = PallasBlocklist(
+            path=cfg.get(_CC.PALLAS_BLOCKLIST_PATH_KEY))
+        # last kernel-preflight verdict table run against this executor
+        # (tools/preflight.attach_verdicts); surfaced on GET /debug/pallas
+        self.preflight_verdicts: Optional[dict] = None
         self._segment_pool = None
         self._segment_pool_lock = threading.Lock()
         # request-tier admission: bounded concurrency + bounded queue in
@@ -888,8 +897,10 @@ class ServerQueryExecutor:
                             "pallas_disabled_on_backend")
             return None
         if plan.spec in self._pallas_blocked:
+            # preflight-seeded shapes decline with their predicted rule
+            # (pallas_preflight_*); runtime failures keep the generic code
             record_decision(stats, "pallas", "jnp_kernel", "pallas_kernel",
-                            "pallas_shape_blocked")
+                            self._pallas_blocked.reason_for(plan.spec))
             return None
         with maybe_span(stats, "Stage", segment=seg.segment_name):
             staged = self.residency.stage(seg, lease=self._lease_of(stats))

@@ -1,0 +1,121 @@
+"""Per-shape pallas blocklist: which plan specs must not build a fused
+kernel, and WHY.
+
+Both executors used to hold a bare ``set`` of ``plan.spec`` values whose
+pallas kernel failed to lower/run; every blocked shape then declined with
+the one generic ``pallas_shape_blocked`` reason, and a process restart
+forgot everything a dying chip had taught it. This class keeps the
+``add``/``in`` surface those call sites use and adds:
+
+- a **reason per shape**: runtime failures store ``pallas_shape_blocked``
+  (the pre-existing ledger contract); the kernel preflight
+  (tools/preflight.py) seeds predicted-fail shapes with their
+  ``pallas_preflight_<rule>`` code, so the decline explains which
+  lowering constraint the shape violates;
+- **disk persistence** (``pinot.server.query.pallas.blocklist.path``):
+  every add writes through, and a new executor reloads the file — the
+  blocklist survives the process that learned it;
+- a **snapshot** for ``GET /debug/pallas``.
+
+Specs are plain nested tuples of str/int/bool (``SegmentPlan.spec``), so
+they round-trip exactly through ``repr``/``ast.literal_eval``.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import logging
+import os
+import threading
+
+from typing import Any, Dict, List, Optional, Tuple
+
+log = logging.getLogger(__name__)
+
+# the reason recorded for shapes blocked by a runtime lowering/run failure
+RUNTIME_BLOCK_REASON = "pallas_shape_blocked"
+
+
+class PallasBlocklist:
+    """Thread-safe ``{plan spec -> decline reason}`` with optional
+    write-through persistence. Drop-in for the old ``set``: ``add``,
+    ``in``, ``len`` keep their shapes (``add`` without a reason records
+    the runtime-failure code)."""
+
+    def __init__(self, path: Optional[str] = None):
+        self._lock = threading.Lock()
+        self._specs: Dict[Tuple, str] = {}  # guarded-by: _lock
+        self._path = path or None
+        if self._path:
+            self._load()
+
+    # -- set surface --------------------------------------------------------
+    def add(self, spec: Tuple, reason: str = RUNTIME_BLOCK_REASON) -> None:
+        with self._lock:
+            self._specs[spec] = reason
+            entries = self._entries_locked()
+        self._persist(entries)
+
+    def __contains__(self, spec: Tuple) -> bool:
+        with self._lock:
+            return spec in self._specs
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._specs)
+
+    # -- reasons ------------------------------------------------------------
+    def reason_for(self, spec: Tuple,
+                   default: str = RUNTIME_BLOCK_REASON) -> str:
+        """The reason a blocked shape's decline should record — the
+        preflight rule code for seeded shapes, ``pallas_shape_blocked``
+        for runtime failures."""
+        with self._lock:
+            return self._specs.get(spec, default)
+
+    def snapshot(self) -> List[Dict[str, Any]]:
+        """``GET /debug/pallas`` body rows (spec repr is the stable,
+        re-loadable key)."""
+        with self._lock:
+            return [{"spec": repr(s), "reason": r}
+                    for s, r in self._specs.items()]
+
+    # -- persistence --------------------------------------------------------
+    def _entries_locked(self) -> List[Dict[str, str]]:
+        return [{"spec": repr(s), "reason": r}
+                for s, r in self._specs.items()]
+
+    def _persist(self, entries: List[Dict[str, str]]) -> None:
+        if not self._path:
+            return
+        tmp = f"{self._path}.tmp"
+        try:
+            d = os.path.dirname(self._path)
+            if d:
+                os.makedirs(d, exist_ok=True)
+            with open(tmp, "w", encoding="utf-8") as f:
+                json.dump({"entries": entries}, f, indent=1)
+            os.replace(tmp, self._path)
+        except OSError:
+            # persistence is best-effort: an unwritable path must not
+            # take down the serving path that just learned a bad shape
+            log.exception("pallas blocklist persist failed: %s", self._path)
+
+    def _load(self) -> None:
+        try:
+            with open(self._path, encoding="utf-8") as f:
+                data = json.load(f)
+        except FileNotFoundError:
+            return
+        except (OSError, ValueError):
+            log.exception("pallas blocklist unreadable: %s", self._path)
+            return
+        for e in data.get("entries", []):
+            try:
+                spec = ast.literal_eval(e["spec"])
+            except (KeyError, ValueError, SyntaxError):
+                log.warning("pallas blocklist entry skipped: %r", e)
+                continue
+            with self._lock:
+                self._specs[spec] = e.get("reason", RUNTIME_BLOCK_REASON)

@@ -598,7 +598,8 @@ class ShardedQueryExecutor(ServerQueryExecutor):
             return None
         orig_spec = getattr(plan, "_narrowed_from", plan.spec)
         if orig_spec in self._pallas_blocked:
-            declined("pallas_shape_blocked")
+            # preflight-seeded shapes carry their predicted rule code
+            declined(self._pallas_blocked.reason_for(orig_spec))
             return None
         n_seg = self.mesh.shape[SEG_AXIS]
         n_doc = self.mesh.shape[DOC_AXIS]

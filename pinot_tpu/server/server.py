@@ -628,6 +628,28 @@ class ServerInstance:
 
         return TELEMETRY.recorder.snapshot()
 
+    def pallas_debug(self) -> Dict[str, Any]:
+        """``GET /debug/pallas``: the per-shape blocklist (spec + the
+        reason each shape declines with — ``pallas_shape_blocked`` for
+        runtime lowering failures, ``pallas_preflight_<rule>`` for
+        preflight-seeded predictions) plus the last preflight verdict
+        table run against this executor (tools/preflight.py). A chip
+        that fell over mid-round keeps its lessons visible here — and,
+        with ``pinot.server.query.pallas.blocklist.path`` set, across
+        restarts."""
+        bl = getattr(self.executor, "_pallas_blocked", None)
+        out: Dict[str, Any] = {
+            "blocklist": bl.snapshot() if hasattr(bl, "snapshot") else [],
+            "blockedShapes": len(bl) if bl is not None else 0,
+        }
+        path = getattr(bl, "_path", None)
+        if path:
+            out["blocklistPath"] = path
+        verdicts = getattr(self.executor, "preflight_verdicts", None)
+        out["preflight"] = verdicts if verdicts is not None else {
+            "run": False}
+        return out
+
     def memory_debug(self) -> Dict[str, Any]:
         """Bytes-accurate HBM residency + native mmap accounting
         (ref: MmapDebugResource). Per resident: device bytes, pin count,

@@ -177,6 +177,12 @@ class CommonConstants:
     # ("ivs") fallback node — each run is one SMEM compare pair per tile.
     PALLAS_LUT_MAX_RUNS_KEY = "pinot.server.query.pallas.lut.max.runs"
     DEFAULT_PALLAS_LUT_MAX_RUNS = 64
+    # Per-shape pallas blocklist persistence (engine/pallas_blocklist.py):
+    # when set, runtime lowering failures AND preflight-predicted failures
+    # (tools/preflight.py) are written through to this JSON file and
+    # reloaded at executor start — a chip that fell over mid-round must
+    # not forget its lowering failures on restart.
+    PALLAS_BLOCKLIST_PATH_KEY = "pinot.server.query.pallas.blocklist.path"
     WORKER_THREADS_KEY = "pinot.server.query.worker.threads"
     # Launch coalescing (parallel/launcher.py): max requests one vmapped
     # combine launch may carry. 1 disables batching (dedup + single-thread

@@ -44,6 +44,17 @@ to a registered ledger code (``tracing._DECLINE_RULES`` needle or
 ``DIRECT_DECLINE_CODES`` entry) — new decline sites can never reach the
 ledger as an unregistered reason.
 
+ISSUE 15 adds ``device`` (device.py), the static half of the TPU kernel
+preflight: BlockSpec lane alignment + grid/index-map arity +
+``value_limbs`` ref sizing in the Pallas builders, the SMEM ivs-run cap
+vs the ``pallas.lut.max.runs`` config table, i64/f64 bans inside kernel
+bodies (i64 blessed only in the limb-reassembly layer), ``psum``/
+``shard_map`` mesh-axis-name consistency across the combine builders
+(interprocedurally through helper params), pow2-capacity preservation in
+``narrow_plan_groups``, and the star-tree index-pad capacity contract.
+The CLI also gains ``--changed <git-ref>`` (lint only changed files +
+their direct imports + transitive reverse importers).
+
 Pure stdlib ``ast`` — importing this package must never pull jax or the
 engine (the CLI runs in CI before anything else).
 """

@@ -24,6 +24,7 @@ from pinot_tpu.tools.lint.core import (
     DEFAULT_BASELINE,
     checker_names,
     run_lint,
+    select_changed,
 )
 
 
@@ -53,6 +54,11 @@ def main(argv=None) -> int:
                          "(comma-separated; see --list-families)")
     ap.add_argument("--list-families", action="store_true",
                     help="print the registered family names and exit")
+    ap.add_argument("--changed", default=None, metavar="GIT_REF",
+                    help="lint only package files changed vs GIT_REF, "
+                         "plus their direct imports and transitive "
+                         "reverse importers (the file set the "
+                         "interprocedural families need)")
     args = ap.parse_args(argv)
 
     if args.list_families:
@@ -75,6 +81,22 @@ def main(argv=None) -> int:
         import pinot_tpu
 
         paths = [os.path.dirname(os.path.abspath(pinot_tpu.__file__))]
+
+    if args.changed is not None:
+        if args.paths:
+            print("--changed replaces explicit paths; pass one or the "
+                  "other", file=sys.stderr)
+            return 2
+        try:
+            paths = select_changed(args.changed, paths[0])
+        except Exception as e:  # not a repo / bad ref: loud, non-lint exit
+            print(f"--changed {args.changed}: {e}", file=sys.stderr)
+            return 2
+        if not paths:
+            if not args.as_json:
+                print("graftlint: no changed package files",
+                      file=sys.stderr)
+            return 0
 
     baseline = None if args.no_baseline else args.baseline
     new, accepted = run_lint(paths, baseline=baseline, families=families)

@@ -124,6 +124,7 @@ def _load_checkers() -> None:
     from pinot_tpu.tools.lint import (  # noqa: F401
         conservation,
         declines,
+        device,
         locks,
         pairing,
         protocol,
@@ -166,6 +167,100 @@ def load_modules(paths: Sequence[str]) -> Tuple[LintContext, List[Finding]]:
                 "parse", rel, e.lineno or 0, "syntax",
                 f"cannot parse: {e.msg}"))
     return LintContext(modules), findings
+
+
+# -- changed-file selection (--changed <git-ref>) ---------------------------
+
+def _imported_modules(tree: ast.AST) -> List[str]:
+    """Dotted module names a parsed file imports (absolute imports; the
+    codebase convention). ``from a.b import c`` contributes both ``a.b``
+    and ``a.b.c`` — ``c`` may be a module."""
+    out: List[str] = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            out.extend(a.name for a in node.names)
+        elif isinstance(node, ast.ImportFrom) and node.module \
+                and node.level == 0:
+            out.append(node.module)
+            out.extend(f"{node.module}.{a.name}" for a in node.names)
+    return out
+
+
+def build_import_graph(pkg_dir: str) -> Dict[str, List[str]]:
+    """abs file -> abs files it imports, over one package tree."""
+    pkg_name = os.path.basename(os.path.normpath(pkg_dir))
+    parent = os.path.dirname(os.path.normpath(pkg_dir))
+
+    def module_file(dotted: str) -> Optional[str]:
+        if not dotted.startswith(pkg_name + ".") and dotted != pkg_name:
+            return None
+        rel = dotted.split(".")
+        cand = os.path.join(parent, *rel) + ".py"
+        if os.path.isfile(cand):
+            return cand
+        init = os.path.join(parent, *rel, "__init__.py")
+        return init if os.path.isfile(init) else None
+
+    graph: Dict[str, List[str]] = {}
+    for ap, _rel in _collect_files([pkg_dir]):
+        try:
+            with open(ap, encoding="utf-8") as f:
+                tree = ast.parse(f.read(), filename=ap)
+        except SyntaxError:
+            graph[ap] = []
+            continue
+        deps = []
+        for dotted in _imported_modules(tree):
+            mf = module_file(dotted)
+            if mf and mf != ap:
+                deps.append(mf)
+        graph[ap] = sorted(set(deps))
+    return graph
+
+
+def select_changed(ref: str, pkg_dir: str) -> List[str]:
+    """Package files to lint for ``--changed <ref>``: files changed vs
+    the git ref, plus their DIRECT imports (interprocedural families
+    compare against the modules a changed file talks to — protocol needs
+    plan.py next to a changed consumer) plus their TRANSITIVE reverse
+    importers (a changed module can break every consumer's obligations).
+    """
+    import subprocess
+
+    pkg_dir = os.path.abspath(pkg_dir)
+    res = subprocess.run(
+        ["git", "diff", "--name-only", ref, "--", "*.py"],
+        cwd=pkg_dir, capture_output=True, text=True, check=True)
+    root = subprocess.run(
+        ["git", "rev-parse", "--show-toplevel"],
+        cwd=pkg_dir, capture_output=True, text=True, check=True
+    ).stdout.strip()
+    changed = set()
+    for line in res.stdout.splitlines():
+        ap = os.path.abspath(os.path.join(root, line.strip()))
+        if ap.startswith(pkg_dir + os.sep) and os.path.isfile(ap):
+            changed.add(ap)
+    if not changed:
+        return []
+    graph = build_import_graph(pkg_dir)
+    importers: Dict[str, List[str]] = {}
+    for src, deps in graph.items():
+        for d in deps:
+            importers.setdefault(d, []).append(src)
+    selected = set(changed)
+    frontier = list(changed)              # reverse: transitive
+    while frontier:
+        f = frontier.pop()
+        for imp in importers.get(f, []):
+            if imp not in selected:
+                selected.add(imp)
+                frontier.append(imp)
+    # forward: one hop of context for EVERY selected file — base classes
+    # (inherited lock annotations), pack-side plan.py for protocol, the
+    # tracing/config tables — without pulling the transitive world in
+    for f in list(selected):
+        selected.update(graph.get(f, []))
+    return sorted(selected)
 
 
 # -- baseline ---------------------------------------------------------------

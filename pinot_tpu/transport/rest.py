@@ -568,6 +568,11 @@ class ServerAdminApi(_Api):
         # the last frozen bundle (span roots, decision deltas, snapshots)
         self.route("GET", r"/debug/flightrecorder",
                    lambda m, b: (200, s.flightrecorder_debug()))
+        # per-shape pallas blocklist (runtime failures + preflight-seeded
+        # predictions, each with its decline reason) + the last kernel
+        # preflight verdict table (tools/preflight.py)
+        self.route("GET", r"/debug/pallas",
+                   lambda m, b: (200, s.pallas_debug()))
         # ops hook for the HBM budget knob: force-drop one resident's
         # device arrays (in-flight queries keep theirs via python refs;
         # the next query re-stages)

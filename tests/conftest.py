@@ -78,6 +78,12 @@ def pytest_configure(config):
         "vectorized merge parity vs the row-path oracle, "
         "reduce-as-arrivals; pytest -m reduce runs it in isolation; "
         "part of tier-1)")
+    config.addinivalue_line(
+        "markers",
+        "pallas_preflight: kernel preflight (static lowering model over "
+        "the SSB plan space + fuzz grid, interpret-mode cross-check, "
+        "blocklist seeding/persistence; pytest -m pallas_preflight runs "
+        "it in isolation; part of tier-1)")
 
 
 @pytest.fixture(scope="session")

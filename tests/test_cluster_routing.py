@@ -207,31 +207,9 @@ class TestPartitionPruning:
             "routing:pruned->all_servers:no_partition_metadata") == 1
 
 
-class TestReasonRegistry:
-    def test_routing_reason_literals_are_registered(self):
-        """Every reason literal broker/routing.py hands to
-        record_decision must be in tracing.ROUTING_DECISION_REASONS (and
-        broker.py's gather reasons in GATHER_DECISION_REASONS) — an
-        unregistered code would reach the ledger unexplained."""
-        import re
-
-        import pinot_tpu.broker.broker as broker_mod
-        import pinot_tpu.broker.routing as routing_mod
-        from pinot_tpu.common.tracing import (
-            GATHER_DECISION_REASONS,
-            ROUTING_DECISION_REASONS,
-        )
-
-        src = open(routing_mod.__file__.rstrip("c")).read()
-        declines = set(re.findall(r'declined\("([a-z_]+)"\)', src))
-        prunes = set(re.findall(
-            r'"pruned", "all_servers",\s*\n?\s*"([a-z_]+)"', src))
-        assert declines | prunes <= ROUTING_DECISION_REASONS
-        assert "partition_prune" in prunes and "time_prune" in prunes
-        bsrc = open(broker_mod.__file__.rstrip("c")).read()
-        gather = set(re.findall(
-            r'"full_result",\s*\n?\s*"([a-z_]+)"', bsrc))
-        assert gather == GATHER_DECISION_REASONS
+# (The routing/gather reason-registry conformance test moved to
+# tests/test_reasons.py: ONE generic harness parameterized over
+# tracing.reason_registry() replaced the per-module scans.)
 
 
 class TestTimePruning:

@@ -1218,6 +1218,236 @@ def test_decline_only_scopes_pallas_kernels_module(tmp_path):
 
 
 # --------------------------------------------------------------------------
+# device family (ISSUE 15): TPU-lowering obligations on the kernel
+# builders — each acceptance mutation is a scratch copy of the REAL
+# module with one seeded violation, and must yield exactly one finding
+# --------------------------------------------------------------------------
+
+def _real_src(rel):
+    with open(os.path.join(PKG, *rel.split("/")), encoding="utf-8") as f:
+        return f.read()
+
+
+def _device_scratch(tmp_path, name, src):
+    p = tmp_path / name
+    p.write_text(src)
+    new, _ = run_lint([str(p)])
+    return _by_checker(new, "device")
+
+
+def test_device_clean_on_real_builders(tmp_path):
+    for rel, name in (("engine/pallas_kernels.py", "pallas_kernels.py"),
+                      ("parallel/combine.py", "combine.py"),
+                      ("engine/plan.py", "plan.py"),
+                      ("engine/startree_device.py", "startree_device.py")):
+        hits = _device_scratch(tmp_path, name, _real_src(rel))
+        assert not hits, (rel, [f.render() for f in hits])
+
+
+def test_device_swapped_blockspec_dim(tmp_path):
+    """Seeded mutation 1: a swapped BlockSpec dim — the lane (last) dim
+    is no longer provably %128."""
+    src = _real_src("engine/pallas_kernels.py")
+    bad = src.replace(
+        "pl.BlockSpec((Mf, G), lambda s, t: (0, 0), "
+        "memory_space=pltpu.VMEM),",
+        "pl.BlockSpec((G, Mf), lambda s, t: (0, 0), "
+        "memory_space=pltpu.VMEM),")
+    assert bad != src, "out-spec line moved; update the fixture"
+    hits = _device_scratch(tmp_path, "pallas_kernels.py", bad)
+    assert len(hits) == 1 and "lane dim" in hits[0].message, \
+        [f.render() for f in hits]
+
+
+def test_device_helper_concat_swap_flags_every_call_shape(tmp_path):
+    """The block() helper's (1, 1) prefix swapped to a suffix puts a
+    size-1 lane dim on every helper-built block — one finding per
+    distinct call-site shape."""
+    src = _real_src("engine/pallas_kernels.py")
+    bad = src.replace("return pl.BlockSpec((1, 1) + shape0,",
+                      "return pl.BlockSpec(shape0 + (1, 1),")
+    assert bad != src
+    hits = _device_scratch(tmp_path, "pallas_kernels.py", bad)
+    assert len(hits) == 2, [f.render() for f in hits]
+    assert all("lane dim" in f.message for f in hits)
+
+
+def test_device_over_cap_ivs_lut(tmp_path):
+    """Seeded mutation 2: an over-cap ivs LUT — the module's run cap
+    outgrowing the pallas.lut.max.runs config table."""
+    src = _real_src("engine/pallas_kernels.py")
+    bad = src.replace("DEFAULT_LUT_RUN_CAP = 64",
+                      "DEFAULT_LUT_RUN_CAP = 1024")
+    assert bad != src
+    hits = _device_scratch(tmp_path, "pallas_kernels.py", bad)
+    assert len(hits) == 1 and "DEFAULT_PALLAS_LUT_MAX_RUNS" \
+        in hits[0].message, [f.render() for f in hits]
+
+
+def test_device_i64_inside_kernel_body(tmp_path):
+    """Seeded mutation 3: an i64 op outside the blessed limb-reassembly
+    pattern — here, inside the kernel body itself."""
+    src = _real_src("engine/pallas_kernels.py")
+    bad = src.replace(
+        "out_seg[0, :] += mask.astype(jnp.int32).sum(axis=0, "
+        "dtype=jnp.int32)",
+        "out_seg[0, :] += mask.astype(jnp.int64).sum(axis=0, "
+        "dtype=jnp.int32)")
+    assert bad != src
+    hits = _device_scratch(tmp_path, "pallas_kernels.py", bad)
+    assert len(hits) == 1 and "Pallas kernel body" in hits[0].message, \
+        [f.render() for f in hits]
+
+
+def test_device_i64_outside_blessed_functions(tmp_path):
+    src = _real_src("engine/pallas_kernels.py")
+    bad = src.replace(
+        "def _segment_params(pp: PallasPlan, staged: StagedSegment):\n"
+        "    return jnp.concatenate([",
+        "def _segment_params(pp: PallasPlan, staged: StagedSegment):\n"
+        "    _w = jnp.int64(0)\n    return jnp.concatenate([")
+    assert bad != src
+    hits = _device_scratch(tmp_path, "pallas_kernels.py", bad)
+    assert len(hits) == 1 and "blessed" in hits[0].message, \
+        [f.render() for f in hits]
+
+
+def test_device_mismatched_psum_axis(tmp_path):
+    """Seeded mutation 4: a psum over an axis name the mesh never
+    declared."""
+    src = _real_src("parallel/combine.py")
+    bad = src.replace('local = jax.lax.psum(local, DOC_AXIS)',
+                      'local = jax.lax.psum(local, "docs")')
+    assert bad != src
+    hits = _device_scratch(tmp_path, "combine.py", bad)
+    assert len(hits) == 1 and "'docs'" in hits[0].message, \
+        [f.render() for f in hits]
+
+
+def test_device_bad_axis_through_helper_param(tmp_path):
+    """Interprocedural: a bad literal handed to _cross_reduce's axes
+    param is flagged at the call site."""
+    src = _real_src("parallel/combine.py")
+    bad = src.replace(
+        'seg_local = _cross_reduce(seg_local, "sum", (DOC_AXIS,), mesh)',
+        'seg_local = _cross_reduce(seg_local, "sum", ("docs",), mesh)')
+    assert bad != src
+    hits = _device_scratch(tmp_path, "combine.py", bad)
+    assert len(hits) == 1, [f.render() for f in hits]
+
+
+def test_device_value_ref_count_drift(tmp_path):
+    """value_limbs planes must size the ref blocks: a value-spec loop
+    counting inputs instead of planes is the i64 read-someone-else's-
+    plane bug."""
+    src = _real_src("engine/pallas_kernels.py")
+    bad = src.replace(
+        "for _ in range(n_value_refs):\n        "
+        "in_specs.append(block((RT, 128)))",
+        "for _ in range(n_values):\n        "
+        "in_specs.append(block((RT, 128)))")
+    assert bad != src
+    hits = _device_scratch(tmp_path, "pallas_kernels.py", bad)
+    assert len(hits) == 1 and "value_limbs" in hits[0].message, \
+        [f.render() for f in hits]
+
+
+def test_device_narrow_drops_pow2(tmp_path):
+    src = _real_src("engine/plan.py")
+    bad = src.replace("    num_groups = _next_pow2(total)",
+                      "    num_groups = total")
+    assert bad != src
+    hits = _device_scratch(tmp_path, "plan.py", bad)
+    assert len(hits) == 1 and "_next_pow2" in hits[0].message, \
+        [f.render() for f in hits]
+
+
+def test_device_narrow_drops_capacity(tmp_path):
+    src = _real_src("engine/plan.py")
+    bad = src.replace(
+        "    spec = (filter_spec, agg_specs, group_specs, num_groups, "
+        "capacity)",
+        "    spec = (filter_spec, agg_specs, group_specs, num_groups, "
+        "4096)")
+    assert bad != src
+    hits = _device_scratch(tmp_path, "plan.py", bad)
+    assert len(hits) == 1 and "capacity" in hits[0].message, \
+        [f.render() for f in hits]
+
+
+def test_device_startree_idx_pad_off_spec(tmp_path):
+    src = _real_src("engine/startree_device.py")
+    bad = src.replace("padded = np.zeros(capacity, dtype=np.int32)",
+                      "padded = np.zeros(n, dtype=np.int32)")
+    assert bad != src
+    hits = _device_scratch(tmp_path, "startree_device.py", bad)
+    assert len(hits) == 1 and "capacity" in hits[0].message, \
+        [f.render() for f in hits]
+
+
+# --------------------------------------------------------------------------
+# --changed mode + the wall-clock budget
+# --------------------------------------------------------------------------
+
+def _git(cwd, *args):
+    import subprocess
+
+    subprocess.run(["git", *args], cwd=cwd, check=True,
+                   capture_output=True,
+                   env={**os.environ,
+                        "GIT_AUTHOR_NAME": "t", "GIT_AUTHOR_EMAIL": "t@t",
+                        "GIT_COMMITTER_NAME": "t",
+                        "GIT_COMMITTER_EMAIL": "t@t"})
+
+
+def test_changed_selects_reverse_and_forward_deps(tmp_path):
+    """--changed lints the changed file, its reverse importers
+    (transitively), and one forward hop of context for every selected
+    file — not the whole tree."""
+    from pinot_tpu.tools.lint.core import select_changed
+
+    pkg = tmp_path / "mypkg"
+    pkg.mkdir()
+    (pkg / "__init__.py").write_text("")
+    (pkg / "base.py").write_text("X = 1\n")
+    (pkg / "mid.py").write_text("from mypkg.base import X\nY = X\n")
+    (pkg / "top.py").write_text("from mypkg import mid\nZ = mid.Y\n")
+    (pkg / "island.py").write_text("W = 9\n")
+    _git(tmp_path, "init", "-q")
+    _git(tmp_path, "add", ".")
+    _git(tmp_path, "commit", "-qm", "seed")
+    (pkg / "mid.py").write_text("from mypkg.base import X\nY = X + 1\n")
+    got = {os.path.basename(p)
+           for p in select_changed("HEAD", str(pkg))}
+    # mid changed; top imports mid (reverse, transitive); base is mid's
+    # forward context (and __init__ is top's); island untouched
+    assert got == {"mid.py", "top.py", "base.py", "__init__.py"}
+
+    _git(tmp_path, "add", ".")
+    _git(tmp_path, "commit", "-qm", "mid")
+    assert select_changed("HEAD", str(pkg)) == []
+
+
+def test_changed_cli_on_this_repo():
+    """The CLI path end-to-end against the real repo: HEAD-relative
+    selection runs and stays zero-finding (same gate as the package)."""
+    assert lint_main(["--changed", "HEAD"]) == 0
+
+
+def test_whole_package_wall_clock_budget():
+    """The whole-package run must stay CI-viable as the dataflow tier
+    grows: a generous multiple of today's measured wall clock (~17s),
+    but a hard ceiling — a quadratic blow-up in a new family fails here
+    before it fails the CI budget."""
+    import time
+
+    t0 = time.perf_counter()
+    run_lint([PKG], baseline=DEFAULT_BASELINE)
+    elapsed = time.perf_counter() - t0
+    assert elapsed < 120, f"whole-package lint took {elapsed:.1f}s"
+
+
+# --------------------------------------------------------------------------
 # suppression machinery
 # --------------------------------------------------------------------------
 

@@ -1,0 +1,95 @@
+"""Unified reason-registry conformance (tracing.reason_registry()).
+
+PR 12/13/14 each grew a hand-rolled frozenset of ledger reason codes plus
+its own near-duplicate source-scanning test (routing, gather, star-tree,
+reduce; the pallas registry leaned on the graftlint ``decline`` family).
+Those four tests collapse into ONE harness parameterized by
+``(module, registry)``: every namespace declares how its record-site
+literals are found (regex patterns and/or a quoted-literal prefix), and
+the generic scan proves every literal that can reach the ledger is a
+registered, stable code. New namespaces — the kernel preflight's
+``pallas_preflight_<rule>`` codes — register once and inherit the
+conformance gate for free.
+"""
+
+import re
+
+import pytest
+
+from pinot_tpu.common import tracing
+
+pytestmark = pytest.mark.trace
+
+
+@pytest.mark.parametrize("name", sorted(tracing.reason_registry()))
+def test_namespace_record_sites_conform(name):
+    """Every reason literal at a namespace's record sites is registered
+    (dynamic patterns like ``tree<i>`` excepted); the scan itself must
+    find sites (an empty scan means the patterns drifted, not that the
+    module conformed); ``exact`` namespaces must use every code."""
+    ns = tracing.reason_registry(name)
+    found, unregistered = ns.conformance()
+    assert len(found) >= ns.min_sites, \
+        f"{name}: scan found only {sorted(found)} — patterns drifted?"
+    assert not unregistered, f"{name}: unregistered codes {unregistered}"
+    if ns.exact:
+        missing = ns.codes - found
+        assert not missing, \
+            f"{name}: registered but never recorded: {missing}"
+
+
+def test_registry_covers_the_five_legacy_sets_plus_preflight():
+    names = set(tracing.reason_registry())
+    assert {"pallas", "routing", "gather", "startree", "reduce",
+            "pallas_preflight"} <= names
+    codes = tracing.registered_reason_codes()
+    assert tracing.ROUTING_DECISION_REASONS <= codes
+    assert tracing.GATHER_DECISION_REASONS <= codes
+    assert tracing.STARTREE_DECISION_REASONS <= codes
+    assert tracing.REDUCE_DECISION_REASONS <= codes
+    assert tracing.DIRECT_DECLINE_CODES <= codes
+    assert tracing.PALLAS_PREFLIGHT_REASONS <= codes
+
+
+def test_namespaces_do_not_collide():
+    """A reason code means ONE thing: no code registered under two
+    namespaces (prefix discipline keeps histograms per decision point).
+    The pallas/pallas_preflight split is the one sanctioned overlap
+    surface — preflight codes carry their own prefix."""
+    seen = {}
+    for name, ns in tracing.reason_registry().items():
+        for code in ns.codes:
+            assert code not in seen, \
+                f"{code} in both {seen[code]} and {name}"
+            seen[code] = name
+
+
+def test_startree_rank_and_tree_pattern():
+    """The residual bits of the old per-module tests the generic scan
+    does not cover: the star-tree rank table is a registry subset and
+    the executor's chosen-tree record matches the dynamic pattern."""
+    import pinot_tpu.engine.executor as executor_mod
+    import pinot_tpu.engine.startree_exec as exec_mod
+
+    assert set(exec_mod._REASON_RANK) <= tracing.STARTREE_DECISION_REASONS
+    esrc = open(executor_mod.__file__.rstrip("c")).read()
+    assert 'f"tree{tree_index}"' in esrc
+    assert tracing.STARTREE_TREE_REASON.match("tree0")
+    assert tracing.STARTREE_TREE_REASON.match("tree12")
+    assert not tracing.STARTREE_TREE_REASON.match("tree")
+    assert not tracing.STARTREE_TREE_REASON.match("tree0x")
+
+
+def test_routing_scan_still_sees_the_prune_sites():
+    """The routing namespace's patterns must keep matching the two
+    prune-fired records (the old test pinned these two by name)."""
+    ns = tracing.reason_registry("routing")
+    found = ns.scan_source()
+    assert "partition_prune" in found and "time_prune" in found
+
+
+def test_preflight_namespace_is_exact_and_prefixed():
+    ns = tracing.reason_registry("pallas_preflight")
+    assert ns.exact
+    assert all(re.fullmatch(r"pallas_preflight_[a-z0-9_]+", c)
+               for c in ns.codes)

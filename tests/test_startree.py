@@ -784,37 +784,6 @@ class TestPerTreeResidency:
         assert set(resident.startree_nbytes()) == {0, 1}
 
 
-class TestStarTreeReasonRegistry:
-    def test_reason_literals_are_registered(self):
-        """Satellite: every reason literal startree_exec.py can hand the
-        ledger — note(...), decline(...), and _matching_ids' reason
-        strings — must be in tracing.STARTREE_DECISION_REASONS (the PR-12
-        ROUTING_DECISION_REASONS pattern); the executor's chosen-tree
-        record must match the registered tree<i> shape."""
-        import re
-
-        import pinot_tpu.engine.executor as executor_mod
-        import pinot_tpu.engine.startree_exec as exec_mod
-        from pinot_tpu.common.tracing import (
-            STARTREE_DECISION_REASONS,
-            STARTREE_TREE_REASON,
-        )
-
-        src = open(exec_mod.__file__.rstrip("c")).read()
-        # EVERY quoted startree_* literal in the module is a reason code
-        # (decline sites, note sites, _matching_ids reason returns, and
-        # the _REASON_RANK keys) — scan them all so a new site cannot
-        # slip an unregistered code past the call-shape regexes
-        literals = set(re.findall(r'"(startree_[a-z_]+)"', src))
-        assert len(literals) >= 10, "conformance scan found no decline sites"
-        unregistered = literals - STARTREE_DECISION_REASONS
-        assert not unregistered, unregistered
-        # ranked reasons are a subset of the registry too
-        assert set(exec_mod._REASON_RANK) <= STARTREE_DECISION_REASONS
-        # the success record in the executor rides the tree<i> pattern
-        esrc = open(executor_mod.__file__.rstrip("c")).read()
-        assert 'f"tree{tree_index}"' in esrc
-        assert STARTREE_TREE_REASON.match("tree0")
-        assert STARTREE_TREE_REASON.match("tree12")
-        assert not STARTREE_TREE_REASON.match("tree")
-        assert not STARTREE_TREE_REASON.match("tree0x")
+# (The star-tree reason-registry conformance test moved to
+# tests/test_reasons.py: ONE generic harness parameterized over
+# tracing.reason_registry() replaced the per-module scans.)

@@ -1239,10 +1239,14 @@ class _Worker:
         iters = 5
         per_servers = {}
         for n_servers in (2, 8):
+            # device_reduce: broker and servers share this process, so
+            # group-by partials merge on device (PR-16); per-query
+            # reduce_path below records which rung actually served
             cluster = EmbeddedCluster(
                 num_servers=n_servers,
                 data_dir=os.path.join(self.data_dir,
-                                      f"cluster_{n_servers}"))
+                                      f"cluster_{n_servers}"),
+                device_reduce=True)
             try:
                 cluster.create_table(
                     TableConfig(
@@ -1261,7 +1265,7 @@ class _Worker:
                     "external view did not converge: refusing a partial bench"
                 hosting = cluster.hosting_servers("ssb_lineorder_OFFLINE")
                 fanout, prune_ratio, p50 = {}, {}, {}
-                reduce_p50 = {}
+                reduce_p50, reduce_path = {}, {}
                 for qid in qids:
                     sql = ssb.QUERIES[qid]
                     cluster.query(sql)  # warm: staging + kernel compile
@@ -1283,6 +1287,10 @@ class _Worker:
                         # show up independent of scatter/server time
                         reduce_samples.append(
                             resp.phase_times_ms.get("REDUCE", 0.0))
+                        # which reduce rung served (device / vectorized
+                        # / oracle) — trajectory rounds attribute reduce
+                        # wins to the path, not just the timing
+                        reduce_path[qid] = resp.stats.reduce_path
                     fanout[qid] = queried
                     prune_ratio[qid] = round(
                         1.0 - queried / max(len(hosting), 1), 3)
@@ -1296,6 +1304,7 @@ class _Worker:
                     "prune_ratio": prune_ratio,
                     "p50_ms": p50,
                     "reduce_p50_ms": reduce_p50,
+                    "reduce_path": reduce_path,
                 }
             finally:
                 cluster.shutdown()
@@ -1320,8 +1329,12 @@ class _Worker:
         vs the row-path oracle. Two shapes: a high-cardinality group-by
         merge (>=100k distinct groups after the merge) and a 100k-row
         ORDER BY LIMIT selection of pre-trimmed, pre-sorted server
-        blocks. LOUD-FAIL: vectorized group-by < 5x the oracle, selection
-        < 3x, or ANY row diverging bit-wise from the oracle
+        blocks. The group-by merge is ALSO pushed through the PR-16
+        device rung (in-process constructor tables over the mesh) and
+        must both serve (reduce_path == 'device') and match the oracle
+        bit-wise. LOUD-FAIL: vectorized group-by < 5x the oracle,
+        selection < 3x, device losing to the vectorized host on a
+        multi-device mesh, or ANY row diverging bit-wise from the oracle
         (BENCH_ALLOW_SLOW_REDUCE records the numbers anyway; parity has
         no escape hatch)."""
         import random
@@ -1336,6 +1349,7 @@ class _Worker:
         iters = 5
         vec = BrokerReduceService(vectorized=True)
         ora = BrokerReduceService(vectorized=False)
+        dev = BrokerReduceService(vectorized=True, device_reduce=True)
 
         def timed(svc, ctx, raws):
             best = None
@@ -1353,7 +1367,7 @@ class _Worker:
         gb_ctx = compile_query(
             "SELECT k1, k2, sum(v), count(*) FROM t GROUP BY k1, k2 "
             "ORDER BY sum(v) DESC LIMIT 1000")
-        gb_raws = []
+        gb_tables = []
         for s in range(n_servers):
             groups = {}
             for _ in range(40_000):
@@ -1361,9 +1375,9 @@ class _Worker:
                      rng.randint(0, 499))
                 groups[k] = [float(rng.randint(0, 10**6)),
                              rng.randint(1, 100)]
-            gb_raws.append(DataTable.for_group_by(
-                groups, {"k1": "STRING", "k2": "INT"},
-                QueryStats()).to_bytes())
+            gb_tables.append(DataTable.for_group_by(
+                groups, {"k1": "STRING", "k2": "INT"}, QueryStats()))
+        gb_raws = [t.to_bytes() for t in gb_tables]
         merged_groups = len({k for r in gb_raws
                              for k in DataTable.from_bytes(r)
                              .group_by_groups()})
@@ -1371,6 +1385,45 @@ class _Worker:
         ora_gb_ms, ora_gb_rows = timed(ora, gb_ctx, gb_raws)
         assert vec_gb_rows == ora_gb_rows, \
             "reduce: vectorized group-by diverged from the row-path oracle"
+
+        # -- device rung over the SAME merge (PR-16): the constructor
+        # tables stand in for in-process server partials (the embedded
+        # cluster topology — wire_decoded=False, the route's premise).
+        # Columns pre-sniffed + one warm pass so the timing covers the
+        # MERGE, not kernel compilation; parity vs the oracle has NO
+        # escape hatch, and the path must actually be 'device'.
+        for t in gb_tables:
+            t.group_columns()
+        dev.reduce(gb_ctx, gb_tables)  # warm: mesh + kernel cache
+        dev_gb_ms, dev_gb_rows, dev_gb_path = None, None, None
+        for _ in range(iters):
+            t0 = time.perf_counter()
+            table, dstats, _ = dev.reduce(gb_ctx, gb_tables)
+            dms = (time.perf_counter() - t0) * 1e3
+            dev_gb_ms = dms if dev_gb_ms is None else min(dev_gb_ms, dms)
+            dev_gb_rows, dev_gb_path = table.rows, dstats.reduce_path
+        assert dev_gb_rows == ora_gb_rows, \
+            "reduce: device group-by diverged from the row-path oracle"
+        assert dev_gb_rows == vec_gb_rows, \
+            "reduce: device group-by diverged from the vectorized host path"
+        assert dev_gb_path == "device", (
+            f"reduce: device rung declined to '{dev_gb_path}' "
+            f"({dstats.decisions}) — the bench merge shape must SERVE")
+        import jax
+
+        bench_devices = len(jax.devices())
+        device_speedup = vec_gb_ms / max(dev_gb_ms, 1e-9)
+        if bench_devices > 1 and dev_gb_ms > vec_gb_ms:
+            print(f"reduce: WARN device merge {dev_gb_ms:.1f}ms LOSES to "
+                  f"the vectorized host path {vec_gb_ms:.1f}ms on a "
+                  f"{bench_devices}-device mesh",
+                  file=sys.stderr)
+            if not os.environ.get("BENCH_ALLOW_SLOW_REDUCE"):
+                raise AssertionError(
+                    f"reduce: device merge {dev_gb_ms:.1f}ms > vectorized "
+                    f"host {vec_gb_ms:.1f}ms on a {bench_devices}-device "
+                    f"mesh; set BENCH_ALLOW_SLOW_REDUCE=1 to record "
+                    f"anyway (speed only — parity never waives)")
 
         # -- selection: 100k rows total, ORDER BY LIMIT, pre-sorted -----
         per_server = 100_000 // n_servers
@@ -1398,7 +1451,11 @@ class _Worker:
             "groupby": {"merged_groups": merged_groups,
                         "vectorized_ms": round(vec_gb_ms, 3),
                         "oracle_ms": round(ora_gb_ms, 3),
-                        "speedup": round(gb_speedup, 2)},
+                        "speedup": round(gb_speedup, 2),
+                        "device_ms": round(dev_gb_ms, 3),
+                        "device_speedup": round(device_speedup, 2),
+                        "device_path": dev_gb_path,
+                        "mesh_devices": bench_devices},
             "selection": {"rows": per_server * n_servers,
                           "vectorized_ms": round(vec_sel_ms, 3),
                           "oracle_ms": round(ora_sel_ms, 3),

@@ -36,6 +36,7 @@ from pinot_tpu.query.expressions import (
     Predicate,
     PredicateType,
 )
+from pinot_tpu.spi.config import CommonConstants
 from pinot_tpu.spi.table import TableType, table_name_with_type
 
 log = logging.getLogger(__name__)
@@ -63,12 +64,15 @@ class BrokerRequestHandler:
                  routing: Optional[RoutingManager] = None,
                  scatter_workers: int = 16,
                  query_timeout_s: float = 30.0,
-                 coalesce: bool = True):
+                 coalesce: bool = True,
+                 device_reduce: bool =
+                 CommonConstants.DEFAULT_BROKER_DEVICE_REDUCE):
         from pinot_tpu.spi.metrics import MetricsRegistry
 
         self.store = store
         self.routing = routing or RoutingManager(store)
-        self.reduce_service = BrokerReduceService()
+        self.reduce_service = BrokerReduceService(
+            device_reduce=device_reduce)
         self._servers: Dict[str, object] = {}
         from pinot_tpu.server.scheduler import _DaemonPool
 

@@ -23,6 +23,18 @@ Two execution paths share one accumulator surface:
   (object-typed keys, mixed column kinds across servers, NaN order keys,
   i64 sums near overflow) falls back here — recorded on the decision
   ledger under the ``reduce`` point.
+
+On top of the vectorized path sits the **device** group-by route
+(``BrokerReduceService(device_reduce=True)`` or the ``deviceReduce``
+query option; off by default): when broker and servers share the
+process (embedded cluster — tables never crossed a wire), the
+concatenated (keys, states) block merges ON DEVICE through
+``parallel/reduce_device.py`` — composite-key segment scatter + psum
+over the broker mesh — and only the host finalization (insertion-order
+restore, trim, ORDER BY, output boxing) runs on CPU. Shapes the device
+fold cannot prove exact decline to the vectorized host path with a
+``reduce:device->host:<reason>`` ledger record, giving the full ladder
+device -> vectorized host -> row oracle.
 """
 
 from __future__ import annotations
@@ -58,6 +70,10 @@ from pinot_tpu.spi.config import CommonConstants
 # conservative exactness bound for i64 ufunc folds: the fold stays in
 # int64, so the sum of per-table max magnitudes must not be able to wrap
 _I64_FOLD_BOUND = 1 << 62
+
+# vec state bases -> device segment/collective op (exactly the
+# _VEC_STATE_FOLDS bases: count states fold by addition)
+_DEVICE_OPS = {"count": "sum", "sum": "sum", "min": "min", "max": "max"}
 
 
 class MixedResponseTypeError(QueryError):
@@ -125,6 +141,12 @@ class ReduceAccumulator:
         self._mixed: Optional[MixedResponseTypeError] = None
         self.vectorized = service.vectorized and ctx.options.get(
             "vectorizedReduce", "true").lower() != "false"
+        dev_opt = ctx.options.get("deviceReduce")
+        self.device_route = self.vectorized and (
+            dev_opt.lower() == "true" if dev_opt is not None
+            else service.device_reduce)
+        self._served_device = False
+        self._wire_decoded = False
         self._fallback: Optional[str] = None
         self._aggs: List[AggDef] = [resolve_agg(f)
                                     for f in ctx.aggregations]
@@ -163,6 +185,10 @@ class ReduceAccumulator:
                     f"refusing a wrong-shaped merge")
             return
         self.tables.append(table)
+        if table.wire_decoded:
+            # crossed a process boundary: the device route's premise
+            # (states already resident, no D2H paid) does not hold
+            self._wire_decoded = True
         if self.vectorized and self._fallback is None:
             self._fold(table)
         span = {"name": "Fold", "rows": table.num_rows(),
@@ -177,6 +203,14 @@ class ReduceAccumulator:
         self._fallback = reason
         record_decision(self.stats, "reduce", "row_path", "vectorized",
                         reason)
+
+    def _decline_device(self, reason: str) -> None:
+        """Device merge cannot serve this shape: fall back ONE rung (to
+        the vectorized host path, not the oracle) and say why."""
+        from pinot_tpu.common.tracing import record_decision
+
+        self.device_route = False
+        record_decision(self.stats, "reduce", "host", "device", reason)
 
     def _fold(self, table: DataTable) -> None:
         rtype = table.response_type
@@ -266,6 +300,7 @@ class ReduceAccumulator:
         if not self.vectorized or self._fallback is not None:
             table = svc._reduce_rows(ctx, self.rtype, self.tables,
                                      self.stats)
+            self.stats.reduce_path = "oracle"
             return table, self.stats, self.exceptions
         if self.rtype is ResponseType.AGGREGATION:
             table = reduce_aggregation(ctx, self._aggs, self._agg_merged)
@@ -280,11 +315,17 @@ class ReduceAccumulator:
             # rerun the retained tables through the oracle
             table = svc._reduce_rows(ctx, self.rtype, self.tables,
                                      self.stats)
+            self.stats.reduce_path = "oracle"
+        else:
+            self.stats.reduce_path = ("device" if self._served_device
+                                      else "vectorized")
         return table, self.stats, self.exceptions
 
     def _finish_group_by(self) -> Optional[ResultTable]:
         ctx, aggs = self.ctx, self._aggs
         if self._gb_i64_bound >= _I64_FOLD_BOUND:
+            if self.device_route:
+                self._decline_device("reduce_device_i64_sum_bound")
             self._decline("reduce_i64_sum_bound")
             return None
         if not self._gb_keys:
@@ -297,7 +338,6 @@ class ReduceAccumulator:
             np.concatenate([t[k] for t in self._gb_keys])
             for k in range(arity)]
         n = int(key_concat[0].shape[0])
-        order, starts = lexsort_runs(_sortable_arrays(key_concat))
         entries = []
         for a in range(len(aggs)):
             parts = [t[a] for t in self._gb_states]
@@ -309,8 +349,18 @@ class ReduceAccumulator:
                 for p in parts:
                     flat.extend(p[1])
                 entries.append(("obj", flat))
-        folded = fold_grouped_runs(order, starts, n, entries, aggs)
-        first_idx = order[starts]
+        merged = self._device_group_by(key_concat, entries, n) \
+            if self.device_route else None
+        if merged is not None:
+            # device contract == host contract: per group (any fixed
+            # enumeration), earliest input index + exactly-folded state;
+            # the stable argsort below restores insertion order either way
+            first_idx, folded = merged
+            self._served_device = True
+        else:
+            order, starts = lexsort_runs(_sortable_arrays(key_concat))
+            folded = fold_grouped_runs(order, starts, n, entries, aggs)
+            first_idx = order[starts]
         # restore the oracle's dict-insertion order: groups appear in
         # first-occurrence order of the concatenated input (stable
         # lexsort -> each run's first sorted element IS its earliest)
@@ -341,6 +391,55 @@ class ReduceAccumulator:
                            for a in range(len(aggs))]
         return reduce_group_by(ctx, aggs, GroupByResult(groups),
                                self._gb_types)
+
+    def _device_group_by(self, key_concat, entries, n
+                         ) -> Optional[Tuple[np.ndarray, List[np.ndarray]]]:
+        """Try the on-device merge -> ``(first_idx, folded)``, or None
+        after a ``reduce:device->host:<reason>`` ledger record. Every
+        guard here is an EXACTNESS proof obligation: only folds whose
+        result is order-independent bit-for-bit may leave the host."""
+        from pinot_tpu.parallel import reduce_device as rdev
+
+        if self._wire_decoded:
+            # decoded wire tables already paid D2H + serialization —
+            # the host lexsort is the natural frame for them
+            self._decline_device("reduce_device_cross_process")
+            return None
+        if any(kind != "vec" for kind, _ in entries):
+            self._decline_device("reduce_device_obj_state")
+            return None
+        mesh = rdev.broker_mesh()
+        if mesh is None:
+            self._decline_device("reduce_device_mesh_unavailable")
+            return None
+        if n > rdev.MAX_MERGE_ROWS:
+            self._decline_device("reduce_device_rows_over_capacity")
+            return None
+        for a in key_concat:
+            if a.dtype.kind == "f" and bool(np.isnan(a).any()):
+                # NaN != NaN breaks the composite-key group identity
+                self._decline_device("reduce_device_nan_key")
+                return None
+        comp, space = rdev.encode_composite_keys(key_concat)
+        if comp is None:
+            self._decline_device("reduce_device_key_space_overflow")
+            return None
+        ops: List[str] = []
+        vals: List[np.ndarray] = []
+        for agg, (_, arr) in zip(self._aggs, entries):
+            if agg.base == "sum" and arr.dtype.kind == "f" \
+                    and not rdev.f64_sum_exact(arr):
+                # f64 addition is order-dependent; the psum order is not
+                # the reduceat order, so only provably-exact sums go
+                self._decline_device("reduce_device_f64_sum_order")
+                return None
+            ops.append(_DEVICE_OPS[agg.base])
+            vals.append(arr)
+        try:
+            return rdev.device_group_merge(mesh, comp, space, vals, ops)
+        except Exception:
+            self._decline_device("reduce_device_kernel_error")
+            return None
 
     def _finalize_group_by_vectorized(self, key_concat, first_idx, perm,
                                       folded) -> Optional[ResultTable]:
@@ -543,9 +642,12 @@ class BrokerReduceService:
 
     def __init__(self, num_groups_limit: int =
                  CommonConstants.DEFAULT_NUM_GROUPS_LIMIT,
-                 vectorized: bool = True):
+                 vectorized: bool = True,
+                 device_reduce: bool =
+                 CommonConstants.DEFAULT_BROKER_DEVICE_REDUCE):
         self.num_groups_limit = num_groups_limit
         self.vectorized = vectorized
+        self.device_reduce = device_reduce
 
     def accumulator(self, ctx: QueryContext) -> ReduceAccumulator:
         """Streaming entry: the gather loop folds tables as they arrive

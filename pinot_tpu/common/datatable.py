@@ -242,24 +242,34 @@ class Column:
             return self.tolist()
         return [encode_value(v) for v in self.tolist()]
 
-    def encode_to(self, out: bytearray) -> None:
-        """Write the wire form of this column (the typed fast path of
-        ``_encode_column`` — buffers re-frame without re-boxing)."""
+    def encode_parts(self, parts: list) -> None:
+        """Append the wire form of this column as buffer PARTS (the typed
+        fast path of ``_encode_column``): numeric and decoded-string
+        columns frame their existing buffers directly — memoryviews over
+        the arrays, no intermediate bytearray assembly — and the final
+        ``b"".join`` in ``DataTable.to_bytes`` is the only copy."""
         if self.kind == _COL_I64:
-            out.append(_COL_I64)
-            out.extend(np.ascontiguousarray(self._arr,
-                                            dtype="<i8").tobytes())
+            parts.append(bytes([_COL_I64]))
+            parts.append(np.ascontiguousarray(self._arr, dtype="<i8").data)
         elif self.kind == _COL_F64:
-            out.append(_COL_F64)
-            out.extend(np.ascontiguousarray(self._arr,
-                                            dtype="<f8").tobytes())
+            parts.append(bytes([_COL_F64]))
+            parts.append(np.ascontiguousarray(self._arr, dtype="<f8").data)
         elif self.kind == _COL_STR:
-            out.append(_COL_STR)
-            _encode_str_column(out, self.tolist())
+            parts.append(bytes([_COL_STR]))
+            if self._heap is not None:
+                # wire-decoded: the heap + offsets ARE the wire form
+                parts.append(struct.pack("<I", len(self._heap)))
+                parts.append(self._heap)
+                parts.append(np.ascontiguousarray(self._offsets,
+                                                  dtype="<u4").data)
+            else:
+                _encode_str_parts(parts, self.tolist())
         elif self.kind == _COL_OBJ:
-            out.append(_COL_OBJ)
+            parts.append(bytes([_COL_OBJ]))
+            buf = bytearray()  # serde is inherently byte-at-a-time
             for v in self._vals:
-                serde.pack_obj(v, out)
+                serde.pack_obj(v, buf)
+            parts.append(bytes(buf))
         else:
             raise ValueError(f"unknown column kind {self.kind}")
 
@@ -281,6 +291,17 @@ def _encode_str_column(out: bytearray, vals: List[str]) -> None:
     out.extend(struct.pack("<I", len(heap)))
     out.extend(heap)
     out.extend(offsets.tobytes())
+
+
+def _encode_str_parts(parts: list, vals: List[str]) -> None:
+    """Heap+offsets body of a string column as buffer parts: each encoded
+    string is its own part (the heap never assembles on the python heap —
+    the final join IS the heap) followed by the offsets buffer."""
+    enc = [v.encode("utf-8") for v in vals]
+    offsets = np.cumsum([0] + [len(p) for p in enc]).astype("<u4")
+    parts.append(struct.pack("<I", int(offsets[-1])))
+    parts.extend(enc)
+    parts.append(offsets.data)
 
 
 def _encode_column(out: bytearray, values: List[Any]) -> None:
@@ -336,9 +357,9 @@ def _decode_column(buf: bytes, off: int, n: int) -> Tuple[Column, int]:
     raise ValueError(f"unknown column kind {kind}")
 
 
-def _put_section(out: bytearray, raw: bytes) -> None:
-    out.extend(struct.pack("<I", len(raw)))
-    out.extend(raw)
+def _put_section(parts: list, raw: bytes) -> None:
+    parts.append(struct.pack("<I", len(raw)))
+    parts.append(raw)
 
 
 def _get_section(buf: bytes, off: int) -> tuple:
@@ -367,8 +388,8 @@ class DataTable:
     boxed dict never materializes.
     """
 
-    __slots__ = ("response_type", "stats", "exceptions", "_payload",
-                 "_cols", "_key_cols", "_agg_cols", "_n_rows")
+    __slots__ = ("response_type", "stats", "exceptions", "wire_decoded",
+                 "_payload", "_cols", "_key_cols", "_agg_cols", "_n_rows")
 
     def __init__(self, response_type: ResponseType,
                  payload: Optional[Dict[str, Any]],
@@ -378,6 +399,11 @@ class DataTable:
         self._payload: Dict[str, Any] = payload if payload is not None else {}
         self.stats = stats if stats is not None else QueryStats()
         self.exceptions = exceptions if exceptions is not None else []
+        # True on tables that arrived THROUGH the wire (from_bytes /
+        # legacy JSON): the broker's device reduce keys off it — a table
+        # that crossed a process boundary already paid D2H, so the host
+        # merge is its natural frame
+        self.wire_decoded = False
         self._cols: Optional[List[Column]] = None
         self._key_cols: Optional[List[Column]] = None
         self._agg_cols: Optional[List[Column]] = None
@@ -412,36 +438,42 @@ class DataTable:
                 for i in range(self._n_rows or 0)]
 
     # -- framing -------------------------------------------------------------
-    def to_bytes(self) -> bytes:
-        """Binary columnar framing (see module doc). Layout:
+    def to_buffers(self) -> List[Any]:
+        """The wire form as an ordered list of buffer parts (bytes /
+        memoryviews over the live column arrays). Layout:
         magic | u8 type-ordinal | stats json section | exceptions json
-        section | per-type payload."""
-        out = bytearray(MAGIC)
-        out.append(_WIRE_ORDINAL[self.response_type])
-        _put_section(out, json.dumps(
+        section | per-type payload. Zero-copy: typed column buffers are
+        framed directly (``Column.encode_parts``); nothing assembles an
+        intermediate bytearray. A transport that can writev/scatter sends
+        the parts as-is; ``to_bytes`` is the single-buffer join."""
+        parts: List[Any] = [MAGIC, bytes([_WIRE_ORDINAL[self.response_type]])]
+        _put_section(parts, json.dumps(
             self.stats.to_dict(), separators=(",", ":")).encode("utf-8"))
-        _put_section(out, json.dumps(
+        _put_section(parts, json.dumps(
             self.exceptions, separators=(",", ":")).encode("utf-8"))
         t = self.response_type
         if t is ResponseType.AGGREGATION:
             states = [decode_value(s) for s in self._payload["states"]] \
                 if self._payload else []
-            serde.pack_obj(len(states), out)
+            buf = bytearray()
+            serde.pack_obj(len(states), buf)
             for s in states:
-                serde.pack_obj(s, out)
+                serde.pack_obj(s, buf)
+            parts.append(bytes(buf))
         elif t is ResponseType.GROUP_BY:
-            _put_section(out, json.dumps(
+            _put_section(parts, json.dumps(
                 self._payload.get("schema_types", {}),
                 separators=(",", ":")).encode("utf-8"))
             key_cols, agg_cols = (self.group_columns()
                                   if self._payload or self._key_cols
                                   else ([], []))
             n = key_cols[0].n if key_cols else 0
-            out.extend(struct.pack("<IHH", n, len(key_cols), len(agg_cols)))
+            parts.append(struct.pack("<IHH", n, len(key_cols),
+                                     len(agg_cols)))
             for c in key_cols:
-                c.encode_to(out)
+                c.encode_parts(parts)
             for c in agg_cols:
-                c.encode_to(out)
+                c.encode_parts(parts)
         else:  # SELECTION / DISTINCT
             schema = self._payload.get(
                 "schema", {"columnNames": [], "columnDataTypes": []}) \
@@ -449,13 +481,17 @@ class DataTable:
                                        "columnDataTypes": []}
             cols = self.columns() if self._payload or self._cols else []
             n_rows = cols[0].n if cols else 0
-            _put_section(out, json.dumps(
+            _put_section(parts, json.dumps(
                 schema, separators=(",", ":")).encode("utf-8"))
-            out.extend(struct.pack("<IHH", n_rows, len(cols),
-                                   self.num_hidden))
+            parts.append(struct.pack("<IHH", n_rows, len(cols),
+                                     self.num_hidden))
             for c in cols:
-                c.encode_to(out)
-        return bytes(out)
+                c.encode_parts(parts)
+        return parts
+
+    def to_bytes(self) -> bytes:
+        """Single-buffer wire form: ONE join over ``to_buffers`` parts."""
+        return b"".join(self.to_buffers())
 
     @classmethod
     def from_bytes(cls, raw: bytes) -> "DataTable":
@@ -474,9 +510,12 @@ class DataTable:
             for _ in range(n):
                 s, off = serde.unpack_obj(raw, off)
                 states.append(s)
-            return cls(rtype, {"states": [encode_value(s) for s in states]},
-                       stats, exceptions)
+            dt = cls(rtype, {"states": [encode_value(s) for s in states]},
+                     stats, exceptions)
+            dt.wire_decoded = True
+            return dt
         dt = cls(rtype, {}, stats, exceptions)
+        dt.wire_decoded = True
         if rtype is ResponseType.GROUP_BY:
             st_raw, off = _get_section(raw, off)
             dt._payload["schema_types"] = json.loads(st_raw.decode("utf-8"))
@@ -520,6 +559,7 @@ class DataTable:
             num_servers_responded=st.get("numServersResponded", 0),
             group_by_rung=st.get("groupByRung"),
             startree_tree_index=st.get("startreeTreeIndex"),
+            reduce_path=st.get("reducePath"),
             staging=st.get("staging", {}),
             launch=st.get("launch", {}),
             phase_ms=st.get("phaseTimesMs", {}),
@@ -532,9 +572,11 @@ class DataTable:
     def _from_json_bytes(cls, raw: bytes) -> "DataTable":
         """Legacy JSON framing (kept for mixed-version interop + debug)."""
         d = json.loads(raw.decode("utf-8"))
-        return cls(ResponseType(d["type"]), d["payload"],
-                   cls._stats_from_dict(d.get("stats", {})),
-                   d.get("exceptions", []))
+        dt = cls(ResponseType(d["type"]), d["payload"],
+                 cls._stats_from_dict(d.get("stats", {})),
+                 d.get("exceptions", []))
+        dt.wire_decoded = True
+        return dt
 
     def to_json_bytes(self) -> bytes:
         """The debuggable JSON framing (not the serving default)."""

@@ -446,6 +446,24 @@ REDUCE_DECISION_REASONS = frozenset({
     "reduce_i64_sum_bound",
 })
 
+# Reason codes the broker REDUCE point records (broker/reduce.py
+# ``_decline_device`` sites) when the DEVICE group-by merge
+# (parallel/reduce_device.py) cannot prove bit-exactness or has no
+# substrate, and the query falls back ONE rung to the vectorized host
+# path ("reduce:device->host:<reason>"). Distinct prefix from
+# REDUCE_DECISION_REASONS: that set explains vectorized->oracle falls.
+REDUCE_DEVICE_REASONS = frozenset({
+    "reduce_device_mesh_unavailable",
+    "reduce_device_obj_state",
+    "reduce_device_cross_process",
+    "reduce_device_rows_over_capacity",
+    "reduce_device_nan_key",
+    "reduce_device_key_space_overflow",
+    "reduce_device_f64_sum_order",
+    "reduce_device_i64_sum_bound",
+    "reduce_device_kernel_error",
+})
+
 # Reason codes the KERNEL PREFLIGHT seeds into the per-shape pallas
 # blocklist (tools/preflight.py): one code per lowering-model rule. A
 # blocked shape then declines with ``pallas_preflight_<rule>`` instead of
@@ -573,6 +591,10 @@ _register_reasons(ReasonNamespace(
 _register_reasons(ReasonNamespace(
     "reduce", REDUCE_DECISION_REASONS, "pinot_tpu.broker.reduce",
     literal_patterns=(r'_decline\(\s*"([a-z0-9_]+)"',), min_sites=3))
+_register_reasons(ReasonNamespace(
+    "reduce_device", REDUCE_DEVICE_REASONS, "pinot_tpu.broker.reduce",
+    literal_patterns=(r'_decline_device\(\s*"([a-z0-9_]+)"',),
+    min_sites=4, exact=True))
 _register_reasons(ReasonNamespace(
     "pallas_preflight", PALLAS_PREFLIGHT_REASONS,
     "pinot_tpu.tools.preflight",

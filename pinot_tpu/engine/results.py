@@ -83,6 +83,10 @@ class QueryStats:
     # bench records it per query), or None off the star-tree rungs. A
     # table's segments share one tree config, so merge keeps any value
     startree_tree_index: Optional[int] = None
+    # broker reduce path that produced the final table ('device' |
+    # 'vectorized' | 'oracle'); set ONCE by the broker at finish, so
+    # merge keeps any incoming value (servers leave it None)
+    reduce_path: Optional[str] = None
     # HBM residency counters for this query (engine/residency.py):
     # hits/misses/evictions/pinBlockedEvictions/spills — and the tiered
     # keys promotions/demotions/slices (budget-slice boundaries the query
@@ -145,6 +149,8 @@ class QueryStats:
                 else "mixed")
         if other.startree_tree_index is not None:
             self.startree_tree_index = other.startree_tree_index
+        if other.reduce_path is not None:
+            self.reduce_path = other.reduce_path
         for k, v in other.staging.items():
             if k.endswith("Bytes"):
                 self.staging[k] = max(self.staging.get(k, 0), v)
@@ -188,6 +194,8 @@ class QueryStats:
                if self.group_by_rung else {}),
             **({"startreeTreeIndex": self.startree_tree_index}
                if self.startree_tree_index is not None else {}),
+            **({"reducePath": self.reduce_path}
+               if self.reduce_path else {}),
             **({"staging": self.staging} if self.staging else {}),
             **({"launch": self.launch} if self.launch else {}),
             **({"trace": self.trace} if self.trace else {}),

@@ -134,6 +134,23 @@ class CommonConstants:
     DEFAULT_GROUPBY_TRIM_THRESHOLD = 1_000_000
     DEFAULT_MIN_SEGMENT_GROUP_TRIM_SIZE = -1
     DEFAULT_MIN_SERVER_GROUP_TRIM_SIZE = 5000
+    # Device-resident broker reduce (parallel/reduce_device.py): when
+    # broker and servers share the process (embedded cluster / bench
+    # topology) group-by partials merge ON DEVICE — segment-sum/sort-rung
+    # kernels + psum over the broker mesh — instead of the host lexsort.
+    # Off by default: cross-process tables already paid D2H + wire, so
+    # the host path is the natural fallback frame. Per-query override:
+    # OPTION(deviceReduce=true|false).
+    BROKER_DEVICE_REDUCE_KEY = "pinot.broker.reduce.device.enabled"
+    DEFAULT_BROKER_DEVICE_REDUCE = False
+    # Dense-rung slot cap: composite key spaces up to this many slots
+    # merge via direct segment-sum scatter; larger spaces ride the sort
+    # rung, and spaces whose composite encoding cannot fit i64 decline.
+    DEFAULT_DEVICE_REDUCE_DENSE_SLOTS = 1 << 21
+    # Row cap on the padded merge input (all servers' groups concatenated,
+    # padded to a shared pow2 capacity); above it the device path declines
+    # loudly rather than committing unbounded HBM.
+    DEFAULT_DEVICE_REDUCE_MAX_ROWS = 1 << 22
     # Block size: the reference drains filters in 10k-doc blocks
     # (DocIdSetPlanNode.java:29). On TPU we tile the doc dimension instead;
     # this is the host-side fallback block size.

@@ -32,7 +32,8 @@ class EmbeddedCluster:
 
     def __init__(self, num_servers: int = 1, data_dir: str = "/tmp/pinot_tpu_cluster",
                  snapshot: bool = False, llc_seed: Optional[str] = None,
-                 query_timeout_s: float = 120.0):
+                 query_timeout_s: float = 120.0,
+                 device_reduce: bool = False):
         os.makedirs(data_dir, exist_ok=True)
         snap = os.path.join(data_dir, "cluster_state.json") if snapshot else None
         self.data_dir = data_dir
@@ -40,7 +41,11 @@ class EmbeddedCluster:
         self.controller = Controller(self.store, llc_seed=llc_seed)
         self.servers: Dict[str, ServerInstance] = {}
         self.minions: Dict[str, object] = {}
-        self.broker = BrokerRequestHandler(self.store, query_timeout_s=query_timeout_s)
+        # device_reduce: servers and broker share this process, so the
+        # broker may merge group-by partials on device (PR-16 route)
+        self.broker = BrokerRequestHandler(self.store,
+                                           query_timeout_s=query_timeout_s,
+                                           device_reduce=device_reduce)
         for i in range(num_servers):
             self.add_server(f"server_{i}")
 

@@ -28,9 +28,10 @@ discover at Mosaic time:
   the blessed limb-reassembly functions (``assemble_outputs``, the
   sharded combine's post-kernel widening).
 - ``mesh-axis`` — every ``psum``/``pmin``/``pmax``/``all_gather``/
-  ``axis_index`` axis argument in the combine builders resolves to a
-  declared mesh axis name (``SEG_AXIS``/``DOC_AXIS``), interprocedurally
-  through helper params (``_cross_reduce``'s ``axes``).
+  ``all_to_all``/``axis_index`` axis argument in the combine builders
+  resolves to a declared mesh axis name (``SEG_AXIS``/``DOC_AXIS``),
+  interprocedurally through helper params (``_cross_reduce``'s
+  ``axes``).
 - ``pow2-narrow`` — ``narrow_plan_groups`` preserves the pow2 capacity
   slot and routes the narrowed group count through ``_next_pow2``.
 - ``idxcap`` — the star-tree device rung's padded index buffer is sized
@@ -71,7 +72,7 @@ _WIDE_DTYPES = {"int64", "uint64", "float64"}
 _BLESSED_WIDE = {"assemble_outputs", "build_sharded_pallas_kernel"}
 
 _COLLECTIVE_AXIS_ARG = {
-    "psum": 1, "pmin": 1, "pmax": 1, "all_gather": 1,
+    "psum": 1, "pmin": 1, "pmax": 1, "all_gather": 1, "all_to_all": 1,
     "axis_index": 0, "pbroadcast": 1, "ppermute": 1, "pshuffle": 1,
 }
 
@@ -847,6 +848,12 @@ def check_device(ctx: LintContext) -> List[Finding]:
             _check_dtypes(mod, findings)
         elif base == "combine.py":
             _check_dtypes(mod, findings)
+            findings.extend(_AxisChecker(mod).check())
+        elif base == "reduce_device.py":
+            # broker-reduce merge kernels: mesh-axis resolution through
+            # the reduce helper params (_axis_reduce's / _slice_reduce's
+            # ``axis``). NO _check_dtypes — i64 keys/sums are this
+            # module's contract
             findings.extend(_AxisChecker(mod).check())
         elif base == "plan.py":
             _check_narrow(mod, findings)

@@ -84,6 +84,13 @@ def pytest_configure(config):
         "the SSB plan space + fuzz grid, interpret-mode cross-check, "
         "blocklist seeding/persistence; pytest -m pallas_preflight runs "
         "it in isolation; part of tier-1)")
+    config.addinivalue_line(
+        "markers",
+        "reduce_device: device-resident broker reduce (group-by merge "
+        "over the forced 8-virtual-device mesh, SSB parity vs the "
+        "vectorized host path and the row oracle, decline-shape "
+        "fixtures; pytest -m reduce_device runs it in isolation; part "
+        "of tier-1)")
 
 
 @pytest.fixture(scope="session")

@@ -1238,10 +1238,29 @@ def _device_scratch(tmp_path, name, src):
 def test_device_clean_on_real_builders(tmp_path):
     for rel, name in (("engine/pallas_kernels.py", "pallas_kernels.py"),
                       ("parallel/combine.py", "combine.py"),
+                      ("parallel/reduce_device.py", "reduce_device.py"),
                       ("engine/plan.py", "plan.py"),
                       ("engine/startree_device.py", "startree_device.py")):
         hits = _device_scratch(tmp_path, name, _real_src(rel))
         assert not hits, (rel, [f.render() for f in hits])
+
+
+def test_device_reduce_bad_axis_through_helper_param(tmp_path):
+    """PR-16 seeded mutations: a literal axis at the dense-rung combine
+    dispatch that is NOT the declared ``MERGE_AXIS`` — resolved
+    interprocedurally through the helper's ``axis`` param (one mutation
+    per combine flavor: the psum helper and the all_to_all helper),
+    exactly one finding each."""
+    src = _real_src("parallel/reduce_device.py")
+    for target in ('_axis_reduce(v, op, MERGE_AXIS, mesh)',
+                   '_slice_reduce(v, op, MERGE_AXIS, mesh)'):
+        bad = src.replace(target, target.replace("MERGE_AXIS", '"rows"'))
+        assert bad != src, \
+            f"dense-rung combine dispatch moved ({target}); update fixture"
+        hits = _device_scratch(tmp_path, "reduce_device.py", bad)
+        assert len(hits) == 1 \
+            and "not a declared mesh axis" in hits[0].message, \
+            (target, [f.render() for f in hits])
 
 
 def test_device_swapped_blockspec_dim(tmp_path):

@@ -1,0 +1,396 @@
+"""Device-resident broker reduce (PR 16): group-by merge on the forced
+8-virtual-device mesh, bit-identical to the vectorized host path AND the
+row-path oracle — plus every decline shape proving the fallback ladder
+(device -> vectorized host -> row oracle) fires with its registered
+``reduce:device->host:<reason>`` ledger record.
+
+The device service receives IN-PROCESS tables (constructor-built /
+executor-built, never wire-decoded) — the embedded-cluster topology the
+route exists for; the host paths get wire round-tripped copies, exactly
+what a cross-process broker would hold.
+"""
+
+import math
+import random
+
+import numpy as np
+import pytest
+
+from pinot_tpu.broker.reduce import BrokerReduceService
+from pinot_tpu.common import tracing
+from pinot_tpu.common.datatable import DataTable
+from pinot_tpu.engine.results import QueryStats
+from pinot_tpu.parallel import reduce_device
+from pinot_tpu.query import compile_query
+
+pytestmark = pytest.mark.reduce_device
+
+DEV = BrokerReduceService(vectorized=True, device_reduce=True)
+VEC = BrokerReduceService(vectorized=True)
+ORA = BrokerReduceService(vectorized=False)
+
+
+def _wire(t: DataTable) -> DataTable:
+    return DataTable.from_bytes(t.to_bytes())
+
+
+def _assert_bit_identical(a, b, label=""):
+    assert a.schema.to_dict() == b.schema.to_dict(), label
+    assert len(a.rows) == len(b.rows), (label, len(a.rows), len(b.rows))
+    for ra, rb in zip(a.rows, b.rows):
+        assert len(ra) == len(rb), label
+        for x, y in zip(ra, rb):
+            if isinstance(y, float) and math.isnan(y):
+                assert isinstance(x, float) and math.isnan(x), label
+            else:
+                assert x == y and type(x) is type(y), (label, ra, rb)
+
+
+def _device_declines(stats):
+    return {k: v for k, v in stats.decisions.items()
+            if k.startswith("reduce:device->host:")}
+
+
+def _gb_tables(rng, n_servers, per_server, aggs_fn, key_fn,
+               schema_types=None):
+    tables = []
+    for _ in range(n_servers):
+        groups = {}
+        for _ in range(per_server):
+            groups.setdefault(key_fn(rng), aggs_fn(rng))
+        tables.append(DataTable.for_group_by(
+            groups, schema_types or {"k1": "STRING", "k2": "INT"},
+            QueryStats()))
+    return tables
+
+
+# --------------------------------------------------------------------------
+# three-path parity on randomized merges: dense rung and sort rung
+# --------------------------------------------------------------------------
+
+@pytest.mark.parametrize("sql", [
+    "SELECT k1, k2, sum(v), count(*) FROM t GROUP BY k1, k2 LIMIT 100000",
+    "SELECT k1, k2, sum(v), count(*), min(v), max(v) FROM t "
+    "GROUP BY k1, k2 ORDER BY sum(v) DESC, k1 LIMIT 97",
+    "SELECT k2, count(*) FROM t GROUP BY k2, k1 "
+    "ORDER BY count(*) DESC, k2 LIMIT 13, 29",
+    "SELECT k1, sum(v) FROM t GROUP BY k1, k2 "
+    "HAVING sum(v) > 300 ORDER BY k1, sum(v) LIMIT 50",
+])
+def test_device_group_by_parity(sql, eight_devices):
+    """Device merge == vectorized host merge == row oracle, bit for bit,
+    across ORDER BY / OFFSET / HAVING / value ties — and the device path
+    actually served (no silent host fallback)."""
+    rng = random.Random(hash(sql) & 0xFFFF)
+    ctx = compile_query(sql)
+
+    def aggs_fn(r):
+        states = {
+            "sum(v)": float(r.randint(0, 1000)),
+            "count(*)": r.randint(1, 50),
+            "min(v)": float(r.randint(-100, 100)),
+            "max(v)": float(r.randint(-100, 100)),
+        }
+        return [states[str(f)] for f in ctx.aggregations]
+
+    def key_fn(r):
+        return ("b%02d" % r.randint(0, 25), r.randint(0, 40))
+
+    tables = _gb_tables(rng, 5, 400, aggs_fn, key_fn)
+    rd, sd, _ = DEV.reduce(ctx, tables)
+    rv, _, _ = VEC.reduce(ctx, [_wire(t) for t in tables])
+    ro, _, _ = ORA.reduce(ctx, [_wire(t) for t in tables])
+    _assert_bit_identical(rd, rv, sql)
+    _assert_bit_identical(rd, ro, sql)
+    assert sd.reduce_path == "device", (sql, sd.decisions)
+    assert not _device_declines(sd)
+
+
+def test_device_sort_rung_parity(monkeypatch, eight_devices):
+    """Composite spaces past the dense slot budget ride the sort rung
+    (all_gather + global argsort + rank scatter) — same bit parity."""
+    monkeypatch.setattr(reduce_device, "DENSE_SLOTS", 1)
+    ctx = compile_query(
+        "SELECT k, sum(v), count(*) FROM t GROUP BY k "
+        "ORDER BY sum(v) DESC, k LIMIT 500")
+    tables = _gb_tables(
+        random.Random(3), 6, 500,
+        lambda r: [float(r.randint(0, 9999)), r.randint(1, 5)],
+        lambda r: (r.randint(-(1 << 40), 1 << 40),),
+        schema_types={"k": "LONG"})
+    rd, sd, _ = DEV.reduce(ctx, tables)
+    ro, _, _ = ORA.reduce(ctx, [_wire(t) for t in tables])
+    _assert_bit_identical(rd, ro)
+    assert sd.reduce_path == "device", sd.decisions
+    assert not _device_declines(sd)
+
+
+def test_device_dense_a2a_flavor_parity(monkeypatch, eight_devices):
+    """Dense slot spaces past ``_PSUM_SLOTS`` combine with the
+    all_to_all slice exchange instead of psum (each device folds one
+    slot-space slice; sharded outputs reassemble on the host) — same
+    bit parity, same live-slot compaction."""
+    monkeypatch.setattr(reduce_device, "_PSUM_SLOTS", 1)
+    ctx = compile_query(
+        "SELECT k, sum(v), min(v), max(v), count(*) FROM t GROUP BY k "
+        "ORDER BY sum(v) DESC, k LIMIT 500")
+    tables = _gb_tables(
+        random.Random(7), 6, 500,
+        lambda r: [float(r.randint(0, 9999)), float(r.randint(0, 99)),
+                   float(r.randint(100, 199)), r.randint(1, 5)],
+        lambda r: (r.randint(0, 800),), schema_types={"k": "INT"})
+    rd, sd, _ = DEV.reduce(ctx, tables)
+    ro, _, _ = ORA.reduce(ctx, [_wire(t) for t in tables])
+    _assert_bit_identical(rd, ro)
+    assert sd.reduce_path == "device", sd.decisions
+    assert not _device_declines(sd)
+
+
+def test_device_num_groups_limit_trim_parity(eight_devices):
+    svc_d = BrokerReduceService(num_groups_limit=50, vectorized=True,
+                                device_reduce=True)
+    svc_o = BrokerReduceService(num_groups_limit=50, vectorized=False)
+    ctx = compile_query("SELECT k, count(*) FROM t GROUP BY k LIMIT 100000")
+
+    def build():
+        return _gb_tables(
+            random.Random(11), 4, 60, lambda r: [r.randint(1, 5)],
+            lambda r: (r.randint(0, 500),), schema_types={"k": "INT"})
+
+    rd, sd, _ = svc_d.reduce(ctx, build())
+    ro, so, _ = svc_o.reduce(ctx, [_wire(t) for t in build()])
+    _assert_bit_identical(rd, ro)
+    assert sd.reduce_path == "device"
+    assert sd.num_groups_limit_reached and so.num_groups_limit_reached
+
+
+def test_device_route_query_option_override(eight_devices):
+    """OPTION(deviceReduce=...) flips the route per query, both ways."""
+    ctx = compile_query("SELECT k, sum(v) FROM t GROUP BY k LIMIT 1000")
+    tables = _gb_tables(random.Random(5), 3, 100,
+                        lambda r: [float(r.randint(0, 100))],
+                        lambda r: ("g%02d" % r.randint(0, 30),),
+                        schema_types={"k": "STRING"})
+    ctx.options["deviceReduce"] = "true"
+    _, s_on, _ = VEC.reduce(ctx, tables)     # default-off service
+    assert s_on.reduce_path == "device"
+    ctx.options["deviceReduce"] = "false"
+    _, s_off, _ = DEV.reduce(ctx, tables)    # default-on service
+    assert s_off.reduce_path == "vectorized"
+    assert not _device_declines(s_off)       # opted out, not declined
+
+
+# --------------------------------------------------------------------------
+# SSB: all 13 flights, three paths bit-identical on the 8-device mesh
+# --------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def ssb_server_tables(tmp_path_factory, eight_devices):
+    """Two 'servers' (host executors over disjoint segment halves) share
+    the process with the device reduce — the embedded-cluster topology.
+    Their tables are handed to the device service AS BUILT (in-process,
+    wire_decoded=False); host paths get wire round-tripped copies."""
+    from pinot_tpu.engine import ServerQueryExecutor
+    from pinot_tpu.tools import ssb
+
+    out = tmp_path_factory.mktemp("ssb_reduce_dev_segs")
+    segs = ssb.build_segments(0, str(out), num_segments=4, rows=40_000)
+    servers = [ServerQueryExecutor(use_device=False),
+               ServerQueryExecutor(use_device=False)]
+    halves = [segs[:2], segs[2:]]
+
+    def run(sql: str):
+        ctx = compile_query(sql)
+        return ctx, [srv.execute_instance(ctx, half)
+                     for srv, half in zip(servers, halves)]
+
+    return run
+
+
+from pinot_tpu.tools import ssb as _ssb_queries  # noqa: E402
+
+
+@pytest.mark.parametrize("qid", sorted(_ssb_queries.QUERIES))
+def test_ssb_flight_device_parity(ssb_server_tables, qid):
+    from pinot_tpu.tools import ssb
+
+    ctx, tables = ssb_server_tables(ssb.QUERIES[qid] + " LIMIT 100000")
+    rd, sd, _ = DEV.reduce(ctx, tables)
+    rv, _, _ = VEC.reduce(ctx, [_wire(t) for t in tables])
+    ro, _, _ = ORA.reduce(ctx, [_wire(t) for t in tables])
+    _assert_bit_identical(rd, rv, qid)
+    _assert_bit_identical(rd, ro, qid)
+    if ctx.group_by and rd.rows:
+        # every SSB group-by flight that merges groups must SERVE from
+        # the device path — a decline here is a regression
+        assert sd.reduce_path == "device", (qid, sd.decisions)
+        assert not _device_declines(sd), (qid, sd.decisions)
+    elif ctx.group_by:
+        # empty group set (Q3.4's filter matches no rows in the small
+        # fixture): nothing reaches the device merge, but nothing may
+        # DECLINE either
+        assert not _device_declines(sd), (qid, sd.decisions)
+    else:
+        # Q1.x are scalar aggregations: no group-by block to merge
+        assert sd.reduce_path == "vectorized", (qid, sd.reduce_path)
+
+
+# --------------------------------------------------------------------------
+# decline shapes: each fallback fires loudly with its registered reason
+# --------------------------------------------------------------------------
+
+def _expect_decline(ctx, tables, reason, oracle_parity=False):
+    """DEV declines to the vectorized host path with ``reason`` on the
+    ledger; rows stay bit-identical to the next rung down."""
+    rd, sd, _ = DEV.reduce(ctx, tables)
+    key = f"reduce:device->host:{reason}"
+    assert key in sd.decisions, (reason, sd.decisions)
+    assert reason in tracing.REDUCE_DEVICE_REASONS
+    ref_svc = ORA if oracle_parity else VEC
+    rr, _, _ = ref_svc.reduce(ctx, [_wire(t) for t in tables])
+    _assert_bit_identical(rd, rr, reason)
+    return sd
+
+
+def test_decline_obj_state(eight_devices):
+    """avg ships (sum, count) tuple states — obj kind, host fold only."""
+    ctx = compile_query(
+        "SELECT k, avg(v) FROM t GROUP BY k ORDER BY k LIMIT 100")
+    tables = _gb_tables(
+        random.Random(2), 3, 50,
+        lambda r: [(float(r.randint(0, 500)), r.randint(1, 9))],
+        lambda r: ("a%02d" % r.randint(0, 20),),
+        schema_types={"k": "STRING"})
+    sd = _expect_decline(ctx, tables, "reduce_device_obj_state")
+    assert sd.reduce_path == "vectorized"
+
+
+def test_decline_nan_key(eight_devices):
+    """NaN group keys: NaN != NaN breaks composite-key group identity,
+    so the device route declines (the host vectorized path gives every
+    NaN row its own run — both host paths agree)."""
+    ctx = compile_query("SELECT k, count(*) FROM t GROUP BY k LIMIT 100")
+    t1 = DataTable.for_group_by(
+        {(1.5,): [3], (float("nan"),): [5]}, {"k": "DOUBLE"}, QueryStats())
+    t2 = DataTable.for_group_by(
+        {(1.5,): [2], (2.5,): [1]}, {"k": "DOUBLE"}, QueryStats())
+    _expect_decline(ctx, [t1, t2], "reduce_device_nan_key")
+
+
+def test_decline_i64_sum_bound(eight_devices):
+    """i64 sums near 2^62: BOTH rungs decline — the device record first,
+    then the vectorized path's own bound record — and the oracle's
+    python-int arithmetic is the contract."""
+    ctx = compile_query("SELECT k, sum(v) FROM t GROUP BY k LIMIT 10")
+    t1 = DataTable.for_group_by({("a",): [1 << 61]}, {}, QueryStats())
+    t2 = DataTable.for_group_by({("a",): [1 << 61]}, {}, QueryStats())
+    sd = _expect_decline(ctx, [t1, t2], "reduce_device_i64_sum_bound",
+                         oracle_parity=True)
+    assert "reduce:vectorized->row_path:reduce_i64_sum_bound" \
+        in sd.decisions
+    assert sd.reduce_path == "oracle"
+
+
+def test_decline_cross_process(eight_devices):
+    """Wire-decoded tables already paid D2H + serialization: the device
+    premise is gone, the host lexsort is the frame."""
+    ctx = compile_query("SELECT k, sum(v) FROM t GROUP BY k LIMIT 1000")
+    tables = [_wire(t) for t in _gb_tables(
+        random.Random(9), 3, 80, lambda r: [float(r.randint(0, 100))],
+        lambda r: (r.randint(0, 40),), schema_types={"k": "INT"})]
+    rd, sd, _ = DEV.reduce(ctx, tables)
+    assert "reduce:device->host:reduce_device_cross_process" \
+        in sd.decisions, sd.decisions
+    assert sd.reduce_path == "vectorized"
+    rv, _, _ = VEC.reduce(ctx, tables)
+    _assert_bit_identical(rd, rv)
+
+
+def test_decline_mesh_unavailable(monkeypatch, eight_devices):
+    monkeypatch.setattr(reduce_device, "broker_mesh", lambda: None)
+    ctx = compile_query("SELECT k, count(*) FROM t GROUP BY k LIMIT 100")
+    tables = _gb_tables(random.Random(4), 2, 30,
+                        lambda r: [r.randint(1, 9)],
+                        lambda r: (r.randint(0, 20),),
+                        schema_types={"k": "INT"})
+    _expect_decline(ctx, tables, "reduce_device_mesh_unavailable")
+
+
+def test_decline_rows_over_capacity(monkeypatch, eight_devices):
+    monkeypatch.setattr(reduce_device, "MAX_MERGE_ROWS", 16)
+    ctx = compile_query("SELECT k, count(*) FROM t GROUP BY k LIMIT 1000")
+    tables = _gb_tables(random.Random(6), 4, 50,
+                        lambda r: [r.randint(1, 9)],
+                        lambda r: (r.randint(0, 999),),
+                        schema_types={"k": "INT"})
+    _expect_decline(ctx, tables, "reduce_device_rows_over_capacity")
+
+
+def test_decline_key_space_overflow(eight_devices):
+    """Two wide-range i64 key columns whose composite space cannot fit
+    the i64 budget decline loudly instead of wrapping."""
+    ctx = compile_query(
+        "SELECT k1, k2, count(*) FROM t GROUP BY k1, k2 LIMIT 100")
+    big = 1 << 40
+    t1 = DataTable.for_group_by(
+        {(0, 0): [1], (big, big): [2]},
+        {"k1": "LONG", "k2": "LONG"}, QueryStats())
+    t2 = DataTable.for_group_by(
+        {(0, 0): [3], (big, 0): [4]},
+        {"k1": "LONG", "k2": "LONG"}, QueryStats())
+    _expect_decline(ctx, [t1, t2], "reduce_device_key_space_overflow")
+
+
+def test_decline_f64_sum_order(eight_devices):
+    """Fractional f64 sums are order-dependent; only the host reduceat
+    order is the contract, so the device path refuses them."""
+    ctx = compile_query("SELECT k, sum(v) FROM t GROUP BY k LIMIT 100")
+    t1 = DataTable.for_group_by({("a",): [1.5]}, {}, QueryStats())
+    t2 = DataTable.for_group_by({("a",): [2.25]}, {}, QueryStats())
+    _expect_decline(ctx, [t1, t2], "reduce_device_f64_sum_order")
+
+
+def test_decline_kernel_error(monkeypatch, eight_devices):
+    """A kernel-build/run failure falls back, never crashes the query."""
+    def boom(*a, **k):
+        raise RuntimeError("synthetic kernel failure")
+
+    monkeypatch.setattr(reduce_device, "device_group_merge", boom)
+    ctx = compile_query("SELECT k, count(*) FROM t GROUP BY k LIMIT 100")
+    tables = _gb_tables(random.Random(8), 2, 30,
+                        lambda r: [r.randint(1, 9)],
+                        lambda r: (r.randint(0, 20),),
+                        schema_types={"k": "INT"})
+    _expect_decline(ctx, tables, "reduce_device_kernel_error")
+
+
+# --------------------------------------------------------------------------
+# registry + stats plumbing
+# --------------------------------------------------------------------------
+
+def test_reduce_device_reasons_registered():
+    """The namespace is in the unified registry, exact (every code has a
+    live ``_decline_device`` record site in broker/reduce.py), and
+    disjoint from the vectorized->oracle reason set."""
+    ns = tracing.reason_registry("reduce_device")
+    assert ns.codes == tracing.REDUCE_DEVICE_REASONS
+    assert ns.exact
+    found, unregistered = ns.conformance()
+    assert found == tracing.REDUCE_DEVICE_REASONS
+    assert not unregistered
+    assert not (tracing.REDUCE_DEVICE_REASONS
+                & tracing.REDUCE_DECISION_REASONS)
+
+
+def test_reduce_path_survives_the_wire(eight_devices):
+    """``reducePath`` round-trips DataTable stats framing (the bench's
+    cluster suite reads it off BrokerResponse.stats)."""
+    st = QueryStats()
+    st.reduce_path = "device"
+    t = _wire(DataTable.for_group_by({("a",): [1]}, {}, st))
+    assert t.stats.reduce_path == "device"
+    assert t.wire_decoded
+    merged = QueryStats()
+    merged.merge(t.stats)
+    assert merged.reduce_path == "device"
+    assert QueryStats().to_dict().get("reducePath") is None

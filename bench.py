@@ -75,7 +75,7 @@ WARMUP = 1
 ITERS = 5
 
 SUITES = ("ssb", "qps", "micro", "startree", "sketches", "residency",
-          "cluster", "reduce")
+          "cluster", "reduce", "realtime")
 
 
 def _log(msg: str) -> None:
@@ -311,6 +311,9 @@ _TRAJECTORY_KEYS = {
     # headline = vectorized group-by reduce wall time on the 180k-group
     # merge (the suite's own parity/speedup gates run inside bench_reduce)
     "reduce": ("p50_ms", False),
+    # headline = consuming-segment write throughput; freshness/seal gates
+    # run inside bench_realtime (finite p99, no unexplained host spills)
+    "realtime": ("write_qps", True),
 }
 REGRESSION_X = 1.3
 
@@ -570,7 +573,8 @@ class _Worker:
                           ("sketches", self.bench_sketches),
                           ("residency", self.bench_residency),
                           ("cluster", self.bench_cluster),
-                          ("reduce", self.bench_reduce)):
+                          ("reduce", self.bench_reduce),
+                          ("realtime", self.bench_realtime)):
             if suite in self.skip:
                 _log(f"{suite}: already chip-served, skipping")
                 continue
@@ -1475,6 +1479,138 @@ class _Worker:
                 f"over the row-path oracle (want >=3x); set "
                 f"BENCH_ALLOW_SLOW_REDUCE=1 to record anyway")
         return rec
+
+    def bench_realtime(self) -> dict:
+        """Realtime serving tier (PR-17): consuming-segment write QPS,
+        ingest-to-queryable freshness p50/p99 under a concurrent query
+        cadence (the serve path's per-row freshness histogram), device
+        group-by latency on the consuming segment, and the
+        mutable->immutable seal wall-time through the real commit path
+        (default star-tree stamped at seal). LOUD-FAIL: every
+        device-eligible query on the consuming segment must serve from
+        the mutable_device rung — a host spill means the staging tier
+        regressed (BENCH_ALLOW_MUTABLE_HOST=1 records anyway), and the
+        sealed segment must serve from startree_device."""
+        import math
+
+        from pinot_tpu.common.telemetry import TELEMETRY
+        from pinot_tpu.engine import ServerQueryExecutor
+        from pinot_tpu.ingestion import MemoryStream
+        from pinot_tpu.ingestion.realtime import (
+            ConsumerState,
+            RealtimeSegmentDataManager,
+        )
+        from pinot_tpu.ingestion.stream import StreamOffset
+        from pinot_tpu.query import compile_query
+        from pinot_tpu.segment import load_segment
+        from pinot_tpu.segment.mutable import MutableSegment
+        from pinot_tpu.spi import DataType, FieldSpec, FieldType, Schema
+        from pinot_tpu.spi.table import (
+            SegmentsValidationConfig,
+            StreamIngestionConfig,
+            TableConfig,
+            TableType,
+        )
+
+        schema = Schema("rtbench", [
+            FieldSpec("city", DataType.STRING, FieldType.DIMENSION),
+            FieldSpec("clicks", DataType.LONG, FieldType.METRIC),
+            FieldSpec("price", DataType.DOUBLE, FieldType.METRIC),
+            FieldSpec("ts", DataType.LONG, FieldType.DATE_TIME),
+        ])
+        cities = [f"city{i:03d}" for i in range(64)]
+        rng = np.random.default_rng(7)
+        n_rows = int(os.environ.get("BENCH_REALTIME_ROWS", 40_000))
+        query_every = max(1, n_rows // 10)
+
+        def make_row(i):
+            return {"city": cities[int(rng.integers(64))],
+                    "clicks": int(rng.integers(1000)),
+                    "price": float(rng.integers(10_000)) / 4.0,
+                    "ts": 1_600_000_000_000 + i}
+
+        dev = ServerQueryExecutor(use_device=True)
+        sql = ("SELECT city, count(*), sum(clicks) FROM rtbench "
+               "GROUP BY city LIMIT 100")
+        q = compile_query(sql)
+
+        # -- write QPS + freshness under a query cadence ----------------
+        seg = MutableSegment(schema, "rtbench__0__0__b",
+                             capacity=max(n_rows, 1024))
+        rungs, query_ms, index_s = [], [], 0.0
+        for start in range(0, n_rows, query_every):
+            t0 = time.perf_counter()
+            for i in range(start, min(start + query_every, n_rows)):
+                seg.index(make_row(i))
+            index_s += time.perf_counter() - t0
+            t0 = time.perf_counter()
+            _, qstats = dev.execute(q, [seg])
+            query_ms.append((time.perf_counter() - t0) * 1e3)
+            rungs.append(qstats.group_by_rung)
+        write_qps = n_rows / max(index_s, 1e-9)
+
+        spills = [r for r in rungs if r != "mutable_device"]
+        if spills and not os.environ.get("BENCH_ALLOW_MUTABLE_HOST"):
+            from pinot_tpu.common.tracing import LEDGER
+
+            declines = {k: v for k, v in LEDGER.reason_histogram().items()
+                        if k.startswith("mutable_")}
+            raise AssertionError(
+                f"realtime: {len(spills)}/{len(rungs)} consuming-segment "
+                f"queries spilled to {sorted(set(spills))} instead of "
+                f"mutable_device (mutable declines: {declines}) — the "
+                f"device staging tier regressed; set "
+                f"BENCH_ALLOW_MUTABLE_HOST=1 to record anyway")
+
+        fresh = TELEMETRY.histo("rtbench", "freshness").lifetime.snapshot()
+        assert fresh["count"] > 0, \
+            "realtime: serve path recorded no freshness observations"
+        assert math.isfinite(fresh["p99"]), fresh
+
+        # -- seal wall-time through the real commit path ----------------
+        seal_rows = min(n_rows, 20_000)
+        MemoryStream.create("bench_rt", 1)
+        try:
+            stream = MemoryStream.get("bench_rt")
+            for i in range(seal_rows):
+                stream.produce(make_row(i), partition=0)
+            cfg = TableConfig(
+                "rtbench", TableType.REALTIME,
+                validation_config=SegmentsValidationConfig(
+                    time_column_name="ts"),
+                stream_config=StreamIngestionConfig(
+                    stream_type="memory", topic="bench_rt",
+                    segment_flush_threshold_rows=seal_rows))
+            mgr = RealtimeSegmentDataManager(
+                "rtbench__0__0__s", cfg, schema, partition=0,
+                start_offset=StreamOffset(0),
+                output_dir=os.path.join(self.data_dir, "bench_rt_seal"))
+            res = mgr.consume_until_committed()
+            assert res.state is ConsumerState.COMMITTED, res.state
+            sealed = load_segment(res.segment_dir)
+            _, sstats = dev.execute(q, [sealed])
+            if sstats.group_by_rung != "startree_device" \
+                    and not os.environ.get("BENCH_ALLOW_MUTABLE_HOST"):
+                raise AssertionError(
+                    f"realtime: sealed segment served from "
+                    f"{sstats.group_by_rung!r}, not startree_device — the "
+                    f"seal-time default star-tree stamp regressed")
+            seal_ms = mgr.seal_wall_ms
+        finally:
+            MemoryStream.delete("bench_rt")
+
+        return {
+            "rows": n_rows,
+            "write_qps": round(write_qps, 1),
+            "freshness_p50_ms": fresh["p50"],
+            "freshness_p99_ms": fresh["p99"],
+            "freshness_rows": fresh["count"],
+            "query_p50_ms": round(float(np.percentile(query_ms, 50)), 3),
+            "consuming_rung": sorted(set(rungs)),
+            "seal_rows": seal_rows,
+            "seal_ms": round(seal_ms, 1),
+            "sealed_rung": sstats.group_by_rung,
+        }
 
 
 # ==========================================================================

@@ -198,6 +198,13 @@ class BrokerRequestHandler:
 
         return TELEMETRY.recorder.snapshot()
 
+    def freshness_snapshot(self) -> Dict[str, object]:
+        """``GET /debug/freshness``: per-table ingest-to-queryable
+        histograms + freshness-objective burn."""
+        from pinot_tpu.common.telemetry import TELEMETRY
+
+        return TELEMETRY.freshness_snapshot()
+
     def _handle_sql(self, sql: str, principal=None,
                     access_control=None) -> BrokerResponse:
         """``access_control``/``principal`` enable per-table authorization
@@ -348,7 +355,8 @@ class BrokerRequestHandler:
         # the stragglers' network wait; finish() below runs only the
         # final trim/HAVING/post-agg pass
         acc = self.reduce_service.accumulator(ctx)
-        for table, sub_ctx in self._split_hybrid(ctx, physical):
+        for table, sub_ctx in self._split_hybrid(ctx, physical,
+                                                 stats=broker_stats):
             t = time.perf_counter()
             route = self.routing.route(table, sub_ctx, stats=broker_stats)
             routing, unavailable = route.routing, route.unavailable
@@ -522,20 +530,42 @@ class BrokerRequestHandler:
             raise QueryError(f"table {raw_name!r} does not exist")
         return out
 
-    def _split_hybrid(self, ctx: QueryContext, physical: List[str]
+    @staticmethod
+    def _hybrid_route(stats, reason: str, chosen: str,
+                      declined: str) -> None:
+        """Time-boundary routing outcome onto the decision ledger (the
+        'hybrid' ReasonNamespace scans the first string literal)."""
+        from pinot_tpu.common.tracing import record_decision
+
+        record_decision(stats, "hybrid", chosen, declined, reason)
+
+    def _split_hybrid(self, ctx: QueryContext, physical: List[str],
+                      stats: Optional[QueryStats] = None
                       ) -> List[Tuple[str, QueryContext]]:
         """Hybrid tables get the time-boundary split
-        (ref: BaseBrokerRequestHandler attachTimeBoundary :2002)."""
+        (ref: BaseBrokerRequestHandler attachTimeBoundary :2002); every
+        outcome lands on the decision ledger."""
         if len(physical) < 2:
+            self._hybrid_route(stats, "hybrid_single_table", "direct",
+                               "time_split")
             return [(physical[0], ctx)]
         offline = next(t for t in physical if t.endswith("_OFFLINE"))
         realtime = next(t for t in physical if t.endswith("_REALTIME"))
         cfg = self.store.get_table_config(offline)
         tc = cfg.validation_config.time_column_name if cfg else None
         boundary = self.routing.time_boundary.get_boundary(offline)
-        if tc is None or boundary is None:
-            # no boundary yet: realtime serves everything
+        if tc is None:
+            # no time column: the split predicate can't be expressed
+            self._hybrid_route(stats, "hybrid_no_time_column",
+                               "realtime_all", "time_split")
             return [(realtime, ctx)]
+        if boundary is None:
+            # no boundary yet: realtime serves everything
+            self._hybrid_route(stats, "hybrid_no_boundary",
+                               "realtime_all", "time_split")
+            return [(realtime, ctx)]
+        self._hybrid_route(stats, "hybrid_time_split", "time_split",
+                           "realtime_all")
         off_pred = FilterNode(
             FilterOp.PREDICATE,
             predicate=Predicate(PredicateType.RANGE, Identifier(tc),

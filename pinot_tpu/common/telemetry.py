@@ -352,7 +352,8 @@ class SloTracker:
         self._counters: Dict[str, Tuple[WindowCounter, WindowCounter]] = {}  # guarded-by: _lock
 
     def set_objective(self, table: str, p99_ms: Optional[float] = None,
-                      error_pct: Optional[float] = None) -> None:
+                      error_pct: Optional[float] = None,
+                      freshness_ms: Optional[float] = None) -> None:
         with self._lock:
             obj = self._objectives.setdefault(
                 table, {"p99_ms": None, "error_pct": None})
@@ -360,6 +361,8 @@ class SloTracker:
                 obj["p99_ms"] = float(p99_ms)
             if error_pct is not None:
                 obj["error_pct"] = float(error_pct)
+            if freshness_ms is not None:
+                obj["freshness_ms"] = float(freshness_ms)
 
     def objectives(self) -> Dict[str, Dict[str, Optional[float]]]:
         with self._lock:
@@ -389,9 +392,10 @@ class SloTracker:
         return round((bad / total) / allowed, 4)
 
     def burn_rates(self, table: str,
-                   latency_histo: Optional[WindowedHistogram]
+                   latency_histo: Optional[WindowedHistogram],
+                   freshness_histo: Optional[WindowedHistogram] = None
                    ) -> Dict[str, Any]:
-        """Both objectives x both windows for one table."""
+        """Every objective x both windows for one table."""
         with self._lock:
             obj = dict(self._objectives.get(table) or {})
         out: Dict[str, Any] = {"objectives": obj}
@@ -425,6 +429,23 @@ class SloTracker:
                     "burnRate": self._burn(e, t, err_pct / 100.0),
                 }
             out["errors"] = err
+        fresh_ms = obj.get("freshness_ms")
+        if fresh_ms and freshness_histo is not None:
+            # ingest-to-queryable: each histogram sample is one row's
+            # append->first-covering-watermark latency; "bad" rows took
+            # longer than the objective to become queryable
+            fr: Dict[str, Any] = {}
+            for name, windows in (("short", SHORT_WINDOWS), ("long", None)):
+                h = self._sliding_subset(freshness_histo, windows)
+                over = h.count_over(fresh_ms)
+                fr[name] = {
+                    "rows": h.count,
+                    "overThreshold": over,
+                    "badFraction": round(over / h.count, 4) if h.count
+                    else 0.0,
+                    "burnRate": self._burn(over, h.count, _P99_ALLOWED),
+                }
+            out["freshness"] = fr
         return out
 
     @staticmethod
@@ -689,7 +710,8 @@ class Telemetry:
         self.p99_spike_factor = cfg.get_float(
             CommonConstants.FLIGHT_P99_FACTOR_KEY, self.p99_spike_factor)
         pat = re.compile(
-            r"pinot\.broker\.slo\.(?P<table>.+)\.(?P<kind>p99\.ms|error\.pct)$",
+            r"pinot\.broker\.slo\.(?P<table>.+)"
+            r"\.(?P<kind>p99\.ms|error\.pct|freshness\.ms)$",
             re.IGNORECASE)
         for raw in cfg.keys():
             m = pat.match(raw)
@@ -702,6 +724,8 @@ class Telemetry:
                 continue
             if kind == "p99.ms":
                 self.slo.set_objective(table, p99_ms=value)
+            elif kind == "freshness.ms":
+                self.slo.set_objective(table, freshness_ms=value)
             else:
                 self.slo.set_objective(table, error_pct=value)
 
@@ -841,9 +865,31 @@ class Telemetry:
         with self._lock:
             histos = dict(self._histos)
         return {
-            "tables": {t: self.slo.burn_rates(t, histos.get((t, "broker")))
-                       for t in sorted(tables)},
+            "tables": {t: self.slo.burn_rates(
+                t, histos.get((t, "broker")),
+                freshness_histo=histos.get((t, "freshness")))
+                for t in sorted(tables)},
         }
+
+    def freshness_snapshot(self) -> Dict[str, Any]:
+        """``/debug/freshness`` body: per table with a ``freshness``
+        histogram, the ingest-to-queryable quantiles (sliding + lifetime)
+        plus the freshness objective/burn when one is configured."""
+        with self._lock:
+            histos = {t: h for (t, p), h in self._histos.items()
+                      if p == "freshness"}
+        objectives = self.slo.objectives()
+        out: Dict[str, Any] = {"tables": {}}
+        for t in sorted(histos):
+            h = histos[t]
+            body: Dict[str, Any] = {"histogram": h.snapshot()}
+            obj = (objectives.get(t) or {}).get("freshness_ms")
+            if obj:
+                body["objectiveMs"] = obj
+                body["burn"] = self.slo.burn_rates(
+                    t, None, freshness_histo=h).get("freshness")
+            out["tables"][t] = body
+        return out
 
     def burn_gauges(self) -> Dict[Tuple[str, str, str], float]:
         """(table, objective, window) -> burn rate, for the
@@ -851,7 +897,8 @@ class Telemetry:
         out: Dict[Tuple[str, str, str], float] = {}
         snap = self.slo_snapshot()["tables"]
         for table, body in snap.items():
-            for objective, key in (("p99", "latency"), ("error", "errors")):
+            for objective, key in (("p99", "latency"), ("error", "errors"),
+                                   ("freshness", "freshness")):
                 for window, cell in (body.get(key) or {}).items():
                     burn = cell.get("burnRate")
                     if burn is not None:

@@ -600,6 +600,37 @@ _register_reasons(ReasonNamespace(
     "pinot_tpu.tools.preflight",
     literal_patterns=(r'_Rule\(\s*"([a-z0-9_]+)"',), min_sites=5,
     exact=True))
+# realtime serving tier (PR-17): consuming-segment device declines,
+# broker hybrid time-boundary routing, and the seal swap
+MUTABLE_DECLINE_REASONS = frozenset({
+    "mutable_empty_watermark",   # nothing published yet: host answers
+    "mutable_hll_lut_unstable",  # HLL register LUTs go stale as the
+                                 # dictionary grows mid-consume
+    "mutable_exec_failed",       # staging/kernel raised: host fallback
+})
+HYBRID_ROUTE_REASONS = frozenset({
+    "hybrid_single_table",    # only one physical table: no split
+    "hybrid_no_time_column",  # split predicate inexpressible
+    "hybrid_no_boundary",     # boundary not published: realtime serves all
+    "hybrid_time_split",      # offline <= boundary < realtime
+})
+SEAL_SWAP_REASONS = frozenset({
+    "seal_swap",      # local consumer committed: mutable -> immutable
+    "seal_download",  # replica download of a sealed segment
+})
+_register_reasons(ReasonNamespace(
+    "mutable", MUTABLE_DECLINE_REASONS,
+    "pinot_tpu.engine.mutable_staging",
+    literal_patterns=(
+        r'_decline\(\s*[a-zA-Z_][a-zA-Z0-9_]*\s*,\s*"([a-z0-9_]+)"',),
+    min_sites=3, exact=True))
+_register_reasons(ReasonNamespace(
+    "hybrid", HYBRID_ROUTE_REASONS, "pinot_tpu.broker.broker",
+    literal_patterns=(r'_hybrid_route\(\s*stats,\s*"([a-z0-9_]+)"',),
+    min_sites=4, exact=True))
+_register_reasons(ReasonNamespace(
+    "seal", SEAL_SWAP_REASONS, "pinot_tpu.server.data_manager",
+    literal_patterns=(r'"(seal_[a-z0-9_]+)"',), min_sites=2, exact=True))
 
 
 _SANITIZE = re.compile(r"[^a-z0-9]+")

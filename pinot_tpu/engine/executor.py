@@ -119,8 +119,11 @@ class ServerQueryExecutor:
         # (sql, segment) -> (segment identity, SegmentPlan): the per-segment
         # analogue of the sharded executor's query cache — repeat queries
         # skip predicate translation / LUT builds. Safe because params no
-        # longer embed mutable state (the upsert mask is a placeholder
-        # filled per run). LRU-bounded.
+        # longer embed mutable state: the upsert validdocs placeholder is
+        # filled per run — immutable segments from staged.valid_mask(),
+        # consuming segments from the watermark snapshot's device mask
+        # (mutable_staging._serve). LRU-bounded; mutable segments bypass
+        # the cache entirely (their plans are watermark-specific).
         import threading
         from collections import OrderedDict
 
@@ -648,13 +651,21 @@ class ServerQueryExecutor:
             result, rung = st
             return done(result, rung)
         if self.use_device and self._device_admitted(stats):
-            try:
-                plan = self._plan_for(ctx, seg)
-                return done(self._run_device_scalar(plan, seg, stats),
-                            "device")
-            except PlanError as e:
-                record_decision(stats, "plan", "host_engine",
-                                "device_kernel", e.reason_code)
+            if getattr(seg, "is_mutable", False):
+                from pinot_tpu.engine import mutable_staging
+
+                res = mutable_staging.serve_aggregation(self, ctx, aggs,
+                                                        seg, stats)
+                if res is not None:
+                    return done(res, "mutable_device")
+            else:
+                try:
+                    plan = self._plan_for(ctx, seg)
+                    return done(self._run_device_scalar(plan, seg, stats),
+                                "device")
+                except PlanError as e:
+                    record_decision(stats, "plan", "host_engine",
+                                    "device_kernel", e.reason_code)
         with maybe_span(stats, "HostScan", segment=seg.segment_name):
             return done(host_engine.host_aggregate_segment(ctx, aggs, seg,
                                                            stats), "host")
@@ -763,6 +774,10 @@ class ServerQueryExecutor:
         (ref: MetadataBasedAggregationOperator, DictionaryBasedAggregationOperator)."""
         if ctx.filter is not None or ctx.is_group_by:
             return None
+        if getattr(seg, "is_mutable", False):
+            # consuming segment: live dictionary min/max can include an
+            # in-flight (unpublished) row — answer from a real scan
+            return None
         if getattr(seg, "valid_doc_ids", None) is not None:
             # upsert: metadata counts/extremes include invalidated docs
             # (ref: the fast paths require allDocsMatch + no validDocIds)
@@ -819,13 +834,22 @@ class ServerQueryExecutor:
             stats.group_by_rung = rung
             return done(result, rung)
         if self.use_device and self._device_admitted(stats):
-            try:
-                plan = self._plan_for(ctx, seg)
-                return done(self._run_device_grouped(plan, seg, stats),
-                            "device")
-            except PlanError as e:
-                record_decision(stats, "plan", "host_engine",
-                                "device_kernel", e.reason_code)
+            if getattr(seg, "is_mutable", False):
+                from pinot_tpu.engine import mutable_staging
+
+                res = mutable_staging.serve_group_by(self, ctx, aggs,
+                                                     seg, stats)
+                if res is not None:
+                    stats.group_by_rung = "mutable_device"
+                    return done(res, "mutable_device")
+            else:
+                try:
+                    plan = self._plan_for(ctx, seg)
+                    return done(self._run_device_grouped(plan, seg, stats),
+                                "device")
+                except PlanError as e:
+                    record_decision(stats, "plan", "host_engine",
+                                    "device_kernel", e.reason_code)
         stats.group_by_rung = "host"
         with maybe_span(stats, "HostScan", segment=seg.segment_name):
             return done(host_engine.host_group_by_segment(ctx, aggs, seg,

@@ -1,0 +1,513 @@
+"""Device-resident staging for mutable (consuming) segments.
+
+The realtime serving tier's device half (ref: the consuming-segment query
+path of ``MutableSegmentImpl`` + ``RealtimeSegmentDataManager``): a
+:class:`StagedMutableSegment` keeps chunked append-only device columns for
+one consuming segment and re-serves them through the SAME fused jnp kernel
+path immutable segments use — the host engine remains the fallback for
+shapes the planner declines.
+
+Design points (SURVEY.md §7 said "host-resident forever"; this module is
+the revision that makes the device tier incremental instead):
+
+- **Chunked append-only columns, delta-only H2D.** Device buffers grow by
+  power-of-two *row capacity*; growth copies history device-side
+  (``zeros.at[:old].set(chunk)``) so the PCIe/ICI wire only ever carries
+  rows past the last staged watermark (the TPU v4 HBM cost model in
+  PAPERS.md: incremental H2D beats restage by the ratio of delta to
+  history). Dictionary value tables ride the same scheme in dictId space —
+  ``MutableDictionary`` assigns ids in arrival order, so a staged prefix
+  is never invalidated by later inserts (dictId-stable growth).
+- **Per-query watermark snapshot.** ``snapshot()`` captures, under the
+  resident lock, the row watermark ``wm = segment.num_docs``, the chunk
+  capacity, the column trees, and the upsert valid-doc mask as ONE frozen
+  view; the kernel then runs over exactly ``num_docs = wm`` rows (rows
+  past the watermark sit masked behind the kernel's ``arange(capacity) <
+  num_docs`` guard, so garbage in not-yet-overwritten chunk tails is
+  unreachable). Reading ``wm`` *before* any dictionary cardinality means
+  every dictId referenced by a row below the watermark is covered by the
+  staged value tables (the writer inserts dictionary values before
+  publishing ``_num_docs``).
+- **Residency-managed.** The resident registers with
+  :class:`~pinot_tpu.engine.residency.ResidencyManager` under
+  ``mutable::<segment>`` (leases, pins, byte accounting); eviction demotes
+  the segment back to the host engine — the next device query simply
+  restages from the host-side mutable columns.
+- **Declines are ledger records.** Ineligible shapes (HLL register LUTs
+  go stale as the dictionary grows; empty watermark; kernel failure) fall
+  back to the host engine through ``_decline`` — every reason code below
+  is registered in ``tracing.reason_registry()['mutable']`` and scanned
+  by the conformance harness.
+
+Conservation contract (machine-enforced by the lint ``conservation``
+family's cache-parity AND chunk-accounting rules): every field this class
+populates outside ``__init__`` must be counted in ``nbytes()`` and
+cleared in ``release()``, and every chunk store must reach the running
+byte counter on all paths — chunk installs route through
+``_install_locked()``, which recounts immediately.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+
+from typing import Any, Dict, List, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from pinot_tpu.common.telemetry import TELEMETRY, observe_ms
+from pinot_tpu.common.tracing import maybe_span, record_decision
+from pinot_tpu.engine.plan import PlanError, plan_segment
+from pinot_tpu.segment import metadata as meta
+from pinot_tpu.segment.mutable import (
+    MutableDataSource,
+    MutableSegment,
+    _SnapshotColumns,
+)
+
+log = logging.getLogger(__name__)
+
+#: residency-manager key prefix for consuming-segment residents (the seal
+#: swap evicts ``resident_name(segment)`` when the immutable build lands)
+MUTABLE_RESIDENT_PREFIX = "mutable::"
+
+_MIN_CHUNK_ROWS = 1024
+
+
+def resident_name(segment_name: str) -> str:
+    return MUTABLE_RESIDENT_PREFIX + segment_name
+
+
+def _chunk_capacity(n: int, floor: int = _MIN_CHUNK_ROWS) -> int:
+    """Power-of-two chunk capacity covering ``n`` (kernel retraces are
+    bounded: the spec's capacity only moves on pow2 boundaries)."""
+    cap = max(1, floor)
+    while cap < n:
+        cap *= 2
+    return cap
+
+
+def _dictvals_dtype(data_type) -> np.dtype:
+    """Schema-stable device dtype for a growing dictionary's value table.
+
+    Unlike the immutable path's stats-narrowed :func:`staged_int_dtype`,
+    the dtype here must never change as values arrive (a dtype flip would
+    force a full restage + kernel retrace mid-consume), so it derives from
+    the declared type alone: INT stays i32, LONG stays i64, floats ride
+    f32 like the immutable dictvals tables."""
+    if data_type.is_integral:
+        return np.dtype(np.int32) if np.dtype(data_type.stored_np).itemsize <= 4 \
+            else np.dtype(np.int64)
+    return np.dtype(np.float32)
+
+
+class MutableSnapshot:
+    """Frozen per-query view: the column trees + watermark captured under
+    the resident lock (jnp arrays are immutable; later refreshes replace
+    dict entries with NEW arrays, so holding these references is safe)."""
+
+    __slots__ = ("wm", "capacity", "cols", "valid_host", "valid_device")
+
+    def __init__(self, wm: int, capacity: int,
+                 cols: Dict[str, Dict[str, jnp.ndarray]],
+                 valid_host: Optional[np.ndarray],
+                 valid_device: Optional[jnp.ndarray]):
+        self.wm = wm
+        self.capacity = capacity
+        self.cols = cols
+        self.valid_host = valid_host
+        self.valid_device = valid_device
+
+    def tree(self, name: str) -> Dict[str, jnp.ndarray]:
+        return self.cols[name]
+
+
+class WatermarkView:
+    """Segment duck-type pinned to one snapshot: ``num_docs`` is the
+    watermark and ``padded_capacity`` the chunk capacity, so
+    ``plan_segment`` builds a spec that matches the staged arrays exactly.
+    Deliberately does NOT carry ``is_mutable`` — the planner's mutable
+    gate is the host-only legacy path this module supersedes. Dictionary
+    reads go to the LIVE mutable dictionary: ids at-or-past the snapshot
+    cardinality are unreferenced by rows below the watermark, so an EQ
+    hit on an in-flight value simply matches zero rows (correct), and
+    over-sized LUT/key spaces only cost empty groups the presence vector
+    drops at decode."""
+
+    def __init__(self, segment: MutableSegment, snap: MutableSnapshot):
+        self._seg = segment
+        self._wm = snap.wm
+        self.segment_name = segment.segment_name
+        self.num_docs = snap.wm
+        self.padded_capacity = snap.capacity
+        self.valid_doc_ids = snap.valid_host
+        self.schema = segment.schema
+        self.metadata = meta.SegmentMetadata(
+            segment_name=segment.segment_name,
+            table_name=segment.schema.schema_name,
+            schema=segment.schema,
+            num_docs=snap.wm,
+            padded_capacity=snap.capacity,
+            time_column=segment.time_column,
+            min_time=segment.min_time,
+            max_time=segment.max_time,
+            columns=_SnapshotColumns(segment, snap.wm),
+        )
+
+    def data_source(self, column: str) -> MutableDataSource:
+        col = self._seg._cols.get(column)
+        if col is None:
+            raise KeyError(f"column {column!r} not in segment "
+                           f"{self.segment_name!r}")
+        return MutableDataSource(self._seg, col, self._wm)
+
+
+class StagedMutableSegment:
+    """Chunked device image of one consuming segment (see module doc)."""
+
+    def __init__(self, segment: MutableSegment):
+        self.segment = segment
+        self._lock = threading.Lock()
+        # chunk key ("fwd:<col>" | "dictvals:<col>" | "mv:<col>" |
+        # "mvcount:<col>" | "null:<col>") -> device array
+        self._chunks: Dict[str, jnp.ndarray] = {}  # guarded-by: _lock
+        # staging cursors: "cap" (row chunk capacity), "wm" (last refreshed
+        # watermark), "rows:<col>" (rows staged), "dict:<col>" (dictionary
+        # values staged), "mvw:<col>" (dense MV width) — host ints only
+        self._cursor: Dict[str, int] = {}  # guarded-by: _lock
+        # running device-byte total, recounted by _install_locked on every chunk
+        # store (the lint chunk-accounting obligation)
+        self._staged_bytes = 0  # guarded-by: _lock
+        # (version, wm, cap)-keyed device snapshot of the upsert mask
+        self._valid_cache = None  # guarded-by: _lock
+
+    # -- accounting (conservation contract) ---------------------------------
+    def _recount_bytes_locked(self) -> None:
+        total = 0
+        for arr in self._chunks.values():
+            total += int(getattr(arr, "nbytes", 0))
+        self._staged_bytes = total
+
+    def _install_locked(self, key: str, arr: jnp.ndarray) -> None:
+        """The ONLY chunk store: every append reaches the byte counter."""
+        self._chunks[key] = arr
+        self._recount_bytes_locked()
+
+    def nbytes(self) -> int:
+        with self._lock:
+            total = 0
+            for arr in self._chunks.values():
+                total += int(getattr(arr, "nbytes", 0))
+            vc = self._valid_cache
+            if vc is not None:
+                total += int(getattr(vc[1], "nbytes", 0))
+            if self._cursor:
+                # the cursors hold host ints (no device bytes); the chunk
+                # walk and the running counter agree under the lock —
+                # max() is belt-and-braces for a torn future reader
+                total = max(total, int(self._staged_bytes))
+            return total
+
+    def release(self) -> None:
+        with self._lock:
+            self._chunks.clear()
+            self._cursor.clear()
+            self._staged_bytes = 0
+            self._valid_cache = None
+
+    # -- staging ------------------------------------------------------------
+    def snapshot(self) -> MutableSnapshot:
+        """Refresh the chunks up to the current watermark and return the
+        frozen per-query view (one lock hold: refresh + capture together,
+        so a concurrent refresh can never mix capacities in one view)."""
+        seg = self.segment
+        with self._lock:
+            # watermark FIRST: every dictId referenced by rows < wm is
+            # already inserted (the writer publishes _num_docs last), so
+            # the per-column cardinality reads below are >= what the
+            # staged rows need
+            wm = int(seg._num_docs)
+            cap = int(self._cursor.get("cap", 0))
+            if wm > cap or cap == 0:
+                new_cap = _chunk_capacity(wm)
+                if cap:
+                    self._regrow_rows_locked(new_cap)
+                cap = new_cap
+                self._cursor["cap"] = cap
+            for name, col in seg._cols.items():
+                self._refresh_column_locked(name, col, wm, cap)
+            self._cursor["wm"] = wm
+            cols = {name: self._tree_locked(name, col)
+                    for name, col in seg._cols.items()}
+            valid_host, valid_device = self._valid_locked(wm, cap)
+        return MutableSnapshot(wm, cap, cols, valid_host, valid_device)
+
+    def _regrow_rows_locked(self, cap: int) -> None:
+        """Double the row-capacity of every row-shaped chunk with a
+        device-side history copy (no H2D re-upload)."""
+        for key, arr in list(self._chunks.items()):
+            if key.startswith("dictvals:"):
+                continue  # dictId-shaped, grows on its own cursor
+            if arr.ndim == 1:
+                grown = jnp.zeros((cap,), dtype=arr.dtype)
+                grown = grown.at[:arr.shape[0]].set(arr)
+            else:
+                grown = jnp.zeros((cap, arr.shape[1]), dtype=arr.dtype)
+                grown = grown.at[:arr.shape[0], :].set(arr)
+            self._install_locked(key, grown)
+
+    def _refresh_column_locked(self, name: str, col, wm: int,
+                               cap: int) -> None:
+        staged = int(self._cursor.get(f"rows:{name}", 0))
+        sv = col.mv_offsets is None
+
+        if sv:
+            key = f"fwd:{name}"
+            chunk = self._chunks.get(key)
+            if chunk is None:
+                chunk = jnp.zeros((cap,), dtype=jnp.int32)
+            if wm > staged:
+                delta = np.ascontiguousarray(
+                    col.fwd.view(wm)[staged:wm]).astype(np.int32)
+                chunk = chunk.at[staged:wm].set(jnp.asarray(delta))
+            self._install_locked(key, chunk)
+        else:
+            self._refresh_mv_locked(name, col, staged, wm, cap)
+
+        if col.fs.data_type.is_numeric:
+            self._refresh_dictvals_locked(name, col, wm)
+
+        if col.has_nulls:
+            key = f"null:{name}"
+            chunk = self._chunks.get(key)
+            lo = staged
+            if chunk is None:
+                # has_nulls can flip mid-consume: the null store recorded
+                # every row from doc 0, so the first staging backfills the
+                # whole prefix
+                chunk = jnp.zeros((cap,), dtype=bool)
+                lo = 0
+            if wm > lo:
+                delta = np.ascontiguousarray(col.null.view(wm)[lo:wm])
+                chunk = chunk.at[lo:wm].set(jnp.asarray(delta))
+            self._install_locked(key, chunk)
+
+        self._cursor[f"rows:{name}"] = wm
+
+    def _refresh_mv_locked(self, name: str, col, staged: int, wm: int,
+                           cap: int) -> None:
+        width = int(self._cursor.get(f"mvw:{name}", 0))
+        need = _chunk_capacity(max(col.max_mv, 1), floor=1)
+        mv = self._chunks.get(f"mv:{name}")
+        cnt = self._chunks.get(f"mvcount:{name}")
+        if mv is None:
+            mv = jnp.zeros((cap, need), dtype=jnp.int32)
+            cnt = jnp.zeros((cap,), dtype=jnp.int32)
+            width = need
+            self._cursor[f"mvw:{name}"] = width
+        elif need > width:
+            # width growth pads device-side (history stays on device)
+            mv = jnp.pad(mv, ((0, 0), (0, need - width)))
+            width = need
+            self._cursor[f"mvw:{name}"] = width
+        if wm > staged:
+            off = np.asarray(col.mv_offsets.view(wm + 1), dtype=np.int64)
+            fwd = col.fwd.view(int(off[-1]))
+            block = np.zeros((wm - staged, width), dtype=np.int32)
+            counts = np.diff(off[staged:wm + 1]).astype(np.int32)
+            for i in range(staged, wm):
+                a, b = int(off[i]), int(off[i + 1])
+                block[i - staged, :b - a] = fwd[a:b]
+            mv = mv.at[staged:wm, :].set(jnp.asarray(block))
+            cnt = cnt.at[staged:wm].set(jnp.asarray(counts))
+        self._install_locked(f"mv:{name}", mv)
+        self._install_locked(f"mvcount:{name}", cnt)
+
+    def _refresh_dictvals_locked(self, name: str, col, wm: int) -> None:
+        card = len(col.dictionary)
+        if card == 0:
+            return
+        staged = int(self._cursor.get(f"dict:{name}", 0))
+        dt = _dictvals_dtype(col.fs.data_type)
+        key = f"dictvals:{name}"
+        chunk = self._chunks.get(key)
+        dcap = int(chunk.shape[0]) if chunk is not None else 0
+        if card > dcap:
+            new_dcap = _chunk_capacity(card, floor=_MIN_CHUNK_ROWS)
+            grown = jnp.zeros((new_dcap,), dtype=dt)
+            if chunk is not None:
+                grown = grown.at[:dcap].set(chunk)
+            chunk = grown
+        if card > staged:
+            # dictId-stable growth: ids are arrival-ordered, so the staged
+            # prefix never changes — only values [staged, card) cross H2D
+            vals = np.asarray(
+                col.dictionary.get_values(range(staged, card)), dtype=dt)
+            chunk = chunk.at[staged:card].set(jnp.asarray(vals))
+            self._cursor[f"dict:{name}"] = card
+        if chunk is not None:
+            self._install_locked(key, chunk)
+
+    def _tree_locked(self, name: str, col) -> Dict[str, jnp.ndarray]:
+        out: Dict[str, jnp.ndarray] = {}
+        if col.mv_offsets is None:
+            out["fwd"] = self._chunks[f"fwd:{name}"]
+        else:
+            out["mv"] = self._chunks[f"mv:{name}"]
+            out["mvcount"] = self._chunks[f"mvcount:{name}"]
+        dv = self._chunks.get(f"dictvals:{name}")
+        if dv is not None:
+            out["dictvals"] = dv
+        nc = self._chunks.get(f"null:{name}")
+        if nc is not None:
+            out["null"] = nc
+        return out
+
+    def _valid_locked(self, wm: int, cap: int):
+        """(host numpy snapshot, device snapshot) of the upsert valid-doc
+        bitmap at this watermark, or (None, None). Cached on (bitmap
+        version, wm, cap) — repeat queries at the same watermark skip the
+        O(capacity) copy and the H2D (the staging.valid_mask idiom)."""
+        v = getattr(self.segment, "valid_doc_ids", None)
+        if v is None:
+            return None, None
+        ver = getattr(v, "version", None)
+        cache_key = (ver, wm, cap)
+        cached = self._valid_cache
+        if ver is not None and cached is not None and cached[0] == cache_key:
+            return cached[2], cached[1]
+        snap = np.zeros(cap, dtype=bool)
+        snap[:wm] = np.asarray(v[:wm])
+        arr = jnp.asarray(snap)
+        if ver is not None:
+            self._valid_cache = (cache_key, arr, snap)
+        return snap, arr
+
+
+# --------------------------------------------------------------------------
+# freshness: event append -> first watermark covering it
+# --------------------------------------------------------------------------
+
+def observe_freshness(segment: Any, upto: int, table: str) -> None:
+    """Record ingest-to-queryable latency for every row first covered by
+    watermark ``upto`` into the ``(table, "freshness")`` windowed
+    histogram (the ``pinot.broker.slo.<table>.freshness.ms`` objective
+    burns against it). A per-segment cursor (``_fresh_observed``) makes
+    each row count exactly once — whichever of the serve path (watermark
+    snapshot) or the seal path (final flush) sees it first."""
+    lock = getattr(segment, "_fresh_lock", None)
+    ts = getattr(segment, "_append_ts", None)
+    if lock is None or ts is None or upto <= 0:
+        return
+    with lock:
+        start = int(segment._fresh_observed)
+        if upto <= start:
+            return
+        segment._fresh_observed = upto
+    now = time.monotonic()
+    h = TELEMETRY.histo(table or "", "freshness")
+    for t in np.asarray(ts.view(upto)[start:upto]):
+        h.record(max(0.0, (now - float(t)) * 1e3))
+
+
+# --------------------------------------------------------------------------
+# serve path (called from the executor's device branch for mutable segments)
+# --------------------------------------------------------------------------
+
+def _decline(stats, reason: str) -> None:
+    """Host fallback with a ledger record (scanned by the 'mutable'
+    ReasonNamespace — the first string literal is the reason code)."""
+    record_decision(stats, "mutable", "host_engine", "mutable_device",
+                    reason)
+
+
+def serve_group_by(executor, ctx, aggs: List[Any], seg: MutableSegment,
+                   stats) -> Optional[Any]:
+    return _serve(executor, ctx, aggs, seg, stats, grouped=True)
+
+
+def serve_aggregation(executor, ctx, aggs: List[Any], seg: MutableSegment,
+                      stats) -> Optional[Any]:
+    return _serve(executor, ctx, aggs, seg, stats, grouped=False)
+
+
+def _serve(executor, ctx, aggs, seg, stats, grouped: bool):
+    """Run one query over the consuming segment through the fused device
+    kernel path, or return None for the host-engine fallback (every None
+    is preceded by a ledger record)."""
+    from pinot_tpu.engine.executor import (
+        decode_grouped_result,
+        decode_scalar_result,
+    )
+    from pinot_tpu.engine.kernels import unpack_outputs
+
+    table = getattr(stats, "_tel_table", "") \
+        or getattr(seg.schema, "schema_name", "")
+
+    if any(a.base == "distinctcounthll" for a in aggs):
+        # the dictionary's HLL register LUTs are memoized per log2m and go
+        # stale as the dictionary grows — gathers past the LUT length land
+        # in the wrong bucket. Host engine computes HLL exactly.
+        _decline(stats, "mutable_hll_lut_unstable")
+        return None
+    if int(seg.num_docs) == 0:
+        _decline(stats, "mutable_empty_watermark")
+        return None
+
+    lease = executor._lease_of(stats)
+    name = resident_name(seg.segment_name)
+    with maybe_span(stats, "Stage", segment=seg.segment_name):
+        resident = executor.residency.register(
+            name, lambda: StagedMutableSegment(seg),
+            same=lambda r: getattr(r, "segment", None) is seg,
+            lease=lease)
+        try:
+            snap = resident.snapshot()
+        except Exception:
+            log.exception("mutable staging failed for %s; host fallback",
+                          seg.segment_name)
+            _decline(stats, "mutable_exec_failed")
+            return None
+        # chunks may have grown: re-measure + enforce the HBM budget
+        executor.residency.account(name, lease)
+    if snap.wm == 0:
+        _decline(stats, "mutable_empty_watermark")
+        return None
+
+    view = WatermarkView(seg, snap)
+    try:
+        plan = plan_segment(ctx, view)
+    except PlanError as e:
+        record_decision(stats, "plan", "host_engine", "mutable_device",
+                        e.reason_code)
+        return None
+
+    t0 = time.perf_counter()
+    try:
+        with maybe_span(stats, "Kernel", kernel="jnp",
+                        segment=seg.segment_name):
+            cols = {n: snap.tree(n) for n in plan.columns}
+            kernel = executor.kernels.get(plan.spec)
+            params = tuple(plan.params)
+            if plan.spec[0][:1] == ("and",) \
+                    and plan.spec[0][1][0] == ("validdocs",):
+                # fill the planner's placeholder with the snapshot's
+                # device mask (same watermark as the staged rows — the
+                # upsert filter and the data agree on one point in time)
+                params = (snap.valid_device,) + params[1:]
+            packed = kernel(cols, params, np.int32(snap.wm))
+            out = unpack_outputs(packed, plan.spec)
+    except Exception:
+        log.exception("mutable kernel failed for %s; host fallback",
+                      seg.segment_name)
+        _decline(stats, "mutable_exec_failed")
+        return None
+    observe_ms(table, "kernel", (time.perf_counter() - t0) * 1e3)
+    executor._track_kernel_stats(out, view, stats)
+    observe_freshness(seg, snap.wm, table)
+    if grouped:
+        return decode_grouped_result(plan, view, out)
+    return decode_scalar_result(plan, view, out)

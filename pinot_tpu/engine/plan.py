@@ -835,8 +835,20 @@ def _compile_predicate(pred: Predicate, segment: ImmutableSegment,
         if t is PredicateType.RANGE:
             lo = _conv(ds, pred.lower) if pred.lower is not None else None
             hi = _conv(ds, pred.upper) if pred.upper is not None else None
-            a, b = d.range_to_dict_id_interval(lo, hi, pred.lower_inclusive,
-                                               pred.upper_inclusive)
+            try:
+                a, b = d.range_to_dict_id_interval(lo, hi,
+                                                   pred.lower_inclusive,
+                                                   pred.upper_inclusive)
+            except TypeError:
+                # unsorted (mutable) dictionary: ids are arrival-ordered,
+                # so a contiguous interval doesn't exist — value-scan to a
+                # dictId LUT instead (same kernel op as IN)
+                ids = d.matching_range_ids(lo, hi, pred.lower_inclusive,
+                                           pred.upper_inclusive)
+                lut = np.zeros(d.cardinality, dtype=bool)
+                lut[ids] = True
+                params.append(lut)
+                return (mvp + "lut", col, card)
             params.append(np.array([a, b], dtype=np.int32))
             return (mvp + "range", col)
         if t in (PredicateType.IN, PredicateType.NOT_IN,

@@ -15,6 +15,7 @@ the dictionary's value array instead of using the sorted-interval property.
 
 from __future__ import annotations
 
+import threading
 import time
 
 from typing import Any, Dict, List, Optional, Sequence, Tuple
@@ -206,6 +207,12 @@ class MutableSegment:
         self.min_time: Optional[int] = None
         self.max_time: Optional[int] = None
         self.start_time_ms = int(time.time() * 1000)
+        # freshness SLO inputs: per-row append timestamp (monotonic) plus
+        # the watermark up to which ingest-to-queryable latency has been
+        # recorded (advanced by mutable_staging.observe_freshness)
+        self._append_ts = _GrowArray(np.float64)
+        self._fresh_observed = 0
+        self._fresh_lock = threading.Lock()
 
     # -- write path ---------------------------------------------------------
     #: key carrying null-field names from NullValueTransformer (the
@@ -227,6 +234,7 @@ class MutableSegment:
                 t = int(t)
                 self.min_time = t if self.min_time is None else min(self.min_time, t)
                 self.max_time = t if self.max_time is None else max(self.max_time, t)
+        self._append_ts.append(time.monotonic())
         # publish the new doc last (readers snapshot _num_docs)
         self._num_docs += 1
         return True
@@ -328,10 +336,14 @@ class MutableSegment:
 
     # -- seal ----------------------------------------------------------------
     def build_immutable(self, out_dir: str,
-                        segment_name: Optional[str] = None) -> meta.SegmentMetadata:
+                        segment_name: Optional[str] = None,
+                        indexing_config: Optional[IndexingConfig] = None,
+                        ) -> meta.SegmentMetadata:
         """Convert to the immutable columnar format (two-pass builder over the
         accumulated columns; ref: RealtimeSegmentConverter +
-        SegmentIndexCreationDriverImpl.build)."""
+        SegmentIndexCreationDriverImpl.build). ``indexing_config`` overrides
+        the consuming-time config at seal (the commit path stamps the
+        default star-tree set here)."""
         n = self._num_docs
         columns: Dict[str, List[Any]] = {}
         for name, col in self._cols.items():
@@ -355,7 +367,8 @@ class MutableSegment:
             columns[name] = vals
         builder = SegmentBuilder(self.schema,
                                  segment_name or self.segment_name,
-                                 indexing_config=self.indexing)
+                                 indexing_config=indexing_config
+                                 or self.indexing)
         return builder.build(columns, out_dir)
 
 

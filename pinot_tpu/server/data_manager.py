@@ -252,9 +252,27 @@ class RealtimeTableDataManager(TableDataManager):
         """CONSUMING -> ONLINE flip: swap the mutable segment for the
         immutable build (ref: CONSUMING->ONLINE state transition). Also the
         entry point for replica downloads of upsert tables (keys must
-        register, ref: PartitionUpsertMetadataManager.addSegment)."""
+        register, ref: PartitionUpsertMetadataManager.addSegment).
+
+        No partial-result window: ``add_segment`` is add-or-replace under
+        the registry lock — a query that acquired the consuming segment
+        before the swap finishes against it (refcount keeps it alive), a
+        query routing after sees only the immutable build."""
+        from pinot_tpu.common.tracing import record_decision
+
         with self._lock:
             mgr = self._consumers.pop(segment_name, None)
+        record_decision(None, "seal", "immutable_swap",
+                        "consuming_segment",
+                        "seal_swap" if mgr is not None else "seal_download")
+        if mgr is not None:
+            # final freshness flush: rows ingested after the last serving
+            # snapshot still count once, against the seal watermark
+            from pinot_tpu.engine.mutable_staging import observe_freshness
+            from pinot_tpu.spi.table import raw_table_name
+
+            observe_freshness(mgr.segment, int(mgr.segment.num_docs),
+                              raw_table_name(self.table_name))
         seg = load_segment(segment_dir)
         if self.upsert_manager is not None:
             from pinot_tpu.segment.upsert import attach_valid_docs

@@ -177,10 +177,18 @@ class ServerInstance:
     def segment_added(self, table: str, segment) -> None:
         """Prefetch hook: stage new/reloaded immutable segments in the
         background so the table's first query pays no H2D (residency skips
-        mutable segments and stops at the budget instead of evicting)."""
+        mutable segments and stops at the budget instead of evicting).
+        When the added segment is the sealed replacement of a consuming
+        one, the mutable resident's chunks are dead weight — evict them
+        (in-flight queries keep their snapshot via python refs)."""
         residency = getattr(self.executor, "residency", None)
-        if residency is not None:
-            residency.prefetch(segment)
+        if residency is None:
+            return
+        if not getattr(segment, "is_mutable", False):
+            from pinot_tpu.engine.mutable_staging import resident_name
+
+            residency.evict(resident_name(segment.segment_name))
+        residency.prefetch(segment)
 
     def segment_removed(self, table: str, segment_name: str) -> None:
         """Eviction hook: an unassigned segment's HBM must be reclaimed —
@@ -619,6 +627,14 @@ class ServerInstance:
         from pinot_tpu.common.telemetry import TELEMETRY
 
         return TELEMETRY.slo_snapshot()
+
+    def freshness_debug(self) -> Dict[str, Any]:
+        """``GET /debug/freshness``: per-table ingest-to-queryable
+        histograms (each sample: one row's append -> first covering
+        watermark) + the freshness objective/burn when configured."""
+        from pinot_tpu.common.telemetry import TELEMETRY
+
+        return TELEMETRY.freshness_snapshot()
 
     def flightrecorder_debug(self) -> Dict[str, Any]:
         """``GET /debug/flightrecorder``: the black box — frozen bundle

@@ -512,6 +512,7 @@ def check_conservation(ctx: LintContext) -> List[Finding]:
                     _check_manager(mod, node, findings)
                 if "nbytes" in methods and "release" in methods:
                     _check_cache_parity(mod, node, findings)
+                    _check_chunkacct(mod, node, findings)
             if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
                 _check_spanpair(mod, node, findings)
     return findings
@@ -797,3 +798,124 @@ def _check_cache_parity(mod: Module, node: ast.ClassDef,
                 f"{node.name}.{field} is populated in {mname}() but "
                 f"never cleared in release() — device arrays outlive "
                 f"eviction"))
+
+
+# --------------------------------------------------------------------------
+# chunkacct: every chunk append must reach the running byte counter on all
+# paths (exception edges included) — the mutable-staging watermark
+# accounting obligation
+# --------------------------------------------------------------------------
+
+class _ChunkAcctAnalysis:
+    """Forward obligation analysis over one method: a store into a
+    ``self.*chunk*`` collection opens an obligation that only a ``*bytes*``
+    counter write (direct, or via one of the class's accounting methods)
+    discharges; any path reaching exit with the obligation pending has
+    grown the device image without telling the HBM budget."""
+
+    def __init__(self, fn: ast.AST, accounting: Set[str]):
+        self.fn = fn
+        self.accounting = accounting
+        self.obligation_lines: Dict[Tuple, int] = {}
+
+    @staticmethod
+    def chunk_store_line(st: ast.stmt) -> Optional[int]:
+        """Line of a subscript store into a self.*chunk* field, or None."""
+        targets: List[ast.expr] = []
+        if isinstance(st, ast.Assign):
+            targets = st.targets
+        elif isinstance(st, (ast.AugAssign, ast.AnnAssign)):
+            targets = [st.target]
+        for t in targets:
+            if not isinstance(t, ast.Subscript):
+                continue
+            base = t
+            while isinstance(base, ast.Subscript):
+                base = base.value
+            if isinstance(base, ast.Attribute) and is_self_attr(base) \
+                    and "chunk" in base.attr.lower():
+                return st.lineno
+        return None
+
+    def _discharges(self, st: ast.stmt) -> bool:
+        targets: List[ast.expr] = []
+        if isinstance(st, ast.Assign):
+            targets = st.targets
+        elif isinstance(st, (ast.AugAssign, ast.AnnAssign)):
+            targets = [st.target]
+        for t in targets:
+            if isinstance(t, ast.Attribute) and is_self_attr(t) \
+                    and "bytes" in t.attr.lower():
+                return True
+        for n in stmt_scan(st):
+            if isinstance(n, ast.Call) \
+                    and isinstance(n.func, ast.Attribute) \
+                    and isinstance(n.func.value, ast.Name) \
+                    and n.func.value.id == "self" \
+                    and n.func.attr in self.accounting:
+                return True
+        return False
+
+    def transfer(self, state: Dict[Tuple, bool], st: Optional[ast.AST],
+                 nid: int) -> Dict[Tuple, bool]:
+        if st is None or not isinstance(st, ast.stmt):
+            return state
+        out = dict(state)
+        if self._discharges(st):
+            out = {oid: False for oid in out}
+        line = self.chunk_store_line(st)
+        if line is not None:
+            oid = ("chunk", st.lineno, getattr(st, "col_offset", 0))
+            out[oid] = True
+            self.obligation_lines[oid] = line
+        return out
+
+    def run(self) -> List[int]:
+        cfg = build_cfg(self.fn)
+
+        def join(a: Dict[Tuple, bool],
+                 b: Dict[Tuple, bool]) -> Dict[Tuple, bool]:
+            out = dict(a)
+            for oid, p in b.items():
+                out[oid] = out.get(oid, False) or p
+            return out
+
+        fa = ForwardAnalysis(cfg, {}, self.transfer, join,
+                             exc_filter=lambda s: s)
+        inn = fa.run()
+        exit_state = inn.get(cfg.exit, {})
+        return sorted(self.obligation_lines[oid]
+                      for oid, p in exit_state.items() if p)
+
+
+def _check_chunkacct(mod: Module, node: ast.ClassDef,
+                     findings: List[Finding]) -> None:
+    """Dispatched for every nbytes()+release() resident class; only
+    classes that append into ``self.*chunk*`` collections are analyzed."""
+    model = _ClassModel(mod, node)
+    for mname, fn in model.methods.items():
+        if mname == "__init__":
+            continue
+        store_lines = [
+            line for st in walk_no_nested(fn) if isinstance(st, ast.stmt)
+            for line in [_ChunkAcctAnalysis.chunk_store_line(st)]
+            if line is not None]
+        if not store_lines:
+            continue
+        if not model.accounting:
+            for line in store_lines:
+                findings.append(Finding(
+                    "conservation", mod.relpath, line,
+                    f"{node.name}.{mname}:chunkacct",
+                    f"{node.name}.{mname}() appends a device chunk but the "
+                    f"class has no byte-counter accounting method — staged "
+                    f"bytes invisible to the HBM budget"))
+            continue
+        analysis = _ChunkAcctAnalysis(fn, model.accounting)
+        for line in analysis.run():
+            findings.append(Finding(
+                "conservation", mod.relpath, line,
+                f"{node.name}.{mname}:chunkacct",
+                f"{node.name}.{mname}() appends a device chunk on a path "
+                f"that exits without updating the byte counter — the HBM "
+                f"budget drifts from the true staged footprint"))

@@ -481,6 +481,9 @@ class BrokerApi(_Api):
         # per-table SLO objectives + multi-window burn rates
         self.route("GET", r"/debug/slo",
                    lambda m, b: (200, broker.slo_snapshot()))
+        # ingest-to-queryable freshness histograms + objective burn
+        self.route("GET", r"/debug/freshness",
+                   lambda m, b: (200, broker.freshness_snapshot()))
         # the flight recorder's bundle index + last post-mortem bundle
         self.route("GET", r"/debug/flightrecorder",
                    lambda m, b: (200, broker.flightrecorder_snapshot()))
@@ -564,6 +567,9 @@ class ServerAdminApi(_Api):
         # per-table SLO burn rates (objectives from pinot.broker.slo.*)
         self.route("GET", r"/debug/slo",
                    lambda m, b: (200, s.slo_debug()))
+        # per-table ingest-to-queryable freshness (realtime tables)
+        self.route("GET", r"/debug/freshness",
+                   lambda m, b: (200, s.freshness_debug()))
         # anomaly-triggered flight recorder: post-mortem bundle index +
         # the last frozen bundle (span roots, decision deltas, snapshots)
         self.route("GET", r"/debug/flightrecorder",

@@ -91,6 +91,12 @@ def pytest_configure(config):
         "vectorized host path and the row oracle, decline-shape "
         "fixtures; pytest -m reduce_device runs it in isolation; part "
         "of tier-1)")
+    config.addinivalue_line(
+        "markers",
+        "realtime_tier: realtime serving tier (device-queryable "
+        "consuming segments, watermark-snapshot parity, seal-under-query "
+        "hammer, hybrid time-boundary routing, freshness SLO; pytest "
+        "-m realtime_tier runs it in isolation; part of tier-1)")
 
 
 @pytest.fixture(scope="session")

@@ -925,6 +925,80 @@ def test_conservation_cache_parity_star_tree_nodes(tmp_path):
     assert not any("_columns" in f.symbol for f in cf)
 
 
+def test_conservation_chunkacct_store_must_reach_counter(tmp_path):
+    """PR 17 mutable-staging obligation: every store into a
+    ``self.*chunk*`` collection must reach the class's byte counter on
+    EVERY path out of the method — an early return that skips the
+    recount, or a method with no recount at all, grows the device image
+    invisibly to the HBM budget."""
+    new = _lint(tmp_path, """\
+        class StagedChunks:
+            def __init__(self):
+                self._chunks = {}
+                self._staged_bytes = 0
+
+            def _recount(self):
+                total = 0
+                for a in self._chunks.values():
+                    total += a
+                self._staged_bytes = total
+
+            def install_ok(self, key, arr):
+                self._chunks[key] = arr
+                self._recount()
+
+            def install_bad(self, key, arr):
+                self._chunks[key] = arr
+
+            def install_branchy(self, key, arr, cond):
+                self._chunks[key] = arr
+                if cond:
+                    return
+                self._recount()
+
+            def nbytes(self):
+                total = 0
+                for a in self._chunks.values():
+                    total += a
+                return max(total, self._staged_bytes)
+
+            def release(self):
+                self._chunks.clear()
+                self._staged_bytes = 0
+        """)
+    cf = _by_checker(new, "conservation")
+    assert any(f.symbol == "StagedChunks.install_bad:chunkacct"
+               for f in cf), [f.render() for f in new]
+    assert any(f.symbol == "StagedChunks.install_branchy:chunkacct"
+               for f in cf), [f.render() for f in new]
+    assert not any("install_ok" in f.symbol for f in cf), \
+        [f.render() for f in cf]
+
+
+def test_conservation_chunkacct_no_accounting_method_at_all(tmp_path):
+    """A chunk-storing resident with nbytes()/release() but NO byte
+    counter anywhere cannot discharge the obligation — every store is a
+    finding (the counter is what residency accounting re-measures)."""
+    new = _lint(tmp_path, """\
+        class NoCounter:
+            def __init__(self):
+                self._chunks = {}
+
+            def put(self, key, arr):
+                self._chunks[key] = arr
+
+            def nbytes(self):
+                return len(self._chunks)
+
+            def release(self):
+                self._chunks.clear()
+        """)
+    cf = _by_checker(new, "conservation")
+    assert any(f.symbol == "NoCounter.put:chunkacct"
+               and "no byte-counter" in f.message
+               for f in cf), [f.render() for f in new]
+
+
 def test_conservation_catches_discarded_pop(tmp_path):
     new = _lint(tmp_path, CONSERVATION_PRELUDE + """\
         def drop(self, name):

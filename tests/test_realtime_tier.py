@@ -1,0 +1,549 @@
+"""PR 17 realtime serving tier: device-queryable consuming segments
+(watermark-snapshot parity at every doc count), the seal-to-star-tree
+handoff (seal-under-query hammer, no partial-result window, no pin
+leaks), hybrid time-boundary routing vs the merged-table oracle, and
+the ingest-to-queryable freshness SLO.
+
+Ref: MutableSegmentImpl serving queries while consuming,
+LLRealtimeSegmentDataManager CONSUMING->ONLINE, TimeBoundaryManager,
+HybridClusterIntegrationTest.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from pinot_tpu.common.tracing import LEDGER
+from pinot_tpu.engine.executor import ServerQueryExecutor
+from pinot_tpu.ingestion import MemoryStream
+from pinot_tpu.ingestion.realtime import (
+    CompletionReply,
+    CompletionResponse,
+    ConsumerState,
+    LocalCompletionProtocol,
+    RealtimeSegmentDataManager,
+)
+from pinot_tpu.ingestion.stream import StreamOffset
+from pinot_tpu.query import compile_query
+from pinot_tpu.segment.mutable import MutableSegment
+from pinot_tpu.server.data_manager import RealtimeTableDataManager
+from pinot_tpu.spi import DataType, FieldSpec, FieldType, Schema
+from pinot_tpu.spi.table import (
+    SegmentsValidationConfig,
+    StreamIngestionConfig,
+    TableConfig,
+    TableType,
+)
+
+pytestmark = pytest.mark.realtime_tier
+
+CITIES = ["nyc", "sf", "la", "chi", "sea"]
+
+
+def make_schema(name="rt"):
+    return Schema(name, [
+        FieldSpec("city", DataType.STRING, FieldType.DIMENSION),
+        FieldSpec("clicks", DataType.LONG, FieldType.METRIC),
+        FieldSpec("price", DataType.DOUBLE, FieldType.METRIC),
+        FieldSpec("ts", DataType.LONG, FieldType.DATE_TIME),
+    ])
+
+
+def make_row(i, rng):
+    return {"city": CITIES[int(rng.integers(len(CITIES)))],
+            "clicks": int(rng.integers(100)),
+            "price": float(rng.integers(1000)) / 4.0,
+            "ts": 1_600_000_000_000 + i}
+
+
+def rows_key(rows):
+    """Group-by row order without ORDER BY is path-dependent (the mutable
+    dictionary is arrival-ordered, the immutable one sorted) — parity is
+    on the row SET."""
+    return sorted(map(repr, rows))
+
+
+# --------------------------------------------------------------------------
+# Consuming segment on the device kernel
+# --------------------------------------------------------------------------
+
+class TestConsumingDeviceParity:
+    QUERIES = (
+        "SELECT city, count(*), sum(clicks), max(price) FROM rt "
+        "WHERE clicks > 10 GROUP BY city LIMIT 100",
+        "SELECT city, avg(price) FROM rt WHERE city IN ('nyc', 'sf') "
+        "GROUP BY city LIMIT 100",
+        "SELECT count(*), sum(clicks) FROM rt",
+        "SELECT count(*) FROM rt WHERE price > 100.0 AND price <= 200.0",
+        "SELECT min(clicks), max(clicks) FROM rt WHERE city <> 'la'",
+    )
+
+    def test_parity_at_every_watermark(self):
+        """The consuming segment answers through the fused device kernel
+        bit-identically to the host engine at every watermark, including
+        one below the chunk floor, one mid-chunk, and one that forces
+        pow2 capacity regrowth."""
+        seg = MutableSegment(make_schema(), "rt__0__0__x", capacity=100_000)
+        rng = np.random.default_rng(0)
+        dev = ServerQueryExecutor(use_device=True)
+        host = ServerQueryExecutor(use_device=False)
+        n = 0
+        for step in (7, 100, 1500):
+            for _ in range(step):
+                seg.index(make_row(n, rng))
+                n += 1
+            for sql in self.QUERIES:
+                drt, dstats = dev.execute(compile_query(sql), [seg])
+                hrt, _ = host.execute(compile_query(sql), [seg])
+                assert rows_key(drt.rows) == rows_key(hrt.rows), \
+                    (sql, n, drt.rows, hrt.rows)
+                if "GROUP BY" in sql:
+                    # parity must come from the DEVICE path, not a silent
+                    # host fallback
+                    assert dstats.group_by_rung == "mutable_device", \
+                        (sql, n, dstats.group_by_rung)
+
+    def test_watermark_snapshot_is_stable_under_writes(self):
+        """A snapshot taken at watermark W answers for exactly W rows even
+        while the writer keeps appending: two executions bracketing a
+        burst of writes see monotonically consistent counts, never a torn
+        read of half-published rows."""
+        seg = MutableSegment(make_schema(), "rt__0__1__x", capacity=65536)
+        rng = np.random.default_rng(1)
+        dev = ServerQueryExecutor(use_device=True)
+        q = compile_query("SELECT count(*) FROM rt")
+        stop = threading.Event()
+        errs = []
+
+        def writer():
+            i = 0
+            while not stop.is_set() and i < 20_000:
+                seg.index(make_row(i, rng))
+                i += 1
+
+        t = threading.Thread(target=writer, daemon=True)
+        t.start()
+        try:
+            prev = 0
+            for _ in range(30):
+                cnt = dev.execute(q, [seg])[0].rows[0][0]
+                if cnt < prev:
+                    errs.append((prev, cnt))
+                prev = cnt
+        finally:
+            stop.set()
+            t.join(timeout=10)
+        assert not errs, f"count went backwards across snapshots: {errs}"
+        # quiesced: the final snapshot sees every published row
+        assert dev.execute(q, [seg])[0].rows[0][0] == seg.num_docs
+
+    def test_unsupported_shapes_decline_onto_the_ledger(self):
+        """HLL aggregations pre-decline (memoized register LUTs are not
+        dictId-stable on a growing dictionary) — served by host, with the
+        decline on the decision ledger, never silently."""
+        seg = MutableSegment(make_schema(), "rt__0__2__x", capacity=4096)
+        rng = np.random.default_rng(2)
+        for i in range(50):
+            seg.index(make_row(i, rng))
+        dev = ServerQueryExecutor(use_device=True)
+        host = ServerQueryExecutor(use_device=False)
+        mark = LEDGER.snapshot()
+        sql = ("SELECT city, distinctcounthll(clicks) FROM rt "
+               "GROUP BY city LIMIT 100")
+        drt, dstats = dev.execute(compile_query(sql), [seg])
+        hrt, _ = host.execute(compile_query(sql), [seg])
+        assert rows_key(drt.rows) == rows_key(hrt.rows)
+        assert dstats.group_by_rung == "host"
+        delta = LEDGER.delta(mark)
+        assert any("mutable_hll_lut_unstable" in k for k in delta), delta
+
+
+# --------------------------------------------------------------------------
+# Seal-to-star-tree handoff under concurrent queries
+# --------------------------------------------------------------------------
+
+class _GatedProtocol(LocalCompletionProtocol):
+    """HOLDs the completion protocol until the test opens the gate, so the
+    hammer threads get a long stable consuming phase before the seal."""
+
+    def __init__(self):
+        self.gate = threading.Event()
+
+    def segment_consumed(self, segment_name, instance, offset):
+        if not self.gate.is_set():
+            return CompletionReply(CompletionResponse.HOLD)
+        return CompletionReply(CompletionResponse.COMMIT)
+
+
+class _ResidencyListener:
+    """The server's segment-lifecycle -> HBM residency wiring
+    (ServerInstance.segment_added/segment_removed), minus the server."""
+
+    def __init__(self, executor):
+        self.executor = executor
+
+    def segment_added(self, table, segment):
+        residency = self.executor.residency
+        if residency is None:
+            return
+        if not getattr(segment, "is_mutable", False):
+            from pinot_tpu.engine.mutable_staging import resident_name
+
+            residency.evict(resident_name(segment.segment_name))
+        residency.prefetch(segment)
+
+    def segment_removed(self, table, segment_name):
+        evict = getattr(self.executor, "evict_segment", None)
+        if evict is not None:
+            evict(segment_name)
+
+
+N_HAMMER_ROWS = 400
+HAMMER_SQL = ("SELECT city, count(*), sum(clicks), max(price) FROM rt "
+              "GROUP BY city LIMIT 100")
+
+
+class TestSealUnderQuery:
+    def _consuming_table(self, tmp_path, topic, executor):
+        MemoryStream.create(topic, 1)
+        schema = make_schema()
+        cfg = TableConfig(
+            "rt", TableType.REALTIME,
+            validation_config=SegmentsValidationConfig(
+                time_column_name="ts"),
+            stream_config=StreamIngestionConfig(
+                stream_type="memory", topic=topic,
+                segment_flush_threshold_rows=N_HAMMER_ROWS))
+        stream = MemoryStream.get(topic)
+        rng = np.random.default_rng(5)
+        for i in range(N_HAMMER_ROWS):
+            stream.produce(make_row(i, rng), partition=0)
+        tdm = RealtimeTableDataManager(
+            "rt_REALTIME", listener=_ResidencyListener(executor))
+        protocol = _GatedProtocol()
+        mgr = RealtimeSegmentDataManager(
+            "rt__0__0__h", cfg, schema, partition=0,
+            start_offset=StreamOffset(0), protocol=protocol,
+            output_dir=str(tmp_path),
+            on_committed=lambda m, md, d: tdm.on_sealed(m.segment_name, d))
+        tdm.add_consuming(mgr)
+        return tdm, mgr, protocol
+
+    def test_seal_under_query_hammer(self, tmp_path):
+        """4 query threads hammer the table through the seal: every result
+        is bit-identical to the full-watermark oracle (the consuming and
+        sealed views contain the same 400 rows), every acquire sees
+        exactly one registered segment (no partial-result window), and
+        after the swap no residency pins leak and the mutable resident's
+        chunks are evicted."""
+        dev = ServerQueryExecutor(use_device=True)
+        host = ServerQueryExecutor(use_device=False)
+        tdm, mgr, protocol = self._consuming_table(tmp_path, "rt_hammer",
+                                                   dev)
+        try:
+            mgr.start(tick_seconds=0.002)
+            deadline = time.time() + 20
+            while mgr.segment.num_docs < N_HAMMER_ROWS:
+                assert time.time() < deadline, mgr.segment.num_docs
+                time.sleep(0.01)
+
+            sdms = tdm.acquire_segments()
+            oracle = rows_key(host.execute(
+                compile_query(HAMMER_SQL),
+                [s.segment for s in sdms])[0].rows)
+            tdm.release_segments(sdms)
+
+            q = compile_query(HAMMER_SQL)
+            stop = threading.Event()
+            failures = []
+            kinds_seen = set()
+
+            def hammer():
+                while not stop.is_set():
+                    acquired = tdm.acquire_segments()
+                    try:
+                        if len(acquired) != 1:
+                            failures.append(
+                                ("partial_window",
+                                 [s.segment_name for s in acquired]))
+                            continue
+                        seg = acquired[0].segment
+                        kinds_seen.add(bool(getattr(seg, "is_mutable",
+                                                    False)))
+                        got = rows_key(dev.execute(q, [seg])[0].rows)
+                        if got != oracle:
+                            failures.append(("mismatch", got))
+                    except Exception as e:  # pragma: no cover - fail loud
+                        failures.append(("exception", repr(e)))
+                    finally:
+                        tdm.release_segments(acquired)
+
+            threads = [threading.Thread(target=hammer, daemon=True)
+                       for _ in range(4)]
+            for t in threads:
+                t.start()
+            time.sleep(0.3)          # hammer the consuming segment
+            protocol.gate.set()      # trigger build -> commit -> swap
+            deadline = time.time() + 30
+            while mgr.state is not ConsumerState.COMMITTED:
+                assert time.time() < deadline, mgr.state
+                time.sleep(0.01)
+            time.sleep(0.3)          # hammer the sealed segment
+            stop.set()
+            for t in threads:
+                t.join(timeout=10)
+
+            assert not failures, failures[:5]
+            assert kinds_seen == {True, False}, \
+                f"hammer never saw both sides of the swap: {kinds_seen}"
+
+            # the registry holds exactly the sealed immutable build
+            sdms = tdm.acquire_segments()
+            try:
+                assert [s.segment_name for s in sdms] == ["rt__0__0__h"]
+                sealed = sdms[0].segment
+                assert not getattr(sealed, "is_mutable", False)
+                # seal stamped the default star-tree set (COUNT + SUM per
+                # numeric metric): an eligible group-by serves from the
+                # startree_device rung from its first query
+                _, st = dev.execute(compile_query(
+                    "SELECT city, count(*), sum(clicks) FROM rt "
+                    "GROUP BY city LIMIT 100"), [sealed])
+                assert st.group_by_rung == "startree_device", \
+                    st.group_by_rung
+                assert rows_key(dev.execute(q, [sealed])[0].rows) == oracle
+            finally:
+                tdm.release_segments(sdms)
+
+            staged = dev.residency.snapshot()["stagedSegments"]
+            assert all(d["pins"] == 0 for d in staged.values()), staged
+            from pinot_tpu.engine.mutable_staging import resident_name
+
+            assert resident_name("rt__0__0__h") not in staged
+            # seal wall-time was measured (the bench realtime suite input)
+            assert mgr.seal_wall_ms is not None and mgr.seal_wall_ms > 0
+        finally:
+            tdm.shutdown()
+            MemoryStream.delete("rt_hammer")
+
+    def test_seal_swap_lands_on_the_ledger(self, tmp_path):
+        dev = ServerQueryExecutor(use_device=True)
+        tdm, mgr, protocol = self._consuming_table(tmp_path, "rt_ledger",
+                                                   dev)
+        try:
+            mark = LEDGER.snapshot()
+            protocol.gate.set()
+            res = mgr.consume_until_committed()
+            assert res.state is ConsumerState.COMMITTED
+            delta = LEDGER.delta(mark)
+            assert any("seal_swap" in k for k in delta), delta
+        finally:
+            tdm.shutdown()
+            MemoryStream.delete("rt_ledger")
+
+
+# --------------------------------------------------------------------------
+# Hybrid time-boundary routing
+# --------------------------------------------------------------------------
+
+class TestHybridRouting:
+    def test_hybrid_bit_identical_to_merged_oracle(self, tmp_path):
+        """Offline + realtime halves of a hybrid table answer exactly like
+        one merged table: the time-boundary split must neither double
+        count the overlap nor drop rows at the boundary, for both scalar
+        aggregations and group-bys; the split outcome lands on the
+        decision ledger."""
+        from pinot_tpu.tools import EmbeddedCluster
+
+        MemoryStream.create("hy_rt_topic", 1)
+        cluster = EmbeddedCluster(num_servers=2, data_dir=str(tmp_path))
+        try:
+            schema = make_schema("hy")
+            off_cfg = TableConfig(
+                "hy", TableType.OFFLINE,
+                validation_config=SegmentsValidationConfig(
+                    time_column_name="ts"))
+            rt_cfg = TableConfig(
+                "hy", TableType.REALTIME,
+                validation_config=SegmentsValidationConfig(
+                    time_column_name="ts"),
+                stream_config=StreamIngestionConfig(
+                    stream_type="memory", topic="hy_rt_topic",
+                    segment_flush_threshold_rows=10_000))
+            cluster.create_table(off_cfg, schema)
+            cluster.controller.add_table(rt_cfg)
+
+            rng = np.random.default_rng(11)
+            n = 2000
+            df = pd.DataFrame(
+                [make_row(i, rng) for i in range(n)]).sort_values(
+                    "ts").reset_index(drop=True)
+            offline_part = df.iloc[:1200]
+            overlap_and_new = df.iloc[1000:]  # overlaps + extends past
+
+            cluster.ingest_rows(
+                "hy_OFFLINE", schema,
+                {c: offline_part[c].tolist() for c in df.columns},
+                segment_name="hy_off_0")
+            stream = MemoryStream.get("hy_rt_topic")
+            for r in overlap_and_new.to_dict("records"):
+                stream.produce(r, partition=0)
+            assert cluster.wait_for_ev_converged("hy_OFFLINE")
+
+            boundary = cluster.broker.routing.time_boundary.get_boundary(
+                "hy_OFFLINE")
+            assert boundary is not None
+            # the merged-table oracle: offline rows up to the boundary +
+            # realtime rows strictly after it, each row exactly once
+            oracle = pd.concat([
+                offline_part[offline_part.ts <= boundary],
+                overlap_and_new[overlap_and_new.ts > boundary]])
+
+            mark = LEDGER.snapshot()
+            deadline = time.time() + 15
+            while True:
+                rows = cluster.query_rows("SELECT count(*) FROM hy")
+                if rows[0][0] == len(oracle) or time.time() > deadline:
+                    break
+                time.sleep(0.05)
+            assert rows[0][0] == len(oracle), (rows, len(oracle))
+            assert any("hybrid_time_split" in k
+                       for k in LEDGER.delta(mark)), LEDGER.delta(mark)
+
+            rows = cluster.query_rows(
+                "SELECT city, count(*), sum(clicks) FROM hy "
+                "GROUP BY city ORDER BY city LIMIT 50")
+            want = oracle.groupby("city").agg(
+                n=("city", "size"), s=("clicks", "sum")).sort_index()
+            assert [(r[0], r[1], r[2]) for r in rows] == \
+                [(k, int(v.n), float(v.s)) for k, v in want.iterrows()]
+
+            rows = cluster.query_rows(
+                "SELECT sum(price), min(ts), max(ts) FROM hy")
+            assert rows[0][0] == pytest.approx(float(oracle.price.sum()))
+            assert rows[0][1] == int(oracle.ts.min())
+            assert rows[0][2] == int(oracle.ts.max())
+        finally:
+            cluster.shutdown()
+            MemoryStream.delete("hy_rt_topic")
+
+    def test_single_table_and_no_boundary_outcomes_ledgered(self, tmp_path):
+        """The non-split outcomes are decisions too: a single physical
+        table routes direct, a hybrid with no offline boundary routes
+        everything to realtime — both on the ledger."""
+        from pinot_tpu.tools import EmbeddedCluster
+
+        MeteredTopic = "hy_nb_topic"
+        MemoryStream.create(MeteredTopic, 1)
+        cluster = EmbeddedCluster(num_servers=1, data_dir=str(tmp_path))
+        try:
+            schema = make_schema("hynb")
+            rt_cfg = TableConfig(
+                "hynb", TableType.REALTIME,
+                validation_config=SegmentsValidationConfig(
+                    time_column_name="ts"),
+                stream_config=StreamIngestionConfig(
+                    stream_type="memory", topic=MeteredTopic,
+                    segment_flush_threshold_rows=10_000))
+            cluster.create_table(rt_cfg, schema)
+            stream = MemoryStream.get(MeteredTopic)
+            rng = np.random.default_rng(17)
+            for i in range(20):
+                stream.produce(make_row(i, rng), partition=0)
+            assert cluster.wait_for_docs("hynb", 20)
+
+            mark = LEDGER.snapshot()
+            cluster.query_rows("SELECT count(*) FROM hynb")
+            delta = LEDGER.delta(mark)
+            assert any("hybrid_single_table" in k for k in delta), delta
+
+            # add the offline half with NO segments: boundary undefined,
+            # realtime serves everything
+            off_cfg = TableConfig(
+                "hynb", TableType.OFFLINE,
+                validation_config=SegmentsValidationConfig(
+                    time_column_name="ts"))
+            cluster.controller.add_table(off_cfg)
+            mark = LEDGER.snapshot()
+            rows = cluster.query_rows("SELECT count(*) FROM hynb")
+            assert rows[0][0] == 20
+            delta = LEDGER.delta(mark)
+            assert any("hybrid_no_boundary" in k for k in delta), delta
+        finally:
+            cluster.shutdown()
+            MemoryStream.delete(MeteredTopic)
+
+
+# --------------------------------------------------------------------------
+# Freshness SLO
+# --------------------------------------------------------------------------
+
+class TestFreshnessSlo:
+    def test_serve_path_records_ingest_to_queryable(self):
+        """Serving a consuming segment flushes per-row ingest-to-queryable
+        latencies into the (table, 'freshness') windowed histogram — each
+        row counted once, at the first snapshot that made it queryable."""
+        from pinot_tpu.common.telemetry import TELEMETRY
+
+        TELEMETRY.reset()
+        seg = MutableSegment(make_schema("fresh"), "fr__0__0__x",
+                             capacity=4096)
+        rng = np.random.default_rng(23)
+        dev = ServerQueryExecutor(use_device=True)
+        q = compile_query("SELECT city, count(*) FROM fresh "
+                          "GROUP BY city LIMIT 100")
+        for i in range(100):
+            seg.index(make_row(i, rng))
+        dev.execute(q, [seg])
+        h = TELEMETRY.histo("fresh", "freshness")
+        assert h.lifetime.count == 100
+        # repeat query at the same watermark: no double counting
+        dev.execute(q, [seg])
+        assert h.lifetime.count == 100
+        for i in range(40):
+            seg.index(make_row(100 + i, rng))
+        dev.execute(q, [seg])
+        assert h.lifetime.count == 140
+        p99 = h.sliding().quantile(0.99)
+        assert np.isfinite(p99) and p99 >= 0.0
+
+    def test_freshness_objective_burns_and_surfaces(self):
+        """`pinot.broker.slo.<table>.freshness.ms` configures the
+        objective; rows staler than it burn the SLO budget, and the
+        /debug/freshness snapshot carries histogram + burn state."""
+        from pinot_tpu.common.telemetry import Telemetry
+        from pinot_tpu.spi.config import PinotConfiguration
+
+        t = Telemetry(window_s=10.0, num_windows=4)
+        t.configure(PinotConfiguration(
+            {"pinot.broker.slo.fresh.freshness.ms": "100"}, use_env=False))
+        assert t.slo.objectives()["fresh"]["freshness_ms"] == 100.0
+        # 50 fast rows, 50 stale rows: 50% bad vs 1% allowed -> burn ~50
+        for i in range(100):
+            t.observe("fresh", "freshness", 500.0 if i % 2 else 5.0)
+        snap = t.slo_snapshot()["tables"]["fresh"]
+        assert snap["objectives"]["freshness_ms"] == 100.0
+        assert snap["freshness"]["long"]["burnRate"] == pytest.approx(
+            50.0, rel=0.1)
+        burns = t.burn_gauges()
+        assert burns[("fresh", "freshness", "long")] == \
+            snap["freshness"]["long"]["burnRate"]
+        # the debug surface: histogram + objective + burn per table
+        dbg = t.freshness_snapshot()
+        assert "fresh" in dbg["tables"]
+        assert dbg["tables"]["fresh"]["objectiveMs"] == 100.0
+        assert dbg["tables"]["fresh"]["histogram"]["lifetime"]["count"] \
+            == 100
+
+    def test_debug_freshness_routes_exist(self):
+        """Both the broker and server admin APIs expose /debug/freshness
+        (wired beside /debug/slo in transport/rest.py)."""
+        import inspect
+
+        from pinot_tpu.transport import rest
+
+        src = inspect.getsource(rest)
+        assert src.count("/debug/freshness") >= 2

@@ -51,6 +51,26 @@ def test_registry_covers_the_five_legacy_sets_plus_preflight():
     assert tracing.PALLAS_PREFLIGHT_REASONS <= codes
 
 
+def test_registry_covers_the_realtime_tier_sets():
+    """PR 17: the mutable serve declines, hybrid route outcomes, and
+    seal-swap records register as first-class namespaces and inherit
+    the generic conformance scan above."""
+    names = set(tracing.reason_registry())
+    assert {"mutable", "hybrid", "seal"} <= names
+    codes = tracing.registered_reason_codes()
+    assert tracing.MUTABLE_DECLINE_REASONS <= codes
+    assert tracing.HYBRID_ROUTE_REASONS <= codes
+    assert tracing.SEAL_SWAP_REASONS <= codes
+    # Prefix discipline: every code carries its decision-point prefix so
+    # ledger histograms stay partitioned by namespace.
+    assert all(c.startswith("mutable_")
+               for c in tracing.MUTABLE_DECLINE_REASONS)
+    assert all(c.startswith("hybrid_")
+               for c in tracing.HYBRID_ROUTE_REASONS)
+    assert all(c.startswith("seal_")
+               for c in tracing.SEAL_SWAP_REASONS)
+
+
 def test_namespaces_do_not_collide():
     """A reason code means ONE thing: no code registered under two
     namespaces (prefix discipline keeps histograms per decision point).

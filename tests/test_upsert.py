@@ -198,6 +198,83 @@ class TestUpsertDevicePath:
         assert dev.execute(q, [seg])[0].rows[0][0] == 99
 
 
+class TestMutableUpsertDevicePath:
+    """PR 17: CONSUMING segments ride the device kernels too — the
+    watermark snapshot captures the upsert bitmap at the same instant as
+    the doc count, and the kernel's validdocs placeholder is filled from
+    that snapshot (mutable_staging._valid_locked)."""
+
+    pytestmark = pytest.mark.realtime_tier
+
+    def _consuming(self, n_rows, n_keys, seed=7):
+        from pinot_tpu.server.data_manager import _LiveValidDocs
+
+        seg = MutableSegment(make_schema(), "mut_up_0", capacity=65536)
+        pm = PartitionUpsertMetadataManager(["uid"], "ts")
+        attach_valid_docs(seg, _LiveValidDocs(pm, seg.segment_name))
+        rng = np.random.default_rng(seed)
+        latest = {}
+        for i in range(n_rows):
+            row = {"uid": f"u{int(rng.integers(0, n_keys))}",
+                   "status": ["a", "b"][int(rng.integers(0, 2))],
+                   "score": int(rng.integers(0, 100)), "ts": i}
+            seg.index(row)
+            pm.add_record(seg.segment_name, seg.num_docs - 1,
+                          pm.key_of_row(row), row["ts"])
+            latest[row["uid"]] = row
+        return seg, pm, latest
+
+    def test_consuming_upsert_device_host_parity(self):
+        """Writes quiesced: device and host must agree bit-for-bit on a
+        consuming upsert segment, and the device rung must actually have
+        served (a silent host fallback would make parity vacuous)."""
+        seg, _, latest = self._consuming(2000, 300)
+        dev = ServerQueryExecutor(use_device=True)
+        host = ServerQueryExecutor(use_device=False)
+        for sql in ("SELECT status, count(*), sum(score), max(score) "
+                    "FROM users GROUP BY status",
+                    "SELECT uid, max(ts) FROM users "
+                    "WHERE status = 'a' GROUP BY uid LIMIT 500"):
+            drt, dstats = dev.execute(compile_query(sql), [seg])
+            hrt, _ = host.execute(compile_query(sql), [seg])
+            assert sorted(map(repr, drt.rows)) == \
+                sorted(map(repr, hrt.rows)), sql
+            assert dstats.group_by_rung == "mutable_device", \
+                (sql, dstats.group_by_rung)
+        # exactly one live doc per key survives the mask
+        t, _ = dev.execute(compile_query("SELECT count(*) FROM users"),
+                           [seg])
+        assert t.rows[0][0] == len(latest)
+
+    def test_invalidation_between_queries_same_watermark(self):
+        """A key re-ingested between two queries flips its old doc's bit:
+        the version-keyed device mask cache must NOT serve the stale
+        bitmap (same watermark, different validdocs)."""
+        from pinot_tpu.server.data_manager import _LiveValidDocs
+
+        seg = MutableSegment(make_schema(), "mut_up_1", capacity=65536)
+        pm = PartitionUpsertMetadataManager(["uid"], "ts")
+        attach_valid_docs(seg, _LiveValidDocs(pm, seg.segment_name))
+        for i in range(50):  # 50 unique keys, no dups yet
+            row = {"uid": f"u{i}", "status": "a", "score": i, "ts": i}
+            seg.index(row)
+            pm.add_record(seg.segment_name, i, pm.key_of_row(row), i)
+        dev = ServerQueryExecutor(use_device=True)
+        q = compile_query("SELECT count(*), sum(score) FROM users")
+        t0, _ = dev.execute(q, [seg])
+        assert t0.rows[0][0] == 50
+        # newer record for u5: old doc invalidated, count stays 50
+        row = {"uid": "u5", "status": "a", "score": 1, "ts": 10_000}
+        seg.index(row)
+        pm.add_record(seg.segment_name, seg.num_docs - 1,
+                      pm.key_of_row(row), row["ts"])
+        t1, _ = dev.execute(q, [seg])
+        host = ServerQueryExecutor(use_device=False)
+        t1h, _ = host.execute(q, [seg])
+        assert t1.rows == t1h.rows
+        assert t1.rows[0][0] == 50
+
+
 def test_plan_cache_respects_late_bitmap_attach(tmp_path):
     """A valid-doc bitmap attached AFTER a query cached the plan must
     invalidate it (the no-validdocs plan would count invalidated docs)."""

@@ -75,7 +75,7 @@ WARMUP = 1
 ITERS = 5
 
 SUITES = ("ssb", "qps", "micro", "startree", "sketches", "residency",
-          "cluster", "reduce", "realtime")
+          "cluster", "reduce", "realtime", "userfacing")
 
 
 def _log(msg: str) -> None:
@@ -314,6 +314,10 @@ _TRAJECTORY_KEYS = {
     # headline = consuming-segment write throughput; freshness/seal gates
     # run inside bench_realtime (finite p99, no unexplained host spills)
     "realtime": ("write_qps", True),
+    # headline = 4-thread point-filter QPS; the index-rung SLO gates
+    # (selective filters must not scan, declines must be registered)
+    # run inside bench_userfacing
+    "userfacing": ("qps", True),
 }
 REGRESSION_X = 1.3
 
@@ -574,7 +578,8 @@ class _Worker:
                           ("residency", self.bench_residency),
                           ("cluster", self.bench_cluster),
                           ("reduce", self.bench_reduce),
-                          ("realtime", self.bench_realtime)):
+                          ("realtime", self.bench_realtime),
+                          ("userfacing", self.bench_userfacing)):
             if suite in self.skip:
                 _log(f"{suite}: already chip-served, skipping")
                 continue
@@ -1269,7 +1274,7 @@ class _Worker:
                     "external view did not converge: refusing a partial bench"
                 hosting = cluster.hosting_servers("ssb_lineorder_OFFLINE")
                 fanout, prune_ratio, p50 = {}, {}, {}
-                reduce_p50, reduce_path = {}, {}
+                reduce_p50, reduce_path, docs_scanned = {}, {}, {}
                 for qid in qids:
                     sql = ssb.QUERIES[qid]
                     cluster.query(sql)  # warm: staging + kernel compile
@@ -1295,6 +1300,11 @@ class _Worker:
                         # / oracle) — trajectory rounds attribute reduce
                         # wins to the path, not just the timing
                         reduce_path[qid] = resp.stats.reduce_path
+                        # per-query scan footprint (PR-18): with an index
+                        # rung in the ladder, docs_scanned is the selectivity
+                        # story — trajectory rounds can spot a query falling
+                        # off the index back to a full scan
+                        docs_scanned[qid] = resp.stats.num_docs_scanned
                     fanout[qid] = queried
                     prune_ratio[qid] = round(
                         1.0 - queried / max(len(hosting), 1), 3)
@@ -1309,6 +1319,7 @@ class _Worker:
                     "p50_ms": p50,
                     "reduce_p50_ms": reduce_p50,
                     "reduce_path": reduce_path,
+                    "docs_scanned": docs_scanned,
                 }
             finally:
                 cluster.shutdown()
@@ -1610,6 +1621,140 @@ class _Worker:
             "seal_rows": seal_rows,
             "seal_ms": round(seal_ms, 1),
             "sealed_rung": sstats.group_by_rung,
+        }
+
+    def bench_userfacing(self) -> dict:
+        """User-facing analytics: Zipf point-filter group-bys over the wide
+        user-event table at 1/2/4/8 closed-loop client threads (ref:
+        Pinot's user-facing serving story — BitmapInvertedIndexReader /
+        RangeIndexReader-served point lookups at strict latency SLOs).
+        Every query in the mix is <1%-selective, so the PR-18 index rung
+        must serve ALL of them; the suite records p50/p95/p99/QPS per
+        level plus the per-query docs-scanned footprint and the rung
+        histogram from the decision ledger. LOUD-FAIL (escapes noted):
+
+        - a selective filter that leaves the index rung for a scan
+          (``BENCH_ALLOW_SCAN_SELECTIVE=1`` records anyway) — the SLO
+          story collapses if tail-user lookups pay full-scan latency;
+        - any index decline reason in the ledger that is NOT in
+          ``tracing.registered_reason_codes()`` — an unregistered decline
+          is an unexplained fallback, and the BENCH JSON must explain
+          every one."""
+        import concurrent.futures
+
+        from pinot_tpu.common import tracing
+        from pinot_tpu.query import compile_query
+        from pinot_tpu.tools import usertable
+
+        rows = min(self.rows, 2_000_000)
+        n_segs = 4
+        seg_dir = os.path.join(self.data_dir, "user_segs")
+        if not os.path.isdir(os.path.join(seg_dir, f"user_{n_segs - 1}")):
+            _log(f"userfacing: building user table ({rows} rows)")
+            segs = usertable.build_segments(seg_dir, num_segments=n_segs,
+                                            rows=rows)
+        else:
+            from pinot_tpu.segment import load_segment
+            segs = [load_segment(os.path.join(seg_dir, f"user_{i}"))
+                    for i in range(n_segs)]
+        users = usertable.tail_users(rows, num_segments=n_segs)
+        assert users, "userfacing: no tail users sampled"
+        ctxs = [compile_query(q) for q in usertable.point_queries(users)]
+
+        # verification pass: every query is selective by construction, so
+        # every one must ride the index rung on every segment — and every
+        # decline the ledger recorded anywhere in the run must be a
+        # registered reason code
+        allow_scan = os.environ.get("BENCH_ALLOW_SCAN_SELECTIVE")
+        docs_scanned = []
+        scan_leaks = []
+        for ctx in ctxs:
+            _, st = self.dev.execute(ctx, segs)   # doubles as compile/warm
+            docs_scanned.append(st.num_docs_scanned)
+            served = sum(v for k, v in st.decisions.items()
+                         if k.endswith(":index_served"))
+            if served < len(segs):
+                scan_leaks.append((ctx.sql, dict(st.decisions)))
+        if scan_leaks and not allow_scan:
+            raise AssertionError(
+                f"userfacing: {len(scan_leaks)} selective (<1%) point "
+                f"filter(s) left the index rung for a scan — first: "
+                f"{scan_leaks[0]}; set BENCH_ALLOW_SCAN_SELECTIVE=1 to "
+                f"record anyway")
+
+        seconds = 4.0
+        levels = {}
+        lock = threading.Lock()
+
+        def run_level(threads: int) -> dict:
+            lat: list = []
+            stop_at = time.perf_counter() + seconds
+
+            def pump(i: int) -> int:
+                done = 0
+                while time.perf_counter() < stop_at:
+                    ctx = ctxs[(i + done) % len(ctxs)]
+                    t0 = time.perf_counter()
+                    self.dev.execute(ctx, segs)
+                    dt = (time.perf_counter() - t0) * 1e3
+                    with lock:
+                        lat.append(dt)
+                    done += 1
+                return done
+
+            t0 = time.perf_counter()
+            with concurrent.futures.ThreadPoolExecutor(threads) as pool:
+                total = sum(pool.map(pump, range(threads)))
+            wall = time.perf_counter() - t0
+            arr = np.asarray(lat) if lat else np.asarray([0.0])
+            return {
+                "qps": round(total / wall, 2),
+                "p50_ms": round(float(np.percentile(arr, 50)), 3),
+                "p95_ms": round(float(np.percentile(arr, 95)), 3),
+                "p99_ms": round(float(np.percentile(arr, 99)), 3),
+                "queries": total,
+            }
+
+        dmark = self._decision_mark()
+        for threads in (1, 2, 4, 8):
+            _log(f"userfacing: sweeping {threads} thread(s)")
+            levels[str(threads)] = run_level(threads)
+        decisions = self._decision_delta(dmark)
+
+        # rung histogram: where did the sweep's queries actually serve
+        rungs = {}
+        registered = tracing.registered_reason_codes()
+        unregistered = []
+        for key, count in decisions.items():
+            point, chosen, _declined, reason = \
+                tracing.parse_decision_key(key)
+            if point != "index":
+                continue
+            rungs[chosen] = rungs.get(chosen, 0) + count
+            if reason not in registered:
+                unregistered.append(key)
+        if unregistered:
+            raise AssertionError(
+                f"userfacing: unregistered index decline reason(s) in the "
+                f"ledger: {unregistered} — register them in "
+                f"tracing.INDEX_DECISION_REASONS or fix the recording site")
+
+        four = levels["4"]
+        return {
+            "rows": rows,
+            "num_queries": len(ctxs),
+            "threads": 4,
+            "qps": four["qps"],
+            "p50_ms": four["p50_ms"],
+            "p95_ms": four["p95_ms"],
+            "p99_ms": four["p99_ms"],
+            "qps_by_threads": levels,
+            "docs_scanned_p50": int(np.percentile(docs_scanned, 50)),
+            "docs_scanned_max": int(max(docs_scanned)),
+            "selectivity_p50": round(
+                float(np.percentile(docs_scanned, 50)) / max(rows, 1), 6),
+            "rung_histogram": rungs,
+            "scan_leaks": len(scan_leaks),
         }
 
 

@@ -607,6 +607,15 @@ MUTABLE_DECLINE_REASONS = frozenset({
     "mutable_hll_lut_unstable",  # HLL register LUTs go stale as the
                                  # dictionary grows mid-consume
     "mutable_exec_failed",       # staging/kernel raised: host fallback
+    # the consuming-segment index rung (PR-18), recorded through
+    # _decline_rung/_chose_rung — declines fall to the full chunk scan
+    # (NOT to host), so these ride the "index" decision point with the
+    # mutable device scan as the chosen side
+    "mutable_index_unsupported_shape",  # OR/NOT, non-EQ/IN/RANGE, MV,
+                                        # dictionary-less, or upsert
+    "mutable_index_over_threshold",     # broad match: the chunk scan wins
+    "mutable_index_exec_failed",        # gather kernel raised: chunk scan
+    "mutable_index_served",             # gather served the snapshot
 })
 HYBRID_ROUTE_REASONS = frozenset({
     "hybrid_single_table",    # only one physical table: no split
@@ -622,7 +631,9 @@ _register_reasons(ReasonNamespace(
     "mutable", MUTABLE_DECLINE_REASONS,
     "pinot_tpu.engine.mutable_staging",
     literal_patterns=(
-        r'_decline\(\s*[a-zA-Z_][a-zA-Z0-9_]*\s*,\s*"([a-z0-9_]+)"',),
+        r'_decline\(\s*[a-zA-Z_][a-zA-Z0-9_]*\s*,\s*"([a-z0-9_]+)"',
+        r'_decline_rung\(\s*[a-zA-Z_][a-zA-Z0-9_]*\s*,\s*"([a-z0-9_]+)"',
+        r'_chose_rung\(\s*[a-zA-Z_][a-zA-Z0-9_]*\s*,\s*"([a-z0-9_]+)"',),
     min_sites=3, exact=True))
 _register_reasons(ReasonNamespace(
     "hybrid", HYBRID_ROUTE_REASONS, "pinot_tpu.broker.broker",
@@ -631,6 +642,25 @@ _register_reasons(ReasonNamespace(
 _register_reasons(ReasonNamespace(
     "seal", SEAL_SWAP_REASONS, "pinot_tpu.server.data_manager",
     literal_patterns=(r'"(seal_[a-z0-9_]+)"',), min_sites=2, exact=True))
+# index rung (PR-18): docId-gather over inverted/sorted/range indexes —
+# every outcome on an index-candidate filter shape, chosen and declined
+INDEX_DECISION_REASONS = frozenset({
+    "index_served",              # gather rung served the segment
+    "index_filter_shape",        # OR/NOT or non-column predicate
+    "index_pred_type_unsupported",  # not EQ / IN / RANGE
+    "index_missing_index",       # a predicate column has no usable index
+    "index_selectivity_over_threshold",  # broad match: the scan wins
+    "index_upsert_valid_docs",   # valid-doc bitmap ANDs the filter
+    "index_plan_error",          # device plan/unpack declined -> scan
+    "index_exec_failed",         # staging/kernel raised -> scan serves
+})
+_register_reasons(ReasonNamespace(
+    "index", INDEX_DECISION_REASONS, "pinot_tpu.engine.index_exec",
+    literal_patterns=(
+        r'_decline\(\s*stats,\s*"([a-z0-9_]+)"',
+        r'raise _Decline\(\s*"([a-z0-9_]+)"',
+        r'_chose\(\s*stats,\s*"([a-z0-9_]+)"',),
+    min_sites=6, exact=True))
 
 
 _SANITIZE = re.compile(r"[^a-z0-9]+")

@@ -659,6 +659,12 @@ class ServerQueryExecutor:
                 if res is not None:
                     return done(res, "mutable_device")
             else:
+                from pinot_tpu.engine import index_exec
+
+                ix = index_exec.try_index_rung(self, ctx, aggs, seg, stats,
+                                               grouped=False)
+                if ix is not None:
+                    return done(ix, "index")
                 try:
                     plan = self._plan_for(ctx, seg)
                     return done(self._run_device_scalar(plan, seg, stats),
@@ -714,6 +720,27 @@ class ServerQueryExecutor:
         with self._startree_kernel_lock:
             cur = self._startree_kernels.setdefault(spec, k)
             self._startree_kernels.move_to_end(spec)
+            if len(self._startree_kernels) > 256:
+                self._startree_kernels.popitem(last=False)
+            return cur
+
+    def _index_kernel(self, spec: Tuple):
+        """spec -> jitted index-rung docId-gather kernel. Shares the
+        star-tree kernel LRU under a distinct key: the gather differs
+        (dictvals stay un-gathered — they're dictId-shaped), so the two
+        rungs never alias a cache entry."""
+        from pinot_tpu.engine.index_exec import build_gather_kernel
+
+        key = ("index", spec)
+        with self._startree_kernel_lock:
+            k = self._startree_kernels.get(key)
+            if k is not None:
+                self._startree_kernels.move_to_end(key)
+                return k
+        k = build_gather_kernel(spec)
+        with self._startree_kernel_lock:
+            cur = self._startree_kernels.setdefault(key, k)
+            self._startree_kernels.move_to_end(key)
             if len(self._startree_kernels) > 256:
                 self._startree_kernels.popitem(last=False)
             return cur
@@ -843,6 +870,13 @@ class ServerQueryExecutor:
                     stats.group_by_rung = "mutable_device"
                     return done(res, "mutable_device")
             else:
+                from pinot_tpu.engine import index_exec
+
+                ix = index_exec.try_index_rung(self, ctx, aggs, seg, stats,
+                                               grouped=True)
+                if ix is not None:
+                    stats.group_by_rung = "index"
+                    return done(ix, "index")
                 try:
                     plan = self._plan_for(ctx, seg)
                     return done(self._run_device_grouped(plan, seg, stats),

@@ -183,6 +183,11 @@ class StagedMutableSegment:
         self._staged_bytes = 0  # guarded-by: _lock
         # (version, wm, cap)-keyed device snapshot of the upsert mask
         self._valid_cache = None  # guarded-by: _lock
+        # consuming-segment inverted index (the index rung's mutable half):
+        # column -> {"upto": rows indexed, "lists": {dictId: [docId blocks]}}
+        # — host numpy, resident-owned: counted in nbytes(), dropped in
+        # release() like any staged state
+        self._postings: Dict[str, Any] = {}  # guarded-by: _lock
 
     # -- accounting (conservation contract) ---------------------------------
     def _recount_bytes_locked(self) -> None:
@@ -204,6 +209,10 @@ class StagedMutableSegment:
             vc = self._valid_cache
             if vc is not None:
                 total += int(getattr(vc[1], "nbytes", 0))
+            for st in self._postings.values():
+                for blocks in st["lists"].values():
+                    for b in blocks:
+                        total += int(b.nbytes)
             if self._cursor:
                 # the cursors hold host ints (no device bytes); the chunk
                 # walk and the running counter agree under the lock —
@@ -217,6 +226,7 @@ class StagedMutableSegment:
             self._cursor.clear()
             self._staged_bytes = 0
             self._valid_cache = None
+            self._postings.clear()
 
     # -- staging ------------------------------------------------------------
     def snapshot(self) -> MutableSnapshot:
@@ -366,6 +376,42 @@ class StagedMutableSegment:
             out["null"] = nc
         return out
 
+    def postings_doc_ids(self, name: str, col, dict_ids, wm: int
+                         ) -> np.ndarray:
+        """Sorted unique docIds below ``wm`` whose SV column ``name`` holds
+        a dictId in ``dict_ids`` — the consuming-segment analogue of the
+        immutable inverted index, grown incrementally: one stable argsort
+        over the DELTA rows per refresh groups them by dictId, so repeat
+        point queries pay O(delta log delta), never O(wm). Per-dictId block
+        lists stay ascending by construction (blocks arrive in watermark
+        order; within a block the stable sort preserves row order)."""
+        with self._lock:
+            st = self._postings.get(name)
+            if st is None:
+                st = {"upto": 0, "lists": {}}
+                self._postings[name] = st
+            upto = int(st["upto"])
+            if wm > upto:
+                fwd = np.asarray(col.fwd.view(wm)[upto:wm])
+                order = np.argsort(fwd, kind="stable").astype(np.int64)
+                sv = fwd[order]
+                uniq, starts = np.unique(sv, return_index=True)
+                bounds = np.append(starts, sv.size)
+                lists = st["lists"]
+                for i, d in enumerate(uniq.tolist()):
+                    docs = order[bounds[i]:bounds[i + 1]] + upto
+                    lists.setdefault(int(d), []).append(docs)
+                st["upto"] = wm
+            parts = [block for d in dict_ids
+                     for block in st["lists"].get(int(d), ())]
+        if not parts:
+            return np.empty(0, dtype=np.int64)
+        docs = parts[0] if len(parts) == 1 else \
+            np.sort(np.concatenate(parts))
+        # a concurrent query may have refreshed the map past this query's
+        # snapshot: clip to the snapshot watermark
+        return docs[:int(np.searchsorted(docs, wm))]
+
     def _valid_locked(self, wm: int, cap: int):
         """(host numpy snapshot, device snapshot) of the upsert valid-doc
         bitmap at this watermark, or (None, None). Cached on (bitmap
@@ -421,6 +467,18 @@ def _decline(stats, reason: str) -> None:
     """Host fallback with a ledger record (scanned by the 'mutable'
     ReasonNamespace — the first string literal is the reason code)."""
     record_decision(stats, "mutable", "host_engine", "mutable_device",
+                    reason)
+
+
+def _decline_rung(stats, reason: str) -> None:
+    """Index-assisted gather declined to the FULL mutable chunk scan (not
+    to host) — the consuming-segment half of the index rung's ledger."""
+    record_decision(stats, "index", "mutable_device", "index_gather",
+                    reason)
+
+
+def _chose_rung(stats, reason: str) -> None:
+    record_decision(stats, "index", "index_gather", "mutable_device",
                     reason)
 
 
@@ -485,6 +543,11 @@ def _serve(executor, ctx, aggs, seg, stats, grouped: bool):
                         e.reason_code)
         return None
 
+    res = _try_index_gather(executor, ctx, seg, resident, view, snap, plan,
+                            stats, table, grouped)
+    if res is not None:
+        return res
+
     t0 = time.perf_counter()
     try:
         with maybe_span(stats, "Kernel", kernel="jnp",
@@ -511,3 +574,102 @@ def _serve(executor, ctx, aggs, seg, stats, grouped: bool):
     if grouped:
         return decode_grouped_result(plan, view, out)
     return decode_scalar_result(plan, view, out)
+
+
+def _try_index_gather(executor, ctx, seg, resident, view, snap, plan,
+                      stats, table: str, grouped: bool):
+    """The consuming-segment half of the index rung: selective conjunctive
+    EQ/IN/RANGE filters over SV dict columns resolve docIds from the
+    resident's growing dictId->docIds map and run the SAME gather kernel
+    the immutable rung uses over the snapshot's chunk trees. Returns the
+    decoded result, or None — every None on an index-candidate shape is a
+    ``_decline_rung`` record, and the full chunk scan (not host) serves."""
+    from pinot_tpu.engine import index_exec
+    from pinot_tpu.engine.executor import (
+        decode_grouped_result,
+        decode_scalar_result,
+    )
+    from pinot_tpu.engine.host_eval import _matching_dict_ids
+    from pinot_tpu.engine.kernels import unpack_outputs
+    from pinot_tpu.engine.startree_exec import _flatten_and
+    from pinot_tpu.query.expressions import Identifier, PredicateType
+
+    if ctx.options.get("useIndexRung", "true").lower() == "false":
+        return None  # operator opt-out, not a decline
+    if ctx.filter is None:
+        return None  # nothing selective to index
+    preds = _flatten_and(ctx.filter)
+    if not preds:
+        if preds is None:  # OR/NOT shape
+            _decline_rung(stats, "mutable_index_unsupported_shape")
+        return None
+    if snap.valid_host is not None:
+        # upsert: validity must AND the filter and the map doesn't see it
+        _decline_rung(stats, "mutable_index_unsupported_shape")
+        return None
+
+    wm = snap.wm
+    threshold = max(1, int(wm * index_exec.SELECTIVITY_THRESHOLD))
+    per_pred = []
+    for pred in preds:
+        lhs = pred.lhs
+        if not isinstance(lhs, Identifier) or lhs.name.startswith("$") \
+                or pred.type not in (PredicateType.EQ, PredicateType.IN,
+                                     PredicateType.RANGE):
+            _decline_rung(stats, "mutable_index_unsupported_shape")
+            return None
+        col = seg._cols.get(lhs.name)
+        if col is None or col.mv_offsets is not None \
+                or getattr(col, "dictionary", None) is None:
+            # MV / missing / dictionary-less column: the chunk scan serves
+            _decline_rung(stats, "mutable_index_unsupported_shape")
+            return None
+        ids = _matching_dict_ids(view.data_source(lhs.name), pred)
+        if ids.size > 256:  # broad dictId set: the scan wins outright
+            _decline_rung(stats, "mutable_index_over_threshold")
+            return None
+        per_pred.append((lhs.name, col, ids))
+
+    routes = [resident.postings_doc_ids(name, col, ids, wm)
+              for name, col, ids in per_pred]
+    if min(d.size for d in routes) > threshold:
+        _decline_rung(stats, "mutable_index_over_threshold")
+        return None
+    routes.sort(key=lambda d: d.size)
+    idx = routes[0]
+    for d in routes[1:]:
+        if idx.size == 0:
+            break
+        idx = np.intersect1d(idx, d, assume_unique=True)
+    n = int(idx.size)
+
+    stripped = index_exec.gather_plan(plan, n)
+    capacity = stripped.spec[4]
+    padded = np.zeros(capacity, dtype=np.int32)
+    padded[:n] = idx.astype(np.int32, copy=False)
+    t0 = time.perf_counter()
+    try:
+        with maybe_span(stats, "Kernel", kernel="index_gather",
+                        segment=seg.segment_name, records=n):
+            cols = {c: snap.tree(c) for c in stripped.columns}
+            kernel = executor._index_kernel(stripped.spec)
+            packed = kernel(cols, jnp.asarray(padded),
+                            tuple(stripped.params), np.int32(n))
+            out = unpack_outputs(packed, stripped.spec)
+    except Exception:
+        log.exception("mutable index gather failed for %s; chunk scan",
+                      seg.segment_name)
+        _decline_rung(stats, "mutable_index_exec_failed")
+        return None
+    observe_ms(table, "kernel", (time.perf_counter() - t0) * 1e3)
+
+    stats.num_segments_processed += 1
+    stats.total_docs += wm
+    stats.num_docs_scanned += n
+    if n:
+        stats.num_segments_matched += 1
+    _chose_rung(stats, "mutable_index_served")
+    observe_freshness(seg, wm, table)
+    if grouped:
+        return decode_grouped_result(stripped, view, out)
+    return decode_scalar_result(stripped, view, out)

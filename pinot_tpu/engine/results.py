@@ -76,8 +76,9 @@ class QueryStats:
     num_servers_queried: int = 0
     num_servers_responded: int = 0
     # group-by ladder rung that served ('dense'|'compact'|'hash'|'sort'|
-    # 'startree_device'|'startree'|'host'; 'mixed' when segments split
-    # across rungs) — the bench gates SSB Q2.x/Q3.x on this
+    # 'startree_device'|'startree'|'index'|'host'; 'mixed' when segments
+    # split across rungs) — the bench gates SSB Q2.x/Q3.x on this, and
+    # the userfacing suite gates selective point filters on 'index'
     group_by_rung: Optional[str] = None
     # index of the star-tree that served (segment.star_trees order; the
     # bench records it per query), or None off the star-tree rungs. A

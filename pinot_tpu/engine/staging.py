@@ -18,7 +18,8 @@ from __future__ import annotations
 
 import threading
 
-from typing import Dict, Optional
+from collections import OrderedDict
+from typing import Any, Dict, Optional
 
 import jax.numpy as jnp
 import numpy as np
@@ -76,6 +77,11 @@ class StagedColumn:
 # (TPU-side re-design of the reference's unaligned bit extraction,
 # io/util/PinotDataBitSet.java:25).
 PALLAS_TILE = 4096
+
+# resident idx arrays per segment (index rung): LRU working-set bound —
+# each is at most ~SELECTIVITY_THRESHOLD * capacity int32s, so the cap
+# bounds idx residency to a small multiple of one staged column
+_INDEX_SLICE_CAP = 64
 
 # 12-bit value limbs for the fused kernel's exact integer accumulation
 # (pallas_kernels._LIMB_BITS aliases this): i64-staged value columns ship
@@ -201,6 +207,10 @@ class StagedSegment:
         # (engine/plan.py startree_dim_key/startree_metric_key namespace) —
         # resident like any column: counted in nbytes(), dropped in release()
         self._startree: Dict[int, Dict[str, jnp.ndarray]] = {}  # guarded-by-writes: _lock
+        # index-rung idx arrays: filter fingerprint -> padded int32 docIds
+        # (LRU-capped; tiny next to columns but resident all the same —
+        # counted in nbytes(), dropped in release())
+        self._index_slices: "OrderedDict[Any, jnp.ndarray]" = OrderedDict()  # guarded-by-writes: _lock
         self._valid_cache = None  # guarded-by-writes: _lock
         self._lock = threading.Lock()
         # cross-query dedup hook: ``borrower(segment, name)`` may return a
@@ -490,6 +500,43 @@ class StagedSegment:
                 np.asarray(vals).astype(dt))
         return cols
 
+    def index_slice(self, key, build) -> jnp.ndarray:
+        """Device idx array for one resolved filter (index rung): the padded
+        int32 docId slice, H2D'd once per (filter, capacity) and reused by
+        repeat queries — the point-lookup analogue of the star-tree node
+        cache. ``build()`` returns the padded host array on miss. LRU-capped:
+        a dashboard's rotating literal set must not grow the resident
+        unboundedly (the residency manager re-measures via ``account`` after
+        every install, so the cap is a working-set bound, not the budget)."""
+        arr = self._index_slices.get(key)
+        if arr is not None:
+            with self._lock:
+                if key in self._index_slices:
+                    self._index_slices.move_to_end(key)
+            return arr
+        with self._lock:
+            arr = self._index_slices.get(key)
+            if arr is None:
+                arr = jnp.asarray(build())
+                self._index_slices[key] = arr
+                while len(self._index_slices) > _INDEX_SLICE_CAP:
+                    self._index_slices.popitem(last=False)
+        return arr
+
+    def release_index_slices(self) -> int:
+        """Drop every resident idx array (columns stay resident) — the
+        index rung's eviction grain. Returns the device bytes released;
+        in-flight launches keep their array alive by reference."""
+        with self._lock:
+            slices = list(self._index_slices.values())
+            self._index_slices.clear()
+        return sum(int(getattr(a, "nbytes", 0)) for a in slices)
+
+    def index_nbytes(self) -> int:
+        """Device bytes held by resident idx arrays (/debug/memory view)."""
+        return sum(int(getattr(a, "nbytes", 0))
+                   for a in list(self._index_slices.values()))
+
     def valid_mask(self):
         """Upsert valid-doc snapshot [capacity] for the validdocs kernel
         param, or None when the segment isn't upsert-managed. Versioned
@@ -531,6 +578,8 @@ class StagedSegment:
         for t in list(self._startree.values()):
             for arr in t.values():
                 total += int(getattr(arr, "nbytes", 0))
+        for a in list(self._index_slices.values()):
+            total += int(getattr(a, "nbytes", 0))
         vc = self._valid_cache
         if vc is not None:
             total += int(getattr(vc[1], "nbytes", 0))
@@ -590,6 +639,10 @@ class StagedSegment:
             self._packed.clear()
             self._values.clear()
             self._startree.clear()
+            # idx arrays rebuild from the host-resolved docIds in one H2D —
+            # cheaper than any column restage, so they never demote to the
+            # host image; release drops them outright
+            self._index_slices.clear()
             self._valid_cache = None
             img = self._host_image
             if img is not None:

@@ -133,8 +133,22 @@ class ShardedQueryExecutor(ServerQueryExecutor):
                    for s in segments
                    if getattr(s, "star_trees", None))
 
+    def _index_rung_fit(self, ctx, segments) -> bool:
+        """Selective indexed filters take the per-segment path too: the
+        PR-18 docId-gather rung ships a handful of matching rows per
+        segment, which beats a dense sharded scan of every row — same
+        rationale as the star-tree routing above, gated on the index
+        cost model saying EVERY segment stays under the selectivity
+        threshold (index_exec.batch_index_eligible)."""
+        from pinot_tpu.engine import index_exec
+
+        return index_exec.batch_index_eligible(self, ctx, segments)
+
     def _execute_aggregation(self, ctx, aggs, segments, stats):
         if self._any_star_tree_fit(ctx, aggs, segments):
+            return ServerQueryExecutor._execute_aggregation(
+                self, ctx, aggs, segments, stats)
+        if self.use_device and self._index_rung_fit(ctx, segments):
             return ServerQueryExecutor._execute_aggregation(
                 self, ctx, aggs, segments, stats)
         if self.use_device and self._sliced_lease(stats) is not None:
@@ -157,6 +171,9 @@ class ShardedQueryExecutor(ServerQueryExecutor):
 
     def _execute_group_by(self, ctx, aggs, segments, stats):
         if self._any_star_tree_fit(ctx, aggs, segments):
+            return ServerQueryExecutor._execute_group_by(
+                self, ctx, aggs, segments, stats)
+        if self.use_device and self._index_rung_fit(ctx, segments):
             return ServerQueryExecutor._execute_group_by(
                 self, ctx, aggs, segments, stats)
         if self.use_device and self._sliced_lease(stats) is not None:

@@ -41,6 +41,14 @@ caught:
   must be read by ``nbytes()`` AND cleared by ``release()`` — a staged
   cache that accounting cannot see, or that eviction cannot drop, is the
   tiered-storage follow-up's landmine.
+- **idxacct** (every function, package-wide): a ``.index_slice(...)``
+  call pins a freshly-built device idx array on a staged resident, so it
+  must reach a residency ``.account(...)`` call (or a direct ``*bytes*``
+  counter write) on every fall-through path before exit — otherwise the
+  pinned array inflates the resident's true footprint while the budget's
+  running view predates it. Exception paths are exempt for the same
+  reason as the insert rule: ``nbytes()`` walks the slice cache, so the
+  next refresh re-measures.
 - **spanpair** (every function, package-wide): a ``span_begin(...)`` call
   must reach a ``span_end`` mentioning its holder on ALL paths including
   exception edges (the hostacct machinery over the same CFG) — an open
@@ -515,7 +523,101 @@ def check_conservation(ctx: LintContext) -> List[Finding]:
                     _check_chunkacct(mod, node, findings)
             if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
                 _check_spanpair(mod, node, findings)
+                _check_idxacct(mod, node, findings)
     return findings
+
+
+# --------------------------------------------------------------------------
+# idxacct: a pinned idx-array slice must reach byte accounting on every
+# fall-through path — the index-rung residency obligation
+# --------------------------------------------------------------------------
+
+class _IdxAcctAnalysis:
+    """Forward obligation analysis over one function: a ``.index_slice(...)``
+    call grows a staged resident's device footprint (the docId gather array
+    is pinned in the resident's slice cache), so every fall-through path to
+    exit must pass a residency ``.account(...)`` call or a direct ``*bytes*``
+    counter write. Exception edges are exempt — ``nbytes()`` walks the slice
+    cache, so the next refresh re-measures (same rationale as the insert
+    rule)."""
+
+    def __init__(self, fn: ast.AST):
+        self.fn = fn
+        self.obligation_lines: Dict[Tuple, int] = {}
+
+    @staticmethod
+    def _opens(st: ast.stmt) -> Optional[int]:
+        for n in stmt_scan(st):
+            if isinstance(n, ast.Call) \
+                    and isinstance(n.func, ast.Attribute) \
+                    and n.func.attr == "index_slice":
+                return n.lineno
+        return None
+
+    @staticmethod
+    def _discharges(st: ast.stmt) -> bool:
+        targets: List[ast.expr] = []
+        if isinstance(st, ast.Assign):
+            targets = st.targets
+        elif isinstance(st, (ast.AugAssign, ast.AnnAssign)):
+            targets = [st.target]
+        for t in targets:
+            if isinstance(t, ast.Attribute) and is_self_attr(t) \
+                    and "bytes" in t.attr.lower():
+                return True
+        for n in stmt_scan(st):
+            if isinstance(n, ast.Call) \
+                    and isinstance(n.func, ast.Attribute) \
+                    and n.func.attr == "account":
+                return True
+        return False
+
+    def transfer(self, state: Dict[Tuple, bool], st: Optional[ast.AST],
+                 nid: int) -> Dict[Tuple, bool]:
+        if st is None or not isinstance(st, ast.stmt):
+            return state
+        out = dict(state)
+        if self._discharges(st):
+            out = {oid: False for oid in out}
+        line = self._opens(st)
+        if line is not None:
+            oid = ("idx", st.lineno, getattr(st, "col_offset", 0))
+            out[oid] = True
+            self.obligation_lines[oid] = line
+        return out
+
+    def run(self) -> List[int]:
+        cfg = build_cfg(self.fn)
+
+        def join(a: Dict[Tuple, bool],
+                 b: Dict[Tuple, bool]) -> Dict[Tuple, bool]:
+            out = dict(a)
+            for oid, p in b.items():
+                out[oid] = out.get(oid, False) or p
+            return out
+
+        fa = ForwardAnalysis(cfg, {}, self.transfer, join,
+                             exc_filter=lambda s: {})
+        inn = fa.run()
+        exit_state = inn.get(cfg.exit, {})
+        return sorted(self.obligation_lines[oid]
+                      for oid, p in exit_state.items() if p)
+
+
+def _check_idxacct(mod: Module, fn: ast.AST,
+                   findings: List[Finding]) -> None:
+    if not any(isinstance(n, ast.Call)
+               and isinstance(n.func, ast.Attribute)
+               and n.func.attr == "index_slice"
+               for n in walk_no_nested(fn)):
+        return
+    for line in _IdxAcctAnalysis(fn).run():
+        findings.append(Finding(
+            "conservation", mod.relpath, line,
+            f"{fn.name}:idxacct",
+            f"index_slice in {fn.name}() pins a device idx array on a "
+            f"path that exits without reaching byte accounting — the "
+            f"resident's budgeted footprint predates the pinned slice"))
 
 
 # --------------------------------------------------------------------------

@@ -1,0 +1,192 @@
+"""User-event table generator — the index-rung's user-facing workload.
+
+Pinot's signature deployment shape is *user-facing analytics*: a wide
+per-user event table answering huge volumes of tiny point-filter
+group-bys ("this user's last-30-days spend by category") at strict
+latency SLOs. Those queries touch a vanishing fraction of rows, so the
+reference serves them off ``BitmapInvertedIndexReader`` /
+``RangeIndexReader`` postings, never a scan. This module generates that
+table with the distributions that make the shape real:
+
+- ``user_id`` — Zipf-distributed (a few whales, a long tail), inverted
+  index: the point-filter column. A tail user's postings are a handful
+  of docIds; the index rung ships exactly those to the device.
+- ``tags`` — multi-value dimension, inverted index (the MV postings
+  union path).
+- ``latency_ms`` — raw (no-dictionary) metric with a RANGE index: the
+  ``BETWEEN``-predicate column.
+- ``revenue`` — **dictionary-encoded** numeric metric: aggregating it
+  exercises the gather kernel's dictvals passthrough (the dictId->value
+  LUT must NOT be gathered by docId).
+- ``country`` / ``device`` / ``event_type`` — low-cardinality dims for
+  the GROUP BY side (country carries an inverted index too).
+
+``build_segments`` mirrors :mod:`pinot_tpu.tools.ssb`'s per-segment
+independent generation so builds parallelize without cross-segment
+data movement.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, List
+
+import numpy as np
+
+from pinot_tpu.spi import DataType, FieldSpec, FieldType, Schema
+
+# tail users hold a handful of rows each; whales hold thousands —
+# rng.zipf(ZIPF_A) clipped to NUM_USERS gives both in one draw
+NUM_USERS = 100_000
+ZIPF_A = 1.3
+
+COUNTRIES = ["US", "IN", "BR", "DE", "JP", "GB", "FR", "CA", "AU", "MX"]
+DEVICES = ["ios", "android", "web", "tv"]
+EVENT_TYPES = ["view", "click", "cart", "purchase", "refund"]
+TAGS = [f"tag{i}" for i in range(32)]
+
+
+def user_schema() -> Schema:
+    D, M = FieldType.DIMENSION, FieldType.METRIC
+    I, S = DataType.INT, DataType.STRING
+    return Schema("user_events", [
+        FieldSpec("user_id", I, D),
+        FieldSpec("country", S, D),
+        FieldSpec("device", S, D),
+        FieldSpec("event_type", S, D),
+        FieldSpec("tags", S, D, single_value=False),
+        FieldSpec("latency_ms", I, M),
+        FieldSpec("revenue", I, M),
+        FieldSpec("num_items", I, M),
+    ])
+
+
+def user_indexing_config():
+    """Inverted on the point-filter dims (user_id/country/event_type/tags),
+    RANGE on the raw latency column; revenue/num_items stay
+    dictionary-encoded on purpose (the dictvals-passthrough aggregation
+    path), latency_ms raw (range index wants raw sorted values)."""
+    from pinot_tpu.spi.table import IndexingConfig
+
+    return IndexingConfig(
+        inverted_index_columns=["user_id", "country", "event_type", "tags"],
+        range_index_columns=["latency_ms"],
+        no_dictionary_columns=["latency_ms"],
+    )
+
+
+def generate_frame(i: int, num_segments: int, n: int,
+                   seed: int = 7) -> Dict[str, np.ndarray]:
+    """Segment ``i``'s rows — independently seeded, like the SSB builder."""
+    rng = np.random.default_rng(seed * 1_000_003 + i)
+    user = rng.zipf(ZIPF_A, n).clip(1, NUM_USERS).astype(np.int64)
+    n_tags = rng.integers(1, 4, n)
+    tag_pool = np.array(TAGS)
+    # MV columns ride the frame as plain python list-of-lists
+    tags = [tag_pool[rng.integers(0, len(TAGS), k)].tolist()
+            for k in n_tags]
+    return {
+        "user_id": user,
+        "country": np.array(COUNTRIES)[rng.integers(0, len(COUNTRIES), n)],
+        "device": np.array(DEVICES)[rng.integers(0, len(DEVICES), n)],
+        "event_type": np.array(EVENT_TYPES)[
+            rng.integers(0, len(EVENT_TYPES), n)],
+        "tags": tags,
+        # long-tailed latency, integer ms (range-index predicates)
+        "latency_ms": (rng.gamma(2.0, 40.0, n) + 1).astype(np.int64),
+        # small value domain -> dictionary-encodes tightly
+        "revenue": rng.integers(0, 500, n).astype(np.int64),
+        "num_items": rng.integers(1, 10, n).astype(np.int64),
+    }
+
+
+def _build_one(i: int, num_segments: int, n: int, seed: int,
+               out_dir: str) -> str:
+    from pinot_tpu.segment import SegmentBuilder
+
+    frame = generate_frame(i, num_segments, n, seed)
+    name = f"user_{i}"
+    SegmentBuilder(user_schema(), name,
+                   indexing_config=user_indexing_config()).build(frame,
+                                                                 out_dir)
+    return name
+
+
+def build_segments(out_dir: str, num_segments: int = 4, rows: int = 1_000_000,
+                   seed: int = 7, workers: int = 0) -> List:
+    """Build + load ``num_segments`` user-event segments (spawn pool when
+    ``workers`` allows, same rationale as :func:`ssb.build_segments`)."""
+    from pinot_tpu.segment import load_segment
+
+    per = -(-rows // num_segments)
+    jobs = []
+    left = rows
+    for i in range(num_segments):
+        take = min(per, left)
+        if take <= 0:
+            break
+        jobs.append((i, num_segments, take, seed, out_dir))
+        left -= take
+    if not workers:
+        workers = min(len(jobs), os.cpu_count() or 1)
+    if workers > 1 and len(jobs) > 1:
+        import multiprocessing as mp
+
+        with mp.get_context("spawn").Pool(workers) as pool:
+            names = pool.starmap(_build_one, jobs)
+    else:
+        names = [_build_one(*j) for j in jobs]
+    return [load_segment(os.path.join(out_dir, nm)) for nm in names]
+
+
+def tail_users(rows: int, num_segments: int = 4, seed: int = 7,
+               count: int = 64, max_rows_frac: float = 0.001) -> List[int]:
+    """Deterministic sample of user_ids whose TOTAL row count stays under
+    ``max_rows_frac`` of the table — the selective point-filter targets
+    the userfacing suite cycles through (tail users, not whales)."""
+    per = -(-rows // num_segments)
+    counts: Dict[int, int] = {}
+    left = rows
+    for i in range(num_segments):
+        take = min(per, left)
+        if take <= 0:
+            break
+        rng = np.random.default_rng(seed * 1_000_003 + i)
+        user = rng.zipf(ZIPF_A, take).clip(1, NUM_USERS).astype(np.int64)
+        uniq, cnt = np.unique(user, return_counts=True)
+        for u, c in zip(uniq.tolist(), cnt.tolist()):
+            counts[u] = counts.get(u, 0) + c
+        left -= take
+    cap = max(1, int(rows * max_rows_frac))
+    pool = sorted(u for u, c in counts.items() if 0 < c <= cap)
+    if not pool:
+        return []
+    pick = np.random.default_rng(seed).choice(
+        len(pool), size=min(count, len(pool)), replace=False)
+    return [pool[int(j)] for j in sorted(pick)]
+
+
+def point_queries(users: List[int]) -> List[str]:
+    """The user-facing query mix: per-user point-filter group-bys and
+    range-augmented aggregations, one query per sampled user (cycled by
+    the closed-loop workers). Every one is <1%-selective, so each MUST
+    serve from the index rung."""
+    out = []
+    for k, u in enumerate(users):
+        shape = k % 3
+        if shape == 0:
+            out.append(
+                f"SELECT event_type, count(*), sum(revenue) "
+                f"FROM user_events WHERE user_id = {u} "
+                f"GROUP BY event_type")
+        elif shape == 1:
+            out.append(
+                f"SELECT country, count(*), sum(num_items) "
+                f"FROM user_events WHERE user_id = {u} "
+                f"AND event_type IN ('click', 'purchase') "
+                f"GROUP BY country")
+        else:
+            out.append(
+                f"SELECT count(*), sum(revenue) FROM user_events "
+                f"WHERE user_id = {u} AND latency_ms BETWEEN 10 AND 200")
+    return out

@@ -97,6 +97,13 @@ def pytest_configure(config):
         "consuming segments, watermark-snapshot parity, seal-under-query "
         "hammer, hybrid time-boundary routing, freshness SLO; pytest "
         "-m realtime_tier runs it in isolation; part of tier-1)")
+    config.addinivalue_line(
+        "markers",
+        "index_rung: index-accelerated selective filters (host docId "
+        "resolution over inverted/sorted/range indexes, device gather "
+        "kernel parity vs scan and host oracle, residency pinning, "
+        "decision-ledger exactness; pytest -m index_rung runs it in "
+        "isolation; part of tier-1)")
 
 
 @pytest.fixture(scope="session")

@@ -53,6 +53,33 @@ def test_aggregation_states():
     assert out.stats.num_docs_scanned == 42
 
 
+def test_group_by_rung_index_wire_and_merge():
+    """PR 18: the 'index' rung joins the group_by_rung lattice — it must
+    survive the binary wire round-trip and merge like any other rung
+    (same+same keeps it, disagreement collapses to 'mixed', None adopts)."""
+    stats = QueryStats(num_docs_scanned=7, total_docs=1000,
+                       group_by_rung="index")
+    out = _roundtrip(DataTable.for_aggregation([7], stats))
+    assert out.stats.group_by_rung == "index"
+    assert out.stats.num_docs_scanned == 7
+
+    a = QueryStats(group_by_rung="index")
+    a.merge(QueryStats(group_by_rung="index"))
+    assert a.group_by_rung == "index"
+
+    b = QueryStats()
+    b.merge(QueryStats(group_by_rung="index"))
+    assert b.group_by_rung == "index"
+
+    c = QueryStats(group_by_rung="index")
+    c.merge(QueryStats(group_by_rung="dense"))
+    assert c.group_by_rung == "mixed"
+
+    d = QueryStats(group_by_rung="startree_device")
+    d.merge(QueryStats(group_by_rung="index"))
+    assert d.group_by_rung == "mixed"
+
+
 def test_group_by_columnar():
     groups = {("east", 2019): [10, 1.5], ("west", 2020): [20, -2.5]}
     dt = DataTable.for_group_by(groups, {"region": "STRING", "year": "INT"},

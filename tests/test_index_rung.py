@@ -1,0 +1,416 @@
+"""Index-accelerated selective filters (PR 18): the docId-gather rung.
+
+Parity contract: for any filter the rung accepts, the result must be
+BIT-IDENTICAL to the scan rungs (``OPTION(useIndexRung=false)``) and the
+host oracle — the gather feeds the very same ``build_kernel_body`` the
+scan kernels run, minus the filter. ``num_docs_scanned`` must equal the
+matched row count (the selectivity story user-facing SLOs are built on),
+every decline must land in the ledger with a registered reason code, and
+the pinned idx arrays must obey residency accounting/eviction.
+
+Ref: BitmapBasedFilterOperator / SortedIndexBasedFilterOperator /
+RangeIndexBasedFilterOperator — the reference's index-served filter
+operators this rung re-shapes for the device.
+"""
+
+import numpy as np
+import pytest
+
+from pinot_tpu.common import tracing
+from pinot_tpu.engine import ServerQueryExecutor
+from pinot_tpu.query import compile_query
+from pinot_tpu.spi import DataType, FieldSpec, FieldType, Schema
+
+pytestmark = pytest.mark.index_rung
+
+ROWS = 60_000
+N_SEGS = 2
+
+SERVED = "index:scan->index_gather:index_served"
+DECLINED = "index:index_gather->scan:{}"
+MUT_SERVED = "index:mutable_device->index_gather:mutable_index_served"
+MUT_DECLINED = "index:index_gather->mutable_device:{}"
+
+
+@pytest.fixture(scope="module")
+def setup(tmp_path_factory):
+    from pinot_tpu.tools import usertable
+
+    out = tmp_path_factory.mktemp("index_rung_segs")
+    segs = usertable.build_segments(str(out), num_segments=N_SEGS,
+                                    rows=ROWS, workers=1)
+    frame = {}
+    per = ROWS // N_SEGS
+    for i in range(N_SEGS):
+        f = usertable.generate_frame(i, N_SEGS, per)
+        for k, v in f.items():
+            if k == "tags":
+                frame.setdefault(k, []).extend(v)
+            else:
+                frame[k] = (v if k not in frame
+                            else np.concatenate([frame[k], v]))
+    dev = ServerQueryExecutor(use_device=True)
+    host = ServerQueryExecutor(use_device=False)
+    return segs, frame, dev, host
+
+
+def _rows(result):
+    return sorted(tuple(r) for r in result.rows)
+
+
+def _run3(dev, host, segs, sql):
+    """(index-run rows+stats, scan-rung rows, host-oracle rows)."""
+    r_i, s_i = dev.execute(compile_query(sql), segs)
+    r_s, _ = dev.execute(
+        compile_query(sql + " OPTION(useIndexRung=false)"), segs)
+    r_h, _ = host.execute(compile_query(sql), segs)
+    return (r_i, s_i), _rows(r_s), _rows(r_h)
+
+
+def _tail_user(frame, lo=3, hi=50):
+    uniq, cnt = np.unique(frame["user_id"], return_counts=True)
+    for u, c in zip(uniq.tolist(), cnt.tolist()):
+        if lo <= c <= hi:
+            return int(u), int(c)
+    raise AssertionError("no tail user in range")
+
+
+# -- parity across filter shapes --------------------------------------------
+
+def test_eq_point_group_by_parity(setup):
+    segs, frame, dev, host = setup
+    u, c = _tail_user(frame)
+    (r_i, s_i), scan, oracle = _run3(
+        dev, host, segs,
+        f"SELECT event_type, count(*), sum(revenue) FROM user_events "
+        f"WHERE user_id = {u} GROUP BY event_type")
+    assert _rows(r_i) == scan == oracle
+    assert s_i.group_by_rung == "index"
+    assert s_i.num_docs_scanned == c
+    assert s_i.decisions.get(SERVED) == N_SEGS
+
+
+def test_string_in_and_range_parity(setup):
+    segs, frame, dev, host = setup
+    u, _ = _tail_user(frame)
+    for sql in (
+        f"SELECT country, count(*), sum(num_items) FROM user_events "
+        f"WHERE user_id IN ({u}, 987654321) GROUP BY country",
+        f"SELECT count(*), sum(revenue) FROM user_events "
+        f"WHERE user_id = {u} AND latency_ms BETWEEN 10 AND 200",
+        f"SELECT count(*) FROM user_events WHERE user_id = {u} "
+        f"AND event_type IN ('click', 'purchase')",
+        f"SELECT device, count(*) FROM user_events WHERE user_id = {u} "
+        f"AND country = 'US' GROUP BY device",
+    ):
+        (r_i, s_i), scan, oracle = _run3(dev, host, segs, sql)
+        assert _rows(r_i) == scan == oracle, sql
+        assert s_i.decisions.get(SERVED) == N_SEGS, (sql, s_i.decisions)
+
+
+def test_mv_postings_union_parity(setup):
+    """MV predicate: a tag's postings are the union over per-value lists —
+    still index-served when selective enough (tags here are broad, so
+    conjoin with the point filter; the MV route contributes its postings
+    to the intersection)."""
+    segs, frame, dev, host = setup
+    u, _ = _tail_user(frame)
+    (r_i, s_i), scan, oracle = _run3(
+        dev, host, segs,
+        f"SELECT count(*) FROM user_events WHERE user_id = {u} "
+        f"AND tags = 'tag3'")
+    assert _rows(r_i) == scan == oracle
+    assert s_i.decisions.get(SERVED) == N_SEGS
+
+
+def test_dict_encoded_sum_parity(setup):
+    """SUM over a DICTIONARY-ENCODED numeric: the gather kernel must pass
+    the dictId->value LUT through UNGATHERED (gathering dictvals by docId
+    would corrupt every dict-encoded aggregation — the one column class
+    the scan kernels index by dictId, not docId)."""
+    segs, frame, dev, host = setup
+    u, c = _tail_user(frame)
+    (r_i, s_i), scan, oracle = _run3(
+        dev, host, segs,
+        f"SELECT sum(revenue), sum(num_items), min(revenue), max(revenue) "
+        f"FROM user_events WHERE user_id = {u}")
+    assert _rows(r_i) == scan == oracle
+    m = frame["user_id"] == u
+    assert _rows(r_i)[0][0] == float(frame["revenue"][m].sum())
+    assert s_i.num_docs_scanned == c
+
+
+def test_empty_match_is_index_served(setup):
+    """An absent literal resolves to ZERO docIds — still index-served
+    (scanned 0), identical to the scan rungs' empty result."""
+    segs, _, dev, host = setup
+    (r_i, s_i), scan, oracle = _run3(
+        dev, host, segs,
+        "SELECT count(*), sum(revenue) FROM user_events "
+        "WHERE user_id = 987654321")
+    assert _rows(r_i) == scan == oracle
+    assert s_i.num_docs_scanned == 0
+    # min/max pruning may eat segments before the rung sees them; every
+    # unpruned segment must be index-served
+    served = s_i.decisions.get(SERVED, 0)
+    assert served >= 1
+    assert served + s_i.num_segments_pruned == N_SEGS
+
+
+def test_parity_fuzz_random_conjunctions(setup):
+    """Randomized eq/IN/range conjunctions over indexed columns: every
+    index-served query is bit-identical to scan and host, and
+    docs_scanned equals the numpy-oracle match count."""
+    segs, frame, dev, host = setup
+    rng = np.random.default_rng(42)
+    uniq = np.unique(frame["user_id"])
+    served = 0
+    for _ in range(12):
+        u = int(uniq[rng.integers(0, uniq.size)])
+        lo = int(rng.integers(1, 150))
+        hi = lo + int(rng.integers(10, 300))
+        preds = [f"user_id = {u}"]
+        m = frame["user_id"] == u
+        if rng.random() < 0.5:
+            preds.append(f"latency_ms BETWEEN {lo} AND {hi}")
+            m = m & (frame["latency_ms"] >= lo) & (frame["latency_ms"] <= hi)
+        if rng.random() < 0.5:
+            preds.append("event_type IN ('view', 'cart')")
+            m = m & np.isin(frame["event_type"], ["view", "cart"])
+        sql = (f"SELECT count(*), sum(revenue) FROM user_events "
+               f"WHERE {' AND '.join(preds)}")
+        (r_i, s_i), scan, oracle = _run3(dev, host, segs, sql)
+        assert _rows(r_i) == scan == oracle, sql
+        if s_i.decisions.get(SERVED) == N_SEGS:
+            served += 1
+            assert s_i.num_docs_scanned == int(m.sum()), sql
+    assert served >= 8  # the mix is dominated by selective shapes
+
+
+# -- declines: every one ledgered with the exact registered reason ----------
+
+def test_over_threshold_declines_to_scan(setup):
+    """A ~100%-selectivity filter must NOT ride the index rung: the cost
+    gate declines (exact ledger reason) and the scan rungs serve with
+    identical results."""
+    segs, _, dev, host = setup
+    sql = ("SELECT country, count(*) FROM user_events "
+           "WHERE latency_ms >= 1 GROUP BY country")
+    (r_i, s_i), scan, oracle = _run3(dev, host, segs, sql)
+    assert _rows(r_i) == scan == oracle
+    assert s_i.group_by_rung != "index"
+    assert s_i.decisions.get(
+        DECLINED.format("index_selectivity_over_threshold")) == N_SEGS
+    assert SERVED not in s_i.decisions
+
+
+def test_missing_index_declines(setup):
+    """`device` carries a dictionary but no inverted index and is not
+    sorted — the rung declines with the missing-index reason."""
+    segs, _, dev, host = setup
+    (r_i, s_i), scan, oracle = _run3(
+        dev, host, segs,
+        "SELECT count(*) FROM user_events WHERE device = 'ios'")
+    assert _rows(r_i) == scan == oracle
+    assert s_i.decisions.get(
+        DECLINED.format("index_missing_index")) == N_SEGS
+
+
+def test_or_shape_declines(setup):
+    """Cross-column OR: indexes don't compose here (same-column OR
+    normalizes to IN upstream and stays index-served — covered above)."""
+    segs, frame, dev, host = setup
+    u, _ = _tail_user(frame)
+    (r_i, s_i), scan, oracle = _run3(
+        dev, host, segs,
+        f"SELECT count(*) FROM user_events WHERE user_id = {u} "
+        f"OR device = 'ios'")
+    assert _rows(r_i) == scan == oracle
+    assert s_i.decisions.get(
+        DECLINED.format("index_filter_shape")) == N_SEGS
+
+
+def test_every_reason_code_is_registered(setup):
+    """Ledger exactness: every index-point decision recorded by this
+    module's workload uses a reason registered in
+    tracing.INDEX_DECISION_REASONS (+ the mutable codes) — an
+    unregistered reason is an unexplained fallback."""
+    registered = tracing.registered_reason_codes()
+    assert tracing.INDEX_DECISION_REASONS <= registered
+    mark = tracing.LEDGER.snapshot()
+    segs, frame, dev, host = setup
+    u, _ = _tail_user(frame)
+    for sql in (
+        f"SELECT count(*) FROM user_events WHERE user_id = {u}",
+        "SELECT count(*) FROM user_events WHERE latency_ms >= 1",
+        "SELECT count(*) FROM user_events WHERE device = 'web'",
+    ):
+        dev.execute(compile_query(sql), segs)
+    delta = tracing.LEDGER.delta(mark)
+    index_keys = [k for k in delta if k.startswith("index:")]
+    assert index_keys, delta
+    for key in index_keys:
+        _, _, _, reason = tracing.parse_decision_key(key)
+        assert reason in registered, key
+
+
+def test_operator_opt_out_is_silent(setup):
+    """OPTION(useIndexRung=false) routes to the scan rungs with NO index
+    decision recorded — an operator choice is not a decline."""
+    segs, frame, dev, _ = setup
+    u, _ = _tail_user(frame)
+    _, s = dev.execute(compile_query(
+        f"SELECT count(*) FROM user_events WHERE user_id = {u} "
+        f"OPTION(useIndexRung=false)"), segs)
+    assert not any(k.startswith("index:") for k in s.decisions)
+
+
+# -- sorted-column route ----------------------------------------------------
+
+def test_sorted_column_route(tmp_path):
+    """A dict column whose values arrive sorted gets is_sorted metadata;
+    EQ/range predicates resolve to contiguous docId runs by binary search
+    (SortedIndexBasedFilterOperator's shape) — no inverted index needed."""
+    from pinot_tpu.segment import SegmentBuilder, load_segment
+
+    n = 20_000
+    rng = np.random.default_rng(3)
+    schema = Schema("sorted_t", [
+        FieldSpec("k", DataType.INT, FieldType.DIMENSION),
+        FieldSpec("v", DataType.INT, FieldType.METRIC),
+    ])
+    frame = {"k": np.sort(rng.integers(0, 2000, n)).astype(np.int64),
+             "v": rng.integers(1, 100, n).astype(np.int64)}
+    SegmentBuilder(schema, "sorted_0").build(frame, str(tmp_path))
+    seg = load_segment(str(tmp_path / "sorted_0"))
+    assert seg.metadata.column("k").is_sorted
+
+    dev = ServerQueryExecutor(use_device=True)
+    host = ServerQueryExecutor(use_device=False)
+    k = int(frame["k"][n // 2])
+    for sql in (
+        f"SELECT count(*), sum(v) FROM sorted_t WHERE k = {k}",
+        f"SELECT count(*) FROM sorted_t WHERE k IN ({k}, {k + 1})",
+    ):
+        r_i, s_i = dev.execute(compile_query(sql), [seg])
+        r_h, _ = host.execute(compile_query(sql), [seg])
+        assert _rows(r_i) == _rows(r_h), sql
+        if s_i.decisions.get("index:scan->index_gather:index_served"):
+            m = int((frame["k"] == k).sum()) if "=" in sql.split("WHERE")[1] \
+                else 0
+            assert s_i.num_docs_scanned > 0 or m == 0
+
+
+# -- residency: pinned idx arrays under churn -------------------------------
+
+def test_idx_slices_accounted_and_capped(setup):
+    """Pinned idx arrays count into the resident's nbytes, survive repeat
+    queries (cache hit), stay bounded under filter churn (LRU cap), and
+    release() drops them."""
+    segs, frame, dev, _ = setup
+    seg = segs[0]
+    uniq = np.unique(frame["user_id"])[:80]
+    for u in uniq.tolist():
+        dev.execute(compile_query(
+            f"SELECT count(*) FROM user_events WHERE user_id = {int(u)}"),
+            [seg])
+    staged = dev.residency.stage(seg, lease=None)
+    assert staged.index_nbytes() > 0
+    assert len(staged._index_slices) <= 64  # _INDEX_SLICE_CAP
+    total = staged.nbytes()
+    assert total >= staged.index_nbytes()
+    freed = staged.release_index_slices()
+    assert freed > 0
+    assert staged.index_nbytes() == 0
+    # post-release queries still serve correctly (slices rebuild)
+    u, c = _tail_user(frame)
+    r, s = dev.execute(compile_query(
+        f"SELECT count(*) FROM user_events WHERE user_id = {u}"), segs)
+    assert s.decisions.get(SERVED) == N_SEGS
+    assert r.rows[0][0] == c
+
+
+def test_eviction_churn_keeps_parity(setup):
+    """Evicting the resident between index-served queries forces restage +
+    idx rebuild — results stay identical."""
+    segs, frame, dev, host = setup
+    u, c = _tail_user(frame)
+    sql = (f"SELECT event_type, count(*) FROM user_events "
+           f"WHERE user_id = {u} GROUP BY event_type")
+    before, _ = dev.execute(compile_query(sql), segs)
+    for seg in segs:
+        dev.residency.evict(seg.segment_name)
+    after, s = dev.execute(compile_query(sql), segs)
+    oracle, _ = host.execute(compile_query(sql), segs)
+    assert _rows(before) == _rows(after) == _rows(oracle)
+    assert s.decisions.get(SERVED) == N_SEGS
+
+
+# -- mutable (consuming) segments -------------------------------------------
+
+def _mutable_segment():
+    from pinot_tpu.segment.mutable import MutableSegment
+
+    schema = Schema("events", [
+        FieldSpec("user", DataType.INT, FieldType.DIMENSION),
+        FieldSpec("kind", DataType.STRING, FieldType.DIMENSION),
+        FieldSpec("tags", DataType.STRING, FieldType.DIMENSION,
+                  single_value=False),
+        FieldSpec("value", DataType.INT, FieldType.METRIC),
+    ])
+    rng = np.random.default_rng(11)
+    seg = MutableSegment(schema, "events__0")
+    users = rng.zipf(1.4, 12_000).clip(1, 400).astype(np.int64)
+    kinds = rng.choice(["a", "b", "c"], 12_000)
+    vals = rng.integers(1, 50, 12_000)
+    for i in range(12_000):
+        seg.index({"user": int(users[i]), "kind": str(kinds[i]),
+                   "tags": [f"t{int(users[i]) % 5}"],
+                   "value": int(vals[i])})
+    return seg, users, vals
+
+
+def test_mutable_index_gather_parity():
+    """Consuming segment: the growing dictId->docIds map serves selective
+    point filters through the same gather kernel, rung stays
+    mutable_device, ledger says the index gather served."""
+    seg, users, vals = _mutable_segment()
+    dev = ServerQueryExecutor(use_device=True)
+    host = ServerQueryExecutor(use_device=False)
+    uniq, cnt = np.unique(users, return_counts=True)
+    u = int(next(u for u, c in zip(uniq.tolist(), cnt.tolist())
+                 if 5 <= c <= 60))
+    c = int(cnt[uniq == u][0])
+    sql = (f"SELECT kind, count(*), sum(value) FROM events "
+           f"WHERE user = {u} GROUP BY kind")
+    r, s = dev.execute(compile_query(sql), [seg])
+    rh, _ = host.execute(compile_query(sql), [seg])
+    assert _rows(r) == _rows(rh)
+    assert s.group_by_rung == "mutable_device"
+    assert s.num_docs_scanned == c
+    assert s.decisions.get(MUT_SERVED) == 1
+
+    # append rows AFTER the postings map was built: incremental growth
+    for _ in range(40):
+        seg.index({"user": u, "kind": "a", "tags": ["t0"], "value": 1})
+    r2, s2 = dev.execute(compile_query(sql), [seg])
+    rh2, _ = host.execute(compile_query(sql), [seg])
+    assert _rows(r2) == _rows(rh2)
+    assert s2.num_docs_scanned == c + 40
+
+
+def test_mutable_unsupported_shape_declines():
+    """MV-column predicate on a consuming segment: the growing map only
+    covers SV dict columns — the rung declines with the registered
+    unsupported-shape reason and the chunk scan serves correctly."""
+    seg, _, _ = _mutable_segment()
+    dev = ServerQueryExecutor(use_device=True)
+    host = ServerQueryExecutor(use_device=False)
+    sql = ("SELECT kind, count(*) FROM events WHERE tags = 't1' "
+           "GROUP BY kind")
+    r, s = dev.execute(compile_query(sql), [seg])
+    rh, _ = host.execute(compile_query(sql), [seg])
+    assert _rows(r) == _rows(rh)
+    assert s.decisions.get(
+        MUT_DECLINED.format("mutable_index_unsupported_shape")) == 1
+    assert s.group_by_rung == "mutable_device"

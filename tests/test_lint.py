@@ -999,6 +999,50 @@ def test_conservation_chunkacct_no_accounting_method_at_all(tmp_path):
                for f in cf), [f.render() for f in new]
 
 
+def test_conservation_idxacct_pin_must_reach_accounting(tmp_path):
+    """PR 18 index-rung obligation: a ``.index_slice(...)`` call pins a
+    freshly-built device idx array on a staged resident, so every
+    fall-through path out of the function must reach a residency
+    ``.account(...)`` call (or a direct ``*bytes*`` counter write) — a
+    branch that returns early leaves the budget's running view predating
+    the pinned slice. Exception paths are exempt (nbytes() walks the
+    slice cache; the next refresh re-measures)."""
+    new = _lint(tmp_path, """\
+        def serve_ok(executor, staged, key, build, name, lease):
+            idx = staged.index_slice(key, build)
+            executor.residency.account(name, lease)
+            return idx
+
+        def serve_bad(executor, staged, key, build, name, lease):
+            idx = staged.index_slice(key, build)
+            return idx
+
+        def serve_branchy(executor, staged, key, build, name, lease, hot):
+            idx = staged.index_slice(key, build)
+            if hot:
+                return idx
+            executor.residency.account(name, lease)
+            return idx
+
+        def serve_exc_ok(executor, staged, key, build, name, lease):
+            try:
+                idx = staged.index_slice(key, build)
+                executor.residency.account(name, lease)
+            except Exception:
+                return None
+            return idx
+        """)
+    cf = _by_checker(new, "conservation")
+    assert any(f.symbol == "serve_bad:idxacct"
+               for f in cf), [f.render() for f in new]
+    assert any(f.symbol == "serve_branchy:idxacct"
+               for f in cf), [f.render() for f in new]
+    assert not any("serve_ok" in f.symbol for f in cf), \
+        [f.render() for f in cf]
+    assert not any("serve_exc_ok" in f.symbol for f in cf), \
+        [f.render() for f in cf]
+
+
 def test_conservation_catches_discarded_pop(tmp_path):
     new = _lint(tmp_path, CONSERVATION_PRELUDE + """\
         def drop(self, name):

@@ -51,6 +51,7 @@ from __future__ import annotations
 
 import json
 import os
+import re
 import subprocess
 import sys
 import tempfile
@@ -541,6 +542,38 @@ class _Worker:
         return LEDGER.delta(mark)
 
     @staticmethod
+    def _validate_decisions(suite: str, decisions: dict) -> None:
+        """Every reason in a suite's decision histogram must be registered
+        in tracing.reason_registry() (per-tree ``treeN`` picks are the one
+        dynamic namespace). The lint `decisions` family proves literal
+        reasons statically; this is the runtime mirror that also catches
+        reasons built from variables/f-strings. BENCH_ALLOW_UNREGISTERED_
+        REASON=1 downgrades the failure to a log line for bring-up runs."""
+        from pinot_tpu.common import tracing
+
+        registered = tracing.registered_reason_codes()
+        bad = []
+        for key in decisions or {}:
+            try:
+                _point, _chosen, _declined, reason = \
+                    tracing.parse_decision_key(key)
+            except Exception:
+                bad.append(key)
+                continue
+            if reason not in registered \
+                    and not re.fullmatch(r"tree\d+", reason):
+                bad.append(key)
+        if not bad:
+            return
+        msg = (f"{suite}: unregistered decision reason(s) in the ledger: "
+               f"{sorted(bad)[:8]} — register them in the matching "
+               f"tracing reason namespace or fix the recording site")
+        if os.environ.get("BENCH_ALLOW_UNREGISTERED_REASON"):
+            _log(f"WARNING {msg}")
+            return
+        raise AssertionError(msg)
+
+    @staticmethod
     def _mesh_devices():
         """Device count the sharded combine's mesh spans (conftest-forced
         virtual CPU devices count too) — recorded per suite so every round
@@ -595,6 +628,10 @@ class _Worker:
                 # BENCH JSON must EXPLAIN every non-device fallback, not
                 # just count it (the "why is pallas_kernels 0" evidence)
                 rec.setdefault("decisions", self._decision_delta(dmark))
+                # ... and the histogram must parse against the reason
+                # registry, whatever suite produced it (the userfacing
+                # suite's loud-fail, promoted to all suites)
+                self._validate_decisions(suite, rec.get("decisions"))
                 self.record(suite, rec)
             except Exception as exc:
                 traceback.print_exc(file=sys.stderr)

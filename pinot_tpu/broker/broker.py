@@ -65,10 +65,18 @@ class BrokerRequestHandler:
                  scatter_workers: int = 16,
                  query_timeout_s: float = 30.0,
                  coalesce: bool = True,
-                 device_reduce: bool =
-                 CommonConstants.DEFAULT_BROKER_DEVICE_REDUCE):
+                 device_reduce: Optional[bool] = None):
         from pinot_tpu.spi.metrics import MetricsRegistry
 
+        if device_reduce is None:
+            # operator knob (pinot.broker.reduce.device.enabled): an
+            # explicit constructor argument — the embedded cluster's and
+            # the bench's path — wins over the environment
+            from pinot_tpu.spi.config import PinotConfiguration
+
+            device_reduce = PinotConfiguration().get_bool(
+                CommonConstants.BROKER_DEVICE_REDUCE_KEY,
+                CommonConstants.DEFAULT_BROKER_DEVICE_REDUCE)
         self.store = store
         self.routing = routing or RoutingManager(store)
         self.reduce_service = BrokerReduceService(

@@ -45,6 +45,7 @@ from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from pinot_tpu.common.bounds import I64_FOLD_BOUND
 from pinot_tpu.common.datatable import Column, DataTable, ResponseType
 from pinot_tpu.engine.aggregates import AggDef, resolve_agg
 from pinot_tpu.engine.errors import QueryError
@@ -66,10 +67,6 @@ from pinot_tpu.engine.results import (
 )
 from pinot_tpu.query.context import QueryContext
 from pinot_tpu.spi.config import CommonConstants
-
-# conservative exactness bound for i64 ufunc folds: the fold stays in
-# int64, so the sum of per-table max magnitudes must not be able to wrap
-_I64_FOLD_BOUND = 1 << 62
 
 # vec state bases -> device segment/collective op (exactly the
 # _VEC_STATE_FOLDS bases: count states fold by addition)
@@ -229,7 +226,8 @@ class ReduceAccumulator:
     def _fold_group_by(self, table: DataTable) -> None:
         self._gb_types.update(table.schema_types())
         if table.num_rows() == 0:
-            return  # nothing to merge; empty wire columns carry no kind
+            return  # nothing to merge (empty wire columns carry no
+            #         kind): not a decline
         key_cols, agg_cols = table.group_columns()
         kinds = [c.kind for c in key_cols]
         if any(not (c.is_numeric or c.is_string) for c in key_cols):
@@ -276,7 +274,7 @@ class ReduceAccumulator:
         self._num_hidden = max(self._num_hidden, table.num_hidden)
         self._all_sorted = self._all_sorted and table.selection_sorted
         if table.num_rows() == 0:
-            return
+            return  # empty arrival: not a decline
         cols = table.columns()
         kinds = [c.kind for c in cols]
         if self._col_kinds is None:
@@ -323,7 +321,7 @@ class ReduceAccumulator:
 
     def _finish_group_by(self) -> Optional[ResultTable]:
         ctx, aggs = self.ctx, self._aggs
-        if self._gb_i64_bound >= _I64_FOLD_BOUND:
+        if self._gb_i64_bound >= I64_FOLD_BOUND:
             if self.device_route:
                 self._decline_device("reduce_device_i64_sum_bound")
             self._decline("reduce_i64_sum_bound")

@@ -485,8 +485,9 @@ class RoutingManager:
         segments whose recorded partition set contains a literal's
         partition. Every outcome is a ledger record."""
         if not entry.partition_pruning:
-            return segments  # ref: PartitionSegmentPruner runs only when
-            #                  configured in routing.segmentPrunerTypes
+            return segments  # pruner not configured: not a decline (ref:
+            #                  PartitionSegmentPruner runs only when set
+            #                  in routing.segmentPrunerTypes)
 
         def declined(reason: str) -> None:
             if ctx is not None:
@@ -528,7 +529,8 @@ class RoutingManager:
         """Ref: TimeSegmentPruner — drop segments whose [start,end] time
         range cannot intersect the query's time interval."""
         if ctx is None or entry.time_column is None:
-            return segments
+            return segments  # no time column / bare routing probe:
+            #                  pruner cannot apply — not a decline
 
         def declined(reason: str) -> None:
             record_decision(stats, "routing", "all_servers", "pruned",

@@ -1,0 +1,35 @@
+"""Named numeric-exactness bounds: the wide-bound constants that license
+device and vectorized sums.
+
+Every bit-exactness proof in the engine/broker/parallel tiers compares
+against ONE of these named constants — never a raw ``1 << 62`` /
+``1 << 53`` literal (the graftlint ``exactness`` family bans the raw
+forms and checks each guard pairs with the dtype it protects). Each
+constant carries its derivation so the guard and the arithmetic it
+licenses can be audited side by side.
+"""
+
+from __future__ import annotations
+
+# i64 fold headroom: a signed-64 accumulator overflows at 2^63, and the
+# limb-reassembly carry chain (engine/pallas_kernels.py) shifts partial
+# rows by up to 62 bits — so any fold whose total absolute mass stays
+# strictly under 2^62 keeps a 2x safety margin under the overflow line.
+I64_FOLD_BOUND = 1 << 62
+
+# f64 exact-integer bound: float64 carries a 53-bit mantissa, so every
+# integer with |v| < 2^53 is exactly representable and integral partial
+# sums under this mass are order-independent (device psum order may
+# differ from the host reduceat order without changing a bit).
+F64_EXACT_INT_BOUND = float(1 << 53)
+
+# composite-key space budget: group-by key columns encode injectively
+# into one non-negative i64 composite per row; capping the composite
+# space strictly under 2^62 keeps every live code below the pad
+# sentinel (and leaves the same 2x margin as the fold bound).
+I64_KEY_SPACE_BOUND = 1 << 62
+
+# i64 max as pad/sentinel key: live composite keys are non-negative and
+# < I64_KEY_SPACE_BOUND, so i64 max sorts strictly after every live key
+# on the device sort-merge rung.
+I64_PAD_SENTINEL = (1 << 63) - 1

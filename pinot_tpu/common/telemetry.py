@@ -709,8 +709,10 @@ class Telemetry:
             self.recorder.out_dir = out_dir
         self.p99_spike_factor = cfg.get_float(
             CommonConstants.FLIGHT_P99_FACTOR_KEY, self.p99_spike_factor)
+        # built from the declared SLO_KEY_PREFIX constant, so the doc'd
+        # key namespace and the parse can never drift
         pat = re.compile(
-            r"pinot\.broker\.slo\.(?P<table>.+)"
+            re.escape(CommonConstants.SLO_KEY_PREFIX) + r"(?P<table>.+)"
             r"\.(?P<kind>p99\.ms|error\.pct|freshness\.ms)$",
             re.IGNORECASE)
         for raw in cfg.keys():

@@ -417,6 +417,9 @@ STARTREE_DECISION_REASONS = frozenset({
     "startree_no_fitting_tree",
     "startree_raw_dimension",
     "startree_dictid_overflow_noncontiguous",
+    # recorded from engine/executor.py _try_star_tree: the host walker
+    # refused a tree the pick accepted (defensive disagreement) -> scan
+    "startree_walker_declined",
 })
 
 # the chosen-tree ledger reason: which of the segment's trees served

@@ -790,6 +790,10 @@ class ServerQueryExecutor:
         res = startree_exec.execute_with_matches(ctx, aggs, seg, tree,
                                                  matches, stats)
         if res is None:
+            # the host walker refused a tree the pick accepted (defensive:
+            # the fit re-check inside execute_with_matches disagreed) —
+            # the scan serves, and the ledger says why
+            declined("startree_walker_declined")
             return None
         chose("startree")
         return res, "startree"
@@ -1008,7 +1012,7 @@ class ServerQueryExecutor:
             declined("pallas_exec_failed")
             return None
         if served is None:
-            return None
+            return None  # run_segment recorded its own reason (on_decline)
         self._track_kernel_stats(served[0], seg, stats)
         return served
 

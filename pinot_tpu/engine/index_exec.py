@@ -334,14 +334,15 @@ def try_index_rung(executor, ctx: QueryContext, aggs: List[AggDef],
     if ctx.options.get("useIndexRung", "true").lower() == "false":
         return None  # operator opt-out, not a decline
     if ctx.filter is None:
-        return None  # no filter: nothing selective to index
+        return None  # no filter: nothing selective to index — not a decline
     from pinot_tpu.engine.startree_exec import _flatten_and
 
     preds = _flatten_and(ctx.filter)
     if not preds:
         if preds is None:  # OR/NOT shape: indexes don't compose here (yet)
             _decline(stats, "index_filter_shape")
-        return None
+        return None  # constant-true filter ([]): nothing selective to
+        #              index — not a decline
     if getattr(segment, "valid_doc_ids", None) is not None:
         # upsert: the valid-doc bitmap ANDs every filter and postings don't
         # see it — the scan kernel's validdocs param path serves

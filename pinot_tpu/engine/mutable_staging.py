@@ -597,12 +597,13 @@ def _try_index_gather(executor, ctx, seg, resident, view, snap, plan,
     if ctx.options.get("useIndexRung", "true").lower() == "false":
         return None  # operator opt-out, not a decline
     if ctx.filter is None:
-        return None  # nothing selective to index
+        return None  # nothing selective to index: not a decline
     preds = _flatten_and(ctx.filter)
     if not preds:
         if preds is None:  # OR/NOT shape
             _decline_rung(stats, "mutable_index_unsupported_shape")
-        return None
+        return None  # constant-true filter ([]): nothing selective to
+        #              index — not a decline
     if snap.valid_host is not None:
         # upsert: validity must AND the filter and the map doesn't see it
         _decline_rung(stats, "mutable_index_unsupported_shape")

@@ -53,6 +53,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from pinot_tpu.common.bounds import I64_FOLD_BOUND
 from pinot_tpu.engine.staging import LIMB_BITS, PALLAS_TILE, StagedSegment
 
 # one-hot chunk width along the group dimension (lane count)
@@ -333,7 +334,7 @@ def extract_plan(plan, provider, on_decline=None,
                     # exact reassembly needs the provider-wide sum inside
                     # i64 (the carry-chain rows shift by up to 62 bits)
                     if max_abs * max(1, provider.metadata.num_docs) \
-                            >= (1 << 62):
+                            >= I64_FOLD_BOUND:
                         raise _Ineligible("i64 sum bound over i64")
                     limbs = _limbs_for(max_abs)
             if name not in value_names:

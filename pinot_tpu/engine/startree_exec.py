@@ -187,7 +187,7 @@ def pick_star_tree(ctx: QueryContext, aggs: List[AggDef],
 
     trees = getattr(segment, "star_trees", None)
     if not trees or not ctx.is_aggregation:
-        return None
+        return None  # no trees / non-agg shape: not a decline (docstring)
     if getattr(segment, "valid_doc_ids", None) is not None:
         # pre-agg records ignore upsert invalidation
         return decline("startree_upsert_valid_docs")

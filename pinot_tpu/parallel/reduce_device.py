@@ -42,16 +42,21 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
+from pinot_tpu.common.bounds import (
+    F64_EXACT_INT_BOUND,
+    I64_KEY_SPACE_BOUND,
+    I64_PAD_SENTINEL,
+)
 from pinot_tpu.spi.config import CommonConstants
 
 # the broker merge mesh is 1-D: every device holds one shard of the
 # concatenated (keys, states) block and partials meet over this axis
 MERGE_AXIS = "merge"
 
-# composite keys are non-negative and < 2^62 (encode_composite_keys
-# declines anything larger), so i64 max is a safe pad/sentinel key that
-# sorts strictly after every live key
-_PAD_KEY = (1 << 63) - 1
+# composite keys are non-negative and < I64_KEY_SPACE_BOUND
+# (encode_composite_keys declines anything larger), so i64 max is a safe
+# pad/sentinel key that sorts strictly after every live key
+_PAD_KEY = I64_PAD_SENTINEL
 
 # caps (spi/config.py): dense-rung slot budget and padded-row ceiling
 DENSE_SLOTS = CommonConstants.DEFAULT_DEVICE_REDUCE_DENSE_SLOTS
@@ -63,11 +68,6 @@ MAX_MERGE_ROWS = CommonConstants.DEFAULT_DEVICE_REDUCE_MAX_ROWS
 # each slot crosses ICI once instead of being replicated to every device
 _PSUM_SLOTS = 1 << 12
 
-# exact-f64 fold bound: every partial sum of integral values whose total
-# absolute mass stays under 2^53 is an exactly-representable integer, so
-# the fold is order-independent (the device psum order differs from the
-# host reduceat order)
-_F64_EXACT_BOUND = float(1 << 53)
 
 _MESH = None
 _MESH_FAILED = False
@@ -140,7 +140,7 @@ def encode_composite_keys(key_cols: List[np.ndarray]
                 (lut.setdefault(v, len(lut)) for v in a.tolist()),
                 dtype=np.int64, count=n)
             r = len(lut) if n else 1
-        if r < 1 or space > (1 << 62) // r:
+        if r < 1 or space > I64_KEY_SPACE_BOUND // r:
             return None, 0
         comp = comp * r + codes
         space *= r
@@ -155,7 +155,7 @@ def f64_sum_exact(arr: np.ndarray) -> bool:
         return False
     if not bool((arr == np.floor(arr)).all()):
         return False
-    return float(np.abs(arr).sum()) < _F64_EXACT_BOUND
+    return float(np.abs(arr).sum()) < F64_EXACT_INT_BOUND
 
 
 def _next_pow2(n: int) -> int:

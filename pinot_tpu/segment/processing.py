@@ -243,8 +243,9 @@ class SegmentProcessorFramework:
             agg = self.config.aggregation_types.get(m, "SUM").upper()
             dt = self.config.schema.field_spec(m).data_type
             if dt.is_integral:
-                # exact Python-int accumulation: LONG sums past 2**53 must not
-                # round-trip through float64
+                # exact Python-int accumulation: LONG sums past the f64
+                # exact-integer bound (common/bounds.py
+                # F64_EXACT_INT_BOUND) must not round-trip through float64
                 res_i = []
                 for g in range(len(order)):
                     v = [int(cols[m][i])

@@ -33,7 +33,7 @@ class EmbeddedCluster:
     def __init__(self, num_servers: int = 1, data_dir: str = "/tmp/pinot_tpu_cluster",
                  snapshot: bool = False, llc_seed: Optional[str] = None,
                  query_timeout_s: float = 120.0,
-                 device_reduce: bool = False):
+                 device_reduce: Optional[bool] = None):
         os.makedirs(data_dir, exist_ok=True)
         snap = os.path.join(data_dir, "cluster_state.json") if snapshot else None
         self.data_dir = data_dir

@@ -9,8 +9,10 @@ inline ``# lint: ignore[...]``). With no paths, lints the whole
 can import jax.
 
 ``--json`` prints one JSON object per finding (key, family, file, line,
-message) for CI / bench-harness annotation; ``--families`` restricts the
-run to a comma-separated subset (see ``--list-families``).
+message) for CI / bench-harness annotation; ``--sarif`` prints one SARIF
+2.1.0 log for code-scanning UIs (one reportingDescriptor per family);
+``--families`` restricts the run to a comma-separated subset (see
+``--list-families``).
 """
 
 from __future__ import annotations
@@ -26,6 +28,44 @@ from pinot_tpu.tools.lint.core import (
     run_lint,
     select_changed,
 )
+
+
+def to_sarif(findings) -> dict:
+    """SARIF 2.1.0 log for the findings: one run, one rule per family.
+
+    The shape code-scanning UIs ingest — ``runs[0].tool.driver.rules``
+    enumerates every registered family (so a clean run still advertises
+    what was checked), each result carries the stable baseline key as
+    its ``partialFingerprints`` entry so re-runs dedupe line moves the
+    same way the baseline does.
+    """
+    return {
+        "$schema": ("https://raw.githubusercontent.com/oasis-tcs/"
+                    "sarif-spec/master/Schemata/sarif-schema-2.1.0.json"),
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {"driver": {
+                "name": "graftlint",
+                "informationUri": "https://example.invalid/graftlint",
+                "rules": [{"id": name,
+                           "shortDescription": {"text": name}}
+                          for name in checker_names()],
+            }},
+            "results": [{
+                "ruleId": f.checker,
+                "level": "error",
+                "message": {"text": f.message},
+                "partialFingerprints": {"graftlintKey/v1": f.key},
+                "locations": [{
+                    "physicalLocation": {
+                        "artifactLocation": {
+                            "uri": f.path.replace(os.sep, "/")},
+                        "region": {"startLine": max(1, f.line)},
+                    },
+                }],
+            } for f in findings],
+        }],
+    }
 
 
 def main(argv=None) -> int:
@@ -49,6 +89,9 @@ def main(argv=None) -> int:
     ap.add_argument("--json", action="store_true", dest="as_json",
                     help="machine-readable output: one JSON object per "
                          "finding (key, family, file, line, message)")
+    ap.add_argument("--sarif", action="store_true", dest="as_sarif",
+                    help="emit one SARIF 2.1.0 log on stdout (one rule "
+                         "per family) for code-scanning UIs")
     ap.add_argument("--families", default=None, metavar="F1,F2",
                     help="run only the named checker families "
                          "(comma-separated; see --list-families)")
@@ -93,13 +136,18 @@ def main(argv=None) -> int:
             print(f"--changed {args.changed}: {e}", file=sys.stderr)
             return 2
         if not paths:
-            if not args.as_json:
+            if args.as_sarif:
+                print(json.dumps(to_sarif([]), sort_keys=True))
+            elif not args.as_json:
                 print("graftlint: no changed package files",
                       file=sys.stderr)
             return 0
 
     baseline = None if args.no_baseline else args.baseline
     new, accepted = run_lint(paths, baseline=baseline, families=families)
+    if args.as_sarif:
+        print(json.dumps(to_sarif(new), indent=2, sort_keys=True))
+        return 1 if new else 0
     for f in new:
         if args.as_json:
             print(json.dumps({"key": f.key, "family": f.checker,

@@ -122,9 +122,12 @@ def _load_checkers() -> None:
     if _LOADED:
         return
     from pinot_tpu.tools.lint import (  # noqa: F401
+        configkeys,
         conservation,
+        decisions,
         declines,
         device,
+        exactness,
         locks,
         pairing,
         protocol,

@@ -1573,15 +1573,304 @@ def test_changed_cli_on_this_repo():
 
 def test_whole_package_wall_clock_budget():
     """The whole-package run must stay CI-viable as the dataflow tier
-    grows: a generous multiple of today's measured wall clock (~17s),
-    but a hard ceiling — a quadratic blow-up in a new family fails here
-    before it fails the CI budget."""
+    grows — v4 added three more families (decisions totality over the
+    ledger scope CFGs, the exactness proof guards, config-key
+    conformance with the README table check): a generous multiple of
+    the measured wall clock, but a hard ceiling — a quadratic blow-up
+    in a new family fails here before it fails the CI budget."""
     import time
 
     t0 = time.perf_counter()
     run_lint([PKG], baseline=DEFAULT_BASELINE)
     elapsed = time.perf_counter() - t0
     assert elapsed < 120, f"whole-package lint took {elapsed:.1f}s"
+
+
+# --------------------------------------------------------------------------
+# v4: decision-path totality (seeded mutations, each exactly one finding)
+# --------------------------------------------------------------------------
+
+def _lint_family(tmp_path, source, family, name="fixture.py"):
+    p = tmp_path / name
+    p.write_text(textwrap.dedent(source))
+    new, _accepted = run_lint([str(p)], families=[family])
+    return new
+
+
+def test_decisions_dropped_record_on_return_path(tmp_path):
+    """A scoped rung probe with one decline exit that never reaches the
+    ledger: exactly the silent-fallback shape the family exists for."""
+    new = _lint_family(tmp_path, """\
+        def record_decision(stats, point, chosen, declined, reason):
+            pass
+
+        def _try_star_tree(self, ctx, aggs, seg, stats):
+            tree = seg.tree
+            if tree is None:
+                return None
+            record_decision(stats, "startree", "scan", "startree", "tree1")
+            return None
+        """, "decisions", name="executor.py")
+    assert len(new) == 1
+    assert new[0].checker == "decisions"
+    assert "_try_star_tree" in new[0].symbol
+
+
+def test_decisions_dropped_record_on_exception_edge(tmp_path):
+    """A handler that swallows the rung's failure and returns None must
+    record on its own — the exception edge carries the raising
+    statement's PRE-state, so the record after the try doesn't count."""
+    new = _lint_family(tmp_path, """\
+        def record_decision(stats, point, chosen, declined, reason):
+            pass
+
+        def _try_star_tree(self, ctx, aggs, seg, stats):
+            try:
+                res = seg.walk()
+            except ValueError:
+                return None
+            record_decision(stats, "startree", "scan", "startree", "tree1")
+            return res
+        """, "decisions", name="executor.py")
+    assert len(new) == 1
+    assert "exit" in new[0].symbol
+
+
+def test_decisions_discharges_are_clean(tmp_path):
+    """The three legitimate unrecorded-exit shapes: the 'not a decline'
+    annotation, the hook-credited pass-through (x = f(on_decline=...)
+    then `if x is None: return None`), and the vacuous-hook guard."""
+    new = _lint_family(tmp_path, """\
+        def record_decision(stats, point, chosen, declined, reason):
+            pass
+
+        def _try_star_tree(self, ctx, aggs, seg, stats, on_decline=None):
+            if seg is None:
+                return None  # no segment shipped: not a decline
+            pick = self._pick(seg, on_decline=on_decline)
+            if pick is None:
+                return None
+            if on_decline is None:
+                return None
+            record_decision(stats, "startree", "scan", "startree", "tree1")
+            return None
+        """, "decisions", name="executor.py")
+    assert not new, [f.render() for f in new]
+
+
+def test_decisions_all_mode_checks_every_exit(tmp_path):
+    """`all`-mode scope (routing pruners, the hybrid split): a non-None
+    return without a record is a finding too."""
+    new = _lint_family(tmp_path, """\
+        def record_decision(stats, point, chosen, declined, reason):
+            pass
+
+        def _time_prune(self, ctx, segments):
+            if not segments:
+                return segments
+            record_decision(None, "routing", "pruned", "all_servers",
+                            "time_prune")
+            return [s for s in segments if s.live]
+        """, "decisions", name="routing.py")
+    assert len(new) == 1
+    assert "_time_prune" in new[0].symbol
+
+
+def test_decisions_unregistered_reason_literal(tmp_path):
+    """Every literal reason at a scoped recorder call must discharge
+    against tracing.reason_registry()."""
+    new = _lint_family(tmp_path, """\
+        def record_decision(stats, point, chosen, declined, reason):
+            pass
+
+        def _try_star_tree(self, ctx):
+            record_decision(None, "startree", "scan", "startree",
+                            "totally_bogus_reason")
+            return 1
+        """, "decisions", name="executor.py")
+    assert len(new) == 1
+    assert "totally_bogus_reason" in new[0].symbol
+
+
+# --------------------------------------------------------------------------
+# v4: numeric-exactness proof guards
+# --------------------------------------------------------------------------
+
+def test_exactness_raw_wide_literal(tmp_path):
+    new = _lint_family(tmp_path, """\
+        def fold_cap(n):
+            return n < 1 << 62
+        """, "exactness")
+    assert len(new) == 1
+    assert "wide_literal" in new[0].symbol
+
+
+def test_exactness_power_form_also_banned(tmp_path):
+    new = _lint_family(tmp_path, """\
+        LIMIT = 2 ** 53
+        """, "exactness")
+    assert len(new) == 1
+
+
+def test_exactness_dtype_mismatched_guard(tmp_path):
+    """Comparing a float path against an i64 bound proves nothing: no
+    integer-dtype evidence anywhere in the function."""
+    new = _lint_family(tmp_path, """\
+        from pinot_tpu.common.bounds import I64_FOLD_BOUND
+
+        def check(arr):
+            total = arr.sum() * 2.5
+            return total < I64_FOLD_BOUND
+        """, "exactness")
+    assert len(new) == 1
+    assert "i64_evidence" in new[0].symbol
+
+
+def test_exactness_guard_deletion_is_a_finding(tmp_path):
+    """The known sum-reassembly sites must keep a bounds-constant guard
+    even after every raw literal is gone."""
+    new = _lint_family(tmp_path, """\
+        def _finish_group_by(self):
+            return self._rows
+        """, "exactness", name="reduce.py")
+    assert len(new) == 1
+    assert "guard_missing" in new[0].symbol
+
+
+def test_exactness_real_guard_shape_is_clean(tmp_path):
+    new = _lint_family(tmp_path, """\
+        from pinot_tpu.common.bounds import I64_FOLD_BOUND
+
+        def _finish_group_by(self):
+            if self._gb_i64_bound >= I64_FOLD_BOUND:
+                return None
+            return self._rows
+        """, "exactness", name="reduce.py")
+    assert not new, [f.render() for f in new]
+
+
+# --------------------------------------------------------------------------
+# v4: config-key conformance
+# --------------------------------------------------------------------------
+
+def test_configkeys_undeclared_inline_key(tmp_path):
+    new = _lint_family(tmp_path, """\
+        def setup(cfg):
+            return cfg.get_bool("pinot.server.query.mystery.enabled",
+                                False)
+        """, "configkeys")
+    assert len(new) == 1
+    assert "pinot.server.query.mystery.enabled" in new[0].symbol
+
+
+def test_configkeys_declared_keys_resolve_clean(tmp_path):
+    new = _lint_family(tmp_path, """\
+        from pinot_tpu.spi.config import CommonConstants
+
+        def setup(cfg):
+            return cfg.get_int(CommonConstants.RUNNER_THREADS_KEY, 8)
+        """, "configkeys")
+    assert not new, [f.render() for f in new]
+
+
+def _configkeys_tree(tmp_path, config_src, reader_src, readme=None):
+    pkg = tmp_path / "pkg"
+    (pkg / "spi").mkdir(parents=True)
+    (pkg / "spi" / "config.py").write_text(textwrap.dedent(config_src))
+    (pkg / "reader.py").write_text(textwrap.dedent(reader_src))
+    if readme is not None:
+        (tmp_path / "README.md").write_text(textwrap.dedent(readme))
+    new, _ = run_lint([str(pkg)], families=["configkeys"])
+    return new
+
+
+def test_configkeys_declared_but_unread_key(tmp_path):
+    new = _configkeys_tree(tmp_path, """\
+        class CommonConstants:
+            USED_KEY = "pinot.server.query.used"
+            GHOST_KEY = "pinot.server.query.ghost"
+        """, """\
+        from pkg.spi.config import CommonConstants
+
+        def setup(cfg):
+            return cfg.get(CommonConstants.USED_KEY, None)
+        """)
+    assert len(new) == 1
+    assert "unread:GHOST_KEY" in new[0].symbol
+
+
+def test_configkeys_stale_readme_default(tmp_path):
+    new = _configkeys_tree(tmp_path, """\
+        class CommonConstants:
+            RUNNER_THREADS_KEY = "pinot.server.query.runner.threads"
+            DEFAULT_RUNNER_THREADS = 8
+        """, """\
+        from pkg.spi.config import CommonConstants
+
+        def setup(cfg):
+            return cfg.get_int(CommonConstants.RUNNER_THREADS_KEY, 8)
+        """, readme="""\
+        # fixture
+
+        <!-- config-keys:begin -->
+        | key | default | controls |
+        |---|---|---|
+        | `pinot.server.query.runner.threads` | `4` | runner pool |
+        <!-- config-keys:end -->
+        """)
+    assert len(new) == 1
+    assert "readme:stale:RUNNER_THREADS_KEY" in new[0].symbol
+
+
+def test_configkeys_readme_row_matching_code_is_clean(tmp_path):
+    new = _configkeys_tree(tmp_path, """\
+        class CommonConstants:
+            RUNNER_THREADS_KEY = "pinot.server.query.runner.threads"
+            DEFAULT_RUNNER_THREADS = 8
+        """, """\
+        from pkg.spi.config import CommonConstants
+
+        def setup(cfg):
+            return cfg.get_int(CommonConstants.RUNNER_THREADS_KEY, 8)
+        """, readme="""\
+        # fixture
+
+        <!-- config-keys:begin -->
+        | key | default | controls |
+        |---|---|---|
+        | `pinot.server.query.runner.threads` | `8` | runner pool |
+        <!-- config-keys:end -->
+        """)
+    assert not new, [f.render() for f in new]
+
+
+def test_cli_sarif_output(tmp_path, capsys):
+    """--sarif: one SARIF 2.1.0 run, one rule per family, results carry
+    the stable baseline key as a partial fingerprint."""
+    bad = tmp_path / "bad.py"
+    bad.write_text(textwrap.dedent("""\
+        import threading
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._d = {}  # guarded-by: _lock
+
+            def peek(self):
+                return self._d.get("k")
+        """))
+    assert lint_main([str(bad), "--sarif", "--no-baseline"]) == 1
+    log = json.loads(capsys.readouterr().out)
+    assert log["version"] == "2.1.0"
+    run = log["runs"][0]
+    assert run["tool"]["driver"]["name"] == "graftlint"
+    rule_ids = {r["id"] for r in run["tool"]["driver"]["rules"]}
+    assert {"decisions", "exactness", "configkeys"} <= rule_ids
+    res = run["results"][0]
+    assert res["ruleId"] == "lock-guard"
+    assert res["locations"][0]["physicalLocation"]["region"]["startLine"]
+    assert res["partialFingerprints"]["graftlintKey/v1"].startswith(
+        "lock-guard:")
 
 
 # --------------------------------------------------------------------------

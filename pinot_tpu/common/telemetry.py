@@ -672,7 +672,7 @@ class Telemetry:
                  clock: Callable[[], float] = time.monotonic):
         self.window_s = window_s
         self.num_windows = num_windows
-        self.resolution_s = resolution_s
+        self.resolution_s = resolution_s  # guarded-by-writes: _lock
         self._clock = clock
         self._lock = threading.Lock()
         # writes-only guard: the record path reads with a GIL-atomic
@@ -683,8 +683,8 @@ class Telemetry:
         self._p99_baseline: Dict[Tuple[str, str], float] = {}  # guarded-by: _lock
         self.slo = SloTracker(clock=clock, window_s=window_s,
                               num_windows=num_windows)
-        self.recorder = FlightRecorder()
-        self.p99_spike_factor = P99_SPIKE_FACTOR
+        self.recorder = FlightRecorder()  # guarded-by-writes: _lock
+        self.p99_spike_factor = P99_SPIKE_FACTOR  # guarded-by-writes: _lock
         self._sampler: Optional[threading.Thread] = None  # guarded-by: _lock
         self._sampler_stop = threading.Event()
 
@@ -699,16 +699,20 @@ class Telemetry:
         from pinot_tpu.spi.config import CommonConstants, PinotConfiguration
 
         cfg = config if config is not None else PinotConfiguration()
-        self.resolution_s = max(0.25, cfg.get_float(
-            CommonConstants.TELEMETRY_RESOLUTION_S_KEY, self.resolution_s))
-        self.recorder.min_freeze_interval_s = cfg.get_float(
-            CommonConstants.FLIGHT_MIN_INTERVAL_S_KEY,
-            self.recorder.min_freeze_interval_s)
-        out_dir = cfg.get_str(CommonConstants.FLIGHT_DIR_KEY, "")
-        if out_dir:
-            self.recorder.out_dir = out_dir
-        self.p99_spike_factor = cfg.get_float(
-            CommonConstants.FLIGHT_P99_FACTOR_KEY, self.p99_spike_factor)
+        # the sampler loop reads these each tick; serialize the writes so
+        # a live reconfigure publishes whole values (reads stay lock-free)
+        with self._lock:
+            self.resolution_s = max(0.25, cfg.get_float(
+                CommonConstants.TELEMETRY_RESOLUTION_S_KEY,
+                self.resolution_s))
+            self.recorder.min_freeze_interval_s = cfg.get_float(
+                CommonConstants.FLIGHT_MIN_INTERVAL_S_KEY,
+                self.recorder.min_freeze_interval_s)
+            out_dir = cfg.get_str(CommonConstants.FLIGHT_DIR_KEY, "")
+            if out_dir:
+                self.recorder.out_dir = out_dir
+            self.p99_spike_factor = cfg.get_float(
+                CommonConstants.FLIGHT_P99_FACTOR_KEY, self.p99_spike_factor)
         # built from the declared SLO_KEY_PREFIX constant, so the doc'd
         # key namespace and the parse can never drift
         pat = re.compile(
@@ -953,10 +957,10 @@ class Telemetry:
             self._rings.clear()
             self._tracked.clear()
             self._p99_baseline.clear()
-        self.slo = SloTracker(clock=self._clock, window_s=self.window_s,
-                              num_windows=self.num_windows)
-        out_dir = self.recorder.out_dir
-        self.recorder = FlightRecorder(out_dir=out_dir)
+            self.slo = SloTracker(clock=self._clock, window_s=self.window_s,
+                                  num_windows=self.num_windows)
+            out_dir = self.recorder.out_dir
+            self.recorder = FlightRecorder(out_dir=out_dir)
 
 
 TELEMETRY = Telemetry()

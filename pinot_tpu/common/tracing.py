@@ -520,17 +520,30 @@ class ReasonNamespace:
 
     def scan_source(self) -> set:
         """All reason literals found at this namespace's record sites (by
-        pattern and/or prefix) in its module's source."""
+        pattern and/or prefix) in its module's source. A namespace rooted
+        at a package (the module has ``__path__``) scans every ``.py``
+        beneath it — ``race_ok`` waivers live wherever shared state
+        lives, not in one module."""
         import importlib
+        import os
 
         mod = importlib.import_module(self.module)
-        with open(mod.__file__.rstrip("c"), encoding="utf-8") as f:
-            src = f.read()
+        paths: List[str] = []
+        if hasattr(mod, "__path__"):
+            for root, _dirs, files in os.walk(list(mod.__path__)[0]):
+                paths.extend(os.path.join(root, f) for f in sorted(files)
+                             if f.endswith(".py"))
+        else:
+            paths.append(mod.__file__.rstrip("c"))
         found: set = set()
-        for pat in self.literal_patterns:
-            found |= set(re.findall(pat, src))
-        if self.prefix:
-            found |= set(re.findall(rf'"({self.prefix}[a-z0-9_]+)"', src))
+        for path in paths:
+            with open(path, encoding="utf-8") as f:
+                src = f.read()
+            for pat in self.literal_patterns:
+                found |= set(re.findall(pat, src))
+            if self.prefix:
+                found |= set(
+                    re.findall(rf'"({self.prefix}[a-z0-9_]+)"', src))
         return found
 
     def conformance(self) -> Tuple[set, set]:
@@ -664,6 +677,29 @@ _register_reasons(ReasonNamespace(
         r'raise _Decline\(\s*"([a-z0-9_]+)"',
         r'_chose\(\s*stats,\s*"([a-z0-9_]+)"',),
     min_sites=6, exact=True))
+# race waivers (PR-20): the ``threads`` lint family's ``# race-ok:``
+# annotations. Each code names a concurrency DESIGN the reference also
+# relies on, not a dismissal — the lint rejects any code not in this set,
+# so the vocabulary can only grow through here, next to its meaning.
+RACE_OK_REASONS = frozenset({
+    "single_writer",         # one runtime thread performs every write;
+                             # readers take GIL-atomic snapshots and
+                             # tolerate one-batch staleness (the
+                             # volatile-numDocsIndexed watermark pattern)
+    "publish_once",          # reference assigned once at setup, never
+                             # reassigned; readers null-check
+    "delegates_locking",     # field holds an object that does its own
+                             # locking; the mutator call the lint sees is
+                             # the delegate's atomic op, and the reference
+                             # itself never changes after __init__
+    "quiesced_by_refcount",  # teardown mutation that runs only after the
+                             # residency refcount proves no reader holds
+                             # the object
+})
+_register_reasons(ReasonNamespace(
+    "race_ok", RACE_OK_REASONS, "pinot_tpu",
+    literal_patterns=(r'#\s*race-ok:\s*([a-z0-9_]+)',),
+    min_sites=4, exact=True))
 
 
 _SANITIZE = re.compile(r"[^a-z0-9]+")

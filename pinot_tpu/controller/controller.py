@@ -61,7 +61,7 @@ class Controller:
         from pinot_tpu.controller.tasks import PinotTaskManager
         from pinot_tpu.spi.metrics import MetricsRegistry
 
-        self.store = store or ClusterStateStore()
+        self.store = store or ClusterStateStore()  # race-ok: delegates_locking
         self.metrics = MetricsRegistry(role="controller")
         self.metrics.gauge("tables", lambda: len(self.store.table_names()))
         self.metrics.gauge("segments", lambda: sum(
@@ -75,7 +75,10 @@ class Controller:
         self.completion = SegmentCompletionManager(
             num_replicas_provider=self._num_replicas_for_segment,
             commit_handler=self._on_segment_commit)
-        self._segment_tables: Dict[str, str] = {}  # segment -> table (FSM aid)
+        # segment -> table (FSM aid); filled from the REST path and the
+        # controller-periodic repair loop, so every access takes the lock
+        self._lock = threading.Lock()
+        self._segment_tables: Dict[str, str] = {}  # guarded-by: _lock
         self._periodic_stop = threading.Event()
         self._periodic_thread: Optional[threading.Thread] = None
         self.store.register_instance(
@@ -113,8 +116,9 @@ class Controller:
             if config.stream_config is None:
                 raise ValueError("realtime table needs a stream config")
             consuming = self.llc.setup_new_table(name)
-            for seg in consuming:
-                self._segment_tables[seg] = name
+            with self._lock:
+                for seg in consuming:
+                    self._segment_tables[seg] = name
 
     def update_table(self, config: TableConfig) -> None:
         """Replace an existing table's config (ref: updateTableConfig —
@@ -240,7 +244,8 @@ class Controller:
         return 1
 
     def _table_of(self, segment_name: str) -> Optional[str]:
-        t = self._segment_tables.get(segment_name)
+        with self._lock:
+            t = self._segment_tables.get(segment_name)
         if t:
             return t
         try:
@@ -249,7 +254,8 @@ class Controller:
             return None
         name = raw + "_REALTIME"
         if self.store.get_table_config(name) is not None:
-            self._segment_tables[segment_name] = name
+            with self._lock:
+                self._segment_tables[segment_name] = name
             return name
         return None
 
@@ -263,7 +269,8 @@ class Controller:
             raise KeyError(f"cannot resolve table for {segment_name}")
         new_consuming = self.llc.commit_segment(
             table, segment_name, offset, location, metadata)
-        self._segment_tables[new_consuming] = table
+        with self._lock:
+            self._segment_tables[new_consuming] = table
 
     # -- rebalance (ref: TableRebalancer) -----------------------------------
     def rebalance_table(self, table: str, dry_run: bool = False,
@@ -359,8 +366,9 @@ class Controller:
         for table in self.store.table_names():
             if table_type_from_name(table) is TableType.REALTIME:
                 fresh = self.llc.ensure_all_partitions_consuming(table)
-                for seg in fresh:
-                    self._segment_tables[seg] = table
+                with self._lock:
+                    for seg in fresh:
+                        self._segment_tables[seg] = table
                 created.extend(fresh)
         return created
 

@@ -53,7 +53,7 @@ class SegmentLineageManager:
     """Controller-side lineage book-keeping over the state store."""
 
     def __init__(self, store):
-        self.store = store
+        self.store = store  # race-ok: delegates_locking
 
     def _path(self, table: str) -> str:
         return f"lineage/{table}"

@@ -145,7 +145,7 @@ class ClusterStateStore:
         self._lock = threading.RLock()
         self._data: Dict[str, Any] = {}
         self._version = 0
-        self._watchers: List[Tuple[str, Watcher]] = []
+        self._watchers: List[Tuple[str, Watcher]] = []  # guarded-by: _lock
         self._snapshot_path = snapshot_path
         # mutation-ordered notification queue drained under _notify_lock so
         # watchers observe updates in version order even when mutators race
@@ -282,8 +282,12 @@ class ClusterStateStore:
                     if not self._pending:
                         return
                     batch, self._pending = self._pending, []
+                    # snapshot under the same lock watch() appends under:
+                    # a registration racing the drain sees either the whole
+                    # batch or none of it, never a torn list copy
+                    watchers = list(self._watchers)
                 for path, value in batch:
-                    for prefix, w in list(self._watchers):
+                    for prefix, w in watchers:
                         if path.startswith(prefix):
                             try:
                                 w(path, self._copy(value))

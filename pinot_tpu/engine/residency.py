@@ -265,8 +265,8 @@ class ResidencyManager:
         self._config = config
         self._budget_resolved = False
         self._budget: Optional[int] = None
-        self._host_budget_resolved = False
-        self._host_budget: Optional[int] = None
+        self._host_budget_resolved = False  # guarded-by-writes: _lock
+        self._host_budget: Optional[int] = None  # guarded-by-writes: _lock
         # RLock: evicting a batch resident re-enters through the executor's
         # release callback (discard()), and that must not deadlock
         self._lock = threading.RLock()
@@ -314,7 +314,7 @@ class ResidencyManager:
         # (set by the sharded executor) lets a StagedSegment serve a column
         # from a resident batch's device copy instead of staging its own
         self.column_borrower = None
-        self._metrics = None
+        self._metrics = None  # race-ok: publish_once
         self._prefetch_q: Optional["queue.Queue"] = None
         self._prefetch_thread: Optional[threading.Thread] = None
         self._closed = False

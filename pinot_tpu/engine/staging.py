@@ -127,10 +127,10 @@ class SegmentHostImage:
         # mmapped buffers) alive; identity is re-validated at promotion
         self._segment_ref = weakref.ref(segment)
         self.segment_names = (segment.segment_name,)
-        self.columns: Dict[str, StagedColumn] = {}
-        self.packed: Dict[str, tuple] = {}
-        self.values: Dict[str, np.ndarray] = {}
-        self.startree: Dict[int, Dict[str, np.ndarray]] = {}
+        self.columns: Dict[str, StagedColumn] = {}  # race-ok: quiesced_by_refcount
+        self.packed: Dict[str, tuple] = {}  # race-ok: quiesced_by_refcount
+        self.values: Dict[str, np.ndarray] = {}  # race-ok: quiesced_by_refcount
+        self.startree: Dict[int, Dict[str, np.ndarray]] = {}  # race-ok: quiesced_by_refcount
         self._nbytes = 0
 
     def seal(self) -> "SegmentHostImage":

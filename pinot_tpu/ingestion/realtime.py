@@ -167,7 +167,7 @@ class RealtimeSegmentDataManager:
             capacity=max(sc.segment_flush_threshold_rows, 1),
             indexing_config=table_config.indexing_config)
         self.start_offset = start_offset
-        self.current_offset = start_offset
+        self.current_offset = start_offset  # race-ok: single_writer
         self.flush_threshold_rows = sc.segment_flush_threshold_rows
         self.flush_threshold_ms = sc.segment_flush_threshold_millis
         self._start_time_ms = int(time.time() * 1000)
@@ -175,9 +175,9 @@ class RealtimeSegmentDataManager:
         # row-level upsert hook: called as fn(row, doc_id) after a row is
         # indexed (ref: RealtimeTableDataManager addRecord wiring)
         self.upsert_hook = None
-        self.state = ConsumerState.INITIAL_CONSUMING
-        self.rows_indexed = 0
-        self.rows_dropped = 0
+        self.state = ConsumerState.INITIAL_CONSUMING  # race-ok: single_writer
+        self.rows_indexed = 0  # race-ok: single_writer
+        self.rows_dropped = 0  # race-ok: single_writer
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -250,7 +250,7 @@ class RealtimeSegmentDataManager:
             self._commit()
         return self.state
 
-    _catchup_target: Optional[StreamOffset] = None
+    _catchup_target: Optional[StreamOffset] = None  # race-ok: single_writer
 
     def _commit(self) -> None:
         """Split commit (ref: commitSegment:939 + SplitSegmentCommitter):
@@ -279,8 +279,8 @@ class RealtimeSegmentDataManager:
             log.exception("commit failed for %s", self.segment_name)
             self.state = ConsumerState.ERROR
 
-    _committed_metadata: Optional[SegmentMetadata] = None
-    _committed_dir: Optional[str] = None
+    _committed_metadata: Optional[SegmentMetadata] = None  # race-ok: single_writer
+    _committed_dir: Optional[str] = None  # race-ok: single_writer
 
     def build_segment(self):
         """Ref: buildSegmentForCommit:754 — mutable -> immutable conversion.
@@ -310,7 +310,10 @@ class RealtimeSegmentDataManager:
         return md, seg_dir
 
     #: wall-clock of the last mutable->immutable build (bench `realtime`)
-    seal_wall_ms: Optional[float] = None
+    seal_wall_ms: Optional[float] = None  # race-ok: single_writer
+
+    #: consume-loop error streak (resets on success, trips ERROR at max)
+    _consecutive_errors: int = 0  # race-ok: single_writer
 
     def _run_once_resilient(self) -> ConsumerState:
         """run_once with transient-failure absorption: a throwing consumer

@@ -35,8 +35,8 @@ class MinionInstance:
         self.executors = dict(TASK_EXECUTORS if executors is None else executors)
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
-        self.tasks_succeeded = 0
-        self.tasks_failed = 0
+        self.tasks_succeeded = 0  # race-ok: single_writer
+        self.tasks_failed = 0  # race-ok: single_writer
         controller.store.register_instance(InstanceInfo(instance_id, "MINION"))
 
     # -- lifecycle -----------------------------------------------------------

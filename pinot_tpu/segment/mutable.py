@@ -202,7 +202,7 @@ class MutableSegment:
         self.indexing = indexing_config or IndexingConfig()
         self._cols: Dict[str, _MutableColumn] = {
             fs.name: _MutableColumn(fs) for fs in schema.field_specs}
-        self._num_docs = 0
+        self._num_docs = 0  # race-ok: single_writer
         self.time_column = schema.time_column
         self.min_time: Optional[int] = None
         self.max_time: Optional[int] = None

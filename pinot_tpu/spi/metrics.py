@@ -89,13 +89,13 @@ class MetricsRegistry:
 
     def __init__(self, role: str = ""):
         self.role = role
-        self._meters: Dict[str, Meter] = {}
-        self._timers: Dict[str, Timer] = {}
+        self._meters: Dict[str, Meter] = {}  # guarded-by-writes: _lock
+        self._timers: Dict[str, Timer] = {}  # guarded-by-writes: _lock
         self._gauges: Dict[str, GaugeFn] = {}
         # family -> {sorted (label, value) tuple -> Meter}: counters that
         # export as ONE prometheus metric family with label dimensions
         # instead of N name-mangled metric names
-        self._labeled: Dict[str, Dict[Tuple[Tuple[str, str], ...], Meter]] = {}
+        self._labeled: Dict[str, Dict[Tuple[Tuple[str, str], ...], Meter]] = {}  # guarded-by-writes: _lock
         self._help: Dict[str, str] = {}
         self._telemetry = None
         self._lock = threading.Lock()

@@ -125,11 +125,15 @@ def main(argv=None) -> int:
 
         paths = [os.path.dirname(os.path.abspath(pinot_tpu.__file__))]
 
+    wp_root = None
     if args.changed is not None:
         if args.paths:
             print("--changed replaces explicit paths; pass one or the "
                   "other", file=sys.stderr)
             return 2
+        # whole-program families (threads, configkeys) still analyze the
+        # full package; only their findings are scoped to the changed set
+        wp_root = paths[0]
         try:
             paths = select_changed(args.changed, paths[0])
         except Exception as e:  # not a repo / bad ref: loud, non-lint exit
@@ -144,7 +148,8 @@ def main(argv=None) -> int:
             return 0
 
     baseline = None if args.no_baseline else args.baseline
-    new, accepted = run_lint(paths, baseline=baseline, families=families)
+    new, accepted = run_lint(paths, baseline=baseline, families=families,
+                             whole_program_root=wp_root)
     if args.as_sarif:
         print(json.dumps(to_sarif(new), indent=2, sort_keys=True))
         return 1 if new else 0

@@ -28,8 +28,10 @@ default. Three rules:
    name-mapped ``DEFAULT_<base>`` constant exists its documented default
    must match the code default — stale docs are findings, auto-checked.
 
-Rules 2-3 key off a scanned module whose relpath ends ``spi/config.py``,
-so ``--changed`` runs (basename relpaths) skip them by construction.
+Rules 2-3 key off a scanned module whose relpath ends ``spi/config.py``.
+The family registers ``whole_program=True``: a ``--changed`` run hands
+it the full package (the key universe and the declaration module are
+global facts) and scopes its findings to the changed set afterwards.
 """
 
 from __future__ import annotations
@@ -207,7 +209,7 @@ def _check_readme(cfg_mod: Module, declared: Dict[str, str],
     return findings
 
 
-@register("configkeys")
+@register("configkeys", whole_program=True)
 def check_configkeys(ctx: LintContext) -> List[Finding]:
     declared, defaults, cfg_mod = _load_declared(ctx)
     findings: List[Finding] = []

@@ -9,6 +9,21 @@ for humans. Suppression, in priority order:
 - baseline: an entry in the checked-in baseline JSON
   (``tools/lint/baseline.json``), each with a mandatory ``reason`` —
   the accepted-violation set, ideally empty.
+
+Shared-work tier (v5): ``load_modules`` keeps a cross-run parse cache
+(one ``ast.parse`` + tokenize per file *content*, reused by every family
+and every ``run_lint`` call), each :class:`LintContext` carries a
+``memo`` dict the expensive cross-family artifacts hang off (the lock
+class table, the import-resolution index), and :mod:`dataflow` memoizes
+CFG construction per function node — so 14 families cost one parse, one
+class scan, one index, and one CFG per function, not 14.
+
+Families registered with ``whole_program=True`` (thread topology,
+config-key conformance) reason over the *entire* package at once: under
+a scoped run (``--changed``) they are handed the full-package context
+and only their findings are filtered down to the selected files — a
+spawn-site edit in file A can surface a role violation in untouched
+file B, and scoping must not hide the edge, only the noise.
 """
 
 from __future__ import annotations
@@ -85,11 +100,19 @@ class Module:
 
 
 class LintContext:
-    """Everything a checker sees: the parsed modules, keyed by relpath."""
+    """Everything a checker sees: the parsed modules, keyed by relpath.
+
+    ``memo`` is the per-run shared-artifact cache: families that build
+    the same expensive structure (the ``# guarded-by:`` class table, the
+    import-resolution index) stash it here so the 14-family suite pays
+    for it once. Keys are namespaced strings (``"lint.classes"``,
+    ``"lint.index"``); values must be treated as immutable by readers.
+    """
 
     def __init__(self, modules: List[Module]):
         self.modules = modules
         self.by_path: Dict[str, Module] = {m.relpath: m for m in modules}
+        self.memo: Dict[str, object] = {}
 
     def module_of(self, relpath: str) -> Optional[Module]:
         return self.by_path.get(relpath)
@@ -99,13 +122,26 @@ class LintContext:
 
 CheckFn = Callable[[LintContext], List[Finding]]
 _CHECKERS: List[Tuple[str, CheckFn]] = []
+_WHOLE_PROGRAM: set = set()
 
 
-def register(name: str):
+def register(name: str, whole_program: bool = False):
+    """Register a checker family. ``whole_program=True`` marks families
+    whose findings depend on files *outside* the scanned set (the thread
+    spawn graph, package-wide config-key rules): scoped runs give them
+    the full package and filter their findings, instead of starving them
+    of the cross-file edges they exist to check."""
     def deco(fn: CheckFn) -> CheckFn:
         _CHECKERS.append((name, fn))
+        if whole_program:
+            _WHOLE_PROGRAM.add(name)
         return fn
     return deco
+
+
+def whole_program_families() -> frozenset:
+    _load_checkers()
+    return frozenset(_WHOLE_PROGRAM)
 
 
 def checker_names() -> List[str]:
@@ -132,6 +168,7 @@ def _load_checkers() -> None:
         pairing,
         protocol,
         sync,
+        threads,
         tracer,
         wire,
     )
@@ -157,6 +194,15 @@ def _collect_files(paths: Sequence[str]) -> List[Tuple[str, str]]:
     return out
 
 
+# (abspath, display path) -> parsed Module, reused across run_lint calls
+# when the file CONTENT is unchanged (compared exactly — mtimes lie on
+# fast rewrites). One ast.parse + tokenize per file per edit, however
+# many families or consecutive runs consume it. Bounded: cleared
+# wholesale past the cap (fixture-heavy test sessions churn tmp files).
+_MODULE_CACHE: Dict[Tuple[str, str], Module] = {}
+_MODULE_CACHE_CAP = 4096
+
+
 def load_modules(paths: Sequence[str]) -> Tuple[LintContext, List[Finding]]:
     modules: List[Module] = []
     findings: List[Finding] = []
@@ -164,7 +210,16 @@ def load_modules(paths: Sequence[str]) -> Tuple[LintContext, List[Finding]]:
         try:
             with open(ap, encoding="utf-8") as f:
                 src = f.read()
-            modules.append(Module(ap, rel, src))
+            key = (os.path.abspath(ap), rel)
+            hit = _MODULE_CACHE.get(key)
+            if hit is not None and hit.source == src:
+                modules.append(hit)
+                continue
+            m = Module(ap, rel, src)
+            if len(_MODULE_CACHE) >= _MODULE_CACHE_CAP:
+                _MODULE_CACHE.clear()
+            _MODULE_CACHE[key] = m
+            modules.append(m)
         except SyntaxError as e:
             findings.append(Finding(
                 "parse", rel, e.lineno or 0, "syntax",
@@ -283,15 +338,20 @@ def load_baseline(path: Optional[str]) -> Dict[str, str]:
 # -- runner -----------------------------------------------------------------
 
 def run_lint(paths: Sequence[str], baseline: Optional[str] = None,
-             families: Optional[Sequence[str]] = None
+             families: Optional[Sequence[str]] = None,
+             whole_program_root: Optional[str] = None
              ) -> Tuple[List[Finding], List[Finding]]:
     """Run every registered checker over ``paths``.
 
     ``families`` restricts the run to the named checker families
-    (parse errors always report). Returns ``(new, accepted)``: findings
-    not covered by the baseline, and findings the baseline (or an inline
-    ignore) covers. Exit policy is the caller's (the CLI exits non-zero
-    iff ``new`` is non-empty).
+    (parse errors always report). ``whole_program_root`` (set by
+    ``--changed``) names the package directory whole-program families
+    analyze in full: they see every package file — the spawn graph and
+    config-key universe don't truncate at the changed set — and their
+    findings are then scoped down to the files in ``paths``. Returns
+    ``(new, accepted)``: findings not covered by the baseline, and
+    findings the baseline (or an inline ignore) covers. Exit policy is
+    the caller's (the CLI exits non-zero iff ``new`` is non-empty).
     """
     _load_checkers()
     if families is not None:
@@ -302,10 +362,23 @@ def run_lint(paths: Sequence[str], baseline: Optional[str] = None,
                 f"unknown lint families {sorted(unknown)}; "
                 f"known: {[n for n, _ in _CHECKERS]}")
     ctx, findings = load_modules(paths)
+    wp_ctx: Optional[LintContext] = None
+    selected_abs: set = set()
+    if whole_program_root is not None and any(
+            n in _WHOLE_PROGRAM for n, _ in _CHECKERS
+            if families is None or n in families):
+        wp_ctx, _ = load_modules([whole_program_root])
+        selected_abs = {os.path.abspath(m.path) for m in ctx.modules}
     for name, fn in _CHECKERS:
         if families is not None and name not in families:
             continue
-        findings.extend(fn(ctx))
+        if wp_ctx is not None and name in _WHOLE_PROGRAM:
+            for f in fn(wp_ctx):
+                m = wp_ctx.module_of(f.path)
+                if m is None or os.path.abspath(m.path) in selected_abs:
+                    findings.append(f)
+        else:
+            findings.extend(fn(ctx))
     findings.sort(key=lambda f: (f.path, f.line, f.checker, f.symbol))
 
     accepted_keys = load_baseline(baseline)
@@ -313,6 +386,8 @@ def run_lint(paths: Sequence[str], baseline: Optional[str] = None,
     accepted: List[Finding] = []
     for f in findings:
         mod = ctx.module_of(f.path)
+        if mod is None and wp_ctx is not None:
+            mod = wp_ctx.module_of(f.path)
         if mod is not None and mod.ignored(f.line, f.checker):
             accepted.append(f)
         elif f.key in accepted_keys:

@@ -74,7 +74,28 @@ class CFG:
         self.succ[a].append((b, label))
 
 
+# id(func node) -> (func node, CFG): the shared-CFG cache (lint core v5).
+# Several families build the CFG of the same function in one suite run —
+# and parsed Modules are themselves cached across runs, so ast node
+# identities persist. The entry pins the func node (strong ref) so its
+# id cannot be recycled while the memo holds it; ForwardAnalysis keeps
+# all per-run state on itself, never on the CFG, so sharing is safe.
+_CFG_MEMO: Dict[int, Tuple[ast.AST, CFG]] = {}
+_CFG_MEMO_CAP = 32768
+
+
 def build_cfg(func: ast.AST) -> CFG:
+    hit = _CFG_MEMO.get(id(func))
+    if hit is not None and hit[0] is func:
+        return hit[1]
+    cfg = _build_cfg(func)
+    if len(_CFG_MEMO) >= _CFG_MEMO_CAP:
+        _CFG_MEMO.clear()
+    _CFG_MEMO[id(func)] = (func, cfg)
+    return cfg
+
+
+def _build_cfg(func: ast.AST) -> CFG:
     cfg = CFG(func)
     loop_stack: List[Dict[str, Any]] = []
     finally_stack: List[int] = []

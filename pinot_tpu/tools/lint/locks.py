@@ -93,6 +93,10 @@ def _is_lock_factory(value: ast.expr) -> bool:
 
 
 def collect_classes(ctx: LintContext) -> Tuple[List[ClassInfo], List[Finding]]:
+    cached = ctx.memo.get("lint.classes")
+    if cached is not None:
+        classes, findings = cached
+        return classes, list(findings)
     classes: List[ClassInfo] = []
     findings: List[Finding] = []
     for mod in ctx.modules:
@@ -132,6 +136,9 @@ def collect_classes(ctx: LintContext) -> Tuple[List[ClassInfo], List[Finding]]:
                     f"{ci.name}.{field}:annotation",
                     f"guarded-by names {lock!r}, which is not a "
                     f"threading lock attribute of {ci.name}"))
+    # memoized per context: four+ families build this same table; the
+    # findings are stored immutably (callers extend the returned list)
+    ctx.memo["lint.classes"] = (classes, tuple(findings))
     return classes, findings
 
 

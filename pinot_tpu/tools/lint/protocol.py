@@ -66,7 +66,7 @@ from pinot_tpu.tools.lint.dataflow import (
     walk_no_nested,
 )
 from pinot_tpu.tools.lint.pairing import _functions
-from pinot_tpu.tools.lint.tracer import _Index
+from pinot_tpu.tools.lint.tracer import shared_index
 
 # ops the spec tree uses structurally (children carry the params)
 _STRUCTURAL = {"and", "or", "not"}
@@ -115,7 +115,7 @@ class _Resolver:
     """Shared call resolution + take/append summaries over the scan set."""
 
     def __init__(self, ctx: LintContext):
-        self.idx = _Index(ctx)
+        self.idx = shared_index(ctx)
         self.take_sums = SummaryTable(self._take_counter_for)
 
     def _ctx_of(self, fn: ast.AST):

@@ -68,7 +68,7 @@ from pinot_tpu.tools.lint.locks import (
     collect_classes,
 )
 from pinot_tpu.tools.lint.pairing import _functions
-from pinot_tpu.tools.lint.tracer import _Index, _enclosing_scope
+from pinot_tpu.tools.lint.tracer import _enclosing_scope, shared_index
 
 # attribute reads that never sync (host-side metadata on device arrays)
 METADATA_ATTRS = {"nbytes", "shape", "dtype", "ndim", "size", "itemsize",
@@ -87,7 +87,7 @@ _NP_SINKS = {"asarray", "array"}
 class _TaintEngine:
     def __init__(self, ctx: LintContext):
         self.ctx = ctx
-        self.idx = _Index(ctx)
+        self.idx = shared_index(ctx)
         classes, _ = collect_classes(ctx)
         self.classes = classes
         self.graph = _CallGraph(ctx, classes)

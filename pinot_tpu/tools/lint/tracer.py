@@ -187,6 +187,17 @@ class _Index:
         return False
 
 
+def shared_index(ctx: LintContext) -> _Index:
+    """The per-run shared import/scope index: building it walks every
+    module's AST, and four families need the same one — memoized on the
+    context (read-only after construction)."""
+    idx = ctx.memo.get("lint.index")
+    if idx is None:
+        idx = _Index(ctx)
+        ctx.memo["lint.index"] = idx
+    return idx
+
+
 def _jit_decorated(fn: ast.AST, mod: Module, idx: _Index) -> bool:
     for dec in getattr(fn, "decorator_list", []):
         d = dec.func if isinstance(dec, ast.Call) else dec
@@ -204,7 +215,7 @@ def _jit_decorated(fn: ast.AST, mod: Module, idx: _Index) -> bool:
 
 @register("tracer")
 def check_tracer(ctx: LintContext) -> List[Finding]:
-    idx = _Index(ctx)
+    idx = shared_index(ctx)
     findings: List[Finding] = []
 
     # -- roots --------------------------------------------------------------

@@ -1575,9 +1575,12 @@ def test_whole_package_wall_clock_budget():
     """The whole-package run must stay CI-viable as the dataflow tier
     grows — v4 added three more families (decisions totality over the
     ledger scope CFGs, the exactness proof guards, config-key
-    conformance with the README table check): a generous multiple of
-    the measured wall clock, but a hard ceiling — a quadratic blow-up
-    in a new family fails here before it fails the CI budget."""
+    conformance with the README table check) and v5 adds the whole-
+    program thread-topology family, paid for by the shared parse/CFG
+    tier (one ast.parse + one CFG per function, reused by all 14
+    families): a generous multiple of the measured wall clock, but a
+    hard ceiling — a quadratic blow-up in a new family fails here
+    before it fails the CI budget."""
     import time
 
     t0 = time.perf_counter()
@@ -1865,7 +1868,7 @@ def test_cli_sarif_output(tmp_path, capsys):
     run = log["runs"][0]
     assert run["tool"]["driver"]["name"] == "graftlint"
     rule_ids = {r["id"] for r in run["tool"]["driver"]["rules"]}
-    assert {"decisions", "exactness", "configkeys"} <= rule_ids
+    assert {"decisions", "exactness", "configkeys", "threads"} <= rule_ids
     res = run["results"][0]
     assert res["ruleId"] == "lock-guard"
     assert res["locations"][0]["physicalLocation"]["region"]["startLine"]
@@ -1916,3 +1919,296 @@ def test_baseline_suppresses_by_stable_key(tmp_path):
                   % new[0].key)
     new2, accepted2 = run_lint([str(p)], baseline=str(bl))
     assert not new2 and len(accepted2) == 1
+
+
+# --------------------------------------------------------------------------
+# v5: thread-topology race analysis (seeded mutations, each exactly one
+# finding; the real modules stay clean under the same rules)
+# --------------------------------------------------------------------------
+
+def test_threads_unguarded_cross_role_write(tmp_path):
+    """A daemon sampler thread writing a field the request path reads,
+    with no lock anywhere: the core race the family exists for."""
+    new = _lint_family(tmp_path, """\
+        import threading
+
+        class Sampler:
+            def __init__(self):
+                self.ticks = 0
+                self._thread = None
+
+            def start(self):
+                self._thread = threading.Thread(
+                    target=self._loop, name="telemetry-sampler-0",
+                    daemon=True)
+                self._thread.start()
+
+            def _loop(self):
+                self.ticks += 1
+
+            def snapshot(self):
+                return self.ticks
+        """, "threads")
+    assert len(new) == 1, [f.render() for f in new]
+    assert "Sampler.ticks" in new[0].key
+    assert "sampler" in new[0].message and "request" in new[0].message
+
+
+def test_threads_role_widened_by_new_submit_site(tmp_path):
+    """A worker confined to the prefetch thread is clean; adding ONE
+    ``pool.submit`` call from the public surface widens its role set and
+    the previously-confined field becomes a finding."""
+    confined = """\
+        import threading
+
+        class Prefetcher:
+            def __init__(self):
+                self.staged = 0
+                self._thread = None
+
+            def start(self):
+                self._thread = threading.Thread(
+                    target=self._drain, name="hbm-prefetch-0", daemon=True)
+                self._thread.start()
+
+            def _drain(self):
+                self.staged += 1
+        """
+    assert _lint_family(tmp_path, confined, "threads") == []
+    new = _lint_family(tmp_path, confined + """\
+
+            def flush(self, pool):
+                pool.submit(self._drain)
+        """, "threads", name="widened.py")
+    assert len(new) == 1, [f.render() for f in new]
+    assert "Prefetcher.staged" in new[0].key
+
+
+def test_threads_post_spawn_write_to_immutable_field(tmp_path):
+    """Publish-before-spawn: a config field written before the thread
+    starts is proven immutable-after-publish; moving the write below
+    ``start()`` breaks the proof and is a finding."""
+    new = _lint_family(tmp_path, """\
+        import threading
+
+        class Beat:
+            def __init__(self):
+                self.interval = 1.0
+                self._thread = None
+
+            def boot(self, interval):
+                self.interval = interval
+                self._thread = threading.Thread(
+                    target=self._tick, name="heartbeat-0", daemon=True)
+                self._thread.start()
+
+            def _tick(self):
+                return self.interval
+        """, "threads")
+    assert new == [], [f.render() for f in new]
+    new = _lint_family(tmp_path, """\
+        import threading
+
+        class Beat:
+            def __init__(self):
+                self.interval = 1.0
+                self._thread = None
+
+            def boot(self, interval):
+                self._thread = threading.Thread(
+                    target=self._tick, name="heartbeat-0", daemon=True)
+                self._thread.start()
+                self.interval = interval
+
+            def _tick(self):
+                return self.interval
+        """, "threads", name="postspawn.py")
+    assert len(new) == 1, [f.render() for f in new]
+    assert "Beat.interval" in new[0].key
+
+
+def test_threads_stale_race_ok_on_guarded_field(tmp_path):
+    """A ``# race-ok:`` on a field that IS lock-guarded is a dead
+    annotation — the waiver must be removed, not accumulated."""
+    new = _lint_family(tmp_path, """\
+        import threading
+
+        class Guarded:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.n = 0  # guarded-by: _lock
+                self._thread = None
+
+            def start(self):
+                self._thread = threading.Thread(
+                    target=self._loop, name="telemetry-sampler-0",
+                    daemon=True)
+                self._thread.start()
+
+            def _loop(self):
+                with self._lock:
+                    self.n = self.n + 1  # race-ok: single_writer
+
+            def snapshot(self):
+                with self._lock:
+                    return self.n
+        """, "threads")
+    assert len(new) == 1, [f.render() for f in new]
+    assert "Guarded.n:race-ok-dead" in new[0].key
+
+
+def test_threads_race_ok_reason_must_be_registered(tmp_path):
+    """A waiver only counts with a reason from
+    ``tracing.RACE_OK_REASONS``; an ad-hoc reason is itself a finding,
+    and a registered one silences the race."""
+    racy = """\
+        import threading
+
+        class Loose:
+            def __init__(self):
+                self.flag = False
+                self._thread = None
+
+            def start(self):
+                self._thread = threading.Thread(
+                    target=self._loop, name="telemetry-sampler-0",
+                    daemon=True)
+                self._thread.start()
+
+            def _loop(self):
+                self.flag = True  # race-ok: %s
+
+            def done(self):
+                return self.flag
+        """
+    new = _lint_family(tmp_path, racy % "because_i_said_so", "threads")
+    assert len(new) == 1, [f.render() for f in new]
+    assert "Loose.flag:race-ok-reason" in new[0].key
+    assert _lint_family(tmp_path, racy % "single_writer", "threads",
+                        name="waived.py") == []
+
+
+def test_threads_spawn_graph_rules(tmp_path):
+    """Spawn sites carry obligations of their own: every thread needs a
+    role-mapped name, and every target must resolve statically."""
+    new = _lint_family(tmp_path, """\
+        import threading
+
+        def _work():
+            pass
+
+        def unnamed():
+            threading.Thread(target=_work).start()
+
+        def opaque(fn):
+            threading.Thread(target=fn, name="heartbeat-0").start()
+        """, "threads")
+    keys = {f.key for f in new}
+    assert any(k.endswith("spawn:unnamed:role") for k in keys), keys
+    assert any(k.endswith("spawn:opaque:target") for k in keys), keys
+
+
+def test_threads_real_modules_stay_clean():
+    """The whole package under the threads family alone: every true
+    positive found at landing was fixed or waived with a registered
+    reason — none baselined."""
+    new, _ = run_lint([PKG], families=["threads"])
+    assert new == [], [f.render() for f in new]
+
+
+def test_threads_changed_scope_sees_package_spawn_graph(tmp_path):
+    """--changed correctness for whole-program families: the spawn graph
+    is computed package-wide, findings are scoped afterwards. A spawn-
+    site edit in file A surfaces the role violation in UNTOUCHED file B;
+    scoping to A alone filters B's finding out; and a subset run without
+    the whole-program root is blind to the package's spawn graph."""
+    pkg = tmp_path / "mypkg"
+    pkg.mkdir()
+    (pkg / "__init__.py").write_text("")
+    (pkg / "b.py").write_text(textwrap.dedent("""\
+        class Store:
+            def __init__(self):
+                self.n = 0
+
+            def bump(self):
+                self.n += 1
+
+            def read(self):
+                return self.n
+        """))
+    a_seed = textwrap.dedent("""\
+        import threading
+
+        from mypkg.b import Store
+
+        STORE = Store()
+
+        def _loop():
+            STORE.bump()
+        """)
+    (pkg / "a.py").write_text(a_seed)
+    _git(tmp_path, "init", "-q")
+    _git(tmp_path, "add", ".")
+    _git(tmp_path, "commit", "-qm", "seed")
+
+    # the edit: a.py gains a sampler-thread spawn site for _loop
+    (pkg / "a.py").write_text(a_seed + textwrap.dedent("""\
+
+        def start():
+            threading.Thread(target=_loop, name="telemetry-sampler-0",
+                             daemon=True).start()
+        """))
+    from pinot_tpu.tools.lint.core import select_changed
+
+    sel = select_changed("HEAD", str(pkg))
+    assert {os.path.basename(p) for p in sel} >= {"a.py", "b.py"}
+    new, _ = run_lint(sel, families=["threads"],
+                      whole_program_root=str(pkg))
+    assert len(new) == 1, [f.render() for f in new]
+    assert "Store.n" in new[0].key and new[0].path.endswith("b.py")
+
+    # scope to a.py only: the b.py finding is out of scope
+    new, _ = run_lint([str(pkg / "a.py")], families=["threads"],
+                      whole_program_root=str(pkg))
+    assert new == [], [f.render() for f in new]
+
+    # no whole-program root: the subset never sees a.py's spawn site
+    new, _ = run_lint([str(pkg / "b.py")], families=["threads"])
+    assert new == [], [f.render() for f in new]
+
+    # b.py alone IN scope still inherits the package spawn graph
+    new, _ = run_lint([str(pkg / "b.py")], families=["threads"],
+                      whole_program_root=str(pkg))
+    assert len(new) == 1 and new[0].path.endswith("b.py")
+
+
+# --------------------------------------------------------------------------
+# v5: the shared parse/CFG tier every family reuses
+# --------------------------------------------------------------------------
+
+def test_module_cache_reuses_parses(tmp_path):
+    """load_modules serves the SAME Module object for unchanged source
+    (13+ families re-enter it per run) and invalidates on content — not
+    mtime, which lies on fast rewrites."""
+    from pinot_tpu.tools.lint.core import load_modules
+
+    p = tmp_path / "m.py"
+    p.write_text("X = 1\n")
+    ctx1, _ = load_modules([str(p)])
+    ctx2, _ = load_modules([str(p)])
+    assert ctx1.modules[0] is ctx2.modules[0]
+    p.write_text("X = 2\n")
+    ctx3, _ = load_modules([str(p)])
+    assert ctx3.modules[0] is not ctx2.modules[0]
+
+
+def test_cfg_memo_returns_identical_graphs():
+    """build_cfg memoizes per function node: the dataflow families share
+    one CFG instead of rebuilding it per family."""
+    import ast as _ast
+
+    from pinot_tpu.tools.lint.dataflow import build_cfg
+
+    fn = _ast.parse(
+        "def f(x):\n    if x:\n        return 1\n    return 0\n").body[0]
+    assert build_cfg(fn) is build_cfg(fn)

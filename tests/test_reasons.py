@@ -113,3 +113,16 @@ def test_preflight_namespace_is_exact_and_prefixed():
     assert ns.exact
     assert all(re.fullmatch(r"pallas_preflight_[a-z0-9_]+", c)
                for c in ns.codes)
+
+
+def test_registry_covers_the_race_waiver_set():
+    """PR 20: the threads lint family's ``# race-ok:`` waiver vocabulary
+    is a first-class namespace. It scans the whole package (waivers live
+    on field declarations wherever shared state lives) and is exact — a
+    registered code no annotation uses is itself a conformance failure,
+    so the vocabulary cannot rot in either direction."""
+    ns = tracing.reason_registry("race_ok")
+    assert ns.module == "pinot_tpu" and ns.exact
+    assert tracing.RACE_OK_REASONS <= tracing.registered_reason_codes()
+    found, unregistered = ns.conformance()
+    assert found == tracing.RACE_OK_REASONS and not unregistered
